@@ -1,0 +1,26 @@
+"""erasurehead-tpu: straggler-tolerant distributed GD via gradient coding, TPU-native.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+Distributed-Deep-Learning/ErasureHead (arXiv 1901.09671): a master/worker MPI
+research framework for coded gradient descent under stragglers. Here the MPI
+point-to-point protocol becomes jit-compiled SPMD over a `jax.sharding.Mesh`
+("workers" axis), the first-k Waitany collection becomes fixed-shape masked
+collectives driven by a seeded straggler-arrival simulator, and the host-side
+lstsq decode becomes an on-device masked solve + einsum.
+
+Layout:
+  ops/       coding-theory core (layouts, generator matrices, decode weights)
+             and TPU-friendly sparse feature ops
+  models/    per-partition gradient kernels: logistic / linear GLMs, MLP;
+             losses and metrics
+  parallel/  mesh + collective step, straggler arrival simulation, collection
+             rules (the scheme layer), distributed backend init
+  data/      synthetic GMM + real-dataset preprocessing, partitioning, disk IO
+  train/     GD/AGD optimizer, scan-based trainer, post-hoc evaluation replay,
+             result artifacts, checkpointing
+  utils/     config, logging, timing
+"""
+
+__version__ = "0.1.0"
+
+from erasurehead_tpu.utils.config import RunConfig, Scheme, UpdateRule  # noqa: F401

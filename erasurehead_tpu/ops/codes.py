@@ -1,0 +1,431 @@
+"""Coding-theory core: data-assignment layouts, generator matrices, decode weights.
+
+This is the pure-math layer of the framework: it knows nothing about devices,
+meshes, or data. A *layout* describes which data partitions each logical worker
+holds and with which linear-coding coefficient it folds each partition's
+gradient into the single message it "sends"; *decode weights* recover (exactly
+or approximately) the full-batch gradient from an arbitrary subset of worker
+messages, as a fixed-shape, jit-compatible masked computation.
+
+Reference behavior being matched (citations are file:line in /root/reference):
+  - cyclic MDS supports (worker w holds partitions w..w+s mod W):
+    src/coded.py:33-48, src/util.py:68-73
+  - generator matrix B for exact gradient coding: src/util.py:64-83
+  - fractional-repetition (FRC) assignment (groups of s+1 workers sharing
+    rotated copies of the same s+1 partitions): src/replication.py:46-49,
+    src/approximate_coding.py:47-50
+  - partial two-slice layouts (unique uncoded partitions + a coded band):
+    src/partial_coded.py:20-43,125-126 and src/partial_replication.py:24-50
+  - online lstsq decode over the completed subset: src/coded.py:147-149,
+    src/partial_coded.py:192-194
+  - precomputed all-patterns decode table (defined, unused at runtime in the
+    reference): src/util.py:85-103
+
+Design notes (TPU-first):
+  - Layout construction is host-side numpy: it happens once at setup, produces
+    static integer index tables, and its outputs become *static shapes* for the
+    jitted step.
+  - Decoding is jnp and fixed-shape: the reference's dynamic-shape
+    ``lstsq(B[completed, :].T, 1)`` becomes a masked full-shape lstsq whose
+    minimum-norm solution provably has support only on the completed rows
+    (the masked-out rows of ``mask[:, None] * B`` are zero, and the min-norm
+    lstsq solution lies in the row space of the system matrix).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "CodingLayout",
+    "uncoded_layout",
+    "cyclic_mds_layout",
+    "frc_layout",
+    "partial_cyclic_layout",
+    "partial_frc_layout",
+    "cyclic_generator_matrix",
+    "mds_decode_weights",
+    "mds_decode_weights_host",
+    "enumerate_decode_table",
+    "straggler_pattern_index",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class CodingLayout:
+    """Static description of a coded data assignment.
+
+    Each of the ``n_workers`` logical workers holds ``n_slots`` partition
+    slots. Slot ``s`` of worker ``w`` holds global partition
+    ``assignment[w, s]`` and contributes ``coeffs[w, s] * grad(partition)`` to
+    the worker's transmitted message. Partial ("two-part") schemes mark some
+    slots as *separate* (uncoded, always required by the master) via
+    ``slot_is_coded[s] == False``.
+    """
+
+    name: str
+    n_workers: int
+    n_partitions: int  # number of distinct global partitions
+    assignment: np.ndarray  # [W, S] int32, values in [0, n_partitions)
+    coeffs: np.ndarray  # [W, S] float64 linear-coding coefficients
+    slot_is_coded: np.ndarray  # [S] bool; False = "separate"/uncoded slot
+    n_stragglers: int = 0
+    groups: Optional[np.ndarray] = None  # [W] int32 FRC group ids, else None
+    B: Optional[np.ndarray] = None  # [W, W] generator matrix (MDS family)
+
+    def __post_init__(self):
+        W, S = self.assignment.shape
+        assert self.n_workers == W
+        assert self.coeffs.shape == (W, S)
+        assert self.slot_is_coded.shape == (S,)
+        assert self.assignment.min() >= 0 and self.assignment.max() < self.n_partitions
+
+    @property
+    def n_slots(self) -> int:
+        return self.assignment.shape[1]
+
+    @property
+    def n_groups(self) -> int:
+        if self.groups is None:
+            return self.n_workers
+        return int(self.groups.max()) + 1
+
+    @property
+    def storage_overhead(self) -> float:
+        """Copies of the dataset stored across workers (1.0 = uncoded)."""
+        return self.assignment.size / self.n_partitions
+
+    def effective_matrix(self) -> np.ndarray:
+        """[W, n_partitions] matrix E with ``message = E @ partition_grads``.
+
+        Row w scatters ``coeffs[w, :]`` into the partition columns this worker
+        holds (coded slots only; separate slots form their own always-on
+        message in partial schemes).
+        """
+        E = np.zeros((self.n_workers, self.n_partitions))
+        for w in range(self.n_workers):
+            for s in range(self.n_slots):
+                if self.slot_is_coded[s]:
+                    E[w, self.assignment[w, s]] += self.coeffs[w, s]
+        return E
+
+    def partition_weights(self, slot_weights: jnp.ndarray) -> jnp.ndarray:
+        """Fold per-(worker, slot) decode weights onto per-partition weights.
+
+        Given ``slot_weights`` [W, S] (the multiplier applied to each slot's
+        partial gradient by the master's decode), returns ``p_w`` [n_partitions]
+        such that the decoded gradient equals ``sum_p p_w[p] * grad_p``. This is
+        what makes the *deduplicated* compute mode possible: instead of every
+        worker redundantly computing its (s+1) partition gradients, each
+        partition gradient is computed once and combined with these weights —
+        numerically identical to decode-of-messages, with 1/(s+1) the FLOPs.
+        """
+        flat_idx = jnp.asarray(self.assignment.reshape(-1))
+        flat_wgt = (slot_weights * jnp.asarray(self.coeffs)).reshape(-1)
+        return jnp.zeros(self.n_partitions, flat_wgt.dtype).at[flat_idx].add(flat_wgt)
+
+
+# ---------------------------------------------------------------------------
+# Generator matrix (exact gradient coding, cyclic supports)
+# ---------------------------------------------------------------------------
+
+
+def cyclic_generator_matrix(
+    n_workers: int, n_stragglers: int, seed: int = 0
+) -> np.ndarray:
+    """Random cyclic-support generator matrix B for exact gradient coding.
+
+    Math (Tandon et al.; reference impl at src/util.py:64-83): pick
+    H in R^{s x W} whose rows each sum to zero; row i of B is supported on
+    S_i = {i, ..., i+s mod W} with B[i, i] = 1 and the remaining s entries
+    solving H[:, S_i] @ B[i, S_i] = 0, i.e. every row of B lies in null(H).
+    Since H @ 1 = 0, the all-ones vector is in the (W-s)-dimensional null
+    space too, and for generic H any W-s rows of B span it — so the master
+    can reconstruct the exact full gradient from any W-s worker messages.
+
+    Deviation from the reference: the reference draws H unseeded from the
+    global numpy RNG (src/util.py:65), making runs non-reproducible; we take
+    an explicit seed (default 0).
+    """
+    if not 0 <= n_stragglers < n_workers:
+        raise ValueError("need 0 <= n_stragglers < n_workers")
+    if n_stragglers == 0:
+        return np.eye(n_workers)
+    rng = np.random.default_rng(seed)
+    s, W = n_stragglers, n_workers
+    H = rng.standard_normal((s, W))
+    H[:, -1] = -H[:, :-1].sum(axis=1)  # rows sum to zero => H @ 1 = 0
+    B = np.zeros((W, W))
+    for i in range(W):
+        support = (i + np.arange(s + 1)) % W
+        B[i, support[0]] = 1.0
+        B[i, support[1:]] = -np.linalg.solve(H[:, support[1:]], H[:, support[0]])
+    # Row scaling is a free choice (decode weights compensate); unit rows keep
+    # the masked fp32 decode well-behaved. The reference's B[i,i]=1 convention
+    # (src/util.py:76) is not load-bearing.
+    return B / np.linalg.norm(B, axis=1, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# Layouts
+# ---------------------------------------------------------------------------
+
+
+def uncoded_layout(n_workers: int) -> CodingLayout:
+    """One unique partition per worker, coefficient 1 (naive & avoidstragg).
+
+    Reference: row-sharded uncoded data, src/naive.py:26-36,
+    src/avoidstragg.py:24-32.
+    """
+    return CodingLayout(
+        name="uncoded",
+        n_workers=n_workers,
+        n_partitions=n_workers,
+        assignment=np.arange(n_workers, dtype=np.int32)[:, None],
+        coeffs=np.ones((n_workers, 1)),
+        slot_is_coded=np.array([True]),
+        n_stragglers=0,
+    )
+
+
+def cyclic_mds_layout(
+    n_workers: int, n_stragglers: int, seed: int = 0
+) -> CodingLayout:
+    """Cyclic MDS exact gradient coding ("cyccoded" / EGC-MDS).
+
+    Worker w holds the s+1 cyclically-consecutive partitions w..w+s (mod W)
+    (src/coded.py:33-48) and pre-scales each by its generator-matrix entry
+    B[w, p] (src/coded.py:92-95), so its message is row w of B applied to the
+    partition-gradient stack.
+    """
+    W, s = n_workers, n_stragglers
+    B = cyclic_generator_matrix(W, s, seed)
+    assignment = (np.arange(W)[:, None] + np.arange(s + 1)[None, :]) % W
+    coeffs = np.take_along_axis(B, assignment, axis=1)
+    return CodingLayout(
+        name="cyclic_mds",
+        n_workers=W,
+        n_partitions=W,
+        assignment=assignment.astype(np.int32),
+        coeffs=coeffs,
+        slot_is_coded=np.ones(s + 1, dtype=bool),
+        n_stragglers=s,
+        B=B,
+    )
+
+
+def _frc_groups(n_workers: int, n_stragglers: int) -> np.ndarray:
+    if n_workers % (n_stragglers + 1):
+        raise ValueError(
+            "n_workers must be a multiple of n_stragglers+1 for FRC layouts "
+            "(reference guard: src/replication.py:24-26)"
+        )
+    return (np.arange(n_workers) // (n_stragglers + 1)).astype(np.int32)
+
+
+def frc_layout(n_workers: int, n_stragglers: int) -> CodingLayout:
+    """Fractional repetition code ("repcoded" / EGC-FRC; also AGC's layout).
+
+    Workers form W/(s+1) groups of s+1; all members of group a hold the same
+    s+1 partitions {(s+1)a, ..., (s+1)a+s}, each member starting the rotation
+    at its own position: member b's slot i holds partition
+    (s+1)a + (b+i) mod (s+1) (src/replication.py:46-49,
+    src/approximate_coding.py:47-50). All coefficients are 1, so any single
+    member's message equals the group's summed partition gradient.
+    """
+    W, s = n_workers, n_stragglers
+    groups = _frc_groups(W, s)
+    w = np.arange(W)[:, None]
+    a, b = w // (s + 1), w % (s + 1)
+    i = np.arange(s + 1)[None, :]
+    assignment = (s + 1) * a + (b + i) % (s + 1)
+    return CodingLayout(
+        name="frc",
+        n_workers=W,
+        n_partitions=W,
+        assignment=assignment.astype(np.int32),
+        coeffs=np.ones((W, s + 1)),
+        slot_is_coded=np.ones(s + 1, dtype=bool),
+        n_stragglers=s,
+        groups=groups,
+    )
+
+
+def partial_cyclic_layout(
+    n_workers: int,
+    n_partitions_per_worker: int,
+    n_stragglers: int,
+    seed: int = 0,
+) -> CodingLayout:
+    """Partial coded ("partialcyccoded"): unique uncoded slots + cyclic coded band.
+
+    Worker w holds n_sep = p-s-1 unique partitions (global ids
+    n_sep*w + i, src/partial_coded.py:33-36) plus s+1 partitions of a shared
+    W-partition coded band (global ids n_sep*W + (w + j) mod W for j in 0..s,
+    src/partial_coded.py:38-43), the coded slots scaled by
+    B[w, (w + j) mod W] (src/partial_coded.py:125-126). The master requires
+    *all* uncoded parts and decodes the coded band from any W-s coded parts.
+    """
+    W, p, s = n_workers, n_partitions_per_worker, n_stragglers
+    n_sep = p - s - 1
+    if n_sep < 1:
+        raise ValueError("need n_partitions_per_worker >= n_stragglers + 2")
+    B = cyclic_generator_matrix(W, s, seed)
+    w = np.arange(W)[:, None]
+    sep = n_sep * w + np.arange(n_sep)[None, :]
+    band = (w + np.arange(s + 1)[None, :]) % W
+    assignment = np.concatenate([sep, n_sep * W + band], axis=1)
+    coeffs = np.concatenate(
+        [np.ones((W, n_sep)), np.take_along_axis(B, band, axis=1)], axis=1
+    )
+    return CodingLayout(
+        name="partial_cyclic",
+        n_workers=W,
+        n_partitions=n_sep * W + W,
+        assignment=assignment.astype(np.int32),
+        coeffs=coeffs,
+        slot_is_coded=np.arange(p) >= n_sep,
+        n_stragglers=s,
+        B=B,
+    )
+
+
+def partial_frc_layout(
+    n_workers: int, n_partitions_per_worker: int, n_stragglers: int
+) -> CodingLayout:
+    """Partial replication ("partialrepcoded"): unique slots + FRC coded band.
+
+    Same unique slice as partial_cyclic; the coded band is group-replicated:
+    every member of group a holds the same s+1 band partitions
+    n_sep*W + a*(s+1) + b, b in 0..s, unscaled
+    (src/partial_replication.py:44-50). The master requires all uncoded parts
+    plus one coded part per group.
+    """
+    W, p, s = n_workers, n_partitions_per_worker, n_stragglers
+    n_sep = p - s - 1
+    if n_sep < 1:
+        raise ValueError("need n_partitions_per_worker >= n_stragglers + 2")
+    groups = _frc_groups(W, s)
+    w = np.arange(W)[:, None]
+    sep = n_sep * w + np.arange(n_sep)[None, :]
+    band = groups[:, None] * (s + 1) + np.arange(s + 1)[None, :]
+    assignment = np.concatenate([sep, n_sep * W + band], axis=1)
+    return CodingLayout(
+        name="partial_frc",
+        n_workers=W,
+        n_partitions=n_sep * W + W,
+        assignment=assignment.astype(np.int32),
+        coeffs=np.ones((W, p)),
+        slot_is_coded=np.arange(p) >= n_sep,
+        n_stragglers=s,
+        groups=groups,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Decoding
+# ---------------------------------------------------------------------------
+
+
+def mds_decode_weights(B: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Decode weights a with support on ``mask`` s.t. a @ B ~= all-ones.
+
+    Fixed-shape jit/TPU-safe replacement for the reference's per-iteration
+    dynamic solve ``np.linalg.lstsq(B[completed, :].T, ones(W))``
+    (src/coded.py:147-149): we zero the masked-out *rows* of B and take the
+    minimum-norm least-squares solution of (mask*B)^T a = 1. That solution
+    lies in range(mask*B), whose vectors vanish on masked-out coordinates, so
+    a is automatically supported on the completed workers and coincides with
+    the reference's solution there. When >= W-s workers are unmasked the MDS
+    property makes the reconstruction exact.
+    """
+    Bm = jnp.where(mask[:, None], B, 0.0)
+    ones = jnp.ones(B.shape[0], B.dtype)
+    pinv = jnp.linalg.pinv(Bm.T)
+    a = pinv @ ones
+    # Two rounds of iterative refinement: random cyclic codes can have
+    # ill-conditioned straggler patterns, and in fp32 the one-shot solve can
+    # lose 1e-2 of the all-ones target; refinement recovers it.
+    for _ in range(2):
+        a = a + pinv @ (ones - Bm.T @ a)
+    # The min-norm solution is supported on ``mask`` in exact arithmetic;
+    # hard-zero the rest so fp32 noise can never touch an uncollected
+    # worker's message.
+    return jnp.where(mask, a, 0.0)
+
+
+def mds_decode_weights_host(B: np.ndarray, masks: np.ndarray) -> np.ndarray:
+    """Float64 host-side decode weights for a batch of completion masks.
+
+    The data plane (gradient einsums) runs on TPU, but decode-weight *control*
+    data is tiny ([rounds, W]) and, under the seeded straggler simulator, the
+    completion masks for every round are known before the training scan starts
+    — exactly as the reference's seeded delay schedule predetermines arrivals
+    (src/naive.py:141-148). Solving here in float64 numpy sidesteps a real
+    fp32 hazard: random cyclic codes at the reference's canonical W=30 scale
+    have straggler patterns whose decode systems are so ill-conditioned that
+    an on-device fp32 solve fails outright (measured error ~1.0); the
+    reference never hit this only because its per-iteration
+    ``np.linalg.lstsq`` (src/coded.py:147-149) ran in float64 on the master.
+    Use :func:`mds_decode_weights` only for small-W online/dynamic decoding.
+
+    Args:
+      B: [W, W] generator matrix.
+      masks: [rounds, W] boolean completion masks.
+
+    Returns:
+      [rounds, W] float64 decode weights, zero outside each mask.
+    """
+    masks = np.asarray(masks, dtype=bool)
+    W = B.shape[0]
+    ones = np.ones(W)
+    out = np.zeros(masks.shape)
+    for r in range(masks.shape[0]):
+        live = np.flatnonzero(masks[r])
+        out[r, live] = np.linalg.lstsq(B[live, :].T, ones, rcond=None)[0]
+    return out
+
+
+def enumerate_decode_table(B: np.ndarray, n_stragglers: int) -> np.ndarray:
+    """Precompute decode weights for every C(W, s) straggler pattern.
+
+    Parity with the reference's (runtime-unused) ``getA`` (src/util.py:85-103):
+    row k holds the decode weights for the k-th s-subset of stragglers in
+    ``itertools.combinations`` order. Useful on TPU to replace the in-loop
+    lstsq with a table gather when C(W, s) is small.
+    """
+    W = B.shape[0]
+    patterns = list(itertools.combinations(range(W), n_stragglers))
+    A = np.zeros((len(patterns), W))
+    ones = np.ones(W)
+    for k, stragglers in enumerate(patterns):
+        live = np.setdiff1d(np.arange(W), stragglers)
+        A[k, live] = np.linalg.lstsq(B[live, :].T, ones, rcond=None)[0]
+    return A
+
+
+def straggler_pattern_index(straggler_mask: np.ndarray) -> int:
+    """Row index into :func:`enumerate_decode_table` for a straggler set.
+
+    Combinatorial rank of the sorted straggler positions in
+    ``itertools.combinations(range(W), s)`` order (the reference's equivalent
+    lookup helpers are src/util.py:105-134).
+    """
+    W = len(straggler_mask)
+    positions = np.flatnonzero(straggler_mask)
+    s = len(positions)
+    index = 0
+    prev = -1
+    remaining = s
+    for pos in positions:
+        for skipped in range(prev + 1, pos):
+            index += math.comb(W - skipped - 1, remaining - 1)
+        prev = pos
+        remaining -= 1
+    return index

@@ -1,0 +1,28 @@
+"""Test harness: simulate an 8-device TPU pod on CPU.
+
+Must run before any jax import (SURVEY.md §4): tests exercise the full
+multi-chip sharding path via XLA's forced host-platform device count, the
+same mechanism the driver uses for the multi-chip dry run.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 forced CPU devices, got {len(devs)}"
+    return devs

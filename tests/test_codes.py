@@ -1,0 +1,232 @@
+"""Unit tests for the coding-theory core (erasurehead_tpu.ops.codes).
+
+The central property (SURVEY.md §4): for every (W, s) and every straggler
+pattern of size <= s, the decode weights recovered from the surviving workers
+reconstruct the exact full-batch gradient (sum of all partition gradients).
+"""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from erasurehead_tpu.ops import codes
+
+
+def _all_live_masks(W, s):
+    """Every completion mask with exactly W-s live workers."""
+    for stragglers in itertools.combinations(range(W), s):
+        mask = np.ones(W, dtype=bool)
+        mask[list(stragglers)] = False
+        yield mask
+
+
+# ---------------------------------------------------------------------------
+# Generator matrix & MDS decode
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("W,s", [(4, 1), (6, 2), (6, 3), (9, 2), (10, 3)])
+def test_mds_exact_recovery_all_patterns(W, s):
+    B = codes.cyclic_generator_matrix(W, s, seed=1)
+    ones = np.ones(W)
+    for mask in _all_live_masks(W, s):
+        a = np.asarray(codes.mds_decode_weights(jnp.asarray(B), jnp.asarray(mask)))
+        # support only on live workers
+        assert np.allclose(a[~mask], 0.0)
+        # a @ B == all-ones => decoded gradient == sum of partition gradients
+        assert np.allclose(a @ B, ones, atol=2e-3), (mask, a @ B)
+
+
+@pytest.mark.parametrize("W,s", [(10, 3), (30, 3)])
+def test_mds_host_decode_exact_at_scale(W, s):
+    """The float64 host path must stay exact at the canonical W=30 scale,
+    where the fp32 on-device solve demonstrably cannot (see
+    mds_decode_weights_host docstring)."""
+    B = codes.cyclic_generator_matrix(W, s, seed=1)
+    rng = np.random.default_rng(0)
+    masks = np.ones((50, W), dtype=bool)
+    for r in range(50):
+        masks[r, rng.choice(W, size=s, replace=False)] = False
+    A = codes.mds_decode_weights_host(B, masks)
+    assert np.allclose(A[~masks], 0.0)
+    err = np.abs(A @ B - 1.0).max()
+    assert err < 1e-6, err
+
+
+def test_mds_recovery_of_actual_gradients():
+    W, s, F = 8, 2, 5
+    rng = np.random.default_rng(0)
+    G = rng.standard_normal((W, F))  # per-partition gradients
+    layout = codes.cyclic_mds_layout(W, s, seed=3)
+    E = layout.effective_matrix()
+    msgs = E @ G  # what each worker transmits
+    full = G.sum(axis=0)
+    masks = np.stack(list(itertools.islice(_all_live_masks(W, s), 10)))
+    A = codes.mds_decode_weights_host(layout.B, masks)
+    assert np.allclose(A @ msgs, np.broadcast_to(full, (10, F)), atol=1e-6)
+
+
+def test_generator_matrix_cyclic_support():
+    W, s = 7, 2
+    B = codes.cyclic_generator_matrix(W, s, seed=0)
+    for i in range(W):
+        support = set((i + np.arange(s + 1)) % W)
+        off = [j for j in range(W) if j not in support]
+        assert np.allclose(B[i, off], 0.0)
+        assert abs(B[i, i]) > 0  # diagonal always in the support
+        assert np.isclose(np.linalg.norm(B[i]), 1.0)  # unit rows (conditioning)
+
+
+def test_generator_matrix_no_stragglers_is_identity():
+    assert np.array_equal(codes.cyclic_generator_matrix(5, 0), np.eye(5))
+
+
+def test_decode_table_matches_online_solve():
+    W, s = 6, 2
+    B = codes.cyclic_generator_matrix(W, s, seed=2)
+    table = codes.enumerate_decode_table(B, s)
+    assert table.shape == (15, W)
+    for k, stragglers in enumerate(itertools.combinations(range(W), s)):
+        mask = np.ones(W, dtype=bool)
+        mask[list(stragglers)] = False
+        a = np.asarray(codes.mds_decode_weights(jnp.asarray(B), jnp.asarray(mask)))
+        assert np.allclose(table[k], a, atol=1e-3)
+        idx = codes.straggler_pattern_index(~mask)
+        assert idx == k
+
+
+# ---------------------------------------------------------------------------
+# Layouts
+# ---------------------------------------------------------------------------
+
+
+def test_uncoded_layout():
+    lay = codes.uncoded_layout(6)
+    assert lay.n_partitions == 6
+    assert np.array_equal(lay.assignment[:, 0], np.arange(6))
+    assert lay.storage_overhead == 1.0
+    E = lay.effective_matrix()
+    assert np.array_equal(E, np.eye(6))
+
+
+def test_frc_layout_groups_and_rotation():
+    W, s = 6, 2
+    lay = codes.frc_layout(W, s)
+    assert lay.n_groups == 2
+    # every member of a group holds exactly the group's s+1 partitions
+    for w in range(W):
+        a = w // (s + 1)
+        assert set(lay.assignment[w]) == set(range((s + 1) * a, (s + 1) * (a + 1)))
+    # rotation: member b starts at partition (s+1)a + b (reference
+    # src/approximate_coding.py:47-50)
+    assert lay.assignment[1, 0] == 1
+    # any single member's message is the full group gradient
+    E = lay.effective_matrix()
+    for w in range(W):
+        g_mask = np.zeros(W)
+        a = w // (s + 1)
+        g_mask[(s + 1) * a : (s + 1) * (a + 1)] = 1.0
+        assert np.array_equal(E[w], g_mask)
+    assert lay.storage_overhead == s + 1
+
+
+def test_frc_layout_divisibility_guard():
+    with pytest.raises(ValueError):
+        codes.frc_layout(7, 2)
+
+
+def test_frc_one_per_group_decodes_exactly():
+    W, s, F = 6, 2, 4
+    rng = np.random.default_rng(1)
+    G = rng.standard_normal((W, F))
+    lay = codes.frc_layout(W, s)
+    E = lay.effective_matrix()
+    msgs = E @ G
+    # pick an arbitrary representative per group: sum of their messages is exact
+    for reps in itertools.product(range(s + 1), repeat=W // (s + 1)):
+        chosen = [g * (s + 1) + r for g, r in enumerate(reps)]
+        assert np.allclose(msgs[chosen].sum(axis=0), G.sum(axis=0))
+
+
+def test_partial_cyclic_layout():
+    W, p, s = 4, 4, 1  # n_sep = 2
+    lay = codes.partial_cyclic_layout(W, p, s, seed=0)
+    n_sep = p - s - 1
+    assert lay.n_partitions == n_sep * W + W
+    # separate slots are globally unique and cover partitions 0..n_sep*W-1
+    sep = lay.assignment[:, : n_sep].reshape(-1)
+    assert sorted(sep.tolist()) == list(range(n_sep * W))
+    assert not lay.slot_is_coded[:n_sep].any()
+    assert lay.slot_is_coded[n_sep:].all()
+    # coded band: worker w holds band partitions (w..w+s) mod W
+    band = lay.assignment[:, n_sep:] - n_sep * W
+    for w in range(W):
+        assert set(band[w]) == set((w + np.arange(s + 1)) % W)
+    # coded slots carry the generator-matrix coefficients
+    for w in range(W):
+        for j in range(s + 1):
+            assert lay.coeffs[w, n_sep + j] == lay.B[w, (w + j) % W]
+    # decode: all separate + MDS-decoded band == full gradient
+    rng = np.random.default_rng(2)
+    G = rng.standard_normal((lay.n_partitions, 3))
+    E = lay.effective_matrix()  # coded slots only
+    band_msgs = E @ G
+    mask = np.ones(W, dtype=bool)
+    mask[2] = False
+    a = np.asarray(codes.mds_decode_weights(jnp.asarray(lay.B), jnp.asarray(mask)))
+    decoded = G[: n_sep * W].sum(axis=0) + a @ band_msgs
+    assert np.allclose(decoded, G.sum(axis=0), atol=1e-4)
+
+
+def test_partial_frc_layout():
+    W, p, s = 6, 4, 1  # n_sep = 2, 3 groups
+    lay = codes.partial_frc_layout(W, p, s)
+    n_sep = p - s - 1
+    assert lay.n_partitions == n_sep * W + W
+    # band: all members of group a hold the same partitions, in the same order
+    # (reference src/partial_replication.py:44-50)
+    band = lay.assignment[:, n_sep:]
+    for a in range(W // (s + 1)):
+        members = [w for w in range(W) if lay.groups[w] == a]
+        for m in members[1:]:
+            assert np.array_equal(band[m], band[members[0]])
+    # one coded message per group + all separate slots == full gradient
+    rng = np.random.default_rng(3)
+    G = rng.standard_normal((lay.n_partitions, 2))
+    E = lay.effective_matrix()
+    msgs = E @ G
+    reps = [0, 3, 5]  # one member of each group
+    decoded = G[: n_sep * W].sum(axis=0) + msgs[reps].sum(axis=0)
+    assert np.allclose(decoded, G.sum(axis=0))
+
+
+# ---------------------------------------------------------------------------
+# partition_weights (deduped-mode correctness)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "make",
+    [
+        lambda: codes.uncoded_layout(6),
+        lambda: codes.cyclic_mds_layout(6, 2, seed=0),
+        lambda: codes.frc_layout(6, 2),
+    ],
+)
+def test_partition_weights_equal_message_decode(make):
+    lay = make()
+    W, S = lay.assignment.shape
+    rng = np.random.default_rng(4)
+    G = rng.standard_normal((lay.n_partitions, 3))
+    slot_w = rng.standard_normal((W, S))  # arbitrary decode weights
+    # message-space decode
+    per_slot = lay.coeffs * slot_w
+    decoded = np.zeros(3)
+    for w in range(W):
+        for s_ in range(S):
+            decoded += per_slot[w, s_] * G[lay.assignment[w, s_]]
+    # partition-space decode
+    pw = np.asarray(lay.partition_weights(jnp.asarray(slot_w)))
+    assert np.allclose(pw @ G, decoded, atol=1e-4)
