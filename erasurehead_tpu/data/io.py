@@ -1,0 +1,108 @@
+"""On-disk dataset formats: reference-compatible text/.npz plus fast .npy.
+
+The reference's data layer stores each partition as either a dense
+whitespace text matrix ``<i>.dat`` loaded with np.loadtxt (src/util.py:13-15,
+26-36) or a sparse CSR ``<i>.npz`` (src/util.py:17-24), with ``label.dat`` /
+``test_data[.dat|.npz]`` / ``label_test.dat`` alongside
+(src/generate_data.py:29-46). We read and write that exact layout (so data
+prepared for the reference drops in unchanged) and additionally cache a
+``.npy`` mirror — text parsing 400k-row matrices with loadtxt is minutes;
+np.load is milliseconds.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import scipy.sparse as sps
+
+from erasurehead_tpu.data.synthetic import Dataset
+
+
+def save_dense_text(path: str, m: np.ndarray) -> None:
+    """Whitespace text matrix, reference format (src/util.py:26-30)."""
+    np.savetxt(path, np.atleast_2d(m), fmt="%.18g")
+
+
+def load_dense_text(path: str) -> np.ndarray:
+    """np.loadtxt with a .npy cache sidecar."""
+    cache = path + ".npy"
+    if os.path.exists(cache) and os.path.getmtime(cache) >= os.path.getmtime(path):
+        return np.load(cache)
+    m = np.loadtxt(path, dtype=np.float64)
+    try:
+        np.save(cache, m)
+    except OSError:
+        pass  # read-only data dir: degrade to plain text parsing
+    return m
+
+
+def save_csr(path_no_ext: str, m) -> None:
+    """Reference .npz CSR layout (src/util.py:17-19)."""
+    m = m.tocsr()
+    np.savez(
+        path_no_ext,
+        data=m.data,
+        indices=m.indices,
+        indptr=m.indptr,
+        shape=m.shape,
+    )
+
+
+def load_csr(path_no_ext: str):
+    """Reference .npz CSR loader (src/util.py:21-24)."""
+    with np.load(path_no_ext + ".npz") as z:
+        return sps.csr_matrix(
+            (z["data"], z["indices"], z["indptr"]), shape=z["shape"]
+        )
+
+
+def write_reference_layout(
+    dataset: Dataset, out_dir: str, n_partitions: int
+) -> None:
+    """Write a dataset in the reference's per-partition directory layout
+    (src/generate_data.py:29-46): ``<i>.dat``/``<i>.npz`` (1-based),
+    label.dat, test_data[.dat], label_test.dat."""
+    os.makedirs(out_dir, exist_ok=True)
+    n = dataset.n_samples
+    rows = n // n_partitions
+    sparse = sps.issparse(dataset.X_train)
+    for i in range(n_partitions):
+        block = dataset.X_train[i * rows : (i + 1) * rows]
+        if sparse:
+            save_csr(os.path.join(out_dir, str(i + 1)), block)
+        else:
+            save_dense_text(os.path.join(out_dir, f"{i + 1}.dat"), block)
+    save_dense_text(
+        os.path.join(out_dir, "label.dat"), dataset.y_train[: rows * n_partitions]
+    )
+    if sparse:
+        save_csr(os.path.join(out_dir, "test_data"), dataset.X_test)
+    else:
+        save_dense_text(os.path.join(out_dir, "test_data.dat"), dataset.X_test)
+    save_dense_text(os.path.join(out_dir, "label_test.dat"), dataset.y_test)
+
+
+def read_reference_layout(in_dir: str, n_partitions: int, sparse: bool) -> Dataset:
+    """Load a reference-layout directory back into a Dataset."""
+    parts = []
+    for i in range(n_partitions):
+        if sparse:
+            parts.append(load_csr(os.path.join(in_dir, str(i + 1))))
+        else:
+            parts.append(load_dense_text(os.path.join(in_dir, f"{i + 1}.dat")))
+    X_train = sps.vstack(parts).tocsr() if sparse else np.vstack(parts)
+    y_train = load_dense_text(os.path.join(in_dir, "label.dat")).reshape(-1)
+    if sparse:
+        X_test = load_csr(os.path.join(in_dir, "test_data"))
+    else:
+        X_test = load_dense_text(os.path.join(in_dir, "test_data.dat"))
+    y_test = load_dense_text(os.path.join(in_dir, "label_test.dat")).reshape(-1)
+    return Dataset(
+        X_train=X_train,
+        y_train=y_train[: X_train.shape[0]],
+        X_test=X_test,
+        y_test=y_test,
+        name=os.path.basename(os.path.normpath(in_dir)),
+    )

@@ -1,0 +1,112 @@
+"""Convex GLM models: logistic regression and least-squares linear regression.
+
+These are the two model families of the reference (SURVEY.md §2.2): a single
+dense parameter vector ``beta`` trained by (accelerated) gradient descent on
+row-sharded data. Gradients follow the reference's *sum* (not mean) convention
+— the master applies ``lr/n_samples`` at update time (src/naive.py:113-115) —
+so per-partition gradients add linearly, which is what makes gradient coding's
+"message = linear combination of partition gradients" work.
+
+Closed forms being matched (citations into /root/reference):
+  - logistic gradient  -X^T (y / (exp((X beta) * y) + 1)):
+    src/naive.py:137-139, src/approximate_coding.py:194-196
+  - linear (least-squares) gradient  -2 X^T (y - X beta):
+    src/naive.py:341-346, src/approximate_coding.py:333
+  - logistic loss  mean log(1 + exp(-y * pred)): src/util.py:136-137
+  - mse loss: src/util.py:139-141
+
+Each model also exposes ``grad_sum_auto`` (jax.grad of the summed loss) — the
+extensible path that the MLP and any future model family shares; tests pin the
+closed forms to it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol
+
+import jax
+import jax.numpy as jnp
+
+from erasurehead_tpu.ops.features import matvec, rmatvec
+
+Params = Any  # pytree
+
+
+class Model(Protocol):
+    """Model interface used by the coded trainer.
+
+    ``grad_sum`` must be additive over row-disjoint data shards:
+    grad_sum(p, concat(X1, X2), concat(y1, y2)) ==
+    grad_sum(p, X1, y1) + grad_sum(p, X2, y2). All the coding theory rests on
+    this.
+    """
+
+    def init_params(self, key: jax.Array, n_features: int) -> Params: ...
+
+    def predict(self, params: Params, X) -> jnp.ndarray: ...
+
+    def grad_sum(self, params: Params, X, y) -> Params: ...
+
+    def loss_sum(self, params: Params, X, y) -> jnp.ndarray: ...
+
+    def loss_mean(self, params: Params, X, y) -> jnp.ndarray: ...
+
+
+class _GLMBase:
+    def init_params(self, key: jax.Array, n_features: int) -> jnp.ndarray:
+        """Standard-normal init.
+
+        The reference initializes beta ~ randn with no seed in naive/
+        replication/approx (src/naive.py:23) but zeros in coded/avoidstragg
+        (src/coded.py:52) — so its cross-scheme loss curves start from
+        different points (SURVEY.md §2.5). We deliberately use one seeded
+        init everywhere so scheme comparisons are paired.
+        """
+        return jax.random.normal(key, (n_features,))
+
+    def predict(self, params, X):
+        return matvec(X, params)
+
+    def grad_sum_auto(self, params, X, y):
+        return jax.grad(self.loss_sum)(params, X, y)
+
+    def loss_mean(self, params, X, y):
+        return self.loss_sum(params, X, y) / y.shape[0]
+
+
+class LogisticModel(_GLMBase):
+    """Binary logistic regression with labels in {-1, +1}."""
+
+    name = "logistic"
+
+    def grad_sum(self, params, X, y):
+        margins = matvec(X, params)
+        # d/dbeta sum_r log(1+exp(-y_r m_r)) = -X^T (y * sigmoid(-y*m))
+        # written the reference's way: y / (exp(m*y) + 1)   (src/naive.py:137-139)
+        r = y / (jnp.exp(margins * y) + 1.0)
+        return -rmatvec(X, r)
+
+    def loss_sum(self, params, X, y):
+        margins = matvec(X, params)
+        # log(1+exp(-z)) via softplus for numerical stability; the reference's
+        # literal form (src/util.py:136-137) overflows for large negative
+        # margins.
+        return jnp.sum(jax.nn.softplus(-y * margins))
+
+
+class LinearModel(_GLMBase):
+    """Least-squares linear regression (kc_house_data task)."""
+
+    name = "linear"
+
+    def grad_sum(self, params, X, y):
+        resid = y - matvec(X, params)
+        return -2.0 * rmatvec(X, resid)
+
+    def loss_sum(self, params, X, y):
+        resid = y - matvec(X, params)
+        return jnp.sum(resid**2)
+
+    def loss_mean(self, params, X, y):
+        # reference eval uses sklearn mean_squared_error (src/util.py:139-141)
+        return self.loss_sum(params, X, y) / y.shape[0]

@@ -1,0 +1,51 @@
+"""Two-layer MLP: the stretch model family (BASELINE.json configs[4]).
+
+The reference trains only convex GLMs; this model exists to show the coded-DP
+machinery is model-agnostic: parameters are a pytree, per-partition gradients
+come from jax.grad of the summed loss, and the coding/decode layer combines
+gradient *pytrees* with the same weights it uses for GLM gradient vectors.
+
+Architecture: margins = tanh(X W1 + b1) @ w2 + b2, binary labels in {-1, +1},
+logistic loss on the margin — so it drops into the same training/eval harness
+(loss curves, AUC) as logistic regression.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from erasurehead_tpu.ops.features import matvec
+
+
+class MLPModel:
+    name = "mlp"
+
+    def __init__(self, hidden: int = 64):
+        self.hidden = hidden
+
+    def init_params(self, key: jax.Array, n_features: int):
+        k1, k2 = jax.random.split(key)
+        scale = 1.0 / jnp.sqrt(n_features)
+        return {
+            "W1": scale * jax.random.normal(k1, (n_features, self.hidden)),
+            "b1": jnp.zeros(self.hidden),
+            "w2": jax.random.normal(k2, (self.hidden,)) / jnp.sqrt(self.hidden),
+            "b2": jnp.zeros(()),
+        }
+
+    def predict(self, params, X):
+        h = jnp.tanh(matvec(X, params["W1"]) + params["b1"])
+        return matvec(h, params["w2"]) + params["b2"]
+
+    def loss_sum(self, params, X, y):
+        margins = self.predict(params, X)
+        return jnp.sum(jax.nn.softplus(-y * margins))
+
+    def loss_mean(self, params, X, y):
+        return self.loss_sum(params, X, y) / y.shape[0]
+
+    def grad_sum(self, params, X, y):
+        return jax.grad(self.loss_sum)(params, X, y)
+
+    grad_sum_auto = grad_sum

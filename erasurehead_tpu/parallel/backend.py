@@ -1,0 +1,62 @@
+"""Distributed backend init: multi-host pods over DCN.
+
+The reference's multi-node substrate is mpirun + a hostfile + MPI4Py
+point-to-point (SURVEY.md §2.3); its cluster bring-up is tools/pytorch_ec2.py
+writing hosts files for mpirun (pytorch_ec2.py:656-708). The TPU-native
+equivalent is ``jax.distributed.initialize``: each TPU VM host joins the same
+SPMD program, the worker mesh axis spans all hosts\' local devices, and the
+``psum`` in parallel/step.py rides ICI within a slice and DCN across slices —
+no rank-0 master process exists at all.
+
+On a single host (including the CI CPU mesh and the one-chip bench) this is
+a no-op. The entry point is idempotent and safe to call unconditionally at
+program start.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> dict:
+    """Join (or skip) the multi-host JAX runtime; returns topology info.
+
+    With no arguments and no cluster env (JAX_COORDINATOR_ADDRESS etc. or
+    TPU pod metadata), runs single-process. With arguments or cluster env
+    present, calls ``jax.distributed.initialize`` exactly once.
+    """
+    global _initialized
+    in_cluster = (
+        coordinator_address is not None
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    if in_cluster and not _initialized:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized = True
+    return topology_info()
+
+
+def topology_info() -> dict:
+    """Process/device counts — the analogue of the reference\'s
+    size==n_procs sanity check (main.py:55-57)."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+    }

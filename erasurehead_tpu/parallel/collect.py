@@ -1,0 +1,263 @@
+"""Collection rules: who the master hears from, what weights decode the gradient.
+
+In the reference, each scheme's master sits in an ``MPI.Request.Waitany`` loop
+with a scheme-specific stop condition, stamping per-worker arrival latencies
+and then decoding from whoever made it (SURVEY.md §2.3). On TPU that
+asynchronous ragged protocol becomes a *pure function of arrival times*: given
+the simulated arrivals ``t[round, worker]`` (parallel/straggler.py), each rule
+computes, ahead of the training scan and in float64 on host,
+
+  - ``message_weights`` [R, W]: the decode coefficient applied to each
+    worker's transmitted (coded) message — 0 for uncollected/unused workers;
+  - ``sim_time`` [R]: the simulated master wall-clock for the iteration (the
+    reference's ``timeset``, src/naive.py:95,126);
+  - ``worker_times`` [R, W]: per-worker arrival stamps with the reference's
+    -1 sentinel for workers never collected (src/coded.py:171-173);
+  - ``collected`` [R, W]: who the master heard from at all.
+
+This is the control plane: tiny arrays, exact float64, fully precomputed —
+mirroring how the reference's iteration-seeded delays predetermine every
+arrival. The data plane (the gradient einsum against these weights) runs
+jitted on the mesh (parallel/step.py). An online on-device variant of the MDS
+rule exists for dynamic arrivals (ops/codes.mds_decode_weights) with
+documented fp32 limits.
+
+Stop conditions being reproduced (file:line into /root/reference):
+  naive          wait for all W workers                src/naive.py:103-110
+  cyclic MDS     first W-s arrivals, lstsq decode      src/coded.py:137-149
+  FRC            first arrival of every group          src/replication.py:143-155
+  AGC            num_collect arrivals OR all groups    src/approximate_coding.py:144-158
+  avoidstragg    first W-s, unbiasedness rescale       src/avoidstragg.py:106-116
+  partial MDS    all uncoded parts AND >= W-s coded    src/partial_coded.py:174-194
+  partial FRC    all uncoded parts AND 1 coded/group   src/partial_replication.py:166-187
+
+Tie-breaking: arrivals are processed in ascending (t, worker_index) order —
+continuous delays make exact ties measure-zero; with delays disabled
+(all-zero arrivals) this degrades deterministically to worker-index order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from erasurehead_tpu.ops import codes
+from erasurehead_tpu.ops.codes import CodingLayout
+from erasurehead_tpu.utils.config import Scheme
+
+NEVER = -1.0  # reference sentinel for "not collected" (src/coded.py:171-173)
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectionSchedule:
+    """Per-round decode control data (see module docstring)."""
+
+    message_weights: np.ndarray  # [R, W] float64
+    sim_time: np.ndarray  # [R] float64
+    worker_times: np.ndarray  # [R, W] float64, NEVER sentinel
+    collected: np.ndarray  # [R, W] bool
+
+
+def _order(t_row: np.ndarray) -> np.ndarray:
+    """Arrival processing order: ascending time, worker index tie-break."""
+    return np.lexsort((np.arange(t_row.shape[0]), t_row))
+
+
+def _rank(t: np.ndarray) -> np.ndarray:
+    """[R, W] arrival rank of each worker within its round."""
+    R, W = t.shape
+    ranks = np.empty((R, W), dtype=np.int64)
+    for r in range(R):
+        ranks[r, _order(t[r])] = np.arange(W)
+    return ranks
+
+
+def _group_winners(t: np.ndarray, groups: np.ndarray) -> np.ndarray:
+    """[R, W] bool: is worker the earliest arrival of its group (index tie-break)."""
+    R, W = t.shape
+    n_groups = int(groups.max()) + 1
+    win = np.zeros((R, W), dtype=bool)
+    for g in range(n_groups):
+        members = np.flatnonzero(groups == g)
+        best = members[np.argmin(t[:, members], axis=1)]  # argmin: first index wins
+        win[np.arange(R), best] = True
+    return win
+
+
+def _stamp(t: np.ndarray, collected: np.ndarray) -> np.ndarray:
+    return np.where(collected, t, NEVER)
+
+
+def collect_all(t: np.ndarray) -> CollectionSchedule:
+    """Uncoded synchronous GD: master waits for everyone (src/naive.py:103-110)."""
+    R, W = t.shape
+    return CollectionSchedule(
+        message_weights=np.ones((R, W)),
+        sim_time=t.max(axis=1),
+        worker_times=t.copy(),
+        collected=np.ones((R, W), dtype=bool),
+    )
+
+
+def collect_first_k_mds(
+    t: np.ndarray, B: np.ndarray, n_stragglers: int
+) -> CollectionSchedule:
+    """Exact MDS coding: stop at the first W-s arrivals, solve decode weights
+    over exactly that set (src/coded.py:137-149)."""
+    R, W = t.shape
+    k = W - n_stragglers
+    ranks = _rank(t)
+    collected = ranks < k
+    weights = codes.mds_decode_weights_host(B, collected)
+    kth_time = np.where(ranks == k - 1, t, -np.inf).max(axis=1)
+    return CollectionSchedule(
+        message_weights=weights,
+        sim_time=kth_time,
+        worker_times=_stamp(t, collected),
+        collected=collected,
+    )
+
+
+def collect_frc(t: np.ndarray, groups: np.ndarray) -> CollectionSchedule:
+    """Fractional repetition: wait until every group has reported once; use
+    each group's first arrival, ignore (but stamp) later arrivals processed
+    before the loop exits (src/replication.py:143-155)."""
+    win = _group_winners(t, groups)
+    # the loop exits when the slowest group's first member arrives
+    stop = np.where(win, t, -np.inf).max(axis=1)
+    collected = t <= stop[:, None]
+    return CollectionSchedule(
+        message_weights=win.astype(np.float64),
+        sim_time=stop,
+        worker_times=_stamp(t, collected),
+        collected=collected,
+    )
+
+
+def collect_agc(
+    t: np.ndarray, groups: np.ndarray, num_collect: int
+) -> CollectionSchedule:
+    """Approximate gradient coding: process arrivals until either
+    ``num_collect`` workers have reported or every group is covered; sum the
+    first arrival of each covered group; groups with no arrival among those
+    processed are *erased* from the gradient
+    (src/approximate_coding.py:144-158)."""
+    R, W = t.shape
+    n_groups = int(groups.max()) + 1
+    ranks = _rank(t)
+    win = _group_winners(t, groups)
+    weights = np.zeros((R, W))
+    sim = np.empty(R)
+    collected = np.zeros((R, W), dtype=bool)
+    for r in range(R):
+        order = _order(t[r])
+        covered = np.cumsum(win[r, order])  # groups covered after j+1 arrivals
+        j = np.arange(1, W + 1)
+        done = (j >= num_collect) | (covered >= n_groups)
+        stop_idx = int(np.argmax(done))  # first index where the loop exits
+        taken = order[: stop_idx + 1]
+        collected[r, taken] = True
+        weights[r, taken] = win[r, taken].astype(np.float64)
+        sim[r] = t[r, order[stop_idx]]
+    return CollectionSchedule(
+        message_weights=weights,
+        sim_time=sim,
+        worker_times=_stamp(t, collected),
+        collected=collected,
+    )
+
+
+def collect_avoidstragg(t: np.ndarray, n_stragglers: int) -> CollectionSchedule:
+    """Ignore-stragglers baseline: sum the first W-s uncoded gradients and
+    rescale by W/(W-s) for unbiasedness — the reference folds the rescale
+    into grad_multiplier = lr / (n_samples*(W-s)/W) (src/avoidstragg.py:116)."""
+    R, W = t.shape
+    k = W - n_stragglers
+    ranks = _rank(t)
+    collected = ranks < k
+    kth_time = np.where(ranks == k - 1, t, -np.inf).max(axis=1)
+    return CollectionSchedule(
+        message_weights=collected * (W / k),
+        sim_time=kth_time,
+        worker_times=_stamp(t, collected),
+        collected=collected,
+    )
+
+
+def collect_partial(
+    t: np.ndarray,
+    layout: CodingLayout,
+    variant: str,  # "mds" | "frc"
+) -> CollectionSchedule:
+    """Two-part schemes: every worker sends its uncoded part when its unique
+    partitions are done, its coded part when the rest are; the master needs
+    ALL uncoded parts plus enough coded parts (W-s for MDS decode
+    src/partial_coded.py:174-194; one per group for FRC
+    src/partial_replication.py:166-187).
+
+    Timing model: a worker's full compute finishes at t[r, w]; its uncoded
+    part (n_sep of n_slots partitions) is sent at the same fraction of that
+    time. ``message_weights`` here weight only the *coded* messages — the
+    step applies weight 1.0 to separate slots unconditionally
+    (CodingLayout.slot_is_coded).
+    """
+    R, W = t.shape
+    s = layout.n_stragglers
+    n_sep = int((~layout.slot_is_coded).sum())
+    frac = n_sep / layout.n_slots
+    t_first, t_second = frac * t, t
+    if variant == "mds":
+        ranks = _rank(t_second)
+        kth_time = np.where(ranks == W - s - 1, t_second, -np.inf).max(axis=1)
+        stop = np.maximum(t_first.max(axis=1), kth_time)
+        # every coded part that arrived by the time the loop exits joins the
+        # decode (the reference solves over all of completed_workers,
+        # src/partial_coded.py:192-193 — possibly more than W-s rows)
+        completed = t_second <= stop[:, None]
+        weights = codes.mds_decode_weights_host(layout.B, completed)
+    elif variant == "frc":
+        win = _group_winners(t_second, layout.groups)
+        group_cover = np.where(win, t_second, -np.inf).max(axis=1)
+        stop = np.maximum(t_first.max(axis=1), group_cover)
+        completed = t_second <= stop[:, None]
+        # only each group's first coded arrival is summed
+        # (src/partial_replication.py:173-180)
+        weights = win.astype(np.float64)
+    else:
+        raise ValueError(f"unknown partial variant {variant!r}")
+    # reference worker_timeset: stamped per message, then overwritten with -1
+    # for workers whose coded part never arrived (src/partial_coded.py:210-212)
+    return CollectionSchedule(
+        message_weights=weights,
+        sim_time=stop,
+        worker_times=_stamp(t_second, completed),
+        collected=completed,
+    )
+
+
+def build_schedule(
+    scheme: Scheme,
+    t: np.ndarray,
+    layout: CodingLayout,
+    num_collect: int | None = None,
+) -> CollectionSchedule:
+    """Dispatch to the scheme's collection rule (the reference's dispatch is
+    main.py:62-92)."""
+    if scheme == Scheme.NAIVE:
+        return collect_all(t)
+    if scheme == Scheme.CYCLIC_MDS:
+        return collect_first_k_mds(t, layout.B, layout.n_stragglers)
+    if scheme == Scheme.FRC:
+        return collect_frc(t, layout.groups)
+    if scheme == Scheme.APPROX:
+        if num_collect is None:
+            raise ValueError("AGC needs num_collect")
+        return collect_agc(t, layout.groups, num_collect)
+    if scheme == Scheme.AVOID_STRAGGLERS:
+        return collect_avoidstragg(t, layout.n_stragglers)
+    if scheme == Scheme.PARTIAL_CYCLIC:
+        return collect_partial(t, layout, "mds")
+    if scheme == Scheme.PARTIAL_FRC:
+        return collect_partial(t, layout, "frc")
+    raise ValueError(f"unknown scheme {scheme}")

@@ -1,0 +1,54 @@
+"""Device mesh construction for the coded-DP worker axis.
+
+The reference's parallelism is a master + W workers as MPI ranks over
+ethernet (SURVEY.md §2.2). Here the W *logical* workers live on a 1-D
+``jax.sharding.Mesh`` axis ("workers"): each device holds W/n_devices
+workers' (possibly redundant) partition stacks, gradients reduce over the
+axis with ``psum`` riding ICI (multi-host: DCN via jax.distributed — see
+parallel/backend.py). There is no master device: the decode is replicated,
+its inputs are tiny, and XLA keeps it fused with the update.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+WORKER_AXIS = "workers"
+
+
+def worker_mesh(
+    n_devices: Optional[int] = None, devices: Optional[Sequence] = None
+) -> Mesh:
+    """1-D mesh over the worker axis.
+
+    ``n_devices`` trims to a prefix of the available devices (useful when the
+    logical worker count W must divide the device count's multiple).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(f"asked for {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (WORKER_AXIS,))
+
+
+def worker_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard dim 0 (the worker / partition axis) across the mesh."""
+    return NamedSharding(mesh, P(WORKER_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def check_divisible(n: int, mesh: Mesh, what: str) -> None:
+    d = mesh.devices.size
+    if n % d:
+        raise ValueError(
+            f"{what}={n} must be divisible by the mesh's {d} devices; "
+            f"pick n_workers as a multiple of the device count"
+        )

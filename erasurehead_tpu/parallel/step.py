@@ -1,0 +1,130 @@
+"""The coded gradient step: shard_map SPMD over the worker mesh axis.
+
+This replaces the reference's entire MPI hot loop (SURVEY.md §2.3): the
+per-iteration Isend fan-out of beta, each worker's redundant partial-gradient
+compute, the Waitany partial gather, and the master-side decode
+(src/approximate_coding.py:122-207 and counterparts) become one jitted SPMD
+program:
+
+  - the model params are replicated (the reference broadcast them per
+    iteration; under jit replication is free — there is no repeated transfer),
+  - each device computes the slot gradients of its shard of logical workers
+    (faithful mode) or partitions (deduped mode) — batched matmuls that XLA
+    tiles onto the MXU,
+  - decode = a weighted contraction against the collection weights followed
+    by a single ``psum`` over the worker axis riding ICI — the masked
+    equivalent of "sum the first k arrivals, scaled by the decode
+    coefficients".
+
+Straggler semantics live entirely in the *weights* (parallel/collect.py):
+a worker whose message the master never used contributes with weight 0. On a
+lockstep SPMD machine every chip computes every iteration regardless; what
+gradient coding buys there is captured by the simulated-time accounting, and
+honestly reported as such (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from erasurehead_tpu.parallel.mesh import WORKER_AXIS
+
+GradFn = Callable[..., Any]  # (params, X, y, weights) -> gradient pytree
+
+
+def _weighted_tree_sum(weights: jnp.ndarray, grads: Any, contract: str) -> Any:
+    """sum_i weights[i...] * grads[i...] over the leading axes of each leaf."""
+    return jax.tree.map(
+        lambda G: jnp.einsum(
+            f"{contract},{contract}...->...",
+            weights.astype(G.dtype),
+            G,
+            precision=lax.Precision.HIGHEST,
+        ),
+        grads,
+    )
+
+
+def make_faithful_grad_fn(model, mesh: Mesh) -> GradFn:
+    """Every logical worker computes all of its (redundant) slot gradients.
+
+    Matches the reference's cost model: an FRC/MDS worker really does
+    (s+1) partitions' worth of matvec work each iteration
+    (src/approximate_coding.py:194-196 over the stacked X_current).
+
+    Args of the returned fn:
+      params: replicated pytree.
+      Xw, yw: worker-major stacks [W, S, rows, F] / [W, S, rows] (leaves of
+        PaddedRows likewise lead with [W, S, ...]), sharded on dim 0.
+      slot_weights: [W, S] decode x coding weight per slot message.
+    Returns the decoded gradient pytree, replicated.
+    """
+
+    def local(params, Xw, yw, slot_weights):
+        per_slot = jax.vmap(
+            jax.vmap(lambda X, y: model.grad_sum(params, X, y))
+        )(Xw, yw)  # leaves [Wl, S, ...]
+        g = _weighted_tree_sum(slot_weights, per_slot, "ws")
+        return lax.psum(g, WORKER_AXIS)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
+        out_specs=P(),
+    )
+
+
+def make_deduped_grad_fn(model, mesh: Mesh) -> GradFn:
+    """Each partition gradient is computed exactly once, then combined with
+    folded decode weights (CodingLayout.partition_weights).
+
+    No reference counterpart (the dedup is this framework's optimization);
+    produces bit-comparable gradients to the faithful mode — tests pin the
+    two together.
+
+    Args of the returned fn:
+      params: replicated pytree.
+      Xp, yp: partition-major stacks [Pn, rows, F] / [Pn, rows], sharded.
+      part_weights: [Pn] folded per-partition weights.
+    """
+
+    def local(params, Xp, yp, part_weights):
+        per_part = jax.vmap(lambda X, y: model.grad_sum(params, X, y))(Xp, yp)
+        g = _weighted_tree_sum(part_weights, per_part, "p")
+        return lax.psum(g, WORKER_AXIS)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
+        out_specs=P(),
+    )
+
+
+def expand_slot_weights(
+    message_weights: jnp.ndarray,
+    coeffs: jnp.ndarray,
+    slot_is_coded: jnp.ndarray,
+) -> jnp.ndarray:
+    """[R?, W] per-message decode weights -> [R?, W, S] per-slot weights.
+
+    Coded slots are scaled by the message's decode weight; separate slots
+    (partial schemes' uncoded first parts) always contribute with weight 1
+    (src/partial_coded.py:187-190: every first part is added unscaled).
+
+    This is the single home of that rule: both compute modes (and the host
+    float64 control plane) derive their weights from it, so it accepts numpy
+    inputs without forcing a float32 round-trip through jnp.
+    """
+    xp = np if isinstance(message_weights, np.ndarray) else jnp
+    a = message_weights[..., :, None]
+    return xp.where(slot_is_coded, a * coeffs, coeffs)

@@ -1,0 +1,104 @@
+"""Straggler injection: seeded per-iteration arrival-delay schedules.
+
+The reference injects stragglers by making every worker sleep an
+Exponential(mean 0.5s) delay after computing its gradient, with the numpy
+global RNG re-seeded to the iteration index so the whole delay matrix is
+deterministic and identical on every rank (src/naive.py:140-149, identical
+block in every scheme file). That replayability is the backbone of its
+AGC-vs-EGC-vs-uncoded comparisons: every scheme sees the *same* straggler
+schedule.
+
+On a lockstep SPMD TPU there is nothing to sleep — every chip computes every
+iteration. Straggling instead enters as a simulated *arrival time* per
+(iteration, worker): collection rules turn arrivals into completion masks and
+simulated wall-clock (SURVEY.md §5.8). This module produces those arrival
+matrices:
+
+  - :func:`reference_delay_schedule` reproduces the reference's exact numbers
+    (same MT19937 streams) so time curves are comparable run-for-run.
+  - :func:`jax_delay_schedule` is the native path (threefry counter RNG),
+    usable on-device for dynamic schedules.
+
+Both are *schedules known ahead of the run* — exactly as in the reference,
+where seeding by iteration index makes the future fully predetermined. The
+framework exploits this to precompute decode weights on host (float64 control
+plane) while the gradient data plane runs on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def reference_delay_schedule(
+    rounds: int, n_workers: int, mean: float = 0.5
+) -> np.ndarray:
+    """[rounds, n_workers] delay matrix, bit-exact with the reference.
+
+    The reference executes ``np.random.seed(i); np.random.exponential(0.5,
+    n_workers)`` inside iteration i (src/naive.py:141-147);
+    ``np.random.RandomState(i).exponential`` draws from the identical MT19937
+    stream.
+    """
+    out = np.empty((rounds, n_workers))
+    for i in range(rounds):
+        out[i] = np.random.RandomState(i).exponential(mean, n_workers)
+    return out
+
+
+def jax_delay_schedule(
+    key: jax.Array, rounds: int, n_workers: int, mean: float = 0.5
+) -> jnp.ndarray:
+    """Native JAX exponential delay schedule (not bit-matched to numpy)."""
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(rounds))
+    return jax.vmap(
+        lambda k: mean * jax.random.exponential(k, (n_workers,))
+    )(keys)
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrivalModel:
+    """Turns injected delays into per-(round, worker) arrival times.
+
+    arrival = compute_time + delay. The reference's worker_timeset also
+    includes gradient compute + network transfer on top of the sleep; by
+    default we model a uniform ``compute_time`` of 0 (pure delay ordering —
+    the regime the reference's experiments are in, where the 0.5s-mean sleeps
+    dominate ~ms matvecs). A nonzero compute_time or per-worker speed factors
+    model heterogeneous clusters.
+    """
+
+    compute_time: float = 0.0
+    worker_speed: np.ndarray | None = None  # [W] multiplier on compute_time
+
+    def arrivals(self, delays: np.ndarray) -> np.ndarray:
+        base = self.compute_time
+        if self.worker_speed is not None:
+            base = self.compute_time * np.asarray(self.worker_speed)[None, :]
+        return np.asarray(delays) + base
+
+
+def arrival_schedule(
+    rounds: int,
+    n_workers: int,
+    add_delay: bool,
+    mean: float = 0.5,
+    arrival_model: ArrivalModel | None = None,
+) -> np.ndarray:
+    """The full [rounds, W] arrival-time matrix for a run.
+
+    With ``add_delay=False`` the reference's workers reply in compute order
+    with no injected sleep (main.py arg add_delay, src/naive.py:140); we model
+    that as all-zero arrivals (ties broken by worker index in the collection
+    rules, documented there).
+    """
+    if add_delay:
+        delays = reference_delay_schedule(rounds, n_workers, mean)
+    else:
+        delays = np.zeros((rounds, n_workers))
+    model = arrival_model or ArrivalModel()
+    return model.arrivals(delays)

@@ -1,0 +1,120 @@
+"""Result artifacts: the five per-run files the reference saves.
+
+Every reference run writes, into ``<input_dir>/results/``
+(src/naive.py:200-208, src/coded.py:246-254):
+
+  <prefix>_training_loss.dat   per-iteration train loss
+  <prefix>_testing_loss.dat    per-iteration test loss
+  <prefix>_auc.dat             per-iteration test AUC
+  <prefix>_timeset.dat         per-iteration wall-clock
+  <prefix>_worker_timeset.dat  [rounds x W] per-worker arrival latencies
+
+We keep the same five files and naming skeleton so reference-side analysis
+scripts keep working, with deviations (documented, SURVEY.md §2.5):
+  - every scheme gets its own prefix — the reference saves AGC under
+    ``replication_acc_*`` (src/approximate_coding.py:259-263, clobbering
+    EGC-FRC results) and partial-coded's training loss under a
+    ``partialreplication_`` prefix (src/partial_coded.py:286);
+  - full float precision — the reference's save_vector truncates to 3
+    decimals (src/util.py:32-36);
+  - a run_manifest.json capturing the full config (the reference encodes
+    only n_stragglers in the filename).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from erasurehead_tpu.train.evaluate import EvalResult
+from erasurehead_tpu.train.trainer import TrainResult
+from erasurehead_tpu.utils.config import RunConfig
+
+#: scheme -> artifact filename prefix (reference names, bugs fixed)
+SCHEME_PREFIX = {
+    "naive": "naive",
+    "cyccoded": "coded",
+    "repcoded": "replication",
+    "approx": "approx",
+    "avoidstragg": "avoidstragg",
+    "partialcyccoded": "partialcoded",
+    "partialrepcoded": "partialreplication",
+}
+
+
+def save_vector(v: np.ndarray, path: str) -> None:
+    """One value per line (text, like the reference's .dat files but full
+    precision — src/util.py:32-36 rounds to 3 decimals)."""
+    np.savetxt(path, np.asarray(v).reshape(-1), fmt="%.18g")
+
+
+def save_matrix(m: np.ndarray, path: str) -> None:
+    np.savetxt(path, np.asarray(m), fmt="%.18g")
+
+
+def write_run_artifacts(
+    result: TrainResult,
+    ev: Optional[EvalResult],
+    output_dir: str,
+) -> dict:
+    """Write the five reference artifacts + manifest; returns paths."""
+    cfg: RunConfig = result.config
+    prefix = f"{SCHEME_PREFIX[cfg.scheme.value]}_{cfg.n_stragglers}"
+    os.makedirs(output_dir, exist_ok=True)
+    paths = {}
+
+    def emit(name, saver, data):
+        path = os.path.join(output_dir, f"{prefix}_{name}.dat")
+        saver(data, path)
+        paths[name] = path
+
+    if ev is not None:
+        emit("training_loss", save_vector, ev.training_loss)
+        emit("testing_loss", save_vector, ev.testing_loss)
+        emit("auc", save_vector, ev.auc)
+    emit("timeset", save_vector, result.timeset)
+    emit("worker_timeset", save_matrix, result.worker_times)
+
+    def jsonable(v):
+        if hasattr(v, "value"):  # enums
+            return v.value
+        if isinstance(v, np.ndarray):
+            return v.tolist()
+        return v
+
+    manifest = {
+        "config": {
+            k: jsonable(v) for k, v in dataclasses.asdict(cfg).items()
+        },
+        "sim_total_time": result.sim_total_time,
+        "wall_time": result.wall_time,
+        "steps_per_sec": result.steps_per_sec,
+        "n_train": result.n_train,
+        "artifacts": paths,
+    }
+    mpath = os.path.join(output_dir, f"{prefix}_run_manifest.json")
+    with open(mpath, "w") as f:
+        json.dump(manifest, f, indent=2, default=str)
+    paths["manifest"] = mpath
+    return paths
+
+
+def print_iteration_table(result: TrainResult, ev: EvalResult) -> None:
+    """The reference's per-iteration eval printout (src/naive.py:198)."""
+    for i in range(len(ev.training_loss)):
+        line = (
+            f"Iteration {i}: Train Loss = {ev.training_loss[i]:.5f}, "
+            f"Test Loss = {ev.testing_loss[i]:.5f}"
+        )
+        if not np.isnan(ev.auc[i]):
+            line += f", AUC = {ev.auc[i]:.5f}"
+        line += f", Sim time = {result.timeset[i]:.4f}s"
+        print(line)
+    print(
+        f"Total simulated time: {result.sim_total_time:.3f}s | real wall "
+        f"{result.wall_time:.3f}s | {result.steps_per_sec:.1f} steps/s"
+    )

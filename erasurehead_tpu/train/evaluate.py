@@ -1,0 +1,72 @@
+"""Post-hoc evaluation replay: loss/AUC curves over the whole iterate history.
+
+The reference's master, after training, reloads the full train and test sets
+and replays every saved iterate through numpy + sklearn, printing one line
+per iteration (src/naive.py:157-198). Here the replay is a single jitted
+lax.scan over the stacked history — the full [rounds, F] betaset against the
+full train/test matrices, on device.
+
+Deviations from the reference (documented, SURVEY.md §2.5):
+  - the reference's replay silently drops the last worker's partition from
+    the train loss (``range(2, n_procs-1)``, src/naive.py:161-169); we
+    evaluate on the full training set,
+  - AUC uses the (tested-equal) Mann-Whitney form on device instead of
+    sklearn's roc_curve on host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from erasurehead_tpu.models import metrics
+from erasurehead_tpu.utils.config import ModelKind
+
+
+@dataclasses.dataclass
+class EvalResult:
+    training_loss: np.ndarray  # [rounds]
+    testing_loss: np.ndarray  # [rounds]
+    auc: np.ndarray  # [rounds]; NaN for regression (reference prints none)
+
+
+def replay(
+    model,
+    model_kind: ModelKind,
+    params_history: Any,
+    X_train,
+    y_train,
+    X_test,
+    y_test,
+) -> EvalResult:
+    """Loss (and AUC for classifiers) of every iterate in the history."""
+    is_regression = ModelKind(model_kind) == ModelKind.LINEAR
+
+    def one(carry, params):
+        train_loss = model.loss_mean(params, X_train, y_train)
+        pred_test = model.predict(params, X_test)
+        test_loss = (
+            metrics.mse_mean(y_test, pred_test)
+            if is_regression
+            else metrics.log_loss_mean(y_test, pred_test)
+        )
+        auc_val = (
+            jnp.nan if is_regression else metrics.auc(y_test, pred_test)
+        )
+        return carry, (train_loss, test_loss, auc_val)
+
+    @jax.jit
+    def run(history):
+        _, out = jax.lax.scan(one, 0, history)
+        return out
+
+    train_l, test_l, auc_l = run(params_history)
+    return EvalResult(
+        training_loss=np.asarray(train_l),
+        testing_loss=np.asarray(test_l),
+        auc=np.asarray(auc_l),
+    )

@@ -1,0 +1,67 @@
+"""GD and accelerated-GD updates, generic over parameter pytrees.
+
+The reference copy-pastes these two updates into every scheme file
+(SURVEY.md §2.4); here they are one module, expressed over pytrees so the
+same code trains a GLM vector and an MLP.
+
+Update rules being matched (src/naive.py:113-122):
+  GD:   beta <- (1 - 2*alpha*eta_i) * beta - (eta_i / n) * g
+  AGD (Nesterov-style, theta_i = 2/(i+2)):
+        y      = (1 - theta) * beta + theta * u
+        beta+  = y - (eta_i / n) * g - 2*alpha*eta_i * beta
+        u     <- beta + (beta+ - beta) / theta
+where g is the *sum* gradient over collected samples and n is the total
+sample count (the eta/n "grad_multiplier", src/naive.py:112; avoidstragg's
+rescaled multiplier is folded into the collection weights instead,
+parallel/collect.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from erasurehead_tpu.utils.config import UpdateRule
+
+Params = Any
+
+
+class OptState(NamedTuple):
+    params: Params
+    momentum: Params  # AGD's u sequence; unused by GD
+
+
+def init_state(params: Params) -> OptState:
+    return OptState(params=params, momentum=jax.tree.map(jnp.zeros_like, params))
+
+
+def gd_update(
+    state: OptState, g: Params, eta: jnp.ndarray, alpha: float, n_samples: int, i
+) -> OptState:
+    mult = eta / n_samples
+    new = jax.tree.map(
+        lambda b, gg: (1.0 - 2.0 * alpha * eta) * b - mult * gg, state.params, g
+    )
+    return OptState(params=new, momentum=state.momentum)
+
+
+def agd_update(
+    state: OptState, g: Params, eta: jnp.ndarray, alpha: float, n_samples: int, i
+) -> OptState:
+    mult = eta / n_samples
+    theta = 2.0 / (i + 2.0)
+    def leaf(b, u, gg):
+        y = (1.0 - theta) * b + theta * u
+        b_next = y - mult * gg - 2.0 * alpha * eta * b
+        u_next = b + (b_next - b) / theta
+        return b_next, u_next
+    pairs = jax.tree.map(leaf, state.params, state.momentum, g)
+    new_p = jax.tree.map(lambda t: t[0], pairs, is_leaf=lambda t: isinstance(t, tuple))
+    new_u = jax.tree.map(lambda t: t[1], pairs, is_leaf=lambda t: isinstance(t, tuple))
+    return OptState(params=new_p, momentum=new_u)
+
+
+def make_update_fn(rule: UpdateRule):
+    return gd_update if UpdateRule(rule) == UpdateRule.GD else agd_update

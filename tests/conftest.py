@@ -1,13 +1,19 @@
 """Test harness: simulate an 8-device TPU pod on CPU.
 
-Must run before any jax import (SURVEY.md §4): tests exercise the full
-multi-chip sharding path via XLA's forced host-platform device count, the
-same mechanism the driver uses for the multi-chip dry run.
+Tests exercise the full multi-chip sharding path via XLA's forced
+host-platform device count — the same mechanism the driver uses for the
+multi-chip dry run (SURVEY.md §4).
+
+This environment's sitecustomize force-registers a remote-TPU ("axon") PJRT
+plugin and overwrites JAX_PLATFORMS, so merely setting the env var is not
+enough: we must override the config after import AND deregister the plugin
+factory, otherwise every test process dials the TPU tunnel (and wedges it —
+the terminal serves one client at a time).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,13 +22,18 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_enable_x64", False)
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+for _plugin in ("axon", "tpu"):
+    _xb._backend_factories.pop(_plugin, None)
 
 import pytest  # noqa: E402
 
 
-@pytest.fixture(scope="session")
+@pytest.fixture(scope="session", autouse=True)
 def devices():
     devs = jax.devices()
+    assert devs[0].platform == "cpu"
     assert len(devs) == 8, f"expected 8 forced CPU devices, got {len(devs)}"
     return devs

@@ -1,0 +1,158 @@
+"""Model & metrics tests: closed-form gradients vs autodiff, numpy oracles
+for the reference's formulas, sparse/dense equivalence, AUC vs sklearn."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+from erasurehead_tpu.models import metrics
+from erasurehead_tpu.models.glm import LinearModel, LogisticModel
+from erasurehead_tpu.models.mlp import MLPModel
+from erasurehead_tpu.ops.features import PaddedRows, matvec, rmatvec
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((64, 10)).astype(np.float32)
+    y = np.where(rng.standard_normal(64) > 0, 1.0, -1.0).astype(np.float32)
+    beta = rng.standard_normal(10).astype(np.float32)
+    return jnp.asarray(X), jnp.asarray(y), jnp.asarray(beta)
+
+
+def test_logistic_grad_matches_reference_formula(data):
+    X, y, beta = data
+    m = LogisticModel()
+    g = m.grad_sum(beta, X, y)
+    # reference closed form: -X^T (y / (exp((X beta)*y) + 1)), src/naive.py:137-139
+    Xn, yn, bn = map(np.asarray, (X, y, beta))
+    predy = Xn @ bn
+    expect = -Xn.T @ (yn / (np.exp(predy * yn) + 1.0))
+    assert np.allclose(g, expect, atol=1e-4)
+
+
+def test_logistic_grad_matches_autodiff(data):
+    X, y, beta = data
+    m = LogisticModel()
+    assert np.allclose(m.grad_sum(beta, X, y), m.grad_sum_auto(beta, X, y), atol=1e-3)
+
+
+def test_linear_grad_matches_reference_formula(data):
+    X, y, beta = data
+    m = LinearModel()
+    g = m.grad_sum(beta, X, y)
+    Xn, yn, bn = map(np.asarray, (X, y, beta))
+    expect = -2.0 * Xn.T @ (yn - Xn @ bn)  # src/naive.py:341-346
+    assert np.allclose(g, expect, atol=1e-3)
+    assert np.allclose(g, m.grad_sum_auto(beta, X, y), atol=1e-3)
+
+
+def test_grad_additivity_over_shards(data):
+    """The property gradient coding rests on: sum-gradients add over
+    row-disjoint shards."""
+    X, y, beta = data
+    for m in (LogisticModel(), LinearModel()):
+        whole = m.grad_sum(beta, X, y)
+        parts = m.grad_sum(beta, X[:32], y[:32]) + m.grad_sum(beta, X[32:], y[32:])
+        assert np.allclose(whole, parts, atol=1e-4)
+
+
+def test_logistic_loss_matches_reference_formula(data):
+    X, y, beta = data
+    m = LogisticModel()
+    loss = m.loss_mean(beta, X, y)
+    Xn, yn, bn = map(np.asarray, (X, y, beta))
+    expect = np.sum(np.log(1 + np.exp(-yn * (Xn @ bn)))) / 64  # src/util.py:136-137
+    assert np.allclose(loss, expect, atol=1e-5)
+
+
+def test_logistic_loss_stable_at_large_margins():
+    m = LogisticModel()
+    X = jnp.ones((2, 1)) * 1000.0
+    y = jnp.array([1.0, -1.0])
+    beta = jnp.ones(1)
+    loss = m.loss_mean(beta, X, y)
+    assert np.isfinite(loss)  # reference's literal form overflows here
+
+
+def test_mlp_gradients_and_pytree(data):
+    X, y, _ = data
+    m = MLPModel(hidden=8)
+    params = m.init_params(jax.random.key(0), 10)
+    g = m.grad_sum(params, X, y)
+    assert set(g) == {"W1", "b1", "w2", "b2"}
+    assert g["W1"].shape == (10, 8)
+    # additivity holds for the MLP too
+    parts = jax.tree.map(
+        lambda a, b: a + b,
+        m.grad_sum(params, X[:32], y[:32]),
+        m.grad_sum(params, X[32:], y[32:]),
+    )
+    assert all(
+        np.allclose(parts[k], g[k], atol=1e-3) for k in g
+    )
+
+
+# ---------------------------------------------------------------------------
+# sparse features
+# ---------------------------------------------------------------------------
+
+
+def test_padded_rows_matvec_rmatvec_match_dense():
+    rng = np.random.default_rng(1)
+    dense = sps.random(50, 40, density=0.1, random_state=2, format="csr")
+    P = PaddedRows.from_scipy(dense)
+    Xd = jnp.asarray(dense.toarray())
+    v = jnp.asarray(rng.standard_normal(40).astype(np.float32))
+    r = jnp.asarray(rng.standard_normal(50).astype(np.float32))
+    assert np.allclose(matvec(P, v), matvec(Xd, v), atol=1e-4)
+    assert np.allclose(rmatvec(P, r), rmatvec(Xd, r), atol=1e-4)
+    # matrix right-hand sides (MLP first layer)
+    V = jnp.asarray(rng.standard_normal((40, 7)).astype(np.float32))
+    Rm = jnp.asarray(rng.standard_normal((50, 7)).astype(np.float32))
+    assert np.allclose(matvec(P, V), matvec(Xd, V), atol=1e-4)
+    assert np.allclose(rmatvec(P, Rm), rmatvec(Xd, Rm), atol=1e-4)
+    assert np.allclose(P.to_dense(), dense.toarray(), atol=1e-6)
+
+
+def test_models_work_on_padded_rows(data):
+    _, y, beta = data
+    rng = np.random.default_rng(3)
+    dense = sps.random(64, 10, density=0.3, random_state=3, format="csr")
+    P = PaddedRows.from_scipy(dense)
+    Xd = jnp.asarray(dense.toarray())
+    m = LogisticModel()
+    assert np.allclose(m.grad_sum(beta, P, y), m.grad_sum(beta, Xd, y), atol=1e-4)
+    assert np.allclose(m.loss_mean(beta, P, y), m.loss_mean(beta, Xd, y), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+def test_auc_matches_sklearn():
+    rng = np.random.default_rng(4)
+    y = np.where(rng.standard_normal(200) > 0, 1.0, -1.0)
+    scores = rng.standard_normal(200) + 0.8 * y
+    ours = float(metrics.auc(jnp.asarray(y), jnp.asarray(scores)))
+    skl = metrics.auc_sklearn(y, scores)
+    assert abs(ours - skl) < 1e-6
+
+
+def test_auc_with_ties_matches_sklearn():
+    rng = np.random.default_rng(5)
+    y = np.where(rng.standard_normal(300) > 0, 1.0, -1.0)
+    scores = np.round(rng.standard_normal(300) + 0.5 * y, 1)  # heavy ties
+    ours = float(metrics.auc(jnp.asarray(y), jnp.asarray(scores)))
+    skl = metrics.auc_sklearn(y, scores)
+    assert abs(ours - skl) < 1e-5
+
+
+def test_auc_jittable():
+    rng = np.random.default_rng(6)
+    y = jnp.asarray(np.where(rng.standard_normal(100) > 0, 1.0, -1.0))
+    s = jnp.asarray(rng.standard_normal(100))
+    assert np.isclose(jax.jit(metrics.auc)(y, s), metrics.auc(y, s))
