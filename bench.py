@@ -1,0 +1,98 @@
+"""Benchmark: flagship AGC logistic regression at the reference's canonical
+run shape, on real TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+What is measured: real on-device steps/sec of the full coded training step
+(worker-sharded gradient stacks, slot-weighted decode contraction, psum, AGD
+update) over the canonical configuration from run_approx_coding.sh:2-9 —
+30 workers, s=3 stragglers, num_collect=15, AGD, 100 rounds, seeded
+Exponential(0.5) straggler schedule.
+
+What vs_baseline compares against: the reference's effective iteration rate
+under its own measurement protocol on the same schedule. In the reference,
+every iteration's wall-clock is the arrival time of the worker that satisfies
+the AGC stop rule — the injected sleeps are real time there
+(src/approximate_coding.py:136-175, src/naive.py:141-148). Our control plane
+computes exactly that per-iteration simulated clock from the identical delay
+streams; baseline steps/sec = rounds / sum(simulated timeset). The TPU run
+does the same *science* (same gradients, same decode, same loss curve, same
+timing artifacts) without spending wall-clock on sleeping, which is precisely
+the framework's value proposition.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+ROUNDS = 100
+# run_approx_coding.sh:2-9 sets W=30, s=3, collect=15 — but AGC requires
+# (s+1) | W in the reference as well (src/approximate_coding.py:25-27), and
+# 30 % 4 != 0, so the canonical script's own AGC config is unrunnable there
+# too. s=2 is the nearest valid setting (10 FRC groups of 3).
+W, S, COLLECT = 30, 2, 15
+N_COLS = 128
+
+
+def main() -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    # size the problem to the platform: full canonical rows on an
+    # accelerator, a light slice on CPU fallback so the bench terminates
+    n_rows = 132_000 if platform != "cpu" else 13_200
+
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        scheme="approx",
+        n_workers=W,
+        n_stragglers=S,
+        num_collect=COLLECT,
+        rounds=ROUNDS,
+        n_rows=n_rows,
+        n_cols=N_COLS,
+        update_rule="AGD",
+        lr_schedule=1.0,
+        add_delay=True,
+        seed=0,
+    )
+    print(
+        f"bench: platform={platform} rows={n_rows} cols={N_COLS} "
+        f"W={W} s={S} collect={COLLECT} rounds={ROUNDS}",
+        file=sys.stderr,
+    )
+    data = generate_gmm(n_rows, N_COLS, n_partitions=W, seed=0)
+
+    t0 = time.perf_counter()
+    result = trainer.train(cfg, data)  # compiles, then times the scan
+    total = time.perf_counter() - t0
+
+    steps_per_sec = result.steps_per_sec
+    # reference-protocol effective rate on the identical straggler schedule
+    ref_steps_per_sec = ROUNDS / result.sim_total_time
+
+    print(
+        f"bench: wall(total incl. compile)={total:.1f}s scan={result.wall_time:.3f}s "
+        f"sim_total={result.sim_total_time:.1f}s "
+        f"ref_rate={ref_steps_per_sec:.3f} it/s ours={steps_per_sec:.1f} it/s",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "AGC_logistic_steps_per_sec_30w_s2_collect15",
+                "value": round(float(steps_per_sec), 3),
+                "unit": "iterations/sec",
+                "vs_baseline": round(float(steps_per_sec / ref_steps_per_sec), 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

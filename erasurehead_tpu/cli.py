@@ -1,0 +1,247 @@
+"""Command-line entry point.
+
+Two invocation forms:
+
+1. **Named flags** (the native form)::
+
+       python -m erasurehead_tpu.cli --scheme approx --workers 30 \\
+           --stragglers 3 --num-collect 15 --rounds 100 --dataset artificial \\
+           --rows 4096 --cols 100 --update-rule AGD --add-delay
+
+2. **Legacy positional** — the reference's 13-argument calling convention
+   (main.py:20-27), accepted verbatim so reference launch scripts translate
+   mechanically (mpirun disappears; n_procs keeps its master+workers
+   meaning)::
+
+       python -m erasurehead_tpu.cli n_procs n_rows n_cols input_dir is_real \\
+           dataset is_coded n_stragglers partitions coded_ver num_collect \\
+           add_delay update_rule
+
+   Dispatch parity (main.py:62-92): is_coded=0 -> naive; coded_ver 0 ->
+   cyclic MDS (partial if partitions>0), 1 -> FRC (partial if partitions>0),
+   2 -> avoidstragg, 3 -> AGC; dataset "kc_house_data" selects the linear
+   model (main.py:75-78,83-92).
+
+Run flow: load or generate the dataset, train on the device mesh, replay the
+eval, write the five artifacts into ``<input_dir>/.../results/`` (the
+reference's layout, src/naive.py:200-208).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+from erasurehead_tpu.data import io as data_io
+from erasurehead_tpu.data.synthetic import Dataset, generate_gmm
+from erasurehead_tpu.parallel.backend import initialize_distributed
+from erasurehead_tpu.train import artifacts, evaluate, trainer
+from erasurehead_tpu.utils.config import ModelKind, RunConfig, Scheme
+
+
+def _legacy_to_config(argv: list[str]) -> RunConfig:
+    """Map the reference's 13 positional args onto a RunConfig."""
+    (
+        n_procs, n_rows, n_cols, input_dir, is_real, dataset, is_coded,
+        n_stragglers, partitions, coded_ver, num_collect, add_delay,
+        update_rule,
+    ) = argv
+    n_procs, n_rows, n_cols = int(n_procs), int(n_rows), int(n_cols)
+    is_real, is_coded = int(is_real), int(is_coded)
+    n_stragglers, partitions, coded_ver = (
+        int(n_stragglers), int(partitions), int(coded_ver),
+    )
+    num_collect, add_delay = int(num_collect), int(add_delay)
+
+    if not is_coded:
+        scheme = Scheme.NAIVE
+    elif partitions:
+        table = {1: Scheme.PARTIAL_FRC, 0: Scheme.PARTIAL_CYCLIC}
+        if coded_ver not in table:
+            raise SystemExit(
+                f"coded_ver={coded_ver} invalid with partitions>0 "
+                f"(0=partial coded, 1=partial replication; main.py:64-68)"
+            )
+        scheme = table[coded_ver]
+    else:
+        table = {
+            0: Scheme.CYCLIC_MDS,
+            1: Scheme.FRC,
+            2: Scheme.AVOID_STRAGGLERS,
+            3: Scheme.APPROX,
+        }
+        if coded_ver not in table:
+            raise SystemExit(
+                f"coded_ver={coded_ver} invalid (0=cyclic MDS, 1=FRC, "
+                f"2=avoidstragg, 3=AGC; main.py:70-87)"
+            )
+        scheme = table[coded_ver]
+    model = (
+        ModelKind.LINEAR if dataset == "kc_house_data" else ModelKind.LOGISTIC
+    )
+    return RunConfig(
+        scheme=scheme,
+        model=model,
+        n_workers=n_procs - 1,  # reference: rank 0 is the master
+        n_stragglers=n_stragglers,
+        num_collect=num_collect if num_collect > 0 else None,
+        add_delay=bool(add_delay),
+        update_rule=update_rule,
+        dataset=dataset if is_real else "artificial",
+        n_rows=n_rows,
+        n_cols=n_cols,
+        input_dir=input_dir,
+        is_real_data=bool(is_real),
+        partitions_per_worker=partitions,
+    )
+
+
+def _flags_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="erasurehead-tpu",
+        description="Straggler-tolerant coded gradient descent on TPU",
+    )
+    p.add_argument("--scheme", default="naive", choices=[s.value for s in Scheme])
+    p.add_argument("--model", default=None, choices=[m.value for m in ModelKind])
+    p.add_argument("--workers", type=int, default=8)
+    p.add_argument("--stragglers", type=int, default=1)
+    p.add_argument("--num-collect", type=int, default=None)
+    p.add_argument("--rounds", type=int, default=100)
+    p.add_argument("--dataset", default="artificial")
+    p.add_argument("--rows", type=int, default=4096)
+    p.add_argument("--cols", type=int, default=100)
+    p.add_argument("--input-dir", default=None, help="reference-layout data dir")
+    p.add_argument("--output-dir", default=None, help="artifact dir (default <input>/results)")
+    p.add_argument("--update-rule", default="AGD", choices=["GD", "AGD"])
+    p.add_argument("--lr", type=float, default=None, help="constant lr override")
+    p.add_argument("--alpha", type=float, default=None, help="l2 coefficient")
+    p.add_argument("--add-delay", action="store_true")
+    p.add_argument("--delay-mean", type=float, default=0.5)
+    p.add_argument("--partitions-per-worker", type=int, default=0)
+    p.add_argument("--compute-mode", default="faithful", choices=["faithful", "deduped"])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--quiet", action="store_true")
+    return p
+
+
+def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
+    model = ns.model
+    if model is None:
+        model = (
+            ModelKind.LINEAR
+            if ns.dataset == "kc_house_data"
+            else ModelKind.LOGISTIC
+        )
+    return RunConfig(
+        scheme=ns.scheme,
+        model=model,
+        n_workers=ns.workers,
+        n_stragglers=ns.stragglers,
+        num_collect=ns.num_collect,
+        rounds=ns.rounds,
+        add_delay=ns.add_delay,
+        delay_mean=ns.delay_mean,
+        update_rule=ns.update_rule,
+        alpha=ns.alpha,
+        lr_schedule=ns.lr,
+        dataset=ns.dataset,
+        n_rows=ns.rows,
+        n_cols=ns.cols,
+        input_dir=ns.input_dir,
+        is_real_data=ns.input_dir is not None and ns.dataset != "artificial",
+        partitions_per_worker=ns.partitions_per_worker,
+        compute_mode=ns.compute_mode,
+        seed=ns.seed,
+    )
+
+
+def dataset_dir(cfg: RunConfig) -> str | None:
+    """The reference's on-disk dataset directory for this config
+    (path synthesis: main.py:59-60, generate_data.py:59-62)."""
+    if not cfg.input_dir:
+        return None
+    sub = (
+        cfg.dataset
+        if cfg.is_real_data
+        else f"artificial-data/{cfg.n_rows}x{cfg.n_cols}"
+    )
+    leaf = (
+        str(cfg.n_workers)
+        if not cfg.partitions_per_worker
+        else f"partial/{(cfg.partitions_per_worker - cfg.n_stragglers) * cfg.n_workers}"
+    )
+    return os.path.join(cfg.input_dir, sub, leaf)
+
+
+def load_dataset(cfg: RunConfig) -> Dataset:
+    """Reference-layout directory if present, else in-memory synthetic.
+
+    A real-data config whose directory is missing is an error — silently
+    training on synthetic noise and labeling the artifacts as the real
+    dataset would be worse than failing."""
+    n_partitions = (
+        cfg.n_workers
+        if not cfg.partitions_per_worker
+        else (cfg.partitions_per_worker - cfg.n_stragglers) * cfg.n_workers
+    )
+    path = dataset_dir(cfg)
+    if path is not None and os.path.isdir(path):
+        return data_io.read_reference_layout(
+            path, n_partitions, sparse=cfg.is_real_data
+        )
+    if cfg.is_real_data:
+        raise FileNotFoundError(
+            f"real dataset {cfg.dataset!r} not found at {path!r}; prepare it "
+            f"with erasurehead_tpu.data.real / data_io.write_reference_layout"
+        )
+    if cfg.model == ModelKind.LINEAR:
+        from erasurehead_tpu.data.synthetic import generate_linear
+
+        return generate_linear(cfg.n_rows, cfg.n_cols, n_partitions, cfg.seed)
+    return generate_gmm(cfg.n_rows, cfg.n_cols, n_partitions, cfg.seed)
+
+
+def run(cfg: RunConfig, output_dir: str | None = None, quiet: bool = False):
+    initialize_distributed()
+    dataset = load_dataset(cfg)
+    result = trainer.train(cfg, dataset)
+    model = trainer.build_model(cfg)
+    n = result.n_train
+    ev = evaluate.replay(
+        model,
+        cfg.model,
+        result.params_history,
+        dataset.X_train[:n],
+        dataset.y_train[:n],
+        dataset.X_test,
+        dataset.y_test,
+    )
+    if output_dir is None:
+        # reference parity: results live beside the dataset,
+        # <input_dir>/<dataset>/<W>/results/ (src/naive.py:200-202)
+        base = dataset_dir(cfg) or "."
+        output_dir = os.path.join(base, "results")
+    paths = artifacts.write_run_artifacts(result, ev, output_dir)
+    if not quiet:
+        artifacts.print_iteration_table(result, ev)
+        print(f"artifacts -> {output_dir}")
+    return result, ev, paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) == 13 and not argv[0].startswith("-"):
+        cfg = _legacy_to_config(argv)
+        run(cfg)
+        return 0
+    ns = _flags_parser().parse_args(argv)
+    cfg = _flags_to_config(ns)
+    run(cfg, output_dir=ns.output_dir, quiet=ns.quiet)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,20 +115,33 @@ class CodingLayout:
                     E[w, self.assignment[w, s]] += self.coeffs[w, s]
         return E
 
-    def partition_weights(self, slot_weights: jnp.ndarray) -> jnp.ndarray:
-        """Fold per-(worker, slot) decode weights onto per-partition weights.
+    def fold_slot_weights(self, slot_weights: np.ndarray) -> np.ndarray:
+        """Fold FINAL per-slot weights [..., W, S] onto per-partition weights.
 
-        Given ``slot_weights`` [W, S] (the multiplier applied to each slot's
-        partial gradient by the master's decode), returns ``p_w`` [n_partitions]
-        such that the decoded gradient equals ``sum_p p_w[p] * grad_p``. This is
-        what makes the *deduplicated* compute mode possible: instead of every
-        worker redundantly computing its (s+1) partition gradients, each
-        partition gradient is computed once and combined with these weights —
-        numerically identical to decode-of-messages, with 1/(s+1) the FLOPs.
+        ``slot_weights`` must already include the coding coefficients — it is
+        the output of ``parallel.step.expand_slot_weights`` (the single home
+        of the coded/separate weighting rule). Returns ``p_w``
+        [..., n_partitions] such that the decoded gradient equals
+        ``sum_p p_w[p] * grad_p``. This is what makes the *deduplicated*
+        compute mode possible: instead of every worker redundantly computing
+        its (s+1) partition gradients, each partition gradient is computed
+        once and combined with these weights — numerically identical to
+        decode-of-messages, with 1/(s+1) the FLOPs. Host-side float64 numpy,
+        arbitrary leading batch dims (e.g. rounds).
         """
-        flat_idx = jnp.asarray(self.assignment.reshape(-1))
-        flat_wgt = (slot_weights * jnp.asarray(self.coeffs)).reshape(-1)
-        return jnp.zeros(self.n_partitions, flat_wgt.dtype).at[flat_idx].add(flat_wgt)
+        slot_weights = np.asarray(slot_weights)
+        lead = slot_weights.shape[:-2]
+        flat = slot_weights.reshape(*lead, -1)  # [..., W*S]
+        out = np.zeros((*lead, self.n_partitions))
+        np.add.at(
+            out.reshape(-1, self.n_partitions),
+            (
+                np.arange(int(np.prod(lead)) or 1)[:, None],
+                self.assignment.reshape(-1)[None, :],
+            ),
+            flat.reshape(-1, flat.shape[-1]),
+        )
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -121,18 +121,14 @@ def collect_first_k_mds(
 
 def collect_frc(t: np.ndarray, groups: np.ndarray) -> CollectionSchedule:
     """Fractional repetition: wait until every group has reported once; use
-    each group's first arrival, ignore (but stamp) later arrivals processed
-    before the loop exits (src/replication.py:143-155)."""
-    win = _group_winners(t, groups)
-    # the loop exits when the slowest group's first member arrives
-    stop = np.where(win, t, -np.inf).max(axis=1)
-    collected = t <= stop[:, None]
-    return CollectionSchedule(
-        message_weights=win.astype(np.float64),
-        sim_time=stop,
-        worker_times=_stamp(t, collected),
-        collected=collected,
-    )
+    each group's first arrival, ignore (but stamp) earlier-processed
+    non-first arrivals (src/replication.py:143-155).
+
+    Implemented as AGC with an unreachable worker quota: the stop condition
+    degenerates to "all groups covered", giving identical event-order
+    semantics (including deterministic tie-breaking by worker index when
+    arrivals tie, e.g. with delays disabled)."""
+    return collect_agc(t, groups, num_collect=t.shape[1] + 1)
 
 
 def collect_agc(
@@ -207,23 +203,45 @@ def collect_partial(
     n_sep = int((~layout.slot_is_coded).sum())
     frac = n_sep / layout.n_slots
     t_first, t_second = frac * t, t
+    # Event-based replay of the two-message Waitany loop: 2W events per round
+    # (each worker's uncoded part at t_first, coded part at t_second),
+    # processed in ascending (time, part, worker) order — deterministic under
+    # ties (delays disabled). The loop exits at the first event satisfying
+    # BOTH stop conditions; coded parts processed by then join the decode.
+    n_groups = layout.n_groups
+    completed = np.zeros((R, W), dtype=bool)
+    stop = np.empty(R)
+    for r in range(R):
+        times = np.concatenate([t_first[r], t_second[r]])  # first W = uncoded
+        order = np.lexsort((np.arange(2 * W), times))
+        cnt_first = cnt_second = 0
+        covered = np.zeros(n_groups, dtype=bool)
+        for ev in order:
+            w = ev % W
+            if ev < W:
+                cnt_first += 1
+            else:
+                cnt_second += 1
+                completed[r, w] = True
+                if layout.groups is not None:
+                    covered[layout.groups[w]] = True
+            second_ok = (
+                cnt_second >= W - s
+                if variant == "mds"
+                else covered.all()  # one coded part per group (partial FRC)
+            )
+            if cnt_first >= W and second_ok:
+                stop[r] = times[ev]
+                break
     if variant == "mds":
-        ranks = _rank(t_second)
-        kth_time = np.where(ranks == W - s - 1, t_second, -np.inf).max(axis=1)
-        stop = np.maximum(t_first.max(axis=1), kth_time)
-        # every coded part that arrived by the time the loop exits joins the
-        # decode (the reference solves over all of completed_workers,
-        # src/partial_coded.py:192-193 — possibly more than W-s rows)
-        completed = t_second <= stop[:, None]
+        # the reference solves over ALL completed coded parts at loop exit
+        # (src/partial_coded.py:192-193 — possibly more than W-s rows)
         weights = codes.mds_decode_weights_host(layout.B, completed)
     elif variant == "frc":
-        win = _group_winners(t_second, layout.groups)
-        group_cover = np.where(win, t_second, -np.inf).max(axis=1)
-        stop = np.maximum(t_first.max(axis=1), group_cover)
-        completed = t_second <= stop[:, None]
         # only each group's first coded arrival is summed
         # (src/partial_replication.py:173-180)
-        weights = win.astype(np.float64)
+        win = _group_winners(t_second, layout.groups)
+        weights = (win & completed).astype(np.float64)
     else:
         raise ValueError(f"unknown partial variant {variant!r}")
     # reference worker_timeset: stamped per message, then overwritten with -1

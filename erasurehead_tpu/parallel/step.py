@@ -85,7 +85,7 @@ def make_faithful_grad_fn(model, mesh: Mesh) -> GradFn:
 
 def make_deduped_grad_fn(model, mesh: Mesh) -> GradFn:
     """Each partition gradient is computed exactly once, then combined with
-    folded decode weights (CodingLayout.partition_weights).
+    folded decode weights (CodingLayout.fold_slot_weights).
 
     No reference counterpart (the dedup is this framework's optimization);
     produces bit-comparable gradients to the faithful mode — tests pin the

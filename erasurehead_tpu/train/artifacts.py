@@ -34,16 +34,34 @@ from erasurehead_tpu.train.evaluate import EvalResult
 from erasurehead_tpu.train.trainer import TrainResult
 from erasurehead_tpu.utils.config import RunConfig
 
-#: scheme -> artifact filename prefix (reference names, bugs fixed)
+#: scheme -> artifact filename stem, matching the reference's conventions
+#: (src/naive.py:203-208 "naive_acc", src/coded.py:250-254 "coded_acc_%d",
+#: src/replication.py "replication_acc_%d", src/avoidstragg.py
+#: "avoidstragg_acc_%d", partial schemes "<name>_%d_%d") with its two filename
+#: bugs fixed: AGC gets its own "approx_acc" stem instead of clobbering
+#: replication's (src/approximate_coding.py:259-263), and partial-coded's
+#: training loss no longer carries the partialreplication stem
+#: (src/partial_coded.py:286).
 SCHEME_PREFIX = {
-    "naive": "naive",
-    "cyccoded": "coded",
-    "repcoded": "replication",
-    "approx": "approx",
-    "avoidstragg": "avoidstragg",
+    "naive": "naive_acc",
+    "cyccoded": "coded_acc",
+    "repcoded": "replication_acc",
+    "approx": "approx_acc",
+    "avoidstragg": "avoidstragg_acc",
     "partialcyccoded": "partialcoded",
     "partialrepcoded": "partialreplication",
 }
+
+
+def run_prefix(cfg: RunConfig) -> str:
+    """Reference filename prefix: naive has no straggler suffix, partial
+    schemes carry <s>_<partitions>, the rest carry <s>."""
+    stem = SCHEME_PREFIX[cfg.scheme.value]
+    if cfg.scheme.value == "naive":
+        return stem
+    if cfg.scheme.value in ("partialcyccoded", "partialrepcoded"):
+        return f"{stem}_{cfg.n_stragglers}_{cfg.partitions_per_worker}"
+    return f"{stem}_{cfg.n_stragglers}"
 
 
 def save_vector(v: np.ndarray, path: str) -> None:
@@ -63,7 +81,7 @@ def write_run_artifacts(
 ) -> dict:
     """Write the five reference artifacts + manifest; returns paths."""
     cfg: RunConfig = result.config
-    prefix = f"{SCHEME_PREFIX[cfg.scheme.value]}_{cfg.n_stragglers}"
+    prefix = run_prefix(cfg)
     os.makedirs(output_dir, exist_ok=True)
     paths = {}
 

@@ -43,7 +43,22 @@ def replay(
     X_test,
     y_test,
 ) -> EvalResult:
-    """Loss (and AUC for classifiers) of every iterate in the history."""
+    """Loss (and AUC for classifiers) of every iterate in the history.
+
+    Accepts dense ndarrays or scipy sparse matrices; the latter are converted
+    to the TPU-native PaddedRows format here so callers can pass a Dataset's
+    matrices straight through.
+    """
+    import scipy.sparse as sps
+
+    from erasurehead_tpu.ops.features import PaddedRows
+
+    if sps.issparse(X_train):
+        X_train = PaddedRows.from_scipy(X_train)
+    if sps.issparse(X_test):
+        X_test = PaddedRows.from_scipy(X_test)
+    y_train = jnp.asarray(np.asarray(y_train, np.float32))
+    y_test = jnp.asarray(np.asarray(y_test, np.float32))
     is_regression = ModelKind(model_kind) == ModelKind.LINEAR
 
     def one(carry, params):

@@ -87,24 +87,6 @@ class TrainResult:
     layout: codes.CodingLayout = None
 
 
-def _partition_weight_matrix(
-    layout: codes.CodingLayout, slot_weights: np.ndarray
-) -> np.ndarray:
-    """Fold final per-round per-slot weights [R, W, S] (coding coefficients
-    already applied by expand_slot_weights — the single home of the
-    coded/separate rule) into per-partition weights [R, P] for the deduped
-    step."""
-    R = slot_weights.shape[0]
-    out = np.zeros((R, layout.n_partitions))
-    flat_idx = layout.assignment.reshape(-1)  # [W*S]
-    np.add.at(
-        out,
-        (np.arange(R)[:, None], flat_idx[None, :]),
-        slot_weights.reshape(R, -1),
-    )
-    return out
-
-
 def train(
     cfg: RunConfig,
     dataset: Dataset,
@@ -152,7 +134,7 @@ def train(
         weights_seq, X, y = jnp.asarray(slot_w, dtype), data.Xw, data.yw
     else:
         grad_fn = step_lib.make_deduped_grad_fn(model, mesh)
-        pw = _partition_weight_matrix(layout, slot_w)
+        pw = layout.fold_slot_weights(slot_w)
         weights_seq, X, y = jnp.asarray(pw, dtype), data.Xp, data.yp
 
     update_fn = optimizer.make_update_fn(cfg.update_rule)

@@ -48,7 +48,7 @@ class ComputeMode(str, enum.Enum):
 
     DEDUPED computes each partition gradient exactly once and folds the
     decode x coding coefficients into per-partition weights
-    (CodingLayout.partition_weights) — numerically identical decoded gradient
+    (CodingLayout.fold_slot_weights) — numerically identical decoded gradient
     at 1/(s+1) the FLOPs. This mode has no reference counterpart; it exists
     because on a lockstep SPMD machine redundant compute buys nothing unless
     you are modeling per-chip failures.

@@ -220,13 +220,14 @@ def test_partition_weights_equal_message_decode(make):
     W, S = lay.assignment.shape
     rng = np.random.default_rng(4)
     G = rng.standard_normal((lay.n_partitions, 3))
-    slot_w = rng.standard_normal((W, S))  # arbitrary decode weights
-    # message-space decode
-    per_slot = lay.coeffs * slot_w
-    decoded = np.zeros(3)
-    for w in range(W):
-        for s_ in range(S):
-            decoded += per_slot[w, s_] * G[lay.assignment[w, s_]]
-    # partition-space decode
-    pw = np.asarray(lay.partition_weights(jnp.asarray(slot_w)))
-    assert np.allclose(pw @ G, decoded, atol=1e-4)
+    slot_w = rng.standard_normal((2, W, S))  # FINAL weights, 2 "rounds"
+    # message-space decode per round
+    decoded = np.zeros((2, 3))
+    for r in range(2):
+        for w in range(W):
+            for s_ in range(S):
+                decoded[r] += slot_w[r, w, s_] * G[lay.assignment[w, s_]]
+    # partition-space decode (batched host fold)
+    pw = lay.fold_slot_weights(slot_w)
+    assert pw.shape == (2, lay.n_partitions)
+    assert np.allclose(pw @ G, decoded, atol=1e-10)
