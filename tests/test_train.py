@@ -229,3 +229,17 @@ def test_sim_time_ordering(gmm):
     assert agc.sim_total_time < naive.sim_total_time
     # per-round: kth order statistic <= max
     assert (agc.timeset <= naive.timeset + 1e-12).all()
+
+
+def test_avoidstragg_sim_clock_beats_naive(gmm):
+    """Regression: avoidstragg must stop at the first W-s arrivals — its
+    simulated clock (kth order statistic) strictly beats naive's max under
+    the shared schedule (bug: layout carried n_stragglers=0)."""
+    naive = trainer.train(_cfg(rounds=20), gmm)
+    av = trainer.train(
+        _cfg(scheme=Scheme.AVOID_STRAGGLERS, n_stragglers=2, rounds=20,
+             update_rule="AGD"),
+        gmm,
+    )
+    assert av.sim_total_time < naive.sim_total_time
+    assert (av.collected.sum(axis=1) == W - 2).all()
