@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Dataset preparation — the TPU equivalent of the reference's data_prepare.sh
+# (data_prepare.sh:23): featurize a real dataset and write the partitioned
+# reference on-disk layout so runs can load per-worker shards.
+#
+# Usage: bash data_prepare.sh [dataset] [source_dir] [n_workers]
+set -euo pipefail
+
+DATASET="${1:-kc_house_data}"
+SOURCE="${2:-./straggdata/raw}"
+N_WORKERS="${3:-30}"
+OUT=./straggdata
+
+exec python -m erasurehead_tpu.data.prepare real \
+  --dataset "$DATASET" --source "$SOURCE" --workers "$N_WORKERS" --out "$OUT"

@@ -161,6 +161,128 @@ def straggler_sweep(
     return compare(configs, dataset, **compare_kw)
 
 
+def baseline_suite(
+    scale: float = 1.0,
+    data_dir: Optional[str] = None,
+    rounds: int = 100,
+) -> dict[str, list[RunSummary]]:
+    """Reproduce the five BASELINE.json comparison configs.
+
+    Real datasets (covtype / amazon / kc_house) are used when prepared under
+    ``data_dir`` in the reference layout; otherwise each config falls back to
+    a synthetic stand-in of the same structure (GMM for logistic tasks,
+    linear-model data for least-squares) at ``scale`` x a canonical size, and
+    the suite labels record the substitution. Returns {config_name: summaries}.
+    """
+    from erasurehead_tpu.data.synthetic import generate_gmm, generate_linear
+    from erasurehead_tpu.utils.config import ModelKind
+
+    def _rows(rows, parts):
+        n = max(parts * 8, int(rows * scale))
+        return parts * max(1, round(n / parts))  # multiple of n_partitions
+
+    _cache: dict = {}
+
+    def get_data(name, parts, fallback):
+        """Prepared real dataset if present under data_dir, else a synthetic
+        stand-in of the same structure. Memoized per (name, parts)."""
+        key = (name, parts)
+        if key in _cache:
+            return _cache[key]
+        if data_dir is not None:
+            import os
+
+            from erasurehead_tpu.data import io as data_io
+
+            path = os.path.join(data_dir, name, str(parts))
+            if os.path.isdir(path):
+                ds = data_io.read_reference_layout(path, parts, sparse=True)
+                _cache[key] = (ds, name)
+                return _cache[key]
+        rows, cols = fallback
+        maker = (
+            generate_linear if name in ("kc_house_data", "synthetic-linear")
+            else generate_gmm
+        )
+        ds = maker(_rows(rows, parts), cols, parts, seed=0)
+        _cache[key] = (ds, f"synthetic({name}-shaped)")
+        return _cache[key]
+
+    def preset_cfg(dataset_name, ds, **kw):
+        """Config carrying the dataset's reference lr preset (main.py:37-46)
+        and alpha = 1/n_train for the data actually in use — the stand-in
+        keeps the real dataset's schedule but its own row count."""
+        n_train = ds.X_train.shape[0]
+        return RunConfig.for_dataset(
+            dataset_name, rounds=rounds, add_delay=True,
+            **{"n_rows": n_train, "n_cols": ds.X_train.shape[1], **kw},
+        )
+
+    out: dict[str, list[RunSummary]] = {}
+
+    # 1. Logistic on covtype, uncoded, 8 workers (BASELINE.json configs[0])
+    W = 8
+    ds, src = get_data("covtype", W, (2048, 64))
+    cfg = preset_cfg(
+        "covtype", ds, scheme="naive", n_workers=W, n_stragglers=0,
+        update_rule="GD",
+    )
+    out[f"1_naive_covtype[{src}]"] = compare({"naive": cfg}, ds)
+
+    # 2. Logistic on amazon, exact cyclic-MDS coding, s=2 (configs[1])
+    ds, src = get_data("amazon", W, (2048, 64))
+    cfg = preset_cfg(
+        "amazon", ds, scheme="cyccoded", n_workers=W, n_stragglers=2,
+        update_rule="AGD",
+    )
+    out[f"2_egc_amazon[{src}]"] = compare({"cyccoded_s2": cfg}, ds)
+
+    # 3. Least-squares on kc_house, AGC with num_collect=N-3 (configs[2])
+    W3 = 9  # AGC needs (s+1) | W
+    ds, src = get_data("kc_house_data", W3, (2048, 64))
+    cfg = preset_cfg(
+        "kc_house_data", ds, scheme="approx", model=ModelKind.LINEAR,
+        n_workers=W3, n_stragglers=2, num_collect=W3 - 3, update_rule="AGD",
+    )
+    out[f"3_agc_kc_house[{src}]"] = compare({"agc_collect_N-3": cfg}, ds)
+
+    # 4. Synthetic: partial_replication vs avoidstragg over n_stragglers
+    #    (configs[3]) — partial and plain schemes need different partition
+    #    counts, so run per-config compares sharing one arrival schedule,
+    #    then re-anchor time_to_target on one shared loss target.
+    W4 = 12
+    arr = straggler.arrival_schedule(rounds, W4, add_delay=True, mean=0.5)
+    sweep: list[RunSummary] = []
+    for s in (1, 2, 3):
+        for scheme, ppw in (
+            ("avoidstragg", 0),
+            # ppw = n_separate(2 unique) + (s+1) replicated slots
+            ("partialrepcoded", s + 3),
+        ):
+            parts = (ppw - s) * W4 if ppw else W4
+            d, _ = get_data("artificial", parts, (2048, 64))
+            c = preset_cfg(
+                "artificial", d, scheme=scheme, n_workers=W4, n_stragglers=s,
+                update_rule="AGD", partitions_per_worker=ppw,
+            )
+            sweep.extend(compare({f"{scheme}_s{s}": c}, d, arrivals=arr))
+    shared_target = 1.05 * min(s.final_train_loss for s in sweep)
+    for s in sweep:
+        s.time_to_target = time_to_target_loss(
+            s.training_loss, s.timeset, shared_target
+        )
+    out["4_partialrep_vs_avoidstragg_sweep"] = sweep
+
+    # 5. 2-layer MLP on covtype-shaped data, AGC, wide mesh (configs[4])
+    ds, src = get_data("covtype", W, (2048, 64))
+    cfg = preset_cfg(
+        "covtype", ds, scheme="approx", model=ModelKind.MLP, n_workers=W,
+        n_stragglers=1, num_collect=W - 2, update_rule="GD",
+    )
+    out[f"5_mlp_agc[{src}]"] = compare({"mlp_agc": cfg}, ds)
+    return out
+
+
 def save_summaries(summaries: list[RunSummary], path: str) -> None:
     with open(path, "w") as f:
         json.dump([s.row() for s in summaries], f, indent=2)
@@ -185,3 +307,33 @@ def format_table(summaries: list[RunSummary]) -> str:
             f"{auc} {ttt}"
         )
     return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """`make compare` / `python -m erasurehead_tpu.train.experiments`:
+    run the BASELINE.json suite (scaled down by default) and print tables."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="erasurehead-tpu-experiments")
+    p.add_argument("--scale", type=float, default=1.0)
+    p.add_argument("--rounds", type=int, default=30)
+    p.add_argument("--data-dir", default=None, help="prepared real data root")
+    p.add_argument("--out", default=None, help="write summaries JSON here")
+    ns = p.parse_args(argv)
+
+    suite = baseline_suite(scale=ns.scale, data_dir=ns.data_dir, rounds=ns.rounds)
+    all_rows: list[RunSummary] = []
+    for name, summaries in suite.items():
+        print(f"\n== {name} ==")
+        print(format_table(summaries))
+        all_rows.extend(summaries)
+    if ns.out:
+        save_summaries(all_rows, ns.out)
+        print(f"\nsummaries -> {ns.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -80,6 +80,10 @@ DATASET_PRESETS = {
     "dna": dict(lr=("constant", 0.1), n_rows=400000, n_cols=6890, model=ModelKind.LOGISTIC),
     "artificial": dict(lr=("constant", 10.0), n_rows=4096, n_cols=100, model=ModelKind.LOGISTIC),
 }
+# the reference's on-disk directory names for the same datasets
+# (arrange_real_data.py:34,93): accepted everywhere a dataset name is
+DATASET_PRESETS["amazon-dataset"] = DATASET_PRESETS["amazon"]
+DATASET_PRESETS["dna-dataset"] = DATASET_PRESETS["dna"]
 
 
 @dataclasses.dataclass
