@@ -38,7 +38,7 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 .PHONY: naive cyccoded repcoded avoidstragg approxcoded \
 	partialrepcoded partialcyccoded \
 	generate_random_data arrange_real_data \
-	test bench compare dryrun native clean
+	test bench compare dryrun clean
 
 naive:            ## uncoded wait-for-all baseline (src/naive.py)
 	$(RUN) --scheme naive
@@ -82,8 +82,5 @@ dryrun:           ## validate the multi-chip sharding on a virtual 8-device CPU 
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 		$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-native:           ## build the C++ fast data loader (optional; numpy fallback exists)
-	$(MAKE) -C erasurehead_tpu/data/native
-
 clean:
-	rm -rf erasurehead_tpu/data/native/*.so build/ $(DATA_DIR)/artificial-data
+	rm -rf build/ $(DATA_DIR)/artificial-data

@@ -188,14 +188,7 @@ def load_dataset(cfg: RunConfig) -> Dataset:
         else (cfg.partitions_per_worker - cfg.n_stragglers) * cfg.n_workers
     )
     path = dataset_dir(cfg)
-    # presence of partition 1, not just the directory — writing artifacts
-    # into <dir>/results/ must not flip later runs from in-memory synthetic
-    # to a doomed disk load
-    has_parts = path is not None and (
-        os.path.exists(os.path.join(path, "1.dat"))
-        or os.path.exists(os.path.join(path, "1.npz"))
-    )
-    if has_parts:
+    if data_io.has_reference_layout(path):
         return data_io.read_reference_layout(
             path, n_partitions, sparse=cfg.is_real_data
         )

@@ -84,6 +84,18 @@ def write_reference_layout(
     save_dense_text(os.path.join(out_dir, "label_test.dat"), dataset.y_test)
 
 
+def has_reference_layout(path: str | None) -> bool:
+    """True iff ``path`` holds at least partition 1 of a reference layout.
+
+    Checking for the partition file, not just the directory: artifact
+    writes create ``<dir>/results/`` and must not make a dataset dir look
+    loadable."""
+    return path is not None and (
+        os.path.exists(os.path.join(path, "1.dat"))
+        or os.path.exists(os.path.join(path, "1.npz"))
+    )
+
+
 def read_reference_layout(in_dir: str, n_partitions: int, sparse: bool) -> Dataset:
     """Load a reference-layout directory back into a Dataset."""
     parts = []

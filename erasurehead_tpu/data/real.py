@@ -179,7 +179,8 @@ PREPARERS: dict[str, Callable[..., Dataset]] = {
     "amazon": prepare_amazon,
     "amazon-dataset": prepare_amazon,  # the reference's directory name
     "dna": prepare_dna,
-    "dna-dataset/dna": prepare_dna,
+    "dna-dataset": prepare_dna,
+    "dna-dataset/dna": prepare_dna,  # the reference's nested directory name
     "covtype": prepare_covtype,
     "kc_house_data": prepare_kc_house,
 }

@@ -195,7 +195,7 @@ def baseline_suite(
             from erasurehead_tpu.data import io as data_io
 
             path = os.path.join(data_dir, name, str(parts))
-            if os.path.isdir(path):
+            if data_io.has_reference_layout(path):
                 ds = data_io.read_reference_layout(path, parts, sparse=True)
                 _cache[key] = (ds, name)
                 return _cache[key]

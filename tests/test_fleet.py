@@ -1,0 +1,116 @@
+"""tools/tpu_fleet.py: command construction (dry-run) and inventory parsing.
+
+The fleet controller replaces the reference's EC2 lifecycle tool
+(tools/pytorch_ec2.py:935-948); these tests pin the gcloud command surface
+and the get_hosts inventory format (pytorch_ec2.py:689-702 analogue) without
+any network access.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import tpu_fleet  # noqa: E402
+
+
+def make_fleet(**kw):
+    return tpu_fleet.Fleet(
+        name="eh", zone="us-central2-b", dry_run=True, **kw
+    )
+
+
+def test_launch_command_shape(capsys):
+    f = make_fleet(accelerator_type="v4-32", spot=True)
+    f.launch()
+    assert f.log == [
+        "gcloud compute tpus tpu-vm create eh --accelerator-type=v4-32 "
+        "--version=tpu-ubuntu2204-base --spot --zone=us-central2-b"
+    ]
+
+
+def test_project_flag_appended():
+    f = make_fleet(project="my-proj")
+    f.shutdown()
+    assert f.log[0].endswith("--zone=us-central2-b --project=my-proj")
+    assert "delete eh --quiet" in f.log[0]
+
+
+def test_run_command_fans_out_to_all_workers():
+    f = make_fleet()
+    f.run_command("echo hi")
+    assert "ssh eh --worker=all" in f.log[0]
+    assert "--command=echo hi" in f.log[0]
+
+
+def test_kill_all_python_is_pkill():
+    f = make_fleet()
+    f.kill_all_python()
+    assert "pkill -9 python" in f.log[0]
+
+
+def test_sync_repo_scp_recurse():
+    f = make_fleet()
+    f.sync_repo("/repo")
+    assert "scp --recurse /repo" in f.log[0]
+    assert "eh:~/erasurehead-tpu" in f.log[0]
+    assert "--worker=all" in f.log[0]
+
+
+def test_launch_run_is_plain_ssh_fanout():
+    """The mpirun replacement: the same command on every host, no hostfile."""
+    f = make_fleet()
+    f.launch_run("python -m erasurehead_tpu.cli --scheme approx")
+    assert "--worker=all" in f.log[0]
+    assert "erasurehead_tpu.cli" in f.log[0]
+
+
+def test_hosts_parses_network_endpoints():
+    f = make_fleet()
+    info = {
+        "state": "READY",
+        "networkEndpoints": [
+            {"ipAddress": "10.0.0.2", "accessConfig": {"externalIp": "34.1.2.3"}},
+            {"ipAddress": "10.0.0.3"},
+        ],
+    }
+    hosts = f.hosts(info)
+    assert hosts == [
+        {"index": 0, "internal_ip": "10.0.0.2", "external_ip": "34.1.2.3"},
+        {"index": 1, "internal_ip": "10.0.0.3", "external_ip": None},
+    ]
+
+
+def test_write_hosts_files_reference_format(tmp_path):
+    """hosts = 'ip alias' lines, hosts_address = bare ips
+    (pytorch_ec2.py:689-702)."""
+    f = make_fleet()
+    info = {
+        "networkEndpoints": [
+            {"ipAddress": "10.0.0.2"},
+            {"ipAddress": "10.0.0.3"},
+        ]
+    }
+    paths = f.write_hosts_files(info, prefix=str(tmp_path))
+    hosts = open(paths[0]).read().splitlines()
+    addrs = open(paths[1]).read().splitlines()
+    assert hosts == ["10.0.0.2 eh-host0", "10.0.0.3 eh-host1"]
+    assert addrs == ["10.0.0.2", "10.0.0.3"]
+
+
+def test_cli_dry_run_end_to_end(capsys):
+    rc = tpu_fleet.main(
+        ["--name", "eh", "--zone", "z", "--dry-run", "run_command", "date"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.startswith("[dry-run] gcloud compute tpus tpu-vm ssh eh")
+
+
+def test_cli_status_dry_run(capsys):
+    rc = tpu_fleet.main(["--name", "eh", "--zone", "z", "--dry-run", "status"])
+    assert rc == 0
+    lines = capsys.readouterr().out.splitlines()
+    json_text = "\n".join(l for l in lines if not l.startswith("[dry-run]"))
+    assert json.loads(json_text) == {"state": None, "hosts": []}

@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""TPU fleet controller — the TPU-native replacement for the reference's EC2
+cluster lifecycle tool (tools/pytorch_ec2.py:935-948: launch / get_hosts /
+shutdown / kill_all_python / run_command / setup_nfs).
+
+The reference provisions EC2 spot instances with boto3, fans ssh commands out
+with paramiko, and writes `hosts` / `hosts_address` inventories consumed by
+``mpirun --hostfile`` (pytorch_ec2.py:656-708). On Cloud TPU none of that
+survives: a TPU pod slice is ONE resource with N host VMs, created/destroyed
+atomically by the `gcloud compute tpus tpu-vm` surface; ssh fan-out is
+``gcloud ... ssh --worker=all``; and there is no hostfile because
+``jax.distributed.initialize`` discovers the pod topology from the TPU
+metadata server — every host just runs the same command (SPMD), which is the
+`launch_run` subcommand here. NFS is likewise unnecessary (no shared
+filesystem requirement: each host loads its own data shard), so `setup_nfs`
+has no equivalent; `sync_repo` covers the code-distribution half of the
+reference's remote_script.sh.
+
+Subcommands (mirroring pytorch_ec2.py's command map):
+
+    launch            create a TPU VM / pod slice (optionally spot/queued)
+    status            describe the slice, print per-host endpoints
+    get_hosts         write hosts / hosts_address inventory files (parity
+                      artifact; jax.distributed does not need them)
+    run_command CMD   run a shell command on every host
+    kill_all_python   pkill -9 python on every host (pytorch_ec2.py:821-835)
+    sync_repo DIR     scp the repo to every host (remote_script.sh parity)
+    setup             install deps on every host (pre_run.sh parity)
+    launch_run CMD    the mpirun replacement: run the training command on
+                      every host simultaneously
+    shutdown          delete the slice
+
+All gcloud interaction is via subprocess; ``--dry-run`` prints the exact
+commands instead of executing them (also the zero-egress test mode).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shlex
+import subprocess
+import sys
+from dataclasses import dataclass, field
+
+DEFAULT_DEPS = "jax[tpu] flax optax orbax-checkpoint scikit-learn pandas"
+
+
+@dataclass
+class Fleet:
+    """One TPU pod slice and how to talk to it."""
+
+    name: str
+    zone: str
+    project: str | None = None
+    accelerator_type: str = "v4-32"
+    version: str = "tpu-ubuntu2204-base"
+    spot: bool = False
+    dry_run: bool = False
+    log: list[str] = field(default_factory=list)
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _gcloud(self, *args: str) -> list[str]:
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", *args, f"--zone={self.zone}"]
+        if self.project:
+            cmd.append(f"--project={self.project}")
+        return cmd
+
+    def _run(self, cmd: list[str], capture: bool = False) -> str:
+        line = " ".join(shlex.quote(c) for c in cmd)
+        self.log.append(line)
+        if self.dry_run:
+            print(f"[dry-run] {line}")
+            return ""
+        try:
+            res = subprocess.run(
+                cmd, check=True, text=True,
+                capture_output=capture,
+            )
+        except FileNotFoundError:
+            raise SystemExit(
+                "gcloud CLI not found — install the Google Cloud SDK or use "
+                "--dry-run to inspect the commands this would run"
+            )
+        return res.stdout if capture else ""
+
+    # -- lifecycle (pytorch_ec2.py:176-258 analogue) ------------------------
+
+    def launch(self) -> None:
+        args = [
+            "create", self.name,
+            f"--accelerator-type={self.accelerator_type}",
+            f"--version={self.version}",
+        ]
+        if self.spot:
+            args.append("--spot")  # preemptible, the reference's spot-request mode
+        self._run(self._gcloud(*args))
+
+    def shutdown(self) -> None:
+        self._run(self._gcloud("delete", self.name, "--quiet"))
+
+    def describe(self) -> dict:
+        out = self._run(
+            self._gcloud("describe", self.name, "--format=json"), capture=True
+        )
+        return json.loads(out) if out else {}
+
+    # -- inventory (pytorch_ec2.py:656-708 analogue) ------------------------
+
+    def hosts(self, info: dict | None = None) -> list[dict]:
+        """Per-host endpoints: [{index, internal_ip, external_ip}, ...]."""
+        info = info if info is not None else self.describe()
+        out = []
+        for idx, ep in enumerate(info.get("networkEndpoints", [])):
+            access = ep.get("accessConfig") or {}
+            out.append(
+                {
+                    "index": idx,
+                    "internal_ip": ep.get("ipAddress"),
+                    "external_ip": access.get("externalIp"),
+                }
+            )
+        return out
+
+    def write_hosts_files(self, info: dict | None = None, prefix: str = ".") -> list[str]:
+        """Write `hosts` (ip alias lines) and `hosts_address` (bare ips) —
+        the reference's inventory artifacts (pytorch_ec2.py:689-702). Kept
+        for operator parity/debugging; jax.distributed needs neither."""
+        hosts = self.hosts(info)
+        paths = [f"{prefix}/hosts", f"{prefix}/hosts_address"]
+        with open(paths[0], "w") as f:
+            for h in hosts:
+                f.write(f"{h['internal_ip']} {self.name}-host{h['index']}\n")
+        with open(paths[1], "w") as f:
+            for h in hosts:
+                f.write(f"{h['internal_ip']}\n")
+        return paths
+
+    # -- fan-out (pytorch_ec2.py:269-310, 821-879 analogue) -----------------
+
+    def run_command(self, command: str, worker: str = "all") -> None:
+        self._run(
+            self._gcloud(
+                "ssh", self.name, f"--worker={worker}", f"--command={command}"
+            )
+        )
+
+    def kill_all_python(self) -> None:
+        self.run_command("pkill -9 python || true")
+
+    def sync_repo(self, local_dir: str, remote_dir: str = "~/erasurehead-tpu") -> None:
+        self._run(
+            self._gcloud(
+                "scp", "--recurse", local_dir,
+                f"{self.name}:{remote_dir}", "--worker=all",
+            )
+        )
+
+    def setup(self, deps: str = DEFAULT_DEPS) -> None:
+        """pre_run.sh parity: per-host dependency install (no conda, no MPI)."""
+        self.run_command(f"pip install --upgrade {deps}")
+
+    def launch_run(self, command: str) -> None:
+        """The `mpirun -np N --hostfile ...` replacement: every host runs the
+        same SPMD command; jax.distributed.initialize() inside the program
+        wires the pod together from TPU metadata (parallel/backend.py)."""
+        self.run_command(command)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpu_fleet",
+        description=__doc__.split("\n\n")[0],
+    )
+    p.add_argument("--name", default="erasurehead")
+    p.add_argument("--zone", default="us-central2-b")
+    p.add_argument("--project", default=None)
+    p.add_argument("--accelerator-type", default="v4-32")
+    p.add_argument("--version", default="tpu-ubuntu2204-base")
+    p.add_argument("--spot", action="store_true")
+    p.add_argument("--dry-run", action="store_true")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("launch")
+    sub.add_parser("status")
+    gh = sub.add_parser("get_hosts")
+    gh.add_argument("--prefix", default=".")
+    rc = sub.add_parser("run_command")
+    rc.add_argument("command")
+    rc.add_argument("--worker", default="all")
+    sub.add_parser("kill_all_python")
+    sr = sub.add_parser("sync_repo")
+    sr.add_argument("local_dir")
+    sr.add_argument("--remote-dir", default="~/erasurehead-tpu")
+    st = sub.add_parser("setup")
+    st.add_argument("--deps", default=DEFAULT_DEPS)
+    lr = sub.add_parser("launch_run")
+    lr.add_argument("command")
+    sub.add_parser("shutdown")
+    ns = p.parse_args(argv)
+
+    fleet = Fleet(
+        name=ns.name, zone=ns.zone, project=ns.project,
+        accelerator_type=ns.accelerator_type, version=ns.version,
+        spot=ns.spot, dry_run=ns.dry_run,
+    )
+    if ns.cmd == "launch":
+        fleet.launch()
+    elif ns.cmd == "status":
+        info = fleet.describe()
+        print(json.dumps({"state": info.get("state"), "hosts": fleet.hosts(info)}, indent=2))
+    elif ns.cmd == "get_hosts":
+        for path in fleet.write_hosts_files(prefix=ns.prefix):
+            print(path)
+    elif ns.cmd == "run_command":
+        fleet.run_command(ns.command, worker=ns.worker)
+    elif ns.cmd == "kill_all_python":
+        fleet.kill_all_python()
+    elif ns.cmd == "sync_repo":
+        fleet.sync_repo(ns.local_dir, ns.remote_dir)
+    elif ns.cmd == "setup":
+        fleet.setup(ns.deps)
+    elif ns.cmd == "launch_run":
+        fleet.launch_run(ns.command)
+    elif ns.cmd == "shutdown":
+        fleet.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
