@@ -189,9 +189,7 @@ def load_dataset(cfg: RunConfig) -> Dataset:
     )
     path = dataset_dir(cfg)
     if data_io.has_reference_layout(path):
-        return data_io.read_reference_layout(
-            path, n_partitions, sparse=cfg.is_real_data
-        )
+        return data_io.read_reference_layout(path, n_partitions)
     if cfg.is_real_data:
         raise FileNotFoundError(
             f"real dataset {cfg.dataset!r} not found at {path!r}; prepare it "

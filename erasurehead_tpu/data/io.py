@@ -96,8 +96,21 @@ def has_reference_layout(path: str | None) -> bool:
     )
 
 
-def read_reference_layout(in_dir: str, n_partitions: int, sparse: bool) -> Dataset:
-    """Load a reference-layout directory back into a Dataset."""
+def layout_is_sparse(path: str) -> bool:
+    """Whether a reference-layout directory stores CSR (.npz) partitions."""
+    return os.path.exists(os.path.join(path, "1.npz"))
+
+
+def read_reference_layout(
+    in_dir: str, n_partitions: int, sparse: bool | None = None
+) -> Dataset:
+    """Load a reference-layout directory back into a Dataset.
+
+    ``sparse=None`` autodetects from which partition-1 file exists — callers
+    guessing wrong (e.g. assuming real datasets are always CSR when the
+    preparer wrote dense text) would otherwise crash on np.load."""
+    if sparse is None:
+        sparse = layout_is_sparse(in_dir)
     parts = []
     for i in range(n_partitions):
         if sparse:

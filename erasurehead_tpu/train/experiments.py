@@ -196,7 +196,7 @@ def baseline_suite(
 
             path = os.path.join(data_dir, name, str(parts))
             if data_io.has_reference_layout(path):
-                ds = data_io.read_reference_layout(path, parts, sparse=True)
+                ds = data_io.read_reference_layout(path, parts)
                 _cache[key] = (ds, name)
                 return _cache[key]
         rows, cols = fallback

@@ -127,8 +127,14 @@ class Fleet:
         """Write `hosts` (ip alias lines) and `hosts_address` (bare ips) —
         the reference's inventory artifacts (pytorch_ec2.py:689-702). Kept
         for operator parity/debugging; jax.distributed needs neither."""
-        hosts = self.hosts(info)
         paths = [f"{prefix}/hosts", f"{prefix}/hosts_address"]
+        if self.dry_run and info is None:
+            # the describe() this inventory would come from was skipped, so
+            # the host list is empty/garbage — don't clobber real inventory
+            # files with it; explicit-info callers still get real writes
+            print(f"dry-run: would write {paths[0]}, {paths[1]}")
+            return []
+        hosts = self.hosts(info)
         with open(paths[0], "w") as f:
             for h in hosts:
                 f.write(f"{h['internal_ip']} {self.name}-host{h['index']}\n")
