@@ -122,6 +122,8 @@ def _flags_parser() -> argparse.ArgumentParser:
     p.add_argument("--delay-mean", type=float, default=0.5)
     p.add_argument("--partitions-per-worker", type=int, default=0)
     p.add_argument("--compute-mode", default="faithful", choices=["faithful", "deduped"])
+    p.add_argument("--use-pallas", default="auto", choices=["auto", "on", "off"],
+                   help="fused pallas gradient kernel (ops/kernels.py)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--quiet", action="store_true")
     return p
@@ -154,6 +156,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         is_real_data=ns.input_dir is not None and ns.dataset != "artificial",
         partitions_per_worker=ns.partitions_per_worker,
         compute_mode=ns.compute_mode,
+        use_pallas=ns.use_pallas,
         seed=ns.seed,
     )
 

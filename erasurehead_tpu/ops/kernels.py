@@ -1,0 +1,151 @@
+"""Pallas TPU kernels for the hot op: fused decoded-gradient computation.
+
+The coded-GD iteration is bandwidth-bound: the per-slot GLM gradient needs
+two passes over the feature stack X — a margin matvec ``p = X @ beta`` and a
+transpose matvec ``g = X^T @ s(p, y)`` (reference closed forms
+src/naive.py:137-139, 341-346). Under XLA these are two HBM reads of X per
+step. This kernel fuses margin -> residual -> transpose-accumulate into ONE
+pass over X, and folds the per-slot decode weights (parallel/collect.py) in
+as well, so the *decoded* gradient
+
+    g = sum_m w_m * sum_r s(p_{m,r}, y_{m,r}) * X[m, r, :]
+
+comes out of a single streaming read. s is the residual:
+  logistic: s = -y / (exp(p*y) + 1)        (src/naive.py:137-139)
+  linear:   s = -2 * (y - p)               (src/naive.py:341-346)
+
+Grid: (M slots, row blocks). TPU grids run sequentially, so the (1, F)
+output block accumulates across all grid steps (initialized at step 0).
+Zero-padded rows (X row = 0, y = 0) contribute exactly 0 for both residuals
+— padding to a block multiple is safe with no masking.
+
+The deduped/faithful compute modes (parallel/step.py) both reduce to the
+[M, R, F] slot-major shape this kernel takes; `parallel/step.py` wires it
+under shard_map with a trailing psum over the worker axis.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+GLM_KINDS = ("logistic", "linear")
+
+# VMEM budget per X block (double-buffered by the pipeline, keep modest)
+_X_BLOCK_BYTES = 2 * 1024 * 1024
+_MAX_BLOCK_ROWS = 512
+
+
+def choose_block_rows(n_rows: int, n_features: int) -> int:
+    """Largest multiple-of-8 row block that fits the VMEM budget."""
+    by_vmem = _X_BLOCK_BYTES // max(1, 4 * n_features)
+    cap = min(_MAX_BLOCK_ROWS, max(8, by_vmem // 8 * 8))
+    padded8 = -(-n_rows // 8) * 8
+    return min(cap, padded8)
+
+
+def _residual(kind: str, p, y):
+    if kind == "logistic":
+        return -y / (jnp.exp(p * y) + 1.0)
+    if kind == "linear":
+        return -2.0 * (y - p)
+    raise ValueError(f"unknown GLM kind {kind!r}")
+
+
+def _kernel(kind: str, b_ref, x_ref, y_ref, w_ref, o_ref):
+    """One (slot m, row block) step: o += w_m * X_blk^T s(X_blk b, y_blk)."""
+    m, rb = pl.program_id(0), pl.program_id(1)
+
+    @pl.when((m == 0) & (rb == 0))
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    x = x_ref[0]  # (BR, F)
+    y = y_ref[0]  # (BR, 1)
+    w = w_ref[m, 0]  # scalar from SMEM, dynamic slot index
+    # Both contractions run on the VPU (elementwise multiply + reduce) in
+    # true f32: the MXU default would round products to ~bf16 (measured
+    # 8.8e-4 relative), which MDS decode weights then amplify (see
+    # ops/features.py docstring), and precision=HIGHEST hangs the Mosaic
+    # compiler in this toolchain. The op is HBM-bound, so idle MXUs are
+    # free; matvecs use 1/128 of the MXU anyway.
+    p = jnp.sum(x * b_ref[...], axis=1, keepdims=True)  # (BR, 1)
+    s = _residual(kind, p, y) * w  # (BR, 1)
+    o_ref[...] += jnp.sum(x * s, axis=0, keepdims=True)  # (1, F)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("kind", "interpret", "block_rows")
+)
+def fused_glm_grad(
+    beta: jnp.ndarray,  # [F]
+    X: jnp.ndarray,  # [M, R, F] slot-major dense stack
+    y: jnp.ndarray,  # [M, R]
+    w: jnp.ndarray,  # [M] decode weight per slot
+    kind: str = "logistic",
+    *,
+    interpret: bool = False,
+    block_rows: int | None = None,
+) -> jnp.ndarray:
+    """Decoded GLM gradient in one pass over X. Returns [F] float32."""
+    M, R, F = X.shape
+    BR = block_rows or choose_block_rows(R, F)
+    Rp = -(-R // BR) * BR
+    if Rp != R:
+        # zero rows contribute zero gradient for both residuals; XLA hoists
+        # this out of training scans because X is loop-invariant there
+        X = jnp.pad(X, ((0, 0), (0, Rp - R), (0, 0)))
+        y = jnp.pad(y, ((0, 0), (0, Rp - R)))
+    beta2 = beta.astype(jnp.float32).reshape(1, F)
+    y3 = y.astype(jnp.float32).reshape(M, Rp, 1)
+    w2 = w.astype(jnp.float32).reshape(M, 1)
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, kind),
+        grid=(M, Rp // BR),
+        in_specs=[
+            pl.BlockSpec((1, F), lambda m, rb: (0, 0)),  # beta
+            pl.BlockSpec((1, BR, F), lambda m, rb: (m, rb, 0)),  # X
+            pl.BlockSpec((1, BR, 1), lambda m, rb: (m, rb, 0)),  # y
+            # per-slot decode weights are scalars: whole array in SMEM
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # w
+        ],
+        out_specs=pl.BlockSpec((1, F), lambda m, rb: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, F), jnp.float32),
+        interpret=interpret,
+    )(beta2, X.astype(jnp.float32), y3, w2)
+    return out[0]
+
+
+def reference_glm_grad(beta, X, y, w, kind: str = "logistic"):
+    """Plain-XLA oracle for the fused kernel (two passes over X)."""
+    p = jnp.einsum(
+        "mrf,f->mr", X, beta, precision=lax.Precision.HIGHEST
+    )
+    s = _residual(kind, p, y) * w[:, None]
+    return jnp.einsum(
+        "mrf,mr->f", X, s, precision=lax.Precision.HIGHEST
+    )
+
+
+def supports_fused(X, model_name: str, platform: str) -> bool:
+    """Auto-gate: dense f32-able stacks, GLM model, real TPU, aligned F.
+
+    Currently returns False everywhere ("auto" never enables the kernel):
+    the MXU-dot variant measured *slower* than XLA's two-pass lowering on
+    v5e (2.7ms vs 2.05ms at the bench shape) and the exact-f32 VPU variant
+    is pending on-hardware measurement. Flip the final clause once the VPU
+    kernel wins; use_pallas="on" forces it meanwhile.
+    """
+    if model_name not in GLM_KINDS:
+        return False
+    if not isinstance(X, (jnp.ndarray, np.ndarray, jax.Array)):
+        return False  # PaddedRows sparse stacks take the XLA gather path
+    F = X.shape[-1]
+    return platform == "tpu" and F % 128 == 0 and False

@@ -110,6 +110,41 @@ def make_deduped_grad_fn(model, mesh: Mesh) -> GradFn:
     )
 
 
+def make_fused_grad_fn(kind: str, mesh: Mesh, *, interpret: bool = False) -> GradFn:
+    """Single-pass pallas decoded gradient (ops/kernels.py) under shard_map.
+
+    Drop-in for make_faithful_grad_fn / make_deduped_grad_fn on dense GLM
+    stacks: accepts either the worker-major [Wl, S, rows, F] or the
+    partition-major [Pl, rows, F] shape (leading dims are flattened into
+    kernel slots), computes margin -> residual -> weighted
+    transpose-accumulate in ONE streaming read of X instead of XLA's two,
+    then psums over the worker axis. ``interpret=True`` runs the kernel in
+    pallas interpret mode for CPU tests.
+    """
+    from erasurehead_tpu.ops import kernels
+
+    def local(params, Xs, ys, ws):
+        lead = Xs.shape[:-2]
+        M = int(np.prod(lead))
+        Xf = Xs.reshape((M,) + Xs.shape[-2:])
+        yf = ys.reshape(M, -1)
+        wf = ws.reshape(M)
+        g = kernels.fused_glm_grad(
+            params, Xf, yf, wf, kind, interpret=interpret
+        )
+        return lax.psum(g, WORKER_AXIS)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
+        out_specs=P(),
+        # pallas_call's out_shape carries no varying-across-mesh info, so
+        # jax 0.9's vma checker cannot validate this body
+        check_vma=False,
+    )
+
+
 def expand_slot_weights(
     message_weights: jnp.ndarray,
     coeffs: jnp.ndarray,

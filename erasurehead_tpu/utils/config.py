@@ -114,6 +114,10 @@ class RunConfig:
     compute_mode: ComputeMode = ComputeMode.FAITHFUL
     seed: int = 0  # model init + generator matrix (reference: unseeded)
     dtype: str = "float32"
+    # fused pallas gradient kernel (ops/kernels.py): "on" forces it
+    # (interpret mode off-TPU), "off" disables, "auto" lets
+    # kernels.supports_fused decide per platform/model/shape
+    use_pallas: str = "auto"
 
     @classmethod
     def for_dataset(cls, dataset: str, **overrides) -> "RunConfig":
@@ -133,6 +137,10 @@ class RunConfig:
         self.model = ModelKind(self.model)
         self.update_rule = UpdateRule(self.update_rule)
         self.compute_mode = ComputeMode(self.compute_mode)
+        if self.use_pallas not in ("auto", "on", "off"):
+            raise ValueError(
+                f"use_pallas must be auto/on/off, got {self.use_pallas!r}"
+            )
         if self.num_collect is None:
             self.num_collect = self.n_workers
         if self.dataset not in DATASET_PRESETS:

@@ -25,8 +25,10 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 from jax._src import xla_bridge as _xb  # noqa: E402
 
-for _plugin in ("axon", "tpu"):
-    _xb._backend_factories.pop(_plugin, None)
+# pop only the tunnel plugin: removing "tpu" would unregister the platform
+# name itself, and jax.experimental.pallas then fails at import time
+# (checkify registers a lowering rule for platform "tpu")
+_xb._backend_factories.pop("axon", None)
 
 import pytest  # noqa: E402
 

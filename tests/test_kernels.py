@@ -1,0 +1,112 @@
+"""Fused pallas gradient kernel (ops/kernels.py) vs the XLA oracle.
+
+Interpret mode on CPU; the same kernel compiles via Mosaic on TPU. The
+trainer-level test pins a full coded run with use_pallas="on" to the
+default XLA path — gradient fusion must not change the science.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from erasurehead_tpu.ops import kernels
+
+rng = np.random.default_rng(7)
+
+
+def _case(M, R, F):
+    X = jnp.asarray(rng.standard_normal((M, R, F)), jnp.float32)
+    y = jnp.asarray(np.sign(rng.standard_normal((M, R))), jnp.float32)
+    b = jnp.asarray(rng.standard_normal(F), jnp.float32)
+    w = jnp.asarray(rng.standard_normal(M), jnp.float32)
+    return b, X, y, w
+
+
+@pytest.mark.parametrize("kind", kernels.GLM_KINDS)
+@pytest.mark.parametrize(
+    "shape",
+    [(6, 40, 32), (3, 17, 128), (1, 8, 64)],  # incl. rows % block != 0
+)
+def test_fused_matches_oracle(kind, shape):
+    b, X, y, w = _case(*shape)
+    got = kernels.fused_glm_grad(
+        b, X, y, w, kind, interpret=True, block_rows=16
+    )
+    want = kernels.reference_glm_grad(b, X, y, w, kind)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
+def test_zero_weight_slots_drop_out():
+    """A slot with weight 0 (an erased/uncollected message) contributes
+    nothing — the erasure semantics the decode weights encode."""
+    b, X, y, w = _case(4, 24, 32)
+    w = w.at[2].set(0.0)
+    got = kernels.fused_glm_grad(b, X, y, w, "logistic", interpret=True)
+    want = kernels.reference_glm_grad(
+        b, X[jnp.array([0, 1, 3])], y[jnp.array([0, 1, 3])],
+        w[jnp.array([0, 1, 3])], "logistic",
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_choose_block_rows_bounds():
+    assert kernels.choose_block_rows(4400, 128) % 8 == 0
+    assert kernels.choose_block_rows(5, 128) == 8  # padded-up tiny R
+    big = kernels.choose_block_rows(10_000, 32_768)
+    assert big >= 8 and big * 32_768 * 4 <= 2 * kernels._X_BLOCK_BYTES
+
+
+def test_supports_fused_gating():
+    X = jnp.zeros((2, 8, 128), jnp.float32)
+    from erasurehead_tpu.ops.features import PaddedRows
+
+    sparse = PaddedRows(
+        jnp.zeros((4, 2), jnp.int32), jnp.zeros((4, 2), jnp.float32), 128
+    )
+    assert not kernels.supports_fused(X, "mlp", "tpu")
+    assert not kernels.supports_fused(sparse, "logistic", "tpu")
+    assert not kernels.supports_fused(X, "logistic", "cpu")
+
+
+@pytest.mark.parametrize("scheme", ["approx", "cyccoded", "naive"])
+@pytest.mark.parametrize("compute_mode", ["faithful", "deduped"])
+def test_trainer_pallas_path_matches_xla(scheme, compute_mode):
+    """Full coded training with the fused kernel == default XLA path."""
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    W = 8
+    mesh = worker_mesh(4)
+    data = generate_gmm(16 * W, 32, n_partitions=W, seed=0)
+    histories = {}
+    for use in ("off", "on"):
+        cfg = RunConfig(
+            scheme=scheme, n_workers=W, n_stragglers=1, rounds=4,
+            n_rows=16 * W, n_cols=32, lr_schedule=1.0, update_rule="AGD",
+            add_delay=True, seed=0, compute_mode=compute_mode,
+            use_pallas=use,
+        )
+        res = trainer.train(cfg, data, mesh=mesh)
+        histories[use] = np.asarray(res.params_history)
+    np.testing.assert_allclose(
+        histories["on"], histories["off"], rtol=2e-4, atol=1e-5
+    )
+
+
+def test_trainer_pallas_on_rejects_mlp():
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        scheme="naive", model="mlp", n_workers=4, n_stragglers=0, rounds=1,
+        n_rows=32, n_cols=16, lr_schedule=0.1, use_pallas="on",
+    )
+    data = generate_gmm(32, 16, n_partitions=4, seed=0)
+    with pytest.raises(ValueError, match="use_pallas"):
+        trainer.train(cfg, data, mesh=worker_mesh(4))
