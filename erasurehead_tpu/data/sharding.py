@@ -78,6 +78,23 @@ def worker_stack(layout: CodingLayout, Xp, yp):
     return take(Xp), yp[layout.assignment]
 
 
+def put_global(leaf: np.ndarray, sharding) -> jax.Array:
+    """Materialize a host array as a (possibly multi-host) sharded Array.
+
+    Single-process: plain device_put. Multi-controller (a real pod via
+    jax.distributed — parallel/backend.py): every process holds the full
+    host array (data prep is seeded/deterministic, the reference's NFS
+    share's analogue), and each contributes only its addressable shards via
+    make_array_from_callback — device_put alone cannot build an array that
+    spans non-addressable devices.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(leaf, sharding)
+    return jax.make_array_from_callback(
+        leaf.shape, sharding, lambda idx: leaf[idx]
+    )
+
+
 def shard_run_data(
     dataset: Dataset,
     layout: CodingLayout,
@@ -92,18 +109,18 @@ def shard_run_data(
     """
     Xp_h, yp_h = partition_stack(dataset, layout.n_partitions)
     sharding = mesh_lib.worker_sharding(mesh)
-    put = lambda A: jax.tree.map(lambda leaf: jax.device_put(leaf, sharding), A)
+    put = lambda A: jax.tree.map(lambda leaf: put_global(leaf, sharding), A)
     rows = yp_h.shape[1]
 
     Xp = yp = Xw = yw = None
     if faithful:
         mesh_lib.check_divisible(layout.n_workers, mesh, "n_workers")
         Xw_h, yw_h = worker_stack(layout, Xp_h, yp_h)
-        Xw, yw = put(Xw_h), jax.device_put(yw_h, sharding)
+        Xw, yw = put(Xw_h), put_global(yw_h, sharding)
     else:
         mesh_lib.check_divisible(layout.n_partitions, mesh, "n_partitions")
         Xp = put(Xp_h)
-        yp = jax.device_put(yp_h, sharding)
+        yp = put_global(yp_h, sharding)
     return ShardedData(
         Xp=Xp, yp=yp, Xw=Xw, yw=yw, n_train=rows * layout.n_partitions
     )

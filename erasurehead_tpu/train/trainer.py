@@ -28,7 +28,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from erasurehead_tpu.data.sharding import ShardedData, shard_run_data
+from erasurehead_tpu.data.sharding import (
+    ShardedData,
+    put_global,
+    shard_run_data,
+)
 from erasurehead_tpu.data.synthetic import Dataset
 from erasurehead_tpu.models.glm import LinearModel, LogisticModel
 from erasurehead_tpu.models.mlp import MLPModel
@@ -194,7 +198,10 @@ def train(
     params0 = model.init_params(jax.random.key(cfg.seed), dataset.n_features)
     params0 = jax.tree.map(lambda p: p.astype(dtype), params0)
     state0 = optimizer.init_state(params0)
-    state0 = jax.device_put(state0, replicated(mesh))
+    state0 = jax.tree.map(
+        lambda l: put_global(np.asarray(l), replicated(mesh)),
+        state0,
+    )
 
     lr_seq = jnp.asarray(lr, dtype)
     iters = jnp.arange(cfg.rounds, dtype=dtype)
@@ -222,7 +229,10 @@ def train(
         path = ckpt_lib.latest(checkpoint_dir)
         if path is not None:
             state0, start_round = ckpt_lib.restore(path, state0)
-            state0 = jax.device_put(state0, replicated(mesh))
+            state0 = jax.tree.map(
+        lambda l: put_global(np.asarray(l), replicated(mesh)),
+        state0,
+    )
 
     if start_round >= cfg.rounds:
         # the checkpoint already covers the requested rounds: nothing to run

@@ -1,0 +1,107 @@
+"""Real multi-controller test: a 2-process CPU cluster via jax.distributed.
+
+This drives the actual multi-host code path — ``jax.distributed.initialize``
+(parallel/backend.py), a worker mesh spanning both processes' devices, and
+``put_global``'s make_array_from_callback sharding (data/sharding.py) — the
+TPU-pod analogue of the reference's mpirun+hostfile bring-up (SURVEY.md
+§2.3/§3.5). Each process owns 2 virtual CPU devices; the 4-device mesh spans
+them; the AGC trajectory must equal the single-process run bit-for-bit.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+W, ROUNDS, COLS = 4, 3, 16
+
+_CHILD = textwrap.dedent(
+    """
+    import os, sys
+    import numpy as np
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=os.environ["EH_COORD"],
+        num_processes=2,
+        process_id=int(os.environ["EH_PID"]),
+    )
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.parallel import backend
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    info = backend.topology_info()
+    assert info["process_count"] == 2, info
+    assert info["global_devices"] == 4, info
+
+    cfg = RunConfig(
+        scheme="approx", n_workers=%(W)d, n_stragglers=1, rounds=%(ROUNDS)d,
+        n_rows=8 * %(W)d, n_cols=%(COLS)d, lr_schedule=1.0,
+        update_rule="AGD", add_delay=True, seed=0,
+    )
+    data = generate_gmm(cfg.n_rows, cfg.n_cols, n_partitions=%(W)d, seed=0)
+    res = trainer.train(cfg, data, mesh=worker_mesh(4), measure=False)
+    hist = np.asarray(res.params_history)
+    if info["process_index"] == 0:
+        np.save(os.environ["EH_OUT"], hist)
+    """
+    % {"W": W, "ROUNDS": ROUNDS, "COLS": COLS}
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_cpu_cluster_matches_single_process(tmp_path):
+    port = _free_port()
+    out = str(tmp_path / "hist.npy")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "EH_COORD": f"127.0.0.1:{port}",
+        "EH_OUT": out,
+    }
+    # children must not dial the axon TPU tunnel (sitecustomize registers it
+    # whenever PALLAS_AXON_POOL_IPS is set, before any user code runs)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CHILD],
+            env={**env, "EH_PID": str(pid)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for pid in (0, 1)
+    ]
+    logs = [p.communicate(timeout=300)[0].decode() for p in procs]
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"child failed:\n{log}"
+
+    # single-process oracle on the 8-device conftest mesh, trimmed to 4
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        scheme="approx", n_workers=W, n_stragglers=1, rounds=ROUNDS,
+        n_rows=8 * W, n_cols=COLS, lr_schedule=1.0, update_rule="AGD",
+        add_delay=True, seed=0,
+    )
+    data = generate_gmm(cfg.n_rows, cfg.n_cols, n_partitions=W, seed=0)
+    res = trainer.train(cfg, data, mesh=worker_mesh(4), measure=False)
+    want = np.asarray(res.params_history)
+
+    got = np.load(out)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
