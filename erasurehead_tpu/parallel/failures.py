@@ -1,0 +1,224 @@
+"""Worker-failure injection, detection, and elastic failover.
+
+The reference has straggler *injection* but no failure handling at all: a
+dead worker leaves the master's Waitany loop blocked forever (naive waits
+for all W, src/naive.py:103-110; AGC waits for num_collect arrivals or full
+group coverage, src/approximate_coding.py:144 — both unreachable once too
+many workers are gone; README.md:120-122 lists real straggler termination
+as unsolved future work). This module closes that gap, TPU-style: failures
+are modeled as infinite arrival times in the precomputed schedule, detection
+and feasibility analysis are exact host-side checks ahead of the run, and
+failover rewrites only the unreachable rounds' collection into a best-effort
+unbiased decode over the surviving workers.
+
+Semantics per scheme when workers die (the "would the reference's master
+ever exit its wait loop" question):
+
+  naive          any death => hangs forever           src/naive.py:103-110
+  cyclic MDS     alive < W-s => hangs                 src/coded.py:137
+  FRC            any group fully dead => hangs        src/replication.py:143-155
+  AGC            alive < num_collect AND some group
+                 fully dead => hangs                  src/approximate_coding.py:144
+  avoidstragg    alive < W-s => hangs                 src/avoidstragg.py:106-114
+  partial *      any death => hangs (needs ALL
+                 uncoded first-parts)                 src/partial_coded.py:174-191
+
+Failover decode (replacing only infeasible rounds):
+  uncoded layouts   collect all alive, rescale P/alive — the avoidstragg
+                    unbiasedness rescale generalized (src/avoidstragg.py:116)
+  FRC layouts       first alive member per group; fully-dead groups are
+                    erased, AGC-style (src/approximate_coding.py:155-158)
+  MDS layouts       lstsq decode weights over the alive rows of B — exact
+                    while alive >= W-s, least-squares best-effort below
+  partial layouts   no failover (their uncoded first-parts are structurally
+                    required); analyze() reports, train_with_failover raises
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional
+
+import numpy as np
+
+from erasurehead_tpu.ops import codes
+from erasurehead_tpu.ops.codes import CodingLayout
+from erasurehead_tpu.parallel import collect
+from erasurehead_tpu.utils.config import Scheme
+
+DEAD = np.inf  # a dead worker's arrival time
+
+
+def inject_worker_death(
+    arrivals: np.ndarray, deaths: Mapping[int, int]
+) -> np.ndarray:
+    """Kill worker w from round r onward: ``deaths = {worker: round}``.
+
+    Fault injection beyond the reference's sleep-based straggling — the
+    failure mode its README concedes it never implements (README.md:120-122).
+    """
+    out = np.array(arrivals, dtype=np.float64, copy=True)
+    R = out.shape[0]
+    for w, r in deaths.items():
+        if not 0 <= w < out.shape[1]:
+            raise ValueError(f"worker {w} out of range")
+        out[max(0, r):R, w] = DEAD
+    return out
+
+
+def detect_dead(arrivals: np.ndarray, timeout: float) -> np.ndarray:
+    """[R, W] bool: workers the master would presume dead — no arrival by
+    ``timeout`` simulated seconds into the round.
+
+    The reference cannot express this (its Waitany has no timeout); here it
+    is an exact readout of the schedule.
+    """
+    t = np.asarray(arrivals)
+    # non-finite is dead regardless of timeout (inf <= inf would pass a
+    # plain comparison); NaN also lands on the dead side
+    return ~np.isfinite(t) | (t > timeout)
+
+
+@dataclasses.dataclass(frozen=True)
+class FeasibilityReport:
+    """Would each round's collection rule ever exit its wait loop?"""
+
+    feasible: np.ndarray  # [R] bool
+    dead: np.ndarray  # [R, W] bool (presumed dead per detect_dead)
+    scheme: Scheme
+    reason: str  # human-readable rule that was applied
+
+    @property
+    def all_feasible(self) -> bool:
+        return bool(self.feasible.all())
+
+    @property
+    def first_infeasible(self) -> Optional[int]:
+        bad = np.flatnonzero(~self.feasible)
+        return int(bad[0]) if bad.size else None
+
+
+def analyze(
+    scheme: Scheme,
+    layout: CodingLayout,
+    arrivals: np.ndarray,
+    num_collect: int | None = None,
+    timeout: float = np.inf,
+) -> FeasibilityReport:
+    """Per-round feasibility of the scheme's stop condition (table above)."""
+    scheme = Scheme(scheme)
+    dead = detect_dead(arrivals, timeout)
+    alive_cnt = (~dead).sum(axis=1)
+    W = arrivals.shape[1]
+    s = layout.n_stragglers
+    if layout.groups is not None:
+        n_groups = layout.n_groups
+        group_alive = np.stack(
+            [(~dead[:, layout.groups == g]).any(axis=1) for g in range(n_groups)],
+            axis=1,
+        )  # [R, G]
+        all_groups_alive = group_alive.all(axis=1)
+    if scheme == Scheme.NAIVE:
+        feasible, reason = alive_cnt == W, "needs all W workers"
+    elif scheme in (Scheme.CYCLIC_MDS, Scheme.AVOID_STRAGGLERS):
+        feasible, reason = alive_cnt >= W - s, f"needs first {W - s} arrivals"
+    elif scheme == Scheme.FRC:
+        feasible, reason = all_groups_alive, "needs one arrival per group"
+    elif scheme == Scheme.APPROX:
+        if num_collect is None:
+            raise ValueError("AGC needs num_collect")
+        feasible = (alive_cnt >= num_collect) | all_groups_alive
+        reason = f"needs {num_collect} arrivals or full group coverage"
+    elif scheme in (Scheme.PARTIAL_CYCLIC, Scheme.PARTIAL_FRC):
+        feasible = alive_cnt == W
+        reason = "needs every worker's uncoded first-part"
+    else:
+        raise ValueError(f"unknown scheme {scheme}")
+    return FeasibilityReport(
+        feasible=np.asarray(feasible), dead=dead, scheme=scheme, reason=reason
+    )
+
+
+class InfeasibleRunError(RuntimeError):
+    def __init__(self, report: FeasibilityReport):
+        self.report = report
+        super().__init__(
+            f"scheme {report.scheme.value}: collection unreachable from round "
+            f"{report.first_infeasible} ({report.reason}; the reference's "
+            "master would block in Waitany forever)"
+        )
+
+
+def failover_schedule(
+    schedule: collect.CollectionSchedule,
+    layout: CodingLayout,
+    arrivals: np.ndarray,
+    report: FeasibilityReport,
+    timeout: float,
+) -> collect.CollectionSchedule:
+    """Rewrite infeasible rounds: collect everyone alive at ``timeout``,
+    decode best-effort per the layout (module docstring). Feasible rounds
+    are untouched — the scheme's own rule already exits there."""
+    if report.all_feasible:
+        return schedule
+    if layout.slot_is_coded is not None and not np.all(layout.slot_is_coded):
+        raise InfeasibleRunError(report)  # partial layouts: see docstring
+    weights = np.array(schedule.message_weights, copy=True)
+    sim = np.array(schedule.sim_time, copy=True)
+    wtimes = np.array(schedule.worker_times, copy=True)
+    collected = np.array(schedule.collected, copy=True)
+    t = np.asarray(arrivals, dtype=np.float64)
+    for r in np.flatnonzero(~report.feasible):
+        alive = ~report.dead[r]
+        collected[r] = alive
+        wtimes[r] = np.where(alive, t[r], collect.NEVER)
+        sim[r] = timeout
+        if layout.B is not None:  # MDS: best-effort lstsq over alive rows
+            weights[r] = codes.mds_decode_weights_host(
+                layout.B, alive[None, :]
+            )[0]
+        elif layout.groups is not None:  # FRC/AGC: first alive per group
+            win = collect._group_winners(
+                np.where(alive, t[r], DEAD)[None, :], layout.groups
+            )[0]
+            weights[r] = (win & alive).astype(np.float64)
+        else:  # uncoded: avoidstragg rescale over survivors
+            k = int(alive.sum())
+            if k == 0:
+                raise InfeasibleRunError(report)
+            weights[r] = alive * (layout.n_workers / k)
+    return collect.CollectionSchedule(
+        message_weights=weights,
+        sim_time=sim,
+        worker_times=wtimes,
+        collected=collected,
+    )
+
+
+def plan_run(
+    scheme: Scheme,
+    layout: CodingLayout,
+    arrivals: np.ndarray,
+    num_collect: int | None = None,
+    timeout: float = np.inf,
+    on_infeasible: str = "error",  # "error" | "failover"
+) -> tuple[collect.CollectionSchedule, FeasibilityReport]:
+    """Build the run's collection schedule with failure handling.
+
+    ``on_infeasible="error"`` raises InfeasibleRunError where the reference
+    would hang; ``"failover"`` degrades those rounds per failover_schedule.
+    """
+    report = analyze(scheme, layout, arrivals, num_collect, timeout)
+    schedule = collect.build_schedule(
+        Scheme(scheme), arrivals, layout, num_collect=num_collect
+    )
+    if report.all_feasible:
+        return schedule, report
+    if on_infeasible == "error":
+        raise InfeasibleRunError(report)
+    if on_infeasible != "failover":
+        raise ValueError(f"on_infeasible must be error|failover, got {on_infeasible!r}")
+    return (
+        failover_schedule(schedule, layout, arrivals, report, timeout),
+        report,
+    )

@@ -117,6 +117,7 @@ def train(
     dataset: Dataset,
     mesh=None,
     arrivals: Optional[np.ndarray] = None,
+    schedule: Optional[collect.CollectionSchedule] = None,
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: Optional[int] = None,
     resume: bool = False,
@@ -148,9 +149,12 @@ def train(
         arrivals = straggler.arrival_schedule(
             cfg.rounds, cfg.n_workers, cfg.add_delay, cfg.delay_mean
         )
-    schedule = collect.build_schedule(
-        cfg.scheme, arrivals, layout, num_collect=cfg.num_collect
-    )
+    if schedule is None:
+        # a custom schedule (e.g. parallel/failures.plan_run's failover
+        # rewrite) overrides the scheme's plain collection rule
+        schedule = collect.build_schedule(
+            cfg.scheme, arrivals, layout, num_collect=cfg.num_collect
+        )
     lr = cfg.resolve_lr_schedule()
     alpha = cfg.effective_alpha
     n_train = data.n_train

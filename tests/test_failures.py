@@ -1,0 +1,176 @@
+"""Failure injection / detection / failover (parallel/failures.py).
+
+The reference hangs forever on any worker death (src/naive.py:103-110 waits
+for all W; README.md:120-122 concedes real failures are unhandled). These
+tests pin the feasibility rules to that semantics and check the failover
+decode stays unbiased / erasure-correct per layout.
+"""
+
+import numpy as np
+import pytest
+
+from erasurehead_tpu.ops import codes
+from erasurehead_tpu.parallel import collect, failures, straggler
+from erasurehead_tpu.utils.config import Scheme
+
+R, W, S = 6, 12, 2
+
+
+@pytest.fixture(scope="module")
+def arrivals():
+    return straggler.arrival_schedule(R, W, add_delay=True)
+
+
+def test_inject_worker_death(arrivals):
+    t = failures.inject_worker_death(arrivals, {3: 2, 7: 0})
+    assert np.isinf(t[2:, 3]).all() and np.isfinite(t[:2, 3]).all()
+    assert np.isinf(t[:, 7]).all()
+    assert np.isfinite(np.delete(t, [3, 7], axis=1)).all()
+    # input untouched
+    assert np.isfinite(arrivals).all()
+
+
+def test_detect_dead_timeout(arrivals):
+    t = failures.inject_worker_death(arrivals, {0: 1})
+    dead = failures.detect_dead(t, timeout=1e9)
+    assert dead[:, 0].tolist() == [False] + [True] * (R - 1)
+    assert not dead[:, 1:].any()
+    # a finite but too-slow arrival also detects
+    slow = np.array(arrivals, copy=True)
+    slow[0, 5] = 1e6
+    assert failures.detect_dead(slow, timeout=100.0)[0, 5]
+
+
+@pytest.mark.parametrize(
+    "scheme,layout_fn,kw,deaths,expect_feasible",
+    [
+        # naive: ANY death kills it
+        ("naive", lambda: codes.uncoded_layout(W), {}, {0: 0}, False),
+        # MDS tolerates s deaths, not s+1
+        ("cyccoded", lambda: codes.cyclic_mds_layout(W, S, seed=0), {},
+         {0: 0, 1: 0}, True),
+        ("cyccoded", lambda: codes.cyclic_mds_layout(W, S, seed=0), {},
+         {0: 0, 1: 0, 2: 0}, False),
+        # FRC: deaths in distinct groups fine; a whole group dead is not
+        ("repcoded", lambda: codes.frc_layout(W, S), {}, {0: 0, 3: 0}, True),
+        ("repcoded", lambda: codes.frc_layout(W, S), {}, {0: 0, 1: 0, 2: 0},
+         False),
+        # AGC: group 0 fully dead but num_collect=6 still reachable
+        ("approx", lambda: codes.frc_layout(W, S), {"num_collect": 6},
+         {0: 0, 1: 0, 2: 0}, True),
+        # AGC: group dead AND alive < num_collect
+        ("approx", lambda: codes.frc_layout(W, S), {"num_collect": 10},
+         {0: 0, 1: 0, 2: 0}, False),
+    ],
+)
+def test_feasibility_rules(arrivals, scheme, layout_fn, kw, deaths, expect_feasible):
+    t = failures.inject_worker_death(arrivals, deaths)
+    rep = failures.analyze(Scheme(scheme), layout_fn(), t, **kw)
+    assert rep.all_feasible == expect_feasible
+    if not expect_feasible:
+        assert rep.first_infeasible == 0
+
+
+def test_plan_run_error_mode_raises(arrivals):
+    t = failures.inject_worker_death(arrivals, {0: 3})
+    with pytest.raises(failures.InfeasibleRunError, match="round 3"):
+        failures.plan_run(Scheme.NAIVE, codes.uncoded_layout(W), t)
+
+
+def test_failover_uncoded_unbiased_rescale(arrivals):
+    """Dead worker from round 2: failover collects the 11 alive and rescales
+    by W/11 — the avoidstragg estimator (src/avoidstragg.py:116)."""
+    layout = codes.uncoded_layout(W)
+    t = failures.inject_worker_death(arrivals, {4: 2})
+    sched, rep = failures.plan_run(
+        Scheme.NAIVE, layout, t, timeout=50.0, on_infeasible="failover"
+    )
+    # feasible rounds untouched
+    ref = collect.collect_all(t)
+    np.testing.assert_array_equal(sched.message_weights[:2], np.ones((2, W)))
+    np.testing.assert_array_equal(sched.sim_time[:2], ref.sim_time[:2])
+    # failover rounds: dead worker excluded, survivors rescaled, clock=timeout
+    assert (sched.message_weights[2:, 4] == 0).all()
+    np.testing.assert_allclose(
+        sched.message_weights[2:, :4], W / (W - 1), rtol=0, atol=0
+    )
+    assert (sched.sim_time[2:] == 50.0).all()
+    assert (sched.worker_times[2:, 4] == collect.NEVER).all()
+
+
+def test_failover_frc_erases_dead_group(arrivals):
+    """Group 0 (workers 0..2) fully dead: its partitions are erased
+    (AGC semantics); other groups decode via their first alive member."""
+    layout = codes.frc_layout(W, S)
+    t = failures.inject_worker_death(arrivals, {0: 0, 1: 0, 2: 0})
+    sched, rep = failures.plan_run(
+        Scheme.FRC, layout, t, timeout=50.0, on_infeasible="failover"
+    )
+    assert not rep.all_feasible
+    assert (sched.message_weights[:, :3] == 0).all()
+    # exactly one winner in each surviving group each round
+    for g in range(1, layout.n_groups):
+        members = layout.groups == g
+        np.testing.assert_array_equal(
+            sched.message_weights[:, members].sum(axis=1), np.ones(R)
+        )
+
+
+def test_failover_mds_exact_within_budget(arrivals):
+    """s workers dead: MDS failover decode weights must still satisfy the
+    exact-recovery identity w^T B = 1 (every partition exactly once)."""
+    layout = codes.cyclic_mds_layout(W, S, seed=0)
+    t = failures.inject_worker_death(arrivals, {0: 0, 1: 0, 5: 2})
+    sched, rep = failures.plan_run(
+        Scheme.CYCLIC_MDS, layout, t, timeout=50.0, on_infeasible="failover"
+    )
+    for r in np.flatnonzero(~rep.feasible):
+        recon = sched.message_weights[r] @ layout.B
+        if (~rep.dead[r]).sum() >= W - S:
+            np.testing.assert_allclose(recon, np.ones(W), atol=1e-8)
+
+
+def test_failover_training_still_converges(arrivals):
+    """End-to-end: AGC run with a group wiped out mid-run keeps training."""
+    import jax.numpy as jnp
+
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.models.glm import LogisticModel
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        scheme="approx", n_workers=W, n_stragglers=S, num_collect=10,
+        rounds=12, n_rows=24 * W, n_cols=16, lr_schedule=1.0,
+        update_rule="AGD", add_delay=True, seed=0,
+    )
+    layout = codes.frc_layout(W, S)
+    t = straggler.arrival_schedule(cfg.rounds, W, True)
+    t = failures.inject_worker_death(t, {0: 4, 1: 4, 2: 4})
+    sched, rep = failures.plan_run(
+        cfg.scheme, layout, t, num_collect=cfg.num_collect, timeout=20.0,
+        on_infeasible="failover",
+    )
+    assert not rep.all_feasible
+    data = generate_gmm(cfg.n_rows, cfg.n_cols, n_partitions=W, seed=0)
+    res = trainer.train(
+        cfg, data, mesh=worker_mesh(4), arrivals=t, schedule=sched
+    )
+    hist = np.asarray(res.params_history)
+    assert np.isfinite(hist).all()
+    model = LogisticModel()
+    Xt, yt = jnp.asarray(data.X_test), jnp.asarray(data.y_test)
+    first = float(model.loss_mean(jnp.asarray(hist[0]), Xt, yt))
+    last = float(model.loss_mean(jnp.asarray(hist[-1]), Xt, yt))
+    assert last < first * 0.7
+
+
+def test_partial_layouts_refuse_failover(arrivals):
+    layout = codes.partial_cyclic_layout(W, S + 2, S, seed=0)
+    t = failures.inject_worker_death(arrivals, {0: 0})
+    with pytest.raises(failures.InfeasibleRunError):
+        failures.plan_run(
+            Scheme.PARTIAL_CYCLIC, layout, t, timeout=50.0,
+            on_infeasible="failover",
+        )
