@@ -125,6 +125,8 @@ def _flags_parser() -> argparse.ArgumentParser:
     p.add_argument("--use-pallas", default="auto", choices=["auto", "on", "off"],
                    help="fused pallas gradient kernel (ops/kernels.py)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--trace-dir", default=None,
+                   help="capture a jax.profiler device trace here")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -205,10 +207,18 @@ def load_dataset(cfg: RunConfig) -> Dataset:
     return generate_gmm(cfg.n_rows, cfg.n_cols, n_partitions, cfg.seed)
 
 
-def run(cfg: RunConfig, output_dir: str | None = None, quiet: bool = False):
+def run(
+    cfg: RunConfig,
+    output_dir: str | None = None,
+    quiet: bool = False,
+    trace_dir: str | None = None,
+):
     initialize_distributed()
     dataset = load_dataset(cfg)
-    result = trainer.train(cfg, dataset)
+    from erasurehead_tpu.utils.tracing import device_trace
+
+    with device_trace(trace_dir):
+        result = trainer.train(cfg, dataset)
     model = trainer.build_model(cfg)
     n = result.n_train
     ev = evaluate.replay(
@@ -240,7 +250,12 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     ns = _flags_parser().parse_args(argv)
     cfg = _flags_to_config(ns)
-    run(cfg, output_dir=ns.output_dir, quiet=ns.quiet)
+    run(
+        cfg,
+        output_dir=ns.output_dir,
+        quiet=ns.quiet,
+        trace_dir=ns.trace_dir,
+    )
     return 0
 
 

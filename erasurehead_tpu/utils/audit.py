@@ -1,0 +1,96 @@
+"""Determinism & replay audit — the SPMD answer to race detection.
+
+The reference has no race detection (SURVEY.md §5.2); its concurrency
+correctness rests on MPI tag discipline (tag = iteration index, a band
+reserved for partial schemes' second messages) and stale-send cancellation.
+In this framework those hazards cannot exist by construction — there are no
+tags, no mailboxes, no cancellation: the device program is a single jitted
+scan whose only cross-chip op is a deterministic psum, and the control
+plane is precomputed host float64. What CAN silently break reproducibility
+is (a) an unseeded source entering the control plane, (b) nondeterministic
+reduction order if a backend reassociates, (c) accidental recompilation
+changing fusion between "identical" runs.
+
+This module makes those checkable: run the same config twice (and the
+control plane twice) and demand bitwise equality. It doubles as the
+replayability guarantee the reference gets from iteration-seeded delays
+(src/naive.py:141-147) — the whole run, not just the delay schedule, must
+replay exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditResult:
+    bitwise_equal: bool
+    max_abs_diff: float
+    what: str
+
+    def __bool__(self) -> bool:
+        return self.bitwise_equal
+
+
+def _compare(a, b, what: str) -> AuditResult:
+    a, b = np.asarray(a), np.asarray(b)
+    if a.shape != b.shape:
+        return AuditResult(False, np.inf, f"{what}: shape {a.shape} vs {b.shape}")
+    equal = bool(np.array_equal(a, b))
+    diff = 0.0 if equal else float(np.max(np.abs(a - b)))
+    return AuditResult(equal, diff, what)
+
+
+def audit_schedule_determinism(cfg) -> AuditResult:
+    """The control plane (arrivals -> collection weights) must replay
+    bit-for-bit — the analogue of the reference's seeded delay replay."""
+    from erasurehead_tpu.parallel import collect, straggler
+    from erasurehead_tpu.train.trainer import build_layout
+
+    outs = []
+    for _ in range(2):
+        layout = build_layout(cfg)
+        t = straggler.arrival_schedule(
+            cfg.rounds, cfg.n_workers, cfg.add_delay, cfg.delay_mean
+        )
+        s = collect.build_schedule(
+            cfg.scheme, t, layout, num_collect=cfg.num_collect
+        )
+        outs.append(
+            np.concatenate(
+                [s.message_weights.ravel(), s.sim_time.ravel(),
+                 s.worker_times.ravel()]
+            )
+        )
+    return _compare(outs[0], outs[1], "collection schedule")
+
+
+def audit_training_determinism(cfg, dataset, mesh=None) -> AuditResult:
+    """Two full runs of the jitted training scan must produce bitwise
+    identical iterate histories — catches nondeterministic reductions or
+    state leaking between runs."""
+    from erasurehead_tpu.train import trainer
+
+    hists = []
+    for _ in range(2):
+        res = trainer.train(cfg, dataset, mesh=mesh, measure=False)
+        import jax
+
+        hists.append(
+            np.concatenate(
+                [np.asarray(leaf).ravel()
+                 for leaf in jax.tree.leaves(res.params_history)]
+            )
+        )
+    return _compare(hists[0], hists[1], "iterate history")
+
+
+def audit(cfg, dataset, mesh=None) -> dict[str, AuditResult]:
+    """Full audit; all values must be truthy for a reproducible setup."""
+    return {
+        "schedule": audit_schedule_determinism(cfg),
+        "training": audit_training_determinism(cfg, dataset, mesh=mesh),
+    }
