@@ -1,0 +1,168 @@
+"""Fully on-device collection: arrivals, masks, and decode inside the scan.
+
+The default trainer precomputes the whole straggler schedule on host
+(float64 control plane, parallel/collect.py) — the exact analogue of the
+reference's iteration-seeded, fully predetermined delays. This module is
+the *dynamic* alternative: per-round arrival times are drawn with the JAX
+counter RNG inside the jitted scan, every collection rule is a fixed-shape
+jnp computation, and the MDS decode runs on device
+(ops/codes.mds_decode_weights). Nothing touches the host between rounds.
+
+Why it exists: (a) it demonstrates the collection rules survive jit — no
+data-dependent Python, no dynamic shapes — which is what makes the design
+portable to arrivals *measured* on a real pod rather than simulated; (b) it
+is the shape a reactive/online scheduler would take (per-round masks as
+traced values). Partial schemes keep the host path (their two-message event
+replay is irreducibly sequential; parallel/collect.py).
+
+Equivalence: for every non-partial scheme, the jnp rules here are pinned
+test-for-test against parallel/collect.py's numpy event replay on shared
+arrival matrices (tests/test_dynamic.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from erasurehead_tpu.ops import codes
+from erasurehead_tpu.ops.codes import CodingLayout
+from erasurehead_tpu.utils.config import Scheme
+
+
+NEVER = -1.0  # reference sentinel (src/coded.py:171-173; collect.NEVER)
+
+
+class RoundSchedule(NamedTuple):
+    """One round's collection, all traced values."""
+
+    message_weights: jnp.ndarray  # [W]
+    sim_time: jnp.ndarray  # scalar
+    collected: jnp.ndarray  # [W] bool
+    worker_times: jnp.ndarray | None = None  # [W], NEVER for uncollected
+
+
+def _ranks(t: jnp.ndarray) -> jnp.ndarray:
+    """Arrival rank per worker; ties broken by worker index (the
+    collect.py `_order` lexsort semantics — argsort is stable)."""
+    order = jnp.argsort(t)
+    return jnp.zeros_like(order).at[order].set(jnp.arange(t.shape[0]))
+
+
+def _kth_arrival_time(t: jnp.ndarray, ranks: jnp.ndarray, k: int):
+    return jnp.where(ranks == k - 1, t, -jnp.inf).max()
+
+
+def _group_onehot(groups: np.ndarray) -> np.ndarray:
+    G = int(groups.max()) + 1
+    return np.eye(G)[groups]  # [W, G]
+
+
+def collect_all_jnp(t: jnp.ndarray) -> RoundSchedule:
+    W = t.shape[0]
+    return RoundSchedule(jnp.ones(W), t.max(), jnp.ones(W, bool))
+
+
+def collect_first_k_mds_jnp(
+    t: jnp.ndarray, B: jnp.ndarray, n_stragglers: int
+) -> RoundSchedule:
+    W = t.shape[0]
+    ranks = _ranks(t)
+    mask = ranks < W - n_stragglers
+    return RoundSchedule(
+        codes.mds_decode_weights(B, mask),
+        _kth_arrival_time(t, ranks, W - n_stragglers),
+        mask,
+    )
+
+
+def collect_avoidstragg_jnp(t: jnp.ndarray, n_stragglers: int) -> RoundSchedule:
+    W = t.shape[0]
+    k = W - n_stragglers
+    ranks = _ranks(t)
+    mask = ranks < k
+    return RoundSchedule(
+        mask * (W / k), _kth_arrival_time(t, ranks, k), mask
+    )
+
+
+def collect_agc_jnp(
+    t: jnp.ndarray, onehot: jnp.ndarray, num_collect: int
+) -> RoundSchedule:
+    """AGC stop rule as prefix scans over the arrival order
+    (≙ collect.collect_agc's per-event loop, src/approximate_coding.py:144-158)."""
+    W, G = onehot.shape
+    order = jnp.argsort(t)
+    oh_sorted = onehot[order]  # [W, G] rows in arrival order
+    cum = jnp.cumsum(oh_sorted, axis=0)
+    win_sorted = (oh_sorted * (cum == 1)).sum(axis=1)  # first of its group?
+    covered = (cum >= 1).sum(axis=1)  # groups covered after j+1 arrivals
+    j1 = jnp.arange(1, W + 1)
+    done = (j1 >= num_collect) | (covered >= G)
+    stop_idx = jnp.argmax(done)
+    taken_sorted = jnp.arange(W) <= stop_idx
+    weights = jnp.zeros(W).at[order].set(win_sorted * taken_sorted)
+    collected = jnp.zeros(W, bool).at[order].set(taken_sorted)
+    return RoundSchedule(weights, t[order[stop_idx]], collected)
+
+
+def collect_frc_jnp(t: jnp.ndarray, onehot: jnp.ndarray) -> RoundSchedule:
+    """FRC == AGC with an unreachable worker quota (collect.collect_frc)."""
+    return collect_agc_jnp(t, onehot, num_collect=t.shape[0] + 1)
+
+
+def make_round_schedule_fn(
+    scheme: Scheme,
+    layout: CodingLayout,
+    num_collect: int | None = None,
+    delay_mean: float = 0.5,
+    add_delay: bool = True,
+) -> Callable[[jax.Array], RoundSchedule]:
+    """(per-round key) -> RoundSchedule, fully traceable.
+
+    The arrival model matches straggler.jax_delay_schedule (threefry
+    exponential draws; not bit-matched to the reference's MT19937 — use the
+    host control plane for run-for-run numeric parity with the reference).
+    """
+    scheme = Scheme(scheme)
+    W = layout.n_workers
+    B = None if layout.B is None else jnp.asarray(layout.B, jnp.float32)
+    onehot = (
+        None if layout.groups is None
+        else jnp.asarray(_group_onehot(np.asarray(layout.groups)))
+    )
+
+    def draw(key):
+        if not add_delay:
+            return jnp.zeros(W)
+        return delay_mean * jax.random.exponential(key, (W,))
+
+    if scheme == Scheme.NAIVE:
+        rule = lambda t: collect_all_jnp(t)
+    elif scheme == Scheme.CYCLIC_MDS:
+        rule = lambda t: collect_first_k_mds_jnp(t, B, layout.n_stragglers)
+    elif scheme == Scheme.AVOID_STRAGGLERS:
+        rule = lambda t: collect_avoidstragg_jnp(t, layout.n_stragglers)
+    elif scheme == Scheme.FRC:
+        rule = lambda t: collect_frc_jnp(t, onehot)
+    elif scheme == Scheme.APPROX:
+        if num_collect is None:
+            raise ValueError("AGC needs num_collect")
+        rule = lambda t: collect_agc_jnp(t, onehot, num_collect)
+    else:
+        raise ValueError(
+            f"{scheme.value}: partial schemes use the host control plane "
+            "(parallel/collect.py); see module docstring"
+        )
+
+    def schedule(key: jax.Array) -> RoundSchedule:
+        t = draw(key)
+        rs = rule(t)
+        return rs._replace(
+            worker_times=jnp.where(rs.collected, t, NEVER)
+        )
+
+    return schedule

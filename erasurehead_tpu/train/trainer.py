@@ -306,3 +306,80 @@ def train(
         config=cfg,
         layout=layout,
     )
+
+
+def train_dynamic(cfg: RunConfig, dataset: Dataset, mesh=None) -> TrainResult:
+    """Fully on-device run: arrivals, collection masks, and decode are
+    traced values inside ONE jitted scan (parallel/dynamic.py) — no host
+    control plane between rounds.
+
+    The default :func:`train` is the reference-parity path (bit-matched
+    MT19937 delay streams, float64 decode); this one trades numeric parity
+    for a closed-loop on-device program — the shape an online scheduler
+    fed by *measured* arrivals takes. Faithful compute mode only.
+    """
+    from erasurehead_tpu.parallel import dynamic as dynamic_lib
+
+    layout = build_layout(cfg)
+    model = build_model(cfg)
+    if mesh is None:
+        avail = len(jax.devices())
+        need = layout.n_workers
+        mesh = worker_mesh(max(d for d in range(1, avail + 1) if need % d == 0))
+    data = shard_run_data(dataset, layout, mesh, faithful=True)
+    sched_fn = dynamic_lib.make_round_schedule_fn(
+        cfg.scheme, layout, cfg.num_collect, cfg.delay_mean, cfg.add_delay
+    )
+    grad_fn = step_lib.make_faithful_grad_fn(model, mesh)
+    update_fn = optimizer.make_update_fn(cfg.update_rule)
+    dtype = jnp.dtype(cfg.dtype)
+    coeffs = jnp.asarray(layout.coeffs, dtype)
+    slot_coded = jnp.asarray(np.asarray(layout.slot_is_coded))
+    lr_seq = jnp.asarray(cfg.resolve_lr_schedule(), dtype)
+    alpha = cfg.effective_alpha
+    n_train = data.n_train
+    X, y = data.Xw, data.yw
+
+    params0 = model.init_params(jax.random.key(cfg.seed), dataset.n_features)
+    params0 = jax.tree.map(lambda p: p.astype(dtype), params0)
+    state0 = optimizer.init_state(params0)
+    key = jax.random.key(cfg.seed + 1)
+
+    def body(Xa, ya, state, xs):
+        eta, i = xs
+        rs = sched_fn(jax.random.fold_in(key, i.astype(jnp.int32)))
+        slot_w = step_lib.expand_slot_weights(
+            rs.message_weights.astype(dtype), coeffs, slot_coded
+        )
+        g = grad_fn(state.params, Xa, ya, slot_w)
+        new_state = update_fn(state, g, eta, alpha, n_train, i.astype(dtype))
+        return new_state, (
+            new_state.params, rs.sim_time, rs.worker_times, rs.collected
+        )
+
+    @jax.jit
+    def run(state, Xa, ya, lr_c, it_c):
+        return jax.lax.scan(partial(body, Xa, ya), state, (lr_c, it_c))
+
+    iters = jnp.arange(cfg.rounds)
+    t0 = time.perf_counter()
+    final_state, (hist, sim, wtimes, collected) = run(
+        state0, X, y, lr_seq, iters
+    )
+    _hard_sync(final_state)
+    wall = time.perf_counter() - t0
+
+    sim = np.asarray(sim, np.float64)
+    return TrainResult(
+        params_history=hist,
+        final_params=final_state.params,
+        timeset=sim,
+        worker_times=np.asarray(wtimes, np.float64),
+        collected=np.asarray(collected),
+        sim_total_time=float(sim.sum()),
+        wall_time=wall,
+        steps_per_sec=cfg.rounds / wall if wall > 0 else 0.0,
+        n_train=n_train,
+        config=cfg,
+        layout=layout,
+    )

@@ -1,0 +1,132 @@
+"""On-device dynamic collection (parallel/dynamic.py).
+
+Every jnp rule is pinned against parallel/collect.py's numpy event replay
+on the same arrival matrices, then the fully on-device training scan is
+exercised end-to-end on the mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from erasurehead_tpu.ops import codes
+from erasurehead_tpu.parallel import collect, dynamic, straggler
+from erasurehead_tpu.utils.config import RunConfig, Scheme
+
+R, W, S = 8, 12, 2
+
+
+@pytest.fixture(scope="module")
+def arrivals():
+    return straggler.arrival_schedule(R, W, add_delay=True)
+
+
+def _per_round(rule, t):
+    outs = [rule(jnp.asarray(t[r], jnp.float32)) for r in range(R)]
+    return (
+        np.stack([np.asarray(o.message_weights) for o in outs]),
+        np.array([float(o.sim_time) for o in outs]),
+        np.stack([np.asarray(o.collected) for o in outs]),
+    )
+
+
+def test_all_matches_host(arrivals):
+    w, sim, col = _per_round(dynamic.collect_all_jnp, arrivals)
+    ref = collect.collect_all(arrivals)
+    np.testing.assert_allclose(w, ref.message_weights)
+    np.testing.assert_allclose(sim, ref.sim_time, rtol=1e-6)
+    np.testing.assert_array_equal(col, ref.collected)
+
+
+def test_avoidstragg_matches_host(arrivals):
+    w, sim, col = _per_round(
+        lambda t: dynamic.collect_avoidstragg_jnp(t, S), arrivals
+    )
+    ref = collect.collect_avoidstragg(arrivals, S)
+    np.testing.assert_allclose(w, ref.message_weights, rtol=1e-6)
+    np.testing.assert_allclose(sim, ref.sim_time, rtol=1e-6)
+    np.testing.assert_array_equal(col, ref.collected)
+
+
+def test_frc_matches_host(arrivals):
+    layout = codes.frc_layout(W, S)
+    onehot = jnp.asarray(dynamic._group_onehot(np.asarray(layout.groups)))
+    w, sim, col = _per_round(
+        lambda t: dynamic.collect_frc_jnp(t, onehot), arrivals
+    )
+    ref = collect.collect_frc(arrivals, layout.groups)
+    np.testing.assert_allclose(w, ref.message_weights)
+    np.testing.assert_allclose(sim, ref.sim_time, rtol=1e-6)
+    np.testing.assert_array_equal(col, ref.collected)
+
+
+@pytest.mark.parametrize("num_collect", [4, 7, 10])
+def test_agc_matches_host(arrivals, num_collect):
+    layout = codes.frc_layout(W, S)
+    onehot = jnp.asarray(dynamic._group_onehot(np.asarray(layout.groups)))
+    w, sim, col = _per_round(
+        lambda t: dynamic.collect_agc_jnp(t, onehot, num_collect), arrivals
+    )
+    ref = collect.collect_agc(arrivals, layout.groups, num_collect)
+    np.testing.assert_allclose(w, ref.message_weights)
+    np.testing.assert_allclose(sim, ref.sim_time, rtol=1e-6)
+    np.testing.assert_array_equal(col, ref.collected)
+
+
+def test_mds_decode_exactness(arrivals):
+    """On-device fp32 decode must reconstruct the all-ones vector on the
+    collected support (small W keeps fp32 conditioning safe — see
+    ops/codes.mds_decode_weights docstring)."""
+    layout = codes.cyclic_mds_layout(W, S, seed=0)
+    rule = lambda t: dynamic.collect_first_k_mds_jnp(
+        t, jnp.asarray(layout.B, jnp.float32), S
+    )
+    w, sim, col = _per_round(rule, arrivals)
+    ref = collect.collect_first_k_mds(arrivals, layout.B, S)
+    np.testing.assert_array_equal(col, ref.collected)
+    np.testing.assert_allclose(sim, ref.sim_time, rtol=1e-6)
+    recon = w @ layout.B
+    np.testing.assert_allclose(recon, np.ones((R, W)), atol=5e-3)
+
+
+def test_ranks_tie_break_matches_order():
+    t = jnp.asarray([0.0, 0.0, 1.0, 0.0])
+    ranks = np.asarray(dynamic._ranks(t))
+    assert ranks.tolist() == [0, 1, 3, 2]  # index order among ties
+
+
+def test_partial_schemes_rejected():
+    layout = codes.partial_frc_layout(W, S + 2, S)
+    with pytest.raises(ValueError, match="partial"):
+        dynamic.make_round_schedule_fn(Scheme.PARTIAL_FRC, layout)
+
+
+@pytest.mark.parametrize("scheme,kw", [
+    ("approx", dict(num_collect=8)),
+    ("cyccoded", {}),
+    ("naive", {}),
+])
+def test_train_dynamic_end_to_end(scheme, kw):
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.models.glm import LogisticModel
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+    from erasurehead_tpu.train import trainer
+
+    cfg = RunConfig(
+        scheme=scheme, n_workers=W, n_stragglers=S, rounds=10,
+        n_rows=16 * W, n_cols=16, lr_schedule=1.0, update_rule="AGD",
+        add_delay=True, seed=0, **kw,
+    )
+    data = generate_gmm(cfg.n_rows, cfg.n_cols, n_partitions=W, seed=0)
+    res = trainer.train_dynamic(cfg, data, mesh=worker_mesh(4))
+    hist = np.asarray(res.params_history)
+    assert hist.shape == (10, 16) and np.isfinite(hist).all()
+    assert res.timeset.shape == (10,) and (res.timeset > 0).all()
+    assert res.worker_times.shape == (10, W)
+    model = LogisticModel()
+    Xt, yt = jnp.asarray(data.X_test), jnp.asarray(data.y_test)
+    first = float(model.loss_mean(jnp.asarray(hist[0]), Xt, yt))
+    last = float(model.loss_mean(jnp.asarray(hist[-1]), Xt, yt))
+    assert last < first * 0.8
