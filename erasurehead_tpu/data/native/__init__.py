@@ -1,0 +1,102 @@
+"""ctypes binding for the native text-matrix parser (loadtxt.cpp).
+
+Build-on-first-use: the shared object is compiled next to the source with
+g++ and cached; any failure (no toolchain, parse error, weird file) makes
+:func:`load_dense_text_native` return None and the caller (data/io.py)
+falls back to np.loadtxt. The native path is a pure accelerator — never a
+correctness dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "loadtxt.cpp")
+_SO = os.path.join(_DIR, "_loadtxt.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _compile() -> str:
+    if not (
+        os.path.exists(_SO)
+        and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+    ):
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+            check=True,
+            capture_output=True,
+        )
+    return _SO
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The compiled library, or None if the toolchain is unavailable."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            lib = ctypes.CDLL(_compile())
+        except Exception:
+            _build_failed = True
+            return None
+        lib.eh_parse.restype = ctypes.c_long
+        lib.eh_parse.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_long,
+        ]
+        lib.eh_rows.restype = ctypes.c_long
+        lib.eh_rows.argtypes = [ctypes.c_char_p]
+        lib.eh_parse_alloc.restype = ctypes.POINTER(ctypes.c_double)
+        lib.eh_parse_alloc.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.eh_free.restype = None
+        lib.eh_free.argtypes = [ctypes.POINTER(ctypes.c_double)]
+        _lib = lib
+        return _lib
+
+
+def load_dense_text_native(path: str) -> Optional[np.ndarray]:
+    """np.loadtxt-compatible parse of a dense text matrix, or None.
+
+    Matches np.loadtxt's squeeze semantics for the shapes the reference
+    writes (R x C matrices and label vectors): a single-row or
+    single-column file comes back 1-D.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    n_vals = ctypes.c_long()
+    n_rows = ctypes.c_long()
+    ptr = lib.eh_parse_alloc(
+        os.fsencode(path), ctypes.byref(n_vals), ctypes.byref(n_rows)
+    )
+    if not ptr:
+        return None  # io/parse error: let np.loadtxt decide / report
+    try:
+        n, rows = n_vals.value, n_rows.value
+        if n <= 0 or rows <= 0 or n % rows != 0:
+            return None  # empty or ragged: np.loadtxt's message is better
+        out = np.ctypeslib.as_array(ptr, shape=(n,)).copy()
+    finally:
+        lib.eh_free(ptr)
+    m = out.reshape(rows, n // rows)
+    if m.shape[0] == 1:
+        return m[0]
+    if m.shape[1] == 1:
+        return m[:, 0]
+    return m

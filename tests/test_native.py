@@ -1,0 +1,77 @@
+"""Native text parser (data/native) vs np.loadtxt, value-for-value."""
+
+import numpy as np
+import pytest
+
+from erasurehead_tpu.data import native
+
+
+@pytest.fixture(scope="module")
+def lib_available():
+    if native.get_lib() is None:
+        pytest.skip("no C++ toolchain; np.loadtxt fallback covers this")
+
+
+def _roundtrip(tmp_path, m, fmt="%.18g"):
+    p = str(tmp_path / "m.dat")
+    np.savetxt(p, np.atleast_2d(m), fmt=fmt)
+    want = np.loadtxt(p, dtype=np.float64)
+    got = native.load_dense_text_native(p)
+    assert got is not None
+    assert got.shape == want.shape
+    np.testing.assert_array_equal(got, want)  # bitwise: same strtod grammar
+
+
+def test_matrix_roundtrip(tmp_path, lib_available):
+    rng = np.random.default_rng(0)
+    _roundtrip(tmp_path, rng.standard_normal((37, 11)) * 10.0 ** rng.integers(-30, 30, (37, 11)))
+
+
+def test_label_vector_roundtrip(tmp_path, lib_available):
+    _roundtrip(tmp_path, np.asarray([1.0, -1.0, -1.0, 1.0]))
+
+
+def test_single_row_squeeze(tmp_path, lib_available):
+    _roundtrip(tmp_path, np.asarray([[1.5, 2.5, 3.5]]))
+
+
+def test_special_values(tmp_path, lib_available):
+    _roundtrip(tmp_path, np.asarray([[np.inf, -np.inf], [1e-300, 1e300]]))
+
+
+def test_reference_save_format(tmp_path, lib_available):
+    """The %5.3f style the reference writes (src/util.py:32-36)."""
+    _roundtrip(tmp_path, np.asarray([[0.123456, -7.5], [42.0, 0.001]]), fmt="%5.3f")
+
+
+def test_ragged_file_falls_back(tmp_path, lib_available):
+    p = str(tmp_path / "ragged.dat")
+    with open(p, "w") as f:
+        f.write("1 2 3\n4 5\n")
+    assert native.load_dense_text_native(p) is None
+
+
+def test_non_numeric_falls_back(tmp_path, lib_available):
+    p = str(tmp_path / "bad.dat")
+    with open(p, "w") as f:
+        f.write("1 2\nfoo 4\n")
+    assert native.load_dense_text_native(p) is None
+
+
+def test_missing_file_returns_none(tmp_path, lib_available):
+    assert native.load_dense_text_native(str(tmp_path / "nope.dat")) is None
+
+
+def test_io_integration(tmp_path, lib_available):
+    """load_dense_text routes through the native parser on cold load and
+    the .npy sidecar afterwards; all three agree."""
+    from erasurehead_tpu.data import io as data_io
+
+    rng = np.random.default_rng(1)
+    m = rng.standard_normal((23, 7))
+    p = str(tmp_path / "x.dat")
+    data_io.save_dense_text(p, m)
+    cold = data_io.load_dense_text(p)
+    warm = data_io.load_dense_text(p)  # .npy sidecar
+    np.testing.assert_allclose(cold, m, rtol=0, atol=0)
+    np.testing.assert_array_equal(cold, warm)
