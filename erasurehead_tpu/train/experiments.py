@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from typing import Optional, Sequence
 
 import numpy as np
@@ -319,6 +320,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--rounds", type=int, default=30)
     p.add_argument("--data-dir", default=None, help="prepared real data root")
     p.add_argument("--out", default=None, help="write summaries JSON here")
+    p.add_argument("--figures", default=None,
+                   help="render comparison PNGs into this directory")
     ns = p.parse_args(argv)
 
     suite = baseline_suite(scale=ns.scale, data_dir=ns.data_dir, rounds=ns.rounds)
@@ -327,6 +330,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"\n== {name} ==")
         print(format_table(summaries))
         all_rows.extend(summaries)
+        if ns.figures:
+            from erasurehead_tpu.train import plots
+
+            fig = plots.save_comparison_figure(
+                summaries, os.path.join(ns.figures, f"{name}.png"), title=name
+            )
+            if fig:
+                print(f"figure -> {fig}")
     if ns.out:
         save_summaries(all_rows, ns.out)
         print(f"\nsummaries -> {ns.out}")

@@ -1,0 +1,52 @@
+"""Figure rendering (train/plots.py): files produced, degenerate inputs ok."""
+
+import os
+
+import numpy as np
+
+from erasurehead_tpu.data.synthetic import generate_gmm
+from erasurehead_tpu.train import experiments, plots
+from erasurehead_tpu.utils.config import RunConfig
+
+W = 8
+
+
+def _summaries():
+    data = generate_gmm(16 * W, 16, n_partitions=W, seed=0)
+    base = dict(
+        n_workers=W, n_stragglers=1, rounds=6, n_rows=16 * W, n_cols=16,
+        lr_schedule=1.0, update_rule="AGD", add_delay=True, seed=0,
+    )
+    cfgs = {
+        "naive": RunConfig(scheme="naive", **base),
+        "approx": RunConfig(scheme="approx", num_collect=5, **base),
+    }
+    return experiments.compare(cfgs, data)
+
+
+def test_comparison_figure_renders(tmp_path):
+    summaries = _summaries()
+    out = str(tmp_path / "cmp.png")
+    assert plots.save_comparison_figure(summaries, out, title="t") == out
+    assert os.path.getsize(out) > 10_000
+
+
+def test_comparison_handles_unreached_target(tmp_path):
+    summaries = _summaries()
+    summaries[0].time_to_target = None
+    out = str(tmp_path / "cmp2.png")
+    assert plots.save_comparison_figure(summaries, out) == out
+
+
+def test_sweep_figure_renders(tmp_path):
+    summaries = _summaries()
+    sweep = {"approx": [s for s in summaries if s.label == "approx"]}
+    out = str(tmp_path / "sweep.png")
+    assert plots.save_sweep_figure(sweep, out, title="t") == out
+    assert os.path.getsize(out) > 5_000
+
+
+def test_scheme_colors_are_stable():
+    """Color follows the scheme entity: filtering must not repaint."""
+    assert plots.SCHEME_COLORS["naive"] == "#2a78d6"
+    assert len(set(plots.SCHEME_COLORS.values())) == len(plots.SCHEME_COLORS)
