@@ -124,6 +124,9 @@ def _flags_parser() -> argparse.ArgumentParser:
     p.add_argument("--compute-mode", default="faithful", choices=["faithful", "deduped"])
     p.add_argument("--use-pallas", default="auto", choices=["auto", "on", "off"],
                    help="fused pallas gradient kernel (ops/kernels.py)")
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="DATA dtype (params/updates stay float32)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--trace-dir", default=None,
                    help="capture a jax.profiler device trace here")
@@ -159,6 +162,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         partitions_per_worker=ns.partitions_per_worker,
         compute_mode=ns.compute_mode,
         use_pallas=ns.use_pallas,
+        dtype=ns.dtype,
         seed=ns.seed,
     )
 

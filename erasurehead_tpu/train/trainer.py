@@ -142,7 +142,11 @@ def train(
         need = layout.n_workers if faithful else layout.n_partitions
         avail = len(jax.devices())
         mesh = worker_mesh(max(d for d in range(1, avail + 1) if need % d == 0))
-    data = shard_run_data(dataset, layout, mesh, faithful=faithful)
+    # cfg.dtype is the DATA dtype (bfloat16 halves HBM traffic on the
+    # bandwidth-bound gradient pass); params/optimizer state stay float32
+    data = shard_run_data(
+        dataset, layout, mesh, faithful=faithful, dtype=jnp.dtype(cfg.dtype)
+    )
 
     # ---- control plane (host, float64) ------------------------------------
     if arrivals is None:
@@ -159,7 +163,7 @@ def train(
     alpha = cfg.effective_alpha
     n_train = data.n_train
 
-    dtype = jnp.dtype(cfg.dtype)
+    dtype = jnp.float32  # param/update dtype is always f32 (see above)
     # the coded/separate slot rule lives only in expand_slot_weights; both
     # compute modes derive from its output (float64 on host)
     slot_w = np.asarray(
@@ -326,13 +330,15 @@ def train_dynamic(cfg: RunConfig, dataset: Dataset, mesh=None) -> TrainResult:
         avail = len(jax.devices())
         need = layout.n_workers
         mesh = worker_mesh(max(d for d in range(1, avail + 1) if need % d == 0))
-    data = shard_run_data(dataset, layout, mesh, faithful=True)
+    data = shard_run_data(
+        dataset, layout, mesh, faithful=True, dtype=jnp.dtype(cfg.dtype)
+    )
     sched_fn = dynamic_lib.make_round_schedule_fn(
         cfg.scheme, layout, cfg.num_collect, cfg.delay_mean, cfg.add_delay
     )
     grad_fn = step_lib.make_faithful_grad_fn(model, mesh)
     update_fn = optimizer.make_update_fn(cfg.update_rule)
-    dtype = jnp.dtype(cfg.dtype)
+    dtype = jnp.float32  # param/update dtype (cfg.dtype is the data dtype)
     coeffs = jnp.asarray(layout.coeffs, dtype)
     slot_coded = jnp.asarray(np.asarray(layout.slot_is_coded))
     lr_seq = jnp.asarray(cfg.resolve_lr_schedule(), dtype)

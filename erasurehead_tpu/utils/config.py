@@ -113,6 +113,8 @@ class RunConfig:
     partitions_per_worker: int = 0  # >0 selects partial schemes' slot count
     compute_mode: ComputeMode = ComputeMode.FAITHFUL
     seed: int = 0  # model init + generator matrix (reference: unseeded)
+    # DATA dtype: bfloat16 halves HBM traffic on the gradient pass; model
+    # params and optimizer updates always run in float32 (mixed precision)
     dtype: str = "float32"
     # fused pallas gradient kernel (ops/kernels.py): "on" forces it
     # (interpret mode off-TPU), "off" disables, "auto" lets

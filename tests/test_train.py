@@ -13,6 +13,7 @@ import pytest
 
 from erasurehead_tpu.data.synthetic import generate_gmm, generate_linear
 from erasurehead_tpu.models.glm import LogisticModel
+from erasurehead_tpu.parallel.mesh import worker_mesh
 from erasurehead_tpu.train import evaluate, trainer
 from erasurehead_tpu.utils.config import ModelKind, RunConfig, Scheme, UpdateRule
 
@@ -243,3 +244,29 @@ def test_avoidstragg_sim_clock_beats_naive(gmm):
     )
     assert av.sim_total_time < naive.sim_total_time
     assert (av.collected.sum(axis=1) == W - 2).all()
+
+
+def test_bfloat16_data_dtype(gmm):
+    """cfg.dtype casts the data only: params/updates stay float32, the run
+    stays finite, and the trajectory tracks the f32 run to bf16 precision."""
+    import jax.numpy as jnp
+
+    from erasurehead_tpu.utils.config import RunConfig
+
+    hists = {}
+    for dt in ("float32", "bfloat16"):
+        cfg = RunConfig(
+            scheme="approx", n_workers=W, n_stragglers=1, num_collect=6,
+            rounds=5, n_rows=N_ROWS, n_cols=N_COLS,
+            lr_schedule=1.0, update_rule="AGD", add_delay=True, seed=0,
+            dtype=dt,
+        )
+        res = trainer.train(cfg, gmm, mesh=worker_mesh(4))
+        assert np.asarray(res.params_history).dtype == np.float32
+        hists[dt] = np.asarray(res.params_history, np.float32)
+    assert np.isfinite(hists["bfloat16"]).all()
+    rel = np.max(
+        np.abs(hists["float32"] - hists["bfloat16"])
+        / (np.abs(hists["float32"]) + 1e-6)
+    )
+    assert rel < 0.15  # bf16 quantization drift, not divergence
