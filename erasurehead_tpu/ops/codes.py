@@ -272,6 +272,66 @@ def frc_layout(n_workers: int, n_stragglers: int) -> CodingLayout:
     )
 
 
+def random_regular_layout(
+    n_workers: int, n_stragglers: int, seed: int = 0
+) -> CodingLayout:
+    """Sparse random d-regular bipartite assignment, d = s+1 (beyond the
+    reference; arXiv 1711.06771 via PAPERS.md).
+
+    W partitions, each worker holds d distinct partitions and each partition
+    sits on d distinct workers (superimposed random perfect matchings —
+    the configuration model). All coefficients 1; the decode is the optimal
+    least-squares combination of whichever messages arrive (arXiv
+    2006.09638), via the same masked-lstsq machinery as the MDS path
+    (mds_decode_weights_host on the 0/1 incidence matrix B). Same s+1
+    storage overhead as FRC; the structural difference is graceful
+    degradation — error shrinks smoothly with every extra message and hits
+    exactly zero at full collection ((1/d)*sum of all rows == all-ones),
+    where FRC-AGC erases whole groups all-or-nothing. (At small W with
+    light straggling FRC's group structure can still decode tighter;
+    tests pin the provable properties, not scheme dominance.)
+    """
+    W, d = n_workers, n_stragglers + 1
+    if d > W:
+        raise ValueError(f"degree {d} exceeds n_workers {W}")
+    rng = np.random.default_rng(seed)
+    assignment = np.empty((W, d), dtype=np.int64)
+    # d superimposed random perfect matchings (configuration model),
+    # re-drawing any matching that would hand a worker a duplicate
+    # partition. Dense degrees (d close to W) reject most draws, so after
+    # bounded retries fall back to d shifts of one random permutation —
+    # still d-regular and seeded, just less graph-random.
+    def _draw() -> bool:
+        for k in range(d):
+            for _ in range(200):
+                perm = rng.permutation(W)
+                if k == 0 or not any(
+                    perm[w] in assignment[w, :k] for w in range(W)
+                ):
+                    assignment[:, k] = perm
+                    break
+            else:
+                return False
+        return True
+
+    if not _draw():
+        sigma = rng.permutation(W)
+        for k in range(d):
+            assignment[:, k] = (sigma + k) % W
+    B = np.zeros((W, W))
+    B[np.arange(W)[:, None], assignment] = 1.0
+    return CodingLayout(
+        name="randreg",
+        n_workers=W,
+        n_partitions=W,
+        assignment=assignment.astype(np.int32),
+        coeffs=np.ones((W, d)),
+        slot_is_coded=np.ones(d, dtype=bool),
+        n_stragglers=n_stragglers,
+        B=B,
+    )
+
+
 def partial_cyclic_layout(
     n_workers: int,
     n_partitions_per_worker: int,

@@ -164,6 +164,29 @@ def collect_agc(
     )
 
 
+def collect_first_k_optimal(
+    t: np.ndarray, B: np.ndarray, num_collect: int
+) -> CollectionSchedule:
+    """Optimal-decoding AGC (beyond the reference; arXiv 2006.09638 via
+    PAPERS.md): stop at the first ``num_collect`` arrivals and take the
+    least-squares-optimal combination of their messages — the weights
+    minimizing ||w^T B - 1||_2 over the received rows of the incidence
+    matrix. Exact when the received rows span the all-ones vector;
+    otherwise the minimum-error approximate gradient (vs FRC-AGC's
+    all-or-nothing group erasures)."""
+    R, W = t.shape
+    ranks = _rank(t)
+    collected = ranks < num_collect
+    weights = codes.mds_decode_weights_host(B, collected)
+    kth_time = np.where(ranks == num_collect - 1, t, -np.inf).max(axis=1)
+    return CollectionSchedule(
+        message_weights=weights,
+        sim_time=kth_time,
+        worker_times=_stamp(t, collected),
+        collected=collected,
+    )
+
+
 def collect_avoidstragg(t: np.ndarray, n_stragglers: int) -> CollectionSchedule:
     """Ignore-stragglers baseline: sum the first W-s uncoded gradients and
     rescale by W/(W-s) for unbiasedness — the reference folds the rescale
@@ -272,6 +295,10 @@ def build_schedule(
         if num_collect is None:
             raise ValueError("AGC needs num_collect")
         return collect_agc(t, layout.groups, num_collect)
+    if scheme == Scheme.RANDOM_REGULAR:
+        if num_collect is None:
+            raise ValueError("randreg needs num_collect")
+        return collect_first_k_optimal(t, layout.B, num_collect)
     if scheme == Scheme.AVOID_STRAGGLERS:
         return collect_avoidstragg(t, layout.n_stragglers)
     if scheme == Scheme.PARTIAL_CYCLIC:

@@ -69,12 +69,17 @@ def collect_all_jnp(t: jnp.ndarray) -> RoundSchedule:
 def collect_first_k_mds_jnp(
     t: jnp.ndarray, B: jnp.ndarray, n_stragglers: int
 ) -> RoundSchedule:
-    W = t.shape[0]
+    return _first_k_lstsq_jnp(t, B, t.shape[0] - n_stragglers)
+
+
+def _first_k_lstsq_jnp(t: jnp.ndarray, B: jnp.ndarray, k: int) -> RoundSchedule:
+    """Stop at the k-th arrival, lstsq-decode over the received rows of B
+    (exact MDS for k = W-s; optimal-decoding randreg for k = num_collect)."""
     ranks = _ranks(t)
-    mask = ranks < W - n_stragglers
+    mask = ranks < k
     return RoundSchedule(
         codes.mds_decode_weights(B, mask),
-        _kth_arrival_time(t, ranks, W - n_stragglers),
+        _kth_arrival_time(t, ranks, k),
         mask,
     )
 
@@ -152,6 +157,10 @@ def make_round_schedule_fn(
         if num_collect is None:
             raise ValueError("AGC needs num_collect")
         rule = lambda t: collect_agc_jnp(t, onehot, num_collect)
+    elif scheme == Scheme.RANDOM_REGULAR:
+        if num_collect is None:
+            raise ValueError("randreg needs num_collect")
+        rule = lambda t: _first_k_lstsq_jnp(t, B, num_collect)
     else:
         raise ValueError(
             f"{scheme.value}: partial schemes use the host control plane "

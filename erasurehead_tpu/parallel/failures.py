@@ -129,6 +129,11 @@ def analyze(
             raise ValueError("AGC needs num_collect")
         feasible = (alive_cnt >= num_collect) | all_groups_alive
         reason = f"needs {num_collect} arrivals or full group coverage"
+    elif scheme == Scheme.RANDOM_REGULAR:
+        if num_collect is None:
+            raise ValueError("randreg needs num_collect")
+        feasible = alive_cnt >= num_collect
+        reason = f"needs first {num_collect} arrivals"
     elif scheme in (Scheme.PARTIAL_CYCLIC, Scheme.PARTIAL_FRC):
         feasible = alive_cnt == W
         reason = "needs every worker's uncoded first-part"

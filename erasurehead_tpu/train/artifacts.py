@@ -50,6 +50,7 @@ SCHEME_PREFIX = {
     "avoidstragg": "avoidstragg_acc",
     "partialcyccoded": "partialcoded",
     "partialrepcoded": "partialreplication",
+    "randreg": "randreg_acc",  # beyond-reference scheme, own prefix
 }
 
 

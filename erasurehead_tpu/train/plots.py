@@ -30,8 +30,9 @@ SCHEME_COLORS = {
     "avoidstragg": "#e87ba4",
     "partialcyccoded": "#008300",
     "partialrepcoded": "#4a3aa7",
+    "randreg": "#e34948",
 }
-_FALLBACK = "#e34948"  # slot 8 for unknown labels
+_FALLBACK = "#6b6a60"  # neutral "Other" gray for unknown labels
 _INK = "#1a1a19"
 _INK_2 = "#6b6a60"
 _GRID = "#e8e7e0"

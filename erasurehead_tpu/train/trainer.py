@@ -59,6 +59,8 @@ def build_layout(cfg: RunConfig) -> codes.CodingLayout:
         return codes.cyclic_mds_layout(W, s, seed=cfg.seed)
     if cfg.scheme in (Scheme.FRC, Scheme.APPROX):
         return codes.frc_layout(W, s)
+    if cfg.scheme == Scheme.RANDOM_REGULAR:
+        return codes.random_regular_layout(W, s, seed=cfg.seed)
     if cfg.scheme == Scheme.PARTIAL_CYCLIC:
         return codes.partial_cyclic_layout(
             W, cfg.partitions_per_worker, s, seed=cfg.seed

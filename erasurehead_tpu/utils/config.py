@@ -26,6 +26,10 @@ class Scheme(str, enum.Enum):
     AVOID_STRAGGLERS = "avoidstragg"  # ignore-stragglers baseline (src/avoidstragg.py)
     PARTIAL_CYCLIC = "partialcyccoded"  # two-part coded   (src/partial_coded.py)
     PARTIAL_FRC = "partialrepcoded"  # two-part replicated (src/partial_replication.py)
+    # beyond the reference: sparse random-graph AGC with optimal (lstsq)
+    # decoding — arXiv 1711.06771 + 2006.09638 (PAPERS.md); same s+1
+    # storage overhead as FRC/cyclic, lower erasure error at equal budget
+    RANDOM_REGULAR = "randreg"
 
 
 class UpdateRule(str, enum.Enum):
