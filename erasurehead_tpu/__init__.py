@@ -23,4 +23,25 @@ Layout:
 
 __version__ = "0.1.0"
 
-from erasurehead_tpu.utils.config import RunConfig, Scheme, UpdateRule  # noqa: F401
+from erasurehead_tpu.utils.config import (  # noqa: F401
+    ComputeMode,
+    ModelKind,
+    RunConfig,
+    Scheme,
+    UpdateRule,
+)
+
+
+def train(cfg, dataset, **kw):
+    """Convenience re-export of train.trainer.train (lazy: importing the
+    package must not pull in jax)."""
+    from erasurehead_tpu.train import trainer
+
+    return trainer.train(cfg, dataset, **kw)
+
+
+def train_dynamic(cfg, dataset, **kw):
+    """Convenience re-export of train.trainer.train_dynamic."""
+    from erasurehead_tpu.train import trainer
+
+    return trainer.train_dynamic(cfg, dataset, **kw)

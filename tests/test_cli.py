@@ -1,0 +1,65 @@
+"""CLI integration at the reference's canonical scale (W=30).
+
+Small-W toys hide conditioning/scale bugs (the fp32-decode issue class),
+so this drives the real entry point at 30 workers end-to-end: train ->
+eval replay -> the five reference artifacts on disk. Deduped compute mode
+keeps it fast on the CPU mesh.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from erasurehead_tpu import cli
+
+W = 30
+
+
+@pytest.mark.parametrize(
+    "scheme,extra",
+    [
+        ("approx", ["--num-collect", "15"]),
+        ("cyccoded", []),
+        ("randreg", ["--num-collect", "20"]),
+    ],
+)
+def test_cli_canonical_scale(tmp_path, scheme, extra):
+    data_dir = str(tmp_path / "data")
+    rc = cli.main(
+        [
+            "--scheme", scheme, "--workers", str(W), "--stragglers", "2",
+            "--rounds", "5", "--rows", str(60 * W), "--cols", "24",
+            "--update-rule", "AGD", "--lr", "1.0", "--add-delay",
+            "--compute-mode", "deduped", "--input-dir", data_dir, "--quiet",
+        ]
+        + extra
+    )
+    assert rc == 0
+    results = os.path.join(
+        data_dir, "artificial-data", f"{60 * W}x24", str(W), "results"
+    )
+    files = os.listdir(results)
+    for kind in (
+        "training_loss", "testing_loss", "auc", "timeset", "worker_timeset"
+    ):
+        assert any(kind in f for f in files), (kind, files)
+    # the loss curve is finite and decreasing overall
+    loss_file = next(f for f in files if "training_loss" in f)
+    losses = np.loadtxt(os.path.join(results, loss_file))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_cli_legacy_13_args(tmp_path):
+    """The reference's exact positional calling convention (main.py:20-27):
+    n_procs n_rows n_cols input_dir is_real dataset is_coded n_stragglers
+    partitions coded_ver num_collect add_delay update_rule."""
+    data_dir = str(tmp_path / "legacy")
+    rc = cli.main(
+        [
+            "31", "1860", "16", data_dir, "0", "artificial", "1", "2",
+            "0", "3", "15", "1", "AGD",
+        ]
+    )
+    assert rc == 0
