@@ -115,7 +115,7 @@ def _flags_parser() -> argparse.ArgumentParser:
     p.add_argument("--cols", type=int, default=100)
     p.add_argument("--input-dir", default=None, help="reference-layout data dir")
     p.add_argument("--output-dir", default=None, help="artifact dir (default <input>/results)")
-    p.add_argument("--update-rule", default="AGD", choices=["GD", "AGD"])
+    p.add_argument("--update-rule", default="AGD", choices=["GD", "AGD", "ADAM"])
     p.add_argument("--lr", type=float, default=None, help="constant lr override")
     p.add_argument("--alpha", type=float, default=None, help="l2 coefficient")
     p.add_argument("--add-delay", action="store_true")

@@ -33,8 +33,14 @@ class OptState(NamedTuple):
     momentum: Params  # AGD's u sequence; unused by GD
 
 
-def init_state(params: Params) -> OptState:
-    return OptState(params=params, momentum=jax.tree.map(jnp.zeros_like, params))
+def init_state(params: Params, rule: UpdateRule = UpdateRule.AGD) -> OptState:
+    """``momentum`` holds AGD's u sequence; for ADAM it holds the
+    (mu, nu) moment pair as a 2-tuple pytree (bias-correction count comes
+    from the iteration index the trainer already passes in)."""
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    if UpdateRule(rule) == UpdateRule.ADAM:
+        return OptState(params=params, momentum=(zeros, zeros))
+    return OptState(params=params, momentum=zeros)
 
 
 def gd_update(
@@ -63,5 +69,36 @@ def agd_update(
     return OptState(params=new_p, momentum=new_u)
 
 
+def adam_update(
+    state: OptState, g: Params, eta: jnp.ndarray, alpha: float, n_samples: int, i
+) -> OptState:
+    """Adam (beyond the reference) on the same objective the GD rule
+    descends: mean loss + alpha*||params||^2, so g/n + 2*alpha*params is
+    the gradient fed to the moments. Bias correction uses the iteration
+    index the scan already threads through (t = i+1)."""
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    t = i + 1.0
+    mu, nu = state.momentum
+
+    def leaf(p, m, v, gg):
+        grad = gg / n_samples + 2.0 * alpha * p
+        m_new = b1 * m + (1.0 - b1) * grad
+        v_new = b2 * v + (1.0 - b2) * grad * grad
+        m_hat = m_new / (1.0 - b1**t)
+        v_hat = v_new / (1.0 - b2**t)
+        p_new = p - eta * m_hat / (jnp.sqrt(v_hat) + eps)
+        return p_new, m_new, v_new
+
+    triples = jax.tree.map(leaf, state.params, mu, nu, g)
+    is_triple = lambda x: isinstance(x, tuple) and len(x) == 3
+    pick = lambda k: jax.tree.map(lambda x: x[k], triples, is_leaf=is_triple)
+    return OptState(params=pick(0), momentum=(pick(1), pick(2)))
+
+
 def make_update_fn(rule: UpdateRule):
-    return gd_update if UpdateRule(rule) == UpdateRule.GD else agd_update
+    rule = UpdateRule(rule)
+    if rule == UpdateRule.GD:
+        return gd_update
+    if rule == UpdateRule.ADAM:
+        return adam_update
+    return agd_update

@@ -207,7 +207,7 @@ def train(
 
     params0 = model.init_params(jax.random.key(cfg.seed), dataset.n_features)
     params0 = jax.tree.map(lambda p: p.astype(dtype), params0)
-    state0 = optimizer.init_state(params0)
+    state0 = optimizer.init_state(params0, cfg.update_rule)
     state0 = jax.tree.map(
         lambda l: put_global(np.asarray(l), replicated(mesh)),
         state0,
@@ -350,7 +350,7 @@ def train_dynamic(cfg: RunConfig, dataset: Dataset, mesh=None) -> TrainResult:
 
     params0 = model.init_params(jax.random.key(cfg.seed), dataset.n_features)
     params0 = jax.tree.map(lambda p: p.astype(dtype), params0)
-    state0 = optimizer.init_state(params0)
+    state0 = optimizer.init_state(params0, cfg.update_rule)
     key = jax.random.key(cfg.seed + 1)
 
     def body(Xa, ya, state, xs):

@@ -35,6 +35,9 @@ class Scheme(str, enum.Enum):
 class UpdateRule(str, enum.Enum):
     GD = "GD"
     AGD = "AGD"  # Nesterov-style accelerated GD (src/naive.py:116-122)
+    # beyond the reference (GD/AGD are its only rules): Adam on the mean
+    # gradient + l2, for the MLP stretch family
+    ADAM = "ADAM"
 
 
 class ModelKind(str, enum.Enum):

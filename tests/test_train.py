@@ -270,3 +270,22 @@ def test_bfloat16_data_dtype(gmm):
         / (np.abs(hists["float32"]) + 1e-6)
     )
     assert rel < 0.15  # bf16 quantization drift, not divergence
+
+
+def test_adam_trains_mlp(gmm):
+    """Adam (beyond-reference rule) on the MLP under AGC coding."""
+    cfg = RunConfig(
+        scheme="approx", model="mlp", n_workers=W, n_stragglers=1,
+        num_collect=6, rounds=25, n_rows=N_ROWS, n_cols=N_COLS,
+        lr_schedule=3e-3, update_rule="ADAM", add_delay=True, seed=0,
+    )
+    res = trainer.train(cfg, gmm, mesh=worker_mesh(4))
+    model = trainer.build_model(cfg)
+    import jax.numpy as jnp
+
+    Xt, yt = jnp.asarray(gmm.X_test), jnp.asarray(gmm.y_test)
+    first = jax.tree.map(lambda l: l[0], res.params_history)
+    last = res.final_params
+    l0 = float(model.loss_mean(first, Xt, yt))
+    l1 = float(model.loss_mean(last, Xt, yt))
+    assert np.isfinite(l1) and l1 < l0 * 0.8
