@@ -120,6 +120,10 @@ def _flags_parser() -> argparse.ArgumentParser:
     p.add_argument("--alpha", type=float, default=None, help="l2 coefficient")
     p.add_argument("--add-delay", action="store_true")
     p.add_argument("--delay-mean", type=float, default=0.5)
+    p.add_argument("--compute-time", type=float, default=0.0,
+                   help="simulated per-round compute seconds per worker")
+    p.add_argument("--worker-speed-spread", type=float, default=0.0,
+                   help="uniform per-worker speed spread in [1-s,1+s]")
     p.add_argument("--partitions-per-worker", type=int, default=0)
     p.add_argument("--compute-mode", default="faithful", choices=["faithful", "deduped"])
     p.add_argument("--use-pallas", default="auto", choices=["auto", "on", "off"],
@@ -151,6 +155,8 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         rounds=ns.rounds,
         add_delay=ns.add_delay,
         delay_mean=ns.delay_mean,
+        compute_time=ns.compute_time,
+        worker_speed_spread=ns.worker_speed_spread,
         update_rule=ns.update_rule,
         alpha=ns.alpha,
         lr_schedule=ns.lr,

@@ -82,6 +82,19 @@ class ArrivalModel:
         return np.asarray(delays) + base
 
 
+def model_from_config(cfg) -> "ArrivalModel | None":
+    """ArrivalModel for a RunConfig's heterogeneity fields (None when the
+    config is in the reference's pure-delay regime)."""
+    if not cfg.compute_time and not cfg.worker_speed_spread:
+        return None
+    speed = None
+    if cfg.worker_speed_spread:
+        rng = np.random.default_rng(cfg.seed + 10_007)
+        s = float(cfg.worker_speed_spread)
+        speed = rng.uniform(1.0 - s, 1.0 + s, cfg.n_workers)
+    return ArrivalModel(compute_time=cfg.compute_time, worker_speed=speed)
+
+
 def arrival_schedule(
     rounds: int,
     n_workers: int,

@@ -153,7 +153,8 @@ def train(
     # ---- control plane (host, float64) ------------------------------------
     if arrivals is None:
         arrivals = straggler.arrival_schedule(
-            cfg.rounds, cfg.n_workers, cfg.add_delay, cfg.delay_mean
+            cfg.rounds, cfg.n_workers, cfg.add_delay, cfg.delay_mean,
+            arrival_model=straggler.model_from_config(cfg),
         )
     if schedule is None:
         # a custom schedule (e.g. parallel/failures.plan_run's failover

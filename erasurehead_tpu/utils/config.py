@@ -109,6 +109,11 @@ class RunConfig:
     num_collect: Optional[int] = None  # AGC stop count; None => n_workers
     add_delay: bool = True  # inject the seeded exponential straggler delays
     delay_mean: float = 0.5  # seconds; src/naive.py:146
+    # heterogeneous-cluster arrival model (straggler.ArrivalModel): a base
+    # per-round compute time and a seeded uniform per-worker speed spread
+    # in [1-s, 1+s] multiplying it. 0/0 = the reference's pure-delay regime.
+    compute_time: float = 0.0
+    worker_speed_spread: float = 0.0
     update_rule: UpdateRule = UpdateRule.AGD
     alpha: Optional[float] = None  # l2 coeff; None => 1/n_samples (main.py:34)
     lr_schedule: Optional[Sequence[float]] = None  # None => dataset preset

@@ -206,3 +206,28 @@ def test_reference_delay_schedule_parity():
         np.random.seed(i)
         expect = np.random.exponential(0.5, W)
         assert np.array_equal(sched[i], expect)
+
+
+def test_heterogeneous_arrival_model():
+    """compute_time + worker_speed_spread shift arrivals per worker; the
+    pure-delay reference regime (0/0) is unchanged."""
+    from erasurehead_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        scheme="naive", n_workers=W, n_stragglers=0, rounds=R,
+        compute_time=2.0, worker_speed_spread=0.5, seed=3,
+    )
+    model = straggler.model_from_config(cfg)
+    assert model is not None and model.worker_speed.shape == (W,)
+    assert (model.worker_speed >= 0.5).all() and (model.worker_speed <= 1.5).all()
+    base = straggler.arrival_schedule(R, W, add_delay=True)
+    het = straggler.arrival_schedule(R, W, add_delay=True, arrival_model=model)
+    np.testing.assert_allclose(
+        het - base, np.tile(2.0 * model.worker_speed, (R, 1))
+    )
+    # default config -> None (reference regime)
+    cfg0 = RunConfig(scheme="naive", n_workers=W, n_stragglers=0, rounds=R)
+    assert straggler.model_from_config(cfg0) is None
+    # deterministic per seed
+    m2 = straggler.model_from_config(cfg)
+    np.testing.assert_array_equal(model.worker_speed, m2.worker_speed)
