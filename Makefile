@@ -36,7 +36,7 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 	--dataset $(DATASET) --input-dir $(DATA_DIR) $(ADD_DELAY)
 
 .PHONY: naive cyccoded repcoded avoidstragg approxcoded \
-	partialrepcoded partialcyccoded \
+	partialrepcoded partialcyccoded randreg \
 	generate_random_data arrange_real_data \
 	test bench compare dryrun clean
 
@@ -60,6 +60,9 @@ partialcyccoded:  ## two-part partial MDS scheme (src/partial_coded.py)
 
 partialrepcoded:  ## two-part partial FRC scheme (src/partial_replication.py)
 	$(RUN) --scheme partialrepcoded --partitions-per-worker $(N_PARTITIONS)
+
+randreg:          ## beyond-reference: random-regular code + optimal decode
+	$(RUN) --scheme randreg --num-collect $(N_COLLECT)
 
 generate_random_data:  ## synthetic GMM partitions (src/generate_data.py)
 	$(PY) -m erasurehead_tpu.data.prepare synthetic --rows $(N_ROWS) \
