@@ -31,11 +31,15 @@ def _compile() -> str:
         os.path.exists(_SO)
         and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
     ):
+        # build to a per-pid temp name + atomic rename: concurrent test
+        # processes must never dlopen a half-written .so
+        tmp = f"{_SO}.{os.getpid()}.tmp"
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC],
+            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
             check=True,
             capture_output=True,
         )
+        os.replace(tmp, _SO)
     return _SO
 
 
@@ -50,14 +54,6 @@ def get_lib() -> Optional[ctypes.CDLL]:
         except Exception:
             _build_failed = True
             return None
-        lib.eh_parse.restype = ctypes.c_long
-        lib.eh_parse.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_double),
-            ctypes.c_long,
-        ]
-        lib.eh_rows.restype = ctypes.c_long
-        lib.eh_rows.argtypes = [ctypes.c_char_p]
         lib.eh_parse_alloc.restype = ctypes.POINTER(ctypes.c_double)
         lib.eh_parse_alloc.argtypes = [
             ctypes.c_char_p,

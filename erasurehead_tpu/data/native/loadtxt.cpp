@@ -5,11 +5,11 @@
 // (0.36s vs 2.6s cold).
 //
 // Exposed C ABI (ctypes, see data/native/__init__.py):
-//   eh_parse(path, out, cap): parse every token; out==nullptr counts only.
-//     Returns token count, or <0 on error (-1 io, -2 bad token, -3 cap).
-//   eh_rows(path): number of lines containing at least one token.
+//   eh_parse_alloc(path, &n_vals, &n_rows): single-pass parse into a
+//     malloc'd buffer (nullptr on error; code in n_vals: -1 io, -2 token).
+//   eh_free(buf): release that buffer.
 //
-// Single malloc'd read of the whole file, then one strtod pass. Matches
+// Single malloc'd read of the whole file, then one from_chars pass. Matches
 // np.loadtxt semantics for well-formed numeric matrices (incl. exponents,
 // +/-inf, nan); ragged or non-numeric files report an error and the Python
 // caller falls back to np.loadtxt.
@@ -113,63 +113,5 @@ double* eh_parse_alloc(const char* path, long* n_vals, long* n_rows) {
 }
 
 void eh_free(double* p) { std::free(p); }
-
-long eh_parse(const char* path, double* out, long cap) {
-  long len = 0;
-  char* buf = read_all(path, &len);
-  if (!buf) return -1;
-  long n = 0;
-  const char* p = buf;
-  const char* end = buf + len;
-  while (p < end) {
-    while (p < end && std::isspace(static_cast<unsigned char>(*p))) ++p;
-    if (p >= end) break;
-    double v;
-    // std::from_chars: locale-free, ~3-4x strtod. It rejects a leading
-    // '+' and the inf/nan spellings np.savetxt emits, so fall back to
-    // strtod for any token it refuses.
-    auto res = std::from_chars(p, end, v);
-    const char* q = res.ptr;
-    if (res.ec != std::errc() || q == p) {
-      char* q2 = nullptr;
-      v = std::strtod(p, &q2);
-      if (q2 == p) {
-        std::free(buf);
-        return -2;  // non-numeric token: caller falls back to np.loadtxt
-      }
-      q = q2;
-    }
-    if (out) {
-      if (n >= cap) {
-        std::free(buf);
-        return -3;
-      }
-      out[n] = v;
-    }
-    ++n;
-    p = q;
-  }
-  std::free(buf);
-  return n;
-}
-
-long eh_rows(const char* path) {
-  long len = 0;
-  char* buf = read_all(path, &len);
-  if (!buf) return -1;
-  long rows = 0;
-  bool line_has_token = false;
-  for (const char* p = buf; ; ++p) {
-    if (*p == '\n' || *p == '\0') {
-      if (line_has_token) ++rows;
-      line_has_token = false;
-      if (*p == '\0') break;
-    } else if (!std::isspace(static_cast<unsigned char>(*p))) {
-      line_has_token = true;
-    }
-  }
-  std::free(buf);
-  return rows;
-}
 
 }  // extern "C"

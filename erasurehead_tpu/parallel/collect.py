@@ -100,13 +100,8 @@ def collect_all(t: np.ndarray) -> CollectionSchedule:
     )
 
 
-def collect_first_k_mds(
-    t: np.ndarray, B: np.ndarray, n_stragglers: int
-) -> CollectionSchedule:
-    """Exact MDS coding: stop at the first W-s arrivals, solve decode weights
-    over exactly that set (src/coded.py:137-149)."""
-    R, W = t.shape
-    k = W - n_stragglers
+def _first_k_lstsq(t: np.ndarray, B: np.ndarray, k: int) -> CollectionSchedule:
+    """Stop at the k-th arrival, lstsq-decode over the received rows of B."""
     ranks = _rank(t)
     collected = ranks < k
     weights = codes.mds_decode_weights_host(B, collected)
@@ -117,6 +112,14 @@ def collect_first_k_mds(
         worker_times=_stamp(t, collected),
         collected=collected,
     )
+
+
+def collect_first_k_mds(
+    t: np.ndarray, B: np.ndarray, n_stragglers: int
+) -> CollectionSchedule:
+    """Exact MDS coding: stop at the first W-s arrivals, solve decode weights
+    over exactly that set (src/coded.py:137-149)."""
+    return _first_k_lstsq(t, B, t.shape[1] - n_stragglers)
 
 
 def collect_frc(t: np.ndarray, groups: np.ndarray) -> CollectionSchedule:
@@ -174,17 +177,7 @@ def collect_first_k_optimal(
     matrix. Exact when the received rows span the all-ones vector;
     otherwise the minimum-error approximate gradient (vs FRC-AGC's
     all-or-nothing group erasures)."""
-    R, W = t.shape
-    ranks = _rank(t)
-    collected = ranks < num_collect
-    weights = codes.mds_decode_weights_host(B, collected)
-    kth_time = np.where(ranks == num_collect - 1, t, -np.inf).max(axis=1)
-    return CollectionSchedule(
-        message_weights=weights,
-        sim_time=kth_time,
-        worker_times=_stamp(t, collected),
-        collected=collected,
-    )
+    return _first_k_lstsq(t, B, num_collect)
 
 
 def collect_avoidstragg(t: np.ndarray, n_stragglers: int) -> CollectionSchedule:

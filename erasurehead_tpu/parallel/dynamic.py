@@ -146,7 +146,7 @@ def make_round_schedule_fn(
         return delay_mean * jax.random.exponential(key, (W,))
 
     if scheme == Scheme.NAIVE:
-        rule = lambda t: collect_all_jnp(t)
+        rule = collect_all_jnp
     elif scheme == Scheme.CYCLIC_MDS:
         rule = lambda t: collect_first_k_mds_jnp(t, B, layout.n_stragglers)
     elif scheme == Scheme.AVOID_STRAGGLERS:

@@ -31,7 +31,7 @@ Failover decode (replacing only infeasible rounds):
   MDS layouts       lstsq decode weights over the alive rows of B — exact
                     while alive >= W-s, least-squares best-effort below
   partial layouts   no failover (their uncoded first-parts are structurally
-                    required); analyze() reports, train_with_failover raises
+                    required); analyze() reports, plan_run raises
 """
 
 from __future__ import annotations

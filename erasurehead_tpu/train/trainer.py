@@ -80,6 +80,19 @@ def build_model(cfg: RunConfig):
     raise ValueError(f"unknown model {cfg.model}")
 
 
+def _auto_mesh(need: int):
+    """Largest device count dividing the sharded axis length (the reference
+    ran W workers on exactly W nodes; we fold logical workers onto whatever
+    chips exist — e.g. W=30 uses 6 of 8 chips, 5 workers per chip)."""
+    avail = len(jax.devices())
+    return worker_mesh(max(d for d in range(1, avail + 1) if need % d == 0))
+
+
+def _init_params_f32(cfg: RunConfig, model, n_features: int):
+    p = model.init_params(jax.random.key(cfg.seed), n_features)
+    return jax.tree.map(lambda x: x.astype(jnp.float32), p)
+
+
 def _hard_sync(x) -> None:
     """Wait until the computation that produced ``x`` has really finished.
 
@@ -137,13 +150,7 @@ def train(
     model = build_model(cfg)
     faithful = cfg.compute_mode == ComputeMode.FAITHFUL
     if mesh is None:
-        # auto-size: the largest device count that divides the sharded axis
-        # (the reference ran W=30 on exactly 30 nodes; we map logical workers
-        # onto whatever chips exist — e.g. W=30 uses 6 of 8 chips, 5 workers
-        # per chip)
-        need = layout.n_workers if faithful else layout.n_partitions
-        avail = len(jax.devices())
-        mesh = worker_mesh(max(d for d in range(1, avail + 1) if need % d == 0))
+        mesh = _auto_mesh(layout.n_workers if faithful else layout.n_partitions)
     # cfg.dtype is the DATA dtype (bfloat16 halves HBM traffic on the
     # bandwidth-bound gradient pass); params/optimizer state stay float32
     data = shard_run_data(
