@@ -460,11 +460,14 @@ def mds_decode_weights_host(B: np.ndarray, masks: np.ndarray) -> np.ndarray:
     masks = np.asarray(masks, dtype=bool)
     W = B.shape[0]
     ones = np.ones(W)
-    out = np.zeros(masks.shape)
-    for r in range(masks.shape[0]):
-        live = np.flatnonzero(masks[r])
-        out[r, live] = np.linalg.lstsq(B[live, :].T, ones, rcond=None)[0]
-    return out
+    # straggler patterns repeat across rounds (only ~C(W, s) exist), so solve
+    # each distinct mask once — keeps the control plane sub-second at R=10k
+    uniq, inverse = np.unique(masks, axis=0, return_inverse=True)
+    out = np.zeros(uniq.shape)
+    for k in range(uniq.shape[0]):
+        live = np.flatnonzero(uniq[k])
+        out[k, live] = np.linalg.lstsq(B[live, :].T, ones, rcond=None)[0]
+    return out[inverse.reshape(-1)]
 
 
 def enumerate_decode_table(B: np.ndarray, n_stragglers: int) -> np.ndarray:

@@ -59,17 +59,19 @@ class CollectionSchedule:
     collected: np.ndarray  # [R, W] bool
 
 
-def _order(t_row: np.ndarray) -> np.ndarray:
-    """Arrival processing order: ascending time, worker index tie-break."""
-    return np.lexsort((np.arange(t_row.shape[0]), t_row))
+def _order(t: np.ndarray) -> np.ndarray:
+    """Arrival processing order per round: ascending time, worker index
+    tie-break. Stable argsort == lexsort((index, t)); accepts [W] or [R, W]."""
+    return np.argsort(t, axis=-1, kind="stable")
 
 
 def _rank(t: np.ndarray) -> np.ndarray:
     """[R, W] arrival rank of each worker within its round."""
     R, W = t.shape
     ranks = np.empty((R, W), dtype=np.int64)
-    for r in range(R):
-        ranks[r, _order(t[r])] = np.arange(W)
+    np.put_along_axis(
+        ranks, _order(t), np.broadcast_to(np.arange(W), (R, W)), axis=1
+    )
     return ranks
 
 
@@ -141,24 +143,30 @@ def collect_agc(
     ``num_collect`` workers have reported or every group is covered; sum the
     first arrival of each covered group; groups with no arrival among those
     processed are *erased* from the gradient
-    (src/approximate_coding.py:144-158)."""
+    (src/approximate_coding.py:144-158).
+
+    Vectorized over rounds: all R Waitany replays run as one batched
+    argsort + prefix-scan (no per-round Python — the control plane stays
+    sub-second at R=10,000, tests/test_collect.py)."""
     R, W = t.shape
     n_groups = int(groups.max()) + 1
-    ranks = _rank(t)
-    win = _group_winners(t, groups)
+    order = _order(t)  # [R, W] event processing order
+    onehot = np.eye(n_groups, dtype=np.int64)[np.asarray(groups)]  # [W, G]
+    oh_sorted = onehot[order]  # [R, W, G] group membership in arrival order
+    cum = np.cumsum(oh_sorted, axis=1)
+    # first arrival of its group among events processed so far?
+    win_sorted = (oh_sorted * (cum == 1)).sum(axis=2)  # [R, W] 0/1
+    covered = (cum >= 1).sum(axis=2)  # [R, W] groups covered after j+1 events
+    j = np.arange(1, W + 1)
+    done = (j >= num_collect) | (covered >= n_groups)
+    stop_idx = done.argmax(axis=1)  # first index where the loop exits
+    taken_sorted = np.arange(W) <= stop_idx[:, None]
     weights = np.zeros((R, W))
-    sim = np.empty(R)
+    np.put_along_axis(weights, order, win_sorted * taken_sorted, axis=1)
     collected = np.zeros((R, W), dtype=bool)
-    for r in range(R):
-        order = _order(t[r])
-        covered = np.cumsum(win[r, order])  # groups covered after j+1 arrivals
-        j = np.arange(1, W + 1)
-        done = (j >= num_collect) | (covered >= n_groups)
-        stop_idx = int(np.argmax(done))  # first index where the loop exits
-        taken = order[: stop_idx + 1]
-        collected[r, taken] = True
-        weights[r, taken] = win[r, taken].astype(np.float64)
-        sim[r] = t[r, order[stop_idx]]
+    np.put_along_axis(collected, order, taken_sorted, axis=1)
+    stop_worker = np.take_along_axis(order, stop_idx[:, None], axis=1)
+    sim = np.take_along_axis(t, stop_worker, axis=1)[:, 0]
     return CollectionSchedule(
         message_weights=weights,
         sim_time=sim,
@@ -224,31 +232,29 @@ def collect_partial(
     # processed in ascending (time, part, worker) order — deterministic under
     # ties (delays disabled). The loop exits at the first event satisfying
     # BOTH stop conditions; coded parts processed by then join the decode.
-    n_groups = layout.n_groups
+    times = np.concatenate([t_first, t_second], axis=1)  # [R, 2W]; first W = uncoded
+    order = _order(times)  # stable: ascending (time, part, worker)
+    is_second = order >= W  # [R, 2W]: is the j-th processed event a coded part?
+    cnt_first = np.cumsum(~is_second, axis=1)
+    cnt_second = np.cumsum(is_second, axis=1)
+    if variant == "mds":
+        second_ok = cnt_second >= W - s
+    else:
+        # one coded part per group (partial FRC): per-event group coverage
+        onehot = np.eye(layout.n_groups, dtype=np.int64)[
+            np.asarray(layout.groups)
+        ]  # [W, G]
+        oh_events = onehot[order % W] * is_second[..., None]  # [R, 2W, G]
+        second_ok = (np.cumsum(oh_events, axis=1) >= 1).all(axis=2)
+    done = (cnt_first >= W) & second_ok  # always True at the last event
+    stop_idx = done.argmax(axis=1)  # loop exits at the first such event
+    stop_ev = np.take_along_axis(order, stop_idx[:, None], axis=1)
+    stop = np.take_along_axis(times, stop_ev, axis=1)[:, 0]
+    # coded parts processed up to and including the stop event join the decode
+    sec_taken = is_second & (np.arange(2 * W) <= stop_idx[:, None])
     completed = np.zeros((R, W), dtype=bool)
-    stop = np.empty(R)
-    for r in range(R):
-        times = np.concatenate([t_first[r], t_second[r]])  # first W = uncoded
-        order = np.lexsort((np.arange(2 * W), times))
-        cnt_first = cnt_second = 0
-        covered = np.zeros(n_groups, dtype=bool)
-        for ev in order:
-            w = ev % W
-            if ev < W:
-                cnt_first += 1
-            else:
-                cnt_second += 1
-                completed[r, w] = True
-                if layout.groups is not None:
-                    covered[layout.groups[w]] = True
-            second_ok = (
-                cnt_second >= W - s
-                if variant == "mds"
-                else covered.all()  # one coded part per group (partial FRC)
-            )
-            if cnt_first >= W and second_ok:
-                stop[r] = times[ev]
-                break
+    rr, jj = np.nonzero(sec_taken)
+    completed[rr, order[rr, jj] % W] = True
     if variant == "mds":
         # the reference solves over ALL completed coded parts at loop exit
         # (src/partial_coded.py:192-193 — possibly more than W-s rows)

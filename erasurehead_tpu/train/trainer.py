@@ -248,9 +248,9 @@ def train(
         if path is not None:
             state0, start_round = ckpt_lib.restore(path, state0)
             state0 = jax.tree.map(
-        lambda l: put_global(np.asarray(l), replicated(mesh)),
-        state0,
-    )
+                lambda l: put_global(np.asarray(l), replicated(mesh)),
+                state0,
+            )
 
     if start_round >= cfg.rounds:
         # the checkpoint already covers the requested rounds: nothing to run

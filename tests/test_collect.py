@@ -231,3 +231,29 @@ def test_heterogeneous_arrival_model():
     # deterministic per seed
     m2 = straggler.model_from_config(cfg)
     np.testing.assert_array_equal(model.worker_speed, m2.worker_speed)
+
+
+def test_control_plane_scales_to_10k_rounds():
+    """The collection rules are batched (argsort + prefix scans, deduped
+    lstsq) — no per-round Python. A 10,000-round schedule for every rule
+    must build in well under a second each on this class of host."""
+    import time
+
+    R10 = 10_000
+    t = straggler.arrival_schedule(R10, W, add_delay=True)
+    lay_frc = codes.frc_layout(W, S)
+    lay_pfrc = codes.partial_frc_layout(W, 6, S)
+    lay_pmds = codes.partial_cyclic_layout(W, 6, S, seed=0)
+    B = codes.cyclic_generator_matrix(W, S, seed=0)
+    rules = {
+        "agc": lambda: collect.collect_agc(t, lay_frc.groups, W // 2),
+        "mds": lambda: collect.collect_first_k_mds(t, B, S),
+        "partial_frc": lambda: collect.collect_partial(t, lay_pfrc, "frc"),
+        "partial_mds": lambda: collect.collect_partial(t, lay_pmds, "mds"),
+    }
+    for name, fn in rules.items():
+        t0 = time.perf_counter()
+        sched = fn()
+        took = time.perf_counter() - t0
+        assert sched.sim_time.shape == (R10,)
+        assert took < 1.0, f"{name} control plane took {took:.2f}s at R={R10}"
