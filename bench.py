@@ -1,7 +1,20 @@
 """Benchmark: flagship AGC logistic regression at the reference's canonical
-run shape, on real TPU.
+run shape, on real TPU — hardened so it ALWAYS emits one valid JSON line.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+Architecture (why there are two processes): this image's TPU is reached
+through a remote-relay PJRT plugin that a sitecustomize dials at interpreter
+start. When the relay is healthy, `import jax` takes ~2s; when it is wedged,
+`import jax` HANGS INDEFINITELY in every process (observed for hours), so no
+amount of in-process exception handling can save the benchmark. The parent
+process therefore never imports jax itself: it (1) probes the backend in a
+subprocess under a hard timeout, (2) runs the real bench in a subprocess
+under a hard timeout, retrying once, and (3) on any failure falls back to a
+CPU run with the relay env scrubbed (the sitecustomize skips dialing when
+PALLAS_AXON_POOL_IPS is unset), which is immune to the relay's state. The
+emitted JSON carries an explicit "platform" field so a fallback can never
+masquerade as a TPU number.
 
 What is measured: real on-device steps/sec of the full coded training step
 (worker-sharded gradient stacks, slot-weighted decode contraction, psum, AGD
@@ -19,13 +32,22 @@ streams; baseline steps/sec = rounds / sum(simulated timeset). The TPU run
 does the same *science* (same gradients, same decode, same loss curve, same
 timing artifacts) without spending wall-clock on sleeping, which is precisely
 the framework's value proposition.
+
+Roofline extras (see BASELINE.md "Hardware roofline model"): the GLM
+gradient step is HBM-bandwidth-bound — per iteration XLA streams the feature
+stack X twice (margin matvec + transpose matvec), so
+  bytes_per_step  = 2 * nbytes(X)    (+ O(rows + features) small terms)
+  flops_per_step  = 4 * M * R * F    (2 matvecs x 2 flops/elem)
+  achieved_gbps   = bytes_per_step * steps_per_sec / 1e9
+  pct_roofline    = achieved_gbps / platform HBM peak (v5e: 819 GB/s)
+pct_roofline is null off-TPU (a host's memory roofline is not the claim).
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
-
-import numpy as np
 
 ROUNDS = 100
 # run_approx_coding.sh:2-9 sets W=30, s=3, collect=15 — but AGC requires
@@ -35,14 +57,118 @@ ROUNDS = 100
 W, S, COLLECT = 30, 2, 15
 N_COLS = 128
 
+# v5e HBM peak bandwidth, GB/s (public spec: 819 GB/s per chip)
+HBM_PEAK_GBPS = {"tpu": 819.0, "axon": 819.0}
+
+PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+RUN_TIMEOUT = int(os.environ.get("BENCH_RUN_TIMEOUT", "900"))
+RETRY_TIMEOUT = int(os.environ.get("BENCH_RETRY_TIMEOUT", "420"))
+
+
+def _cpu_env() -> dict:
+    """Env that bypasses the remote-TPU relay entirely (sitecustomize skips
+    dialing when PALLAS_AXON_POOL_IPS is unset)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _probe(env: dict, timeout: int) -> bool:
+    """Can this env even initialize a jax backend? Cheap subprocess check so
+    a wedged relay costs one probe timeout, not a full run timeout."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        print(f"bench: backend probe timed out after {timeout}s", file=sys.stderr)
+        return False
+
+
+def _run_child(env: dict, timeout: int):
+    """Run the bench child under a hard timeout; return its parsed JSON
+    payload or None. Child stderr is relayed for debugging."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"bench: child timed out after {timeout}s", file=sys.stderr)
+        return None
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        print(f"bench: child rc={proc.returncode}", file=sys.stderr)
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            payload = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(payload, dict) and "metric" in payload:
+            return payload
+    print("bench: child produced no JSON line", file=sys.stderr)
+    return None
+
+
+def _attempts():
+    """(name, env, run timeout) for each bench attempt, in order: the live
+    env twice (one retry), then the relay-scrubbed CPU env — unless the live
+    env already IS that (relay var unset and platform pinned to cpu)."""
+    live = dict(os.environ)
+    yield "live", live, RUN_TIMEOUT
+    yield "live-retry", live, RETRY_TIMEOUT
+    if (
+        "PALLAS_AXON_POOL_IPS" in os.environ
+        or os.environ.get("JAX_PLATFORMS") != "cpu"
+    ):
+        yield "cpu-fallback", _cpu_env(), RUN_TIMEOUT
+
 
 def main() -> None:
+    # Each attempt: cheap backend probe first (so a hung relay costs
+    # PROBE_TIMEOUT, not RUN_TIMEOUT), then the real run under its timeout.
+    payload = None
+    for name, env, timeout in _attempts():
+        if not _probe(env, PROBE_TIMEOUT):
+            print(f"bench: {name}: backend probe failed", file=sys.stderr)
+            continue
+        payload = _run_child(env, timeout)
+        if payload is not None:
+            break
+        print(f"bench: {name}: run failed", file=sys.stderr)
+    # 3) never a traceback: emit an explicit failure record as valid JSON
+    if payload is None:
+        payload = {
+            "metric": "AGC_logistic_steps_per_sec_30w_s2_collect15",
+            "value": 0.0,
+            "unit": "iterations/sec",
+            "vs_baseline": 0.0,
+            "platform": "none",
+            "error": "all bench attempts failed or timed out",
+        }
+    print(json.dumps(payload))
+
+
+def child() -> None:
     import jax
 
     platform = jax.devices()[0].platform
     # size the problem to the platform: full canonical rows on an
     # accelerator, a light slice on CPU fallback so the bench terminates
-    n_rows = 132_000 if platform != "cpu" else 13_200
+    on_accel = platform not in ("cpu",)
+    n_rows = 132_000 if on_accel else 13_200
 
     from erasurehead_tpu.data.synthetic import generate_gmm
     from erasurehead_tpu.train import trainer
@@ -76,10 +202,23 @@ def main() -> None:
     # reference-protocol effective rate on the identical straggler schedule
     ref_steps_per_sec = ROUNDS / result.sim_total_time
 
+    # ---- hardware roofline (see module docstring + BASELINE.md) ----------
+    # faithful mode streams the [W, s+1, rows/W, F] slot stack twice/step
+    slot_rows = n_rows // W
+    x_bytes = W * (S + 1) * slot_rows * N_COLS * 4  # f32 data dtype
+    bytes_per_step = 2 * x_bytes
+    flops_per_step = 4 * W * (S + 1) * slot_rows * N_COLS
+    achieved_gbps = bytes_per_step * steps_per_sec / 1e9
+    peak = HBM_PEAK_GBPS.get(platform)
+    pct_roofline = (
+        round(100.0 * achieved_gbps / peak, 2) if peak else None
+    )
+
     print(
         f"bench: wall(total incl. compile)={total:.1f}s scan={result.wall_time:.3f}s "
         f"sim_total={result.sim_total_time:.1f}s "
-        f"ref_rate={ref_steps_per_sec:.3f} it/s ours={steps_per_sec:.1f} it/s",
+        f"ref_rate={ref_steps_per_sec:.3f} it/s ours={steps_per_sec:.1f} it/s "
+        f"achieved={achieved_gbps:.1f} GB/s roofline={pct_roofline}%",
         file=sys.stderr,
     )
     print(
@@ -89,10 +228,20 @@ def main() -> None:
                 "value": round(float(steps_per_sec), 3),
                 "unit": "iterations/sec",
                 "vs_baseline": round(float(steps_per_sec / ref_steps_per_sec), 3),
+                "platform": platform,
+                "n_rows": n_rows,
+                "wall_time_s": round(float(result.wall_time), 4),
+                "flops_per_step": flops_per_step,
+                "bytes_per_step": bytes_per_step,
+                "achieved_gbps": round(float(achieved_gbps), 2),
+                "pct_roofline": pct_roofline,
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()

@@ -256,4 +256,6 @@ def test_control_plane_scales_to_10k_rounds():
         sched = fn()
         took = time.perf_counter() - t0
         assert sched.sim_time.shape == (R10,)
-        assert took < 1.0, f"{name} control plane took {took:.2f}s at R={R10}"
+        # measured ~0.2s/rule on a dev host; 5s still rules out O(R)-Python
+        # regressions while leaving headroom for loaded CI machines
+        assert took < 5.0, f"{name} control plane took {took:.2f}s at R={R10}"
