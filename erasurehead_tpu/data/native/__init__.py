@@ -91,6 +91,8 @@ def load_dense_text_native(path: str) -> Optional[np.ndarray]:
     finally:
         lib.eh_free(ptr)
     m = out.reshape(rows, n // rows)
+    if m.shape == (1, 1):
+        return m.reshape(())  # np.loadtxt yields a 0-d array for a 1x1 file
     if m.shape[0] == 1:
         return m[0]
     if m.shape[1] == 1:

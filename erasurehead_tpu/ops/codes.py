@@ -101,6 +101,15 @@ class CodingLayout:
         """Copies of the dataset stored across workers (1.0 = uncoded)."""
         return self.assignment.size / self.n_partitions
 
+    @property
+    def uncoded_frac(self) -> float:
+        """Partial-scheme timing model: the uncoded ("separate") part is
+        sent when its slots are done, i.e. at this fraction of the worker's
+        full compute time (both control planes share this constant —
+        parallel/collect.py and parallel/dynamic.py)."""
+        n_sep = int((~np.asarray(self.slot_is_coded)).sum())
+        return n_sep / self.n_slots
+
     def effective_matrix(self) -> np.ndarray:
         """[W, n_partitions] matrix E with ``message = E @ partition_grads``.
 

@@ -224,9 +224,7 @@ def collect_partial(
     """
     R, W = t.shape
     s = layout.n_stragglers
-    n_sep = int((~layout.slot_is_coded).sum())
-    frac = n_sep / layout.n_slots
-    t_first, t_second = frac * t, t
+    t_first, t_second = layout.uncoded_frac * t, t
     # Event-based replay of the two-message Waitany loop: 2W events per round
     # (each worker's uncoded part at t_first, coded part at t_second),
     # processed in ascending (time, part, worker) order — deterministic under

@@ -12,12 +12,12 @@ Why it exists: (a) it demonstrates the collection rules survive jit — no
 data-dependent Python, no dynamic shapes — which is what makes the design
 portable to arrivals *measured* on a real pod rather than simulated; (b) it
 is the shape a reactive/online scheduler would take (per-round masks as
-traced values). Partial schemes keep the host path (their two-message event
-replay is irreducibly sequential; parallel/collect.py).
+traced values). The partial schemes' two-message Waitany replay is a
+fixed-shape 2W-event sort + prefix scan (collect_partial_jnp).
 
-Equivalence: for every non-partial scheme, the jnp rules here are pinned
-test-for-test against parallel/collect.py's numpy event replay on shared
-arrival matrices (tests/test_dynamic.py).
+Equivalence: every scheme's jnp rule is pinned test-for-test against
+parallel/collect.py's numpy event replay on shared arrival matrices
+(tests/test_dynamic.py).
 """
 
 from __future__ import annotations
@@ -119,6 +119,62 @@ def collect_frc_jnp(t: jnp.ndarray, onehot: jnp.ndarray) -> RoundSchedule:
     return collect_agc_jnp(t, onehot, num_collect=t.shape[0] + 1)
 
 
+def collect_partial_jnp(
+    t: jnp.ndarray,
+    *,
+    variant: str,  # "mds" | "frc"
+    frac: float,  # uncoded-part send time as a fraction of full compute
+    n_stragglers: int = 0,
+    B: jnp.ndarray | None = None,  # [W, W], mds variant
+    onehot: jnp.ndarray | None = None,  # [W, G], frc variant
+    group_ids: jnp.ndarray | None = None,  # [W], frc variant
+) -> RoundSchedule:
+    """Two-part schemes as a fixed-shape 2W-event sort + prefix scan
+    (≙ collect.collect_partial's vectorized replay of the two-message
+    Waitany loop, src/partial_coded.py:174-194 /
+    src/partial_replication.py:166-187).
+
+    Events 0..W-1 are uncoded parts (arriving at ``frac * t``), events
+    W..2W-1 are coded parts (arriving at ``t``); the master's loop exits at
+    the first event where all W uncoded parts are in AND the coded-part
+    condition holds (>= W-s parts for MDS decode; one part per group for
+    FRC). Coded parts processed by then join the decode. The MDS weights
+    use the on-device fp32 solve — small-W only (see
+    ops/codes.mds_decode_weights)."""
+    W = t.shape[0]
+    times = jnp.concatenate([frac * t, t])  # [2W]; argsort is stable, so
+    order = jnp.argsort(times)  # ties process in (time, part, worker) order
+    is_second = order >= W
+    cnt_first = jnp.cumsum(~is_second)
+    cnt_second = jnp.cumsum(is_second)
+    if variant == "mds":
+        second_ok = cnt_second >= W - n_stragglers
+    elif variant == "frc":
+        oh_events = onehot[order % W] * is_second[:, None]  # [2W, G]
+        second_ok = (jnp.cumsum(oh_events, axis=0) >= 1).all(axis=1)
+    else:
+        raise ValueError(f"unknown partial variant {variant!r}")
+    done = (cnt_first >= W) & second_ok  # always True at the last event
+    stop_idx = jnp.argmax(done)
+    sec_taken = is_second & (jnp.arange(2 * W) <= stop_idx)
+    completed = (
+        jnp.zeros(W, jnp.int32).at[order % W].max(sec_taken.astype(jnp.int32))
+        > 0
+    )
+    if variant == "mds":
+        weights = codes.mds_decode_weights(B, completed)
+    else:
+        # each group's first coded arrival, if completed (stable-rank argmin
+        # == collect._group_winners' first-index tie-break)
+        ranks = _ranks(t)
+        min_rank = jnp.min(
+            jnp.where(onehot.T.astype(bool), ranks[None, :], W), axis=1
+        )  # [G]
+        win = ranks == min_rank[group_ids]
+        weights = (win & completed).astype(t.dtype)
+    return RoundSchedule(weights, times[order[stop_idx]], completed)
+
+
 def make_round_schedule_fn(
     scheme: Scheme,
     layout: CodingLayout,
@@ -161,11 +217,20 @@ def make_round_schedule_fn(
         if num_collect is None:
             raise ValueError("randreg needs num_collect")
         rule = lambda t: _first_k_lstsq_jnp(t, B, num_collect)
+    elif scheme in (Scheme.PARTIAL_CYCLIC, Scheme.PARTIAL_FRC):
+        frac = layout.uncoded_frac
+        if scheme == Scheme.PARTIAL_CYCLIC:
+            rule = lambda t: collect_partial_jnp(
+                t, variant="mds", frac=frac,
+                n_stragglers=layout.n_stragglers, B=B,
+            )
+        else:
+            gids = jnp.asarray(np.asarray(layout.groups))
+            rule = lambda t: collect_partial_jnp(
+                t, variant="frc", frac=frac, onehot=onehot, group_ids=gids,
+            )
     else:
-        raise ValueError(
-            f"{scheme.value}: partial schemes use the host control plane "
-            "(parallel/collect.py); see module docstring"
-        )
+        raise ValueError(f"unknown scheme {scheme}")
 
     def schedule(key: jax.Array) -> RoundSchedule:
         t = draw(key)

@@ -213,6 +213,14 @@ def plan_run(
     ``on_infeasible="error"`` raises InfeasibleRunError where the reference
     would hang; ``"failover"`` degrades those rounds per failover_schedule.
     """
+    if on_infeasible == "failover" and not np.isfinite(timeout):
+        # failover stamps sim_time[r] = timeout for rewritten rounds; an
+        # infinite timeout would silently corrupt every simulated-time view
+        # (sim_total_time, plots, time-to-target)
+        raise ValueError(
+            "on_infeasible='failover' requires a finite timeout "
+            f"(got {timeout!r}) — it becomes the rewritten rounds' sim_time"
+        )
     report = analyze(scheme, layout, arrivals, num_collect, timeout)
     schedule = collect.build_schedule(
         Scheme(scheme), arrivals, layout, num_collect=num_collect

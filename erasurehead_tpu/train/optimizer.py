@@ -33,6 +33,23 @@ class OptState(NamedTuple):
     momentum: Params  # AGD's u sequence; unused by GD
 
 
+class _PairLeaf(NamedTuple):
+    """Per-leaf (params, momentum) bundle inside agd_update's mapped tree —
+    a distinct type so unpacking can never mistake a user tuple for it."""
+
+    p: Any
+    u: Any
+
+
+class _AdamLeaf(NamedTuple):
+    """Per-leaf (params, mu, nu) bundle inside adam_update's mapped tree —
+    a distinct type so unpacking can never mistake a user 3-tuple for it."""
+
+    p: Any
+    m: Any
+    v: Any
+
+
 def init_state(params: Params, rule: UpdateRule = UpdateRule.AGD) -> OptState:
     """``momentum`` holds AGD's u sequence; for ADAM it holds the
     (mu, nu) moment pair as a 2-tuple pytree (bias-correction count comes
@@ -63,9 +80,12 @@ def agd_update(
         b_next = y - mult * gg - 2.0 * alpha * eta * b
         u_next = b + (b_next - b) / theta
         return b_next, u_next
-    pairs = jax.tree.map(leaf, state.params, state.momentum, g)
-    new_p = jax.tree.map(lambda t: t[0], pairs, is_leaf=lambda t: isinstance(t, tuple))
-    new_u = jax.tree.map(lambda t: t[1], pairs, is_leaf=lambda t: isinstance(t, tuple))
+    pairs = jax.tree.map(
+        lambda *a: _PairLeaf(*leaf(*a)), state.params, state.momentum, g
+    )
+    is_pair = lambda t: isinstance(t, _PairLeaf)
+    new_p = jax.tree.map(lambda t: t.p, pairs, is_leaf=is_pair)
+    new_u = jax.tree.map(lambda t: t.u, pairs, is_leaf=is_pair)
     return OptState(params=new_p, momentum=new_u)
 
 
@@ -89,8 +109,10 @@ def adam_update(
         p_new = p - eta * m_hat / (jnp.sqrt(v_hat) + eps)
         return p_new, m_new, v_new
 
-    triples = jax.tree.map(leaf, state.params, mu, nu, g)
-    is_triple = lambda x: isinstance(x, tuple) and len(x) == 3
+    triples = jax.tree.map(
+        lambda *a: _AdamLeaf(*leaf(*a)), state.params, mu, nu, g
+    )
+    is_triple = lambda x: isinstance(x, _AdamLeaf)
     pick = lambda k: jax.tree.map(lambda x: x[k], triples, is_leaf=is_triple)
     return OptState(params=pick(0), momentum=(pick(1), pick(2)))
 

@@ -54,7 +54,10 @@ def audit_schedule_determinism(cfg) -> AuditResult:
     for _ in range(2):
         layout = build_layout(cfg)
         t = straggler.arrival_schedule(
-            cfg.rounds, cfg.n_workers, cfg.add_delay, cfg.delay_mean
+            cfg.rounds, cfg.n_workers, cfg.add_delay, cfg.delay_mean,
+            # same arrival model train() uses — a heterogeneous-cluster
+            # config must audit the schedule it actually runs
+            arrival_model=straggler.model_from_config(cfg),
         )
         s = collect.build_schedule(
             cfg.scheme, t, layout, num_collect=cfg.num_collect

@@ -36,3 +36,32 @@ def test_audit_detects_divergence():
     b = np.array([0.0, 0.0, 1e-3, 0.0])
     r = audit._compare(a, b, "x")
     assert not r and r.max_abs_diff == 1e-3
+
+
+def test_schedule_audit_uses_heterogeneous_model(monkeypatch):
+    """A heterogeneous-cluster config must audit the same schedule train()
+    runs: the audit must pass the config's arrival model through to
+    arrival_schedule (not silently audit the homogeneous schedule)."""
+    from erasurehead_tpu.parallel import straggler
+
+    cfg = _cfg()
+    cfg.compute_time = 2.0
+    cfg.worker_speed_spread = 0.5
+    expected = straggler.model_from_config(cfg)
+    assert expected is not None
+
+    seen = []
+    real = straggler.arrival_schedule
+
+    def spy(*args, **kw):
+        seen.append(kw.get("arrival_model"))
+        return real(*args, **kw)
+
+    monkeypatch.setattr(straggler, "arrival_schedule", spy)
+    assert audit.audit_schedule_determinism(cfg)
+    assert seen, "audit never built a schedule"
+    for model in seen:
+        assert model is not None
+        np.testing.assert_array_equal(
+            model.worker_speed, expected.worker_speed
+        )

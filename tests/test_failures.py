@@ -174,3 +174,16 @@ def test_partial_layouts_refuse_failover(arrivals):
             Scheme.PARTIAL_CYCLIC, layout, t, timeout=50.0,
             on_infeasible="failover",
         )
+
+
+def test_failover_requires_finite_timeout(arrivals):
+    """failover stamps sim_time = timeout on rewritten rounds; an infinite
+    timeout would silently corrupt every simulated-time view downstream."""
+    from erasurehead_tpu.ops import codes
+
+    t = failures.inject_worker_death(arrivals, {0: 0})
+    with pytest.raises(ValueError, match="finite timeout"):
+        failures.plan_run(
+            Scheme.NAIVE, codes.uncoded_layout(W), t,
+            on_infeasible="failover",
+        )

@@ -75,3 +75,8 @@ def test_io_integration(tmp_path, lib_available):
     warm = data_io.load_dense_text(p)  # .npy sidecar
     np.testing.assert_allclose(cold, m, rtol=0, atol=0)
     np.testing.assert_array_equal(cold, warm)
+
+
+def test_1x1_scalar_squeeze(tmp_path, lib_available):
+    """np.loadtxt returns a 0-d array for a 1x1 file; so must we."""
+    _roundtrip(tmp_path, np.asarray([[3.25]]))
