@@ -131,6 +131,11 @@ def _flags_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "bfloat16"],
                    help="DATA dtype (params/updates stay float32)")
+    p.add_argument("--arrival-mode", default="simulated",
+                   choices=["simulated", "measured"],
+                   help="measured: time each worker's real per-round "
+                        "gradient compute and collect on those arrivals "
+                        "(trainer.train_measured)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--trace-dir", default=None,
                    help="capture a jax.profiler device trace here")
@@ -169,6 +174,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         compute_mode=ns.compute_mode,
         use_pallas=ns.use_pallas,
         dtype=ns.dtype,
+        arrival_mode=ns.arrival_mode,
         seed=ns.seed,
     )
 
@@ -228,7 +234,10 @@ def run(
     from erasurehead_tpu.utils.tracing import device_trace
 
     with device_trace(trace_dir):
-        result = trainer.train(cfg, dataset)
+        if cfg.arrival_mode == "measured":
+            result = trainer.train_measured(cfg, dataset)
+        else:
+            result = trainer.train(cfg, dataset)
     model = trainer.build_model(cfg)
     n = result.n_train
     ev = evaluate.replay(

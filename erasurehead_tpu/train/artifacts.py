@@ -91,12 +91,17 @@ def write_run_artifacts(
         saver(data, path)
         paths[name] = path
 
+    # A resumed run's history (and hence the eval curves) covers rounds
+    # [start_round, rounds) while the precomputed clocks cover the full run;
+    # slice the clocks to the same window so row i of EVERY artifact is
+    # round start_round + i (recorded in the manifest).
+    sr = result.start_round
     if ev is not None:
         emit("training_loss", save_vector, ev.training_loss)
         emit("testing_loss", save_vector, ev.testing_loss)
         emit("auc", save_vector, ev.auc)
-    emit("timeset", save_vector, result.timeset)
-    emit("worker_timeset", save_matrix, result.worker_times)
+    emit("timeset", save_vector, result.timeset[sr:])
+    emit("worker_timeset", save_matrix, result.worker_times[sr:])
 
     def jsonable(v):
         if hasattr(v, "value"):  # enums
@@ -109,7 +114,13 @@ def write_run_artifacts(
         "config": {
             k: jsonable(v) for k, v in dataclasses.asdict(cfg).items()
         },
+        # sim_total_time covers the FULL precomputed schedule; for resumed
+        # runs the emitted artifacts cover [start_round, rounds), whose
+        # simulated clock is window_sim_total_time (== sum of the timeset
+        # artifact's rows)
         "sim_total_time": result.sim_total_time,
+        "window_sim_total_time": float(np.sum(result.timeset[sr:])),
+        "start_round": sr,
         "wall_time": result.wall_time,
         "steps_per_sec": result.steps_per_sec,
         "n_train": result.n_train,
@@ -123,17 +134,24 @@ def write_run_artifacts(
 
 
 def print_iteration_table(result: TrainResult, ev: EvalResult) -> None:
-    """The reference's per-iteration eval printout (src/naive.py:198)."""
+    """The reference's per-iteration eval printout (src/naive.py:198).
+
+    Rows are labeled with true round numbers: a resumed run's eval curves
+    start at result.start_round, and the clocks are indexed to match."""
+    sr = result.start_round
     for i in range(len(ev.training_loss)):
         line = (
-            f"Iteration {i}: Train Loss = {ev.training_loss[i]:.5f}, "
+            f"Iteration {sr + i}: Train Loss = {ev.training_loss[i]:.5f}, "
             f"Test Loss = {ev.testing_loss[i]:.5f}"
         )
         if not np.isnan(ev.auc[i]):
             line += f", AUC = {ev.auc[i]:.5f}"
-        line += f", Sim time = {result.timeset[i]:.4f}s"
+        line += f", Sim time = {result.timeset[sr + i]:.4f}s"
         print(line)
+    # the total matches the rows just printed (the resumed window, when
+    # start_round > 0 — result.sim_total_time covers the full schedule)
     print(
-        f"Total simulated time: {result.sim_total_time:.3f}s | real wall "
-        f"{result.wall_time:.3f}s | {result.steps_per_sec:.1f} steps/s"
+        f"Total simulated time: {float(np.sum(result.timeset[sr:])):.3f}s | "
+        f"real wall {result.wall_time:.3f}s | "
+        f"{result.steps_per_sec:.1f} steps/s"
     )

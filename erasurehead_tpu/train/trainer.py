@@ -123,6 +123,12 @@ class TrainResult:
     wall_time: float  # real seconds for the whole scan (compile excluded)
     steps_per_sec: float
     n_train: int
+    # first round covered by params_history: 0 for fresh runs; a resumed run
+    # starts at its checkpoint, so history leaves have rounds - start_round
+    # entries while the (precomputed, deterministic) control-plane arrays
+    # still cover the full run. Artifact writers align on this
+    # (train/artifacts.py slices the clocks to the same window).
+    start_round: int = 0
     config: RunConfig = None
     layout: codes.CodingLayout = None
 
@@ -316,6 +322,169 @@ def train(
         sim_total_time=float(schedule.sim_time.sum()),
         wall_time=wall,
         steps_per_sec=(cfg.rounds - start_round) / wall if wall > 0 else 0.0,
+        n_train=n_train,
+        start_round=start_round,
+        config=cfg,
+        layout=layout,
+    )
+
+
+def train_measured(
+    cfg: RunConfig,
+    dataset: Dataset,
+    mesh=None,
+    work_multiplier=None,
+) -> TrainResult:
+    """Measured-arrival mode (SURVEY §7.4's "real delay" mode).
+
+    Every round, each logical worker's coded message is computed as its own
+    executable dispatch and its real wall-clock is measured; those measured
+    arrivals (plus the injected exponential delays when ``add_delay`` is on,
+    matching the reference where worker latency = compute + sleep) feed the
+    scheme's collection rule *online*, per round — so ``worker_times`` is a
+    measurement again, like the reference's Waitany-stamped
+    ``worker_timeset`` (src/naive.py:106), not a precomputed simulation.
+    Under real per-worker imbalance the collected set genuinely differs
+    from the homogeneous schedule (tests/test_measured.py).
+
+    The cost model is honest but slow: one dispatch per (round, worker) is
+    inherent to measuring workers separately. Use :func:`train` (one scan)
+    for throughput benchmarking; this mode is for heterogeneity diagnosis
+    and online-collection experiments.
+
+    ``work_multiplier``: optional [W] ints — worker w recomputes its
+    message that many times, inducing real compute imbalance (a stand-in
+    for heterogeneous chips, and the test hook).
+    """
+    # configured *simulated* heterogeneity contradicts measuring the real
+    # thing, and the other trainer knobs below have no measured-mode
+    # implementation — refuse rather than silently run something else
+    if cfg.compute_time or cfg.worker_speed_spread:
+        raise ValueError(
+            "arrival_mode='measured' measures real per-worker compute; "
+            "simulated heterogeneity (compute_time/worker_speed_spread) "
+            "does not apply — unset it or use the simulated trainer"
+        )
+    if cfg.compute_mode != ComputeMode.FAITHFUL:
+        raise ValueError(
+            "arrival_mode='measured' times each worker's own (redundant) "
+            "slot compute; only compute_mode='faithful' is meaningful"
+        )
+    if cfg.use_pallas == "on":
+        raise ValueError(
+            "arrival_mode='measured' has no fused-kernel path; "
+            "use use_pallas='auto' or 'off'"
+        )
+    layout = build_layout(cfg)
+    model = build_model(cfg)
+    W = layout.n_workers
+    if mesh is None:
+        mesh = worker_mesh(1)  # per-worker dispatches do their own placement
+    data = shard_run_data(
+        dataset, layout, mesh, faithful=True, dtype=jnp.dtype(cfg.dtype)
+    )
+    mult = (
+        np.ones(W, dtype=np.int64)
+        if work_multiplier is None
+        else np.asarray(work_multiplier, dtype=np.int64)
+    )
+    if mult.shape != (W,) or (mult < 1).any():
+        raise ValueError(f"work_multiplier must be [W] ints >= 1, got {mult}")
+
+    dtype = jnp.float32
+    lr = cfg.resolve_lr_schedule()
+    alpha = cfg.effective_alpha
+    n_train = data.n_train
+    coeffs = np.asarray(layout.coeffs)
+    slot_coded = np.asarray(layout.slot_is_coded)
+    update_fn = optimizer.make_update_fn(cfg.update_rule)
+
+    params0 = model.init_params(jax.random.key(cfg.seed), dataset.n_features)
+    params0 = jax.tree.map(lambda p: p.astype(dtype), params0)
+    state = optimizer.init_state(params0, cfg.update_rule)
+
+    # one worker's transmitted message: its per-slot gradient stack
+    @jax.jit
+    def worker_msg(params, Xs, ys):
+        return jax.vmap(lambda X, y: model.grad_sum(params, X, y))(Xs, ys)
+
+    @jax.jit
+    def decode_update(st, per_slot, slot_w, eta, i):
+        g = step_lib._weighted_tree_sum(slot_w, per_slot, "ws")
+        return update_fn(st, g, eta, alpha, n_train, i)
+
+    def worker_slice(w):
+        return (
+            jax.tree.map(lambda l: l[w], data.Xw),
+            jax.tree.map(lambda l: l[w], data.yw),
+        )
+
+    # injected delay component on top of real compute, like the reference's
+    # post-compute sleep (src/naive.py:140-149)
+    delays = straggler.arrival_schedule(
+        cfg.rounds, W, cfg.add_delay, cfg.delay_mean
+    )
+
+    # hoist the constant per-worker slices out of the timed loop, and warm
+    # up every per-worker executable so measured times are steady-state
+    # compute, not gather dispatch or compile/program-load
+    slices = [worker_slice(w) for w in range(W)]
+    for Xs, ys in slices:
+        _hard_sync(worker_msg(state.params, Xs, ys))
+
+    timeset = np.zeros(cfg.rounds)
+    worker_times = np.zeros((cfg.rounds, W))
+    collected = np.zeros((cfg.rounds, W), dtype=bool)
+    history = []
+    wall0 = time.perf_counter()
+    for r in range(cfg.rounds):
+        # async dispatch: make sure the previous round's decode_update is
+        # off the device stream before timing worker 0, or its cost would
+        # be misattributed as worker 0's compute every round
+        _hard_sync(state)
+        t_row = np.zeros(W)
+        msgs = []
+        for w in range(W):
+            Xs, ys = slices[w]
+            t0 = time.perf_counter()
+            for _ in range(int(mult[w])):
+                m = worker_msg(state.params, Xs, ys)
+            _hard_sync(m)
+            t_row[w] = time.perf_counter() - t0
+            msgs.append(m)
+        arrivals = (t_row + delays[r])[None, :]
+        sched = collect.build_schedule(
+            cfg.scheme, arrivals, layout, num_collect=cfg.num_collect
+        )
+        slot_w = np.asarray(
+            step_lib.expand_slot_weights(
+                sched.message_weights, coeffs, slot_coded
+            )
+        )[0]
+        per_slot = jax.tree.map(lambda *xs: jnp.stack(xs), *msgs)
+        state = decode_update(
+            state,
+            per_slot,
+            jnp.asarray(slot_w, dtype),
+            jnp.asarray(lr[r], dtype),
+            jnp.asarray(float(r), dtype),
+        )
+        timeset[r] = sched.sim_time[0]
+        worker_times[r] = sched.worker_times[0]
+        collected[r] = sched.collected[0]
+        history.append(state.params)
+    _hard_sync(state)
+    wall = time.perf_counter() - wall0
+
+    return TrainResult(
+        params_history=jax.tree.map(lambda *xs: jnp.stack(xs), *history),
+        final_params=state.params,
+        timeset=timeset,
+        worker_times=worker_times,
+        collected=collected,
+        sim_total_time=float(timeset.sum()),
+        wall_time=wall,
+        steps_per_sec=cfg.rounds / wall if wall > 0 else 0.0,
         n_train=n_train,
         config=cfg,
         layout=layout,

@@ -132,6 +132,11 @@ class RunConfig:
     # (interpret mode off-TPU), "off" disables, "auto" lets
     # kernels.supports_fused decide per platform/model/shape
     use_pallas: str = "auto"
+    # "simulated": the default precomputed-schedule scan trainer.
+    # "measured": time each worker's real gradient compute per round and
+    # feed those arrivals to the collection rule (trainer.train_measured —
+    # worker_timeset becomes a measurement, like src/naive.py:106).
+    arrival_mode: str = "simulated"
 
     @classmethod
     def for_dataset(cls, dataset: str, **overrides) -> "RunConfig":
@@ -154,6 +159,11 @@ class RunConfig:
         if self.use_pallas not in ("auto", "on", "off"):
             raise ValueError(
                 f"use_pallas must be auto/on/off, got {self.use_pallas!r}"
+            )
+        if self.arrival_mode not in ("simulated", "measured"):
+            raise ValueError(
+                f"arrival_mode must be simulated/measured, got "
+                f"{self.arrival_mode!r}"
             )
         if self.num_collect is None:
             self.num_collect = self.n_workers

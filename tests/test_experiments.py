@@ -123,3 +123,40 @@ def test_checkpoint_roundtrip(tmp_path):
     back, rnd = checkpoint.restore(path, state)
     assert rnd == 3
     assert np.allclose(back.params["w"], state.params["w"])
+
+
+def test_resumed_artifacts_stay_aligned(gmm, tmp_path):
+    """A resumed run's five artifacts must all cover the same window
+    [start_round, rounds) — the clocks are sliced to match the eval curves
+    and the manifest records the offset (so nobody mistakes a resumed loss
+    curve for a full one)."""
+    from erasurehead_tpu.models.glm import LogisticModel
+    from erasurehead_tpu.train import artifacts, evaluate
+    from erasurehead_tpu.utils.config import ModelKind
+
+    cfg = _base(rounds=12)
+    ckdir = str(tmp_path / "ck3")
+    trainer.train(cfg, gmm, checkpoint_dir=ckdir, checkpoint_every=4)
+    resumed = trainer.train(
+        cfg, gmm, checkpoint_dir=ckdir, checkpoint_every=4, resume=True
+    )
+    assert resumed.start_round == 8
+    n = resumed.n_train
+    ev = evaluate.replay(
+        LogisticModel(), ModelKind.LOGISTIC, resumed.params_history,
+        gmm.X_train[:n], gmm.y_train[:n], gmm.X_test, gmm.y_test,
+    )
+    out = str(tmp_path / "res")
+    paths = artifacts.write_run_artifacts(resumed, ev, out)
+    lens = {
+        name: np.atleast_1d(np.loadtxt(paths[name])).shape[0]
+        for name in ("training_loss", "testing_loss", "auc",
+                     "timeset", "worker_timeset")
+    }
+    assert set(lens.values()) == {4}, lens
+    manifest = json.load(open(paths["manifest"]))
+    assert manifest["start_round"] == 8
+    # the sliced timeset rows are the full schedule's tail
+    full_t = trainer.train(cfg, gmm).timeset
+    np.testing.assert_allclose(np.loadtxt(paths["timeset"]), full_t[8:],
+                               atol=5e-4)  # save_vector writes %5.3f-ish

@@ -1,0 +1,107 @@
+"""Measured-arrival mode (trainer.train_measured): real per-worker compute
+timing feeds the collection rules — SURVEY §7.4's "real delay" mode, making
+worker_timeset a measurement again (src/naive.py:106)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from erasurehead_tpu.data.synthetic import generate_gmm
+from erasurehead_tpu.models.glm import LogisticModel
+from erasurehead_tpu.train import trainer
+from erasurehead_tpu.utils.config import RunConfig
+
+W, S, R = 8, 2, 6
+MULT = 40  # slow workers do 40x the gradient work — dwarfs timing noise
+
+
+def _cfg(**kw):
+    base = dict(
+        scheme="avoidstragg", n_workers=W, n_stragglers=S, rounds=R,
+        n_rows=32 * W, n_cols=32, lr_schedule=1.0, update_rule="AGD",
+        add_delay=False, seed=0,
+    )
+    base.update(kw)
+    return RunConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def data():
+    return generate_gmm(32 * W, 32, n_partitions=W, seed=0)
+
+
+def test_measured_mode_reacts_to_real_imbalance(data):
+    """avoidstragg drops the s slowest arrivals. With workers 0 and 1 doing
+    40x real compute, measured mode must exclude exactly them — while the
+    simulated schedule (no delays -> index-order ties) excludes the LAST
+    two workers instead. The collected sets must differ: that is the whole
+    point of the mode."""
+    mult = np.ones(W, dtype=np.int64)
+    mult[:2] = MULT
+    res = trainer.train_measured(_cfg(), data, work_multiplier=mult)
+    # the slow workers' measured arrivals dominate every round
+    assert (res.worker_times[:, :2] == -1.0).all(), res.worker_times
+    assert res.collected[:, 2:].all()
+    assert not res.collected[:, :2].any()
+    # simulated mode on the same config collects by index tie-break instead
+    sim = trainer.train(_cfg(), data)
+    assert sim.collected[:, : W - S].all()
+    assert not np.array_equal(res.collected, sim.collected)
+    # measured times are real seconds: positive, slow >> fast
+    fast = res.timeset  # stop time = (W-S)-th arrival, a fast worker
+    assert (fast > 0).all()
+
+
+def test_measured_mode_trains(data):
+    """With no induced imbalance the run must still train and emit the full
+    artifact set (history, timeset, worker_times) with coherent shapes."""
+    # (s+1) | W FRC guard: use s=1 for the AGC run on W=8
+    cfg = _cfg(scheme="approx", n_stragglers=1, num_collect=W)
+    res = trainer.train_measured(cfg, data)
+    hist = np.asarray(res.params_history)
+    assert hist.shape == (R, 32) and np.isfinite(hist).all()
+    assert res.timeset.shape == (R,) and (res.timeset > 0).all()
+    assert res.worker_times.shape == (R, W)
+    assert res.sim_total_time > 0 and res.wall_time > 0
+    model = LogisticModel()
+    Xt, yt = jnp.asarray(data.X_test), jnp.asarray(data.y_test)
+    first = float(model.loss_mean(jnp.asarray(hist[0]), Xt, yt))
+    last = float(model.loss_mean(jnp.asarray(hist[-1]), Xt, yt))
+    assert last < first
+
+
+def test_measured_mode_delay_injection(data):
+    """add_delay composes: arrivals = measured compute + injected seeded
+    exponential sleep, matching the reference's compute-then-sleep order
+    (src/naive.py:140-149). The injected part dominates microsecond CPU
+    compute, so collection follows the delay schedule."""
+    from erasurehead_tpu.parallel import straggler
+
+    cfg = _cfg(add_delay=True)
+    res = trainer.train_measured(cfg, data)
+    delays = straggler.arrival_schedule(R, W, True, cfg.delay_mean)
+    # each round's excluded (slowest-s) workers match the delay schedule's
+    want_excluded = np.argsort(delays, axis=1, kind="stable")[:, -S:]
+    for r in range(R):
+        assert not res.collected[r, want_excluded[r]].any()
+
+
+def test_work_multiplier_validation(data):
+    with pytest.raises(ValueError, match="work_multiplier"):
+        trainer.train_measured(
+            _cfg(), data, work_multiplier=np.zeros(W, dtype=np.int64)
+        )
+    with pytest.raises(ValueError, match="work_multiplier"):
+        trainer.train_measured(_cfg(), data, work_multiplier=np.ones(3))
+
+
+def test_measured_mode_rejects_unsupported_knobs(data):
+    """Knobs with no measured-mode implementation must refuse, not
+    silently run something different from what was configured."""
+    with pytest.raises(ValueError, match="simulated heterogeneity"):
+        trainer.train_measured(_cfg(worker_speed_spread=0.5), data)
+    with pytest.raises(ValueError, match="faithful"):
+        trainer.train_measured(_cfg(compute_mode="deduped"), data)
+    with pytest.raises(ValueError, match="fused-kernel"):
+        trainer.train_measured(_cfg(use_pallas="on"), data)
