@@ -91,6 +91,59 @@ def generate_gmm(
     return Dataset(X_train, y_train, X_test, y_test, name="artificial")
 
 
+def generate_onehot(
+    n_rows: int,
+    n_cols: int,
+    n_partitions: int,
+    n_fields: int = 12,
+    seed: int = 0,
+) -> Dataset:
+    """Covtype-style sparse one-hot logistic task (scipy CSR features).
+
+    The reference's flagship real workloads are one-hot sparse CSR matrices
+    (src/arrange_real_data.py:145-205 bins covtype's columns into 15509
+    one-hot categories; amazon hashes to 241915). This generator produces a
+    synthetic task with the identical *structure*: ``n_fields`` categorical
+    fields, each row activating exactly one category per field (value 1.0,
+    so nnz_per_row == n_fields), labels drawn from a true logistic model
+    over the one-hot features — sized by the caller to the canonical shapes
+    so the PaddedRows gather/scatter path can be exercised and timed at
+    reference scale without the Kaggle raws (absent in this environment).
+    """
+    import scipy.sparse as sps
+
+    if n_rows % n_partitions:
+        raise ValueError("n_rows must be a multiple of n_partitions")
+    if n_fields > n_cols:
+        raise ValueError("n_fields cannot exceed n_cols")
+    rng = np.random.default_rng(seed)
+    # contiguous category blocks per field (last absorbs the remainder),
+    # mirroring one-hot encoder column layout
+    bounds = np.linspace(0, n_cols, n_fields + 1).astype(np.int64)
+    # unit logit variance: sum of n_fields iid N(0, 1/n_fields) entries
+    beta_true = rng.standard_normal(n_cols) / np.sqrt(n_fields)
+
+    def block(n):
+        cats = rng.random((n, n_fields))
+        lo, hi = bounds[:-1], bounds[1:]
+        idx = (lo + (cats * (hi - lo)).astype(np.int64)).astype(np.int32)
+        logits = beta_true[idx].sum(axis=1)
+        y = (2.0 * rng.binomial(1, 1.0 / (1.0 + np.exp(-logits))) - 1.0)
+        X = sps.csr_matrix(
+            (
+                np.ones(n * n_fields, dtype=np.float32),
+                idx.ravel(),
+                np.arange(n + 1, dtype=np.int64) * n_fields,
+            ),
+            shape=(n, n_cols),
+        )
+        return X, y.astype(np.float32)
+
+    X_train, y_train = block(n_rows)
+    X_test, y_test = block(int(0.2 * n_rows))
+    return Dataset(X_train, y_train, X_test, y_test, name="artificial-onehot")
+
+
 def generate_linear(
     n_rows: int,
     n_cols: int,

@@ -135,17 +135,18 @@ def reference_glm_grad(beta, X, y, w, kind: str = "logistic"):
 
 
 def supports_fused(X, model_name: str, platform: str) -> bool:
-    """Auto-gate: dense f32-able stacks, GLM model, real TPU, aligned F.
+    """Auto-gate for the fused kernel. Returns False: XLA won the race.
 
-    Currently returns False everywhere ("auto" never enables the kernel):
-    the MXU-dot variant measured *slower* than XLA's two-pass lowering on
-    v5e (2.7ms vs 2.05ms at the bench shape) and the exact-f32 VPU variant
-    is pending on-hardware measurement. Flip the final clause once the VPU
-    kernel wins; use_pallas="on" forces it meanwhile.
+    Measured on v5e at the bench shape ([90, 4400, 128] slot stack, timed
+    inside one dispatch, tools/kernel_race.py):
+      - MXU-dot variant:   2.7 ms  vs XLA 2.05 ms  (r1, slower — bf16
+        rounding also failed the science, see _kernel comment)
+      - exact-f32 VPU variant (this file): logistic 2.60 ms vs XLA 1.87 ms,
+        linear 2.58 ms vs XLA 1.90 ms (r2, slower)
+    XLA's two-pass lowering overlaps the margin and transpose matvecs well
+    enough that the single-streaming-pass VPU kernel cannot beat it — the
+    VPU multiply-reduce is the bottleneck, not HBM. The kernel stays as the
+    measured-and-lost alternative (and pallas reference pattern); force it
+    with use_pallas="on"; tests pin it to the XLA oracle in interpret mode.
     """
-    if model_name not in GLM_KINDS:
-        return False
-    if not isinstance(X, (jnp.ndarray, np.ndarray, jax.Array)):
-        return False  # PaddedRows sparse stacks take the XLA gather path
-    F = X.shape[-1]
-    return platform == "tpu" and F % 128 == 0 and False
+    return False

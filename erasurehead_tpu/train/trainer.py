@@ -93,6 +93,58 @@ def _init_params_f32(cfg: RunConfig, model, n_features: int):
     return jax.tree.map(lambda x: x.astype(jnp.float32), p)
 
 
+@dataclasses.dataclass
+class _RunSetup:
+    """Shared per-run state assembled identically by all three trainers
+    (train / train_measured / train_dynamic) — one home so init, data
+    sharding, and schedules can never desynchronize between them (tests
+    compare the trainers' outputs assuming identical initialization)."""
+
+    layout: codes.CodingLayout
+    model: Any
+    mesh: Any
+    data: ShardedData
+    state0: Any  # optimizer state; params cast to f32 (cfg.dtype is DATA)
+    update_fn: Any
+    lr: np.ndarray
+    alpha: float
+    n_train: int
+
+
+def _setup_run(
+    cfg: RunConfig,
+    dataset: Dataset,
+    mesh,
+    *,
+    faithful: bool,
+    single_device: bool = False,
+) -> _RunSetup:
+    layout = build_layout(cfg)
+    model = build_model(cfg)
+    if mesh is None:
+        mesh = (
+            worker_mesh(1)  # per-worker dispatches do their own placement
+            if single_device
+            else _auto_mesh(layout.n_workers if faithful else layout.n_partitions)
+        )
+    data = shard_run_data(
+        dataset, layout, mesh, faithful=faithful, dtype=jnp.dtype(cfg.dtype)
+    )
+    params0 = _init_params_f32(cfg, model, dataset.n_features)
+    state0 = optimizer.init_state(params0, cfg.update_rule)
+    return _RunSetup(
+        layout=layout,
+        model=model,
+        mesh=mesh,
+        data=data,
+        state0=state0,
+        update_fn=optimizer.make_update_fn(cfg.update_rule),
+        lr=cfg.resolve_lr_schedule(),
+        alpha=cfg.effective_alpha,
+        n_train=data.n_train,
+    )
+
+
 def _hard_sync(x) -> None:
     """Wait until the computation that produced ``x`` has really finished.
 
@@ -152,16 +204,9 @@ def train(
     ``params_history`` then covers only the resumed rounds (the control-plane
     arrays still cover the full run; they are precomputed and deterministic).
     """
-    layout = build_layout(cfg)
-    model = build_model(cfg)
     faithful = cfg.compute_mode == ComputeMode.FAITHFUL
-    if mesh is None:
-        mesh = _auto_mesh(layout.n_workers if faithful else layout.n_partitions)
-    # cfg.dtype is the DATA dtype (bfloat16 halves HBM traffic on the
-    # bandwidth-bound gradient pass); params/optimizer state stay float32
-    data = shard_run_data(
-        dataset, layout, mesh, faithful=faithful, dtype=jnp.dtype(cfg.dtype)
-    )
+    setup = _setup_run(cfg, dataset, mesh, faithful=faithful)
+    layout, model, mesh, data = setup.layout, setup.model, setup.mesh, setup.data
 
     # ---- control plane (host, float64) ------------------------------------
     if arrivals is None:
@@ -175,11 +220,13 @@ def train(
         schedule = collect.build_schedule(
             cfg.scheme, arrivals, layout, num_collect=cfg.num_collect
         )
-    lr = cfg.resolve_lr_schedule()
-    alpha = cfg.effective_alpha
-    n_train = data.n_train
+    lr = setup.lr
+    alpha = setup.alpha
+    n_train = setup.n_train
 
-    dtype = jnp.float32  # param/update dtype is always f32 (see above)
+    # cfg.dtype is the DATA dtype (bfloat16 halves HBM traffic on the
+    # bandwidth-bound gradient pass); params/optimizer state stay float32
+    dtype = jnp.float32
     # the coded/separate slot rule lives only in expand_slot_weights; both
     # compute modes derive from its output (float64 on host)
     slot_w = np.asarray(
@@ -217,14 +264,11 @@ def train(
                 f"got model={kind!r}, X={type(X).__name__}"
             )
 
-    update_fn = optimizer.make_update_fn(cfg.update_rule)
+    update_fn = setup.update_fn
 
-    params0 = model.init_params(jax.random.key(cfg.seed), dataset.n_features)
-    params0 = jax.tree.map(lambda p: p.astype(dtype), params0)
-    state0 = optimizer.init_state(params0, cfg.update_rule)
     state0 = jax.tree.map(
         lambda l: put_global(np.asarray(l), replicated(mesh)),
-        state0,
+        setup.state0,
     )
 
     lr_seq = jnp.asarray(lr, dtype)
@@ -375,14 +419,9 @@ def train_measured(
             "arrival_mode='measured' has no fused-kernel path; "
             "use use_pallas='auto' or 'off'"
         )
-    layout = build_layout(cfg)
-    model = build_model(cfg)
+    setup = _setup_run(cfg, dataset, mesh, faithful=True, single_device=True)
+    layout, model, data = setup.layout, setup.model, setup.data
     W = layout.n_workers
-    if mesh is None:
-        mesh = worker_mesh(1)  # per-worker dispatches do their own placement
-    data = shard_run_data(
-        dataset, layout, mesh, faithful=True, dtype=jnp.dtype(cfg.dtype)
-    )
     mult = (
         np.ones(W, dtype=np.int64)
         if work_multiplier is None
@@ -392,16 +431,13 @@ def train_measured(
         raise ValueError(f"work_multiplier must be [W] ints >= 1, got {mult}")
 
     dtype = jnp.float32
-    lr = cfg.resolve_lr_schedule()
-    alpha = cfg.effective_alpha
-    n_train = data.n_train
+    lr = setup.lr
+    alpha = setup.alpha
+    n_train = setup.n_train
     coeffs = np.asarray(layout.coeffs)
     slot_coded = np.asarray(layout.slot_is_coded)
-    update_fn = optimizer.make_update_fn(cfg.update_rule)
-
-    params0 = model.init_params(jax.random.key(cfg.seed), dataset.n_features)
-    params0 = jax.tree.map(lambda p: p.astype(dtype), params0)
-    state = optimizer.init_state(params0, cfg.update_rule)
+    update_fn = setup.update_fn
+    state = setup.state0
 
     # one worker's transmitted message: its per-slot gradient stack
     @jax.jit
@@ -429,8 +465,23 @@ def train_measured(
     # up every per-worker executable so measured times are steady-state
     # compute, not gather dispatch or compile/program-load
     slices = [worker_slice(w) for w in range(W)]
+    m0 = None
     for Xs, ys in slices:
-        _hard_sync(worker_msg(state.params, Xs, ys))
+        m0 = worker_msg(state.params, Xs, ys)
+        _hard_sync(m0)
+    # warm decode_update too (same shapes as the loop's calls, zero decode
+    # weights, result discarded): its first call would otherwise compile
+    # inside the timed region and be charged to round 0's wall-clock
+    per_slot0 = jax.tree.map(lambda *xs: jnp.stack(xs), *([m0] * W))
+    _hard_sync(
+        decode_update(
+            state,
+            per_slot0,
+            jnp.zeros((W, coeffs.shape[1]), dtype),
+            jnp.asarray(lr[0], dtype),
+            jnp.asarray(0.0, dtype),
+        )
+    )
 
     timeset = np.zeros(cfg.rounds)
     worker_times = np.zeros((cfg.rounds, W))
@@ -503,31 +554,22 @@ def train_dynamic(cfg: RunConfig, dataset: Dataset, mesh=None) -> TrainResult:
     """
     from erasurehead_tpu.parallel import dynamic as dynamic_lib
 
-    layout = build_layout(cfg)
-    model = build_model(cfg)
-    if mesh is None:
-        avail = len(jax.devices())
-        need = layout.n_workers
-        mesh = worker_mesh(max(d for d in range(1, avail + 1) if need % d == 0))
-    data = shard_run_data(
-        dataset, layout, mesh, faithful=True, dtype=jnp.dtype(cfg.dtype)
-    )
+    setup = _setup_run(cfg, dataset, mesh, faithful=True)
+    layout, model, mesh, data = setup.layout, setup.model, setup.mesh, setup.data
     sched_fn = dynamic_lib.make_round_schedule_fn(
         cfg.scheme, layout, cfg.num_collect, cfg.delay_mean, cfg.add_delay
     )
     grad_fn = step_lib.make_faithful_grad_fn(model, mesh)
-    update_fn = optimizer.make_update_fn(cfg.update_rule)
+    update_fn = setup.update_fn
     dtype = jnp.float32  # param/update dtype (cfg.dtype is the data dtype)
     coeffs = jnp.asarray(layout.coeffs, dtype)
     slot_coded = jnp.asarray(np.asarray(layout.slot_is_coded))
-    lr_seq = jnp.asarray(cfg.resolve_lr_schedule(), dtype)
-    alpha = cfg.effective_alpha
-    n_train = data.n_train
+    lr_seq = jnp.asarray(setup.lr, dtype)
+    alpha = setup.alpha
+    n_train = setup.n_train
     X, y = data.Xw, data.yw
 
-    params0 = model.init_params(jax.random.key(cfg.seed), dataset.n_features)
-    params0 = jax.tree.map(lambda p: p.astype(dtype), params0)
-    state0 = optimizer.init_state(params0, cfg.update_rule)
+    state0 = setup.state0
     key = jax.random.key(cfg.seed + 1)
 
     def body(Xa, ya, state, xs):

@@ -11,7 +11,7 @@ import scipy.sparse as sps
 
 from erasurehead_tpu.data import io as data_io
 from erasurehead_tpu.data import prepare, real
-from erasurehead_tpu.data.synthetic import generate_gmm
+from erasurehead_tpu.data.synthetic import generate_gmm, generate_onehot
 from erasurehead_tpu.train import evaluate, trainer
 from erasurehead_tpu.utils.config import RunConfig
 
@@ -163,6 +163,47 @@ def test_prepare_cli_real_and_sparse_training(tmp_path, amazon_raw):
         scheme="approx", n_workers=4, n_stragglers=1, num_collect=3,
         rounds=6, n_rows=ds.n_samples, n_cols=ds.n_features,
         dataset="amazon", lr_schedule=1.0, add_delay=True, seed=0,
+    )
+    res = trainer.train(cfg, ds)
+    ev = evaluate.replay(
+        trainer.build_model(cfg), cfg.model, res.params_history,
+        ds.X_train[: res.n_train], ds.y_train[: res.n_train],
+        ds.X_test, ds.y_test,
+    )
+    assert np.isfinite(ev.training_loss).all()
+    assert ev.training_loss[-1] < ev.training_loss[0]
+
+
+def test_generate_onehot_structure():
+    """Covtype-style synthetic one-hot: CSR, exactly n_fields ones per row,
+    one active category per contiguous field block, deterministic by seed
+    (tools/bench_sparse.py's canonical-scale workload in miniature)."""
+    ds = generate_onehot(240, 130, n_partitions=4, n_fields=12, seed=3)
+    X = ds.X_train.tocsr()
+    assert X.shape == (240, 130) and ds.X_test.shape == (48, 130)
+    assert (np.diff(X.indptr) == 12).all()
+    assert (X.data == 1.0).all()
+    bounds = np.linspace(0, 130, 13).astype(int)
+    idx = X.indices.reshape(240, 12)
+    assert ((idx >= bounds[:-1]) & (idx < bounds[1:])).all()
+    assert set(np.unique(ds.y_train)) <= {-1.0, 1.0}
+    ds2 = generate_onehot(240, 130, n_partitions=4, n_fields=12, seed=3)
+    assert (ds.X_train != ds2.X_train).nnz == 0
+    assert np.array_equal(ds.y_train, ds2.y_train)
+    with pytest.raises(ValueError):
+        generate_onehot(241, 130, n_partitions=4)
+    with pytest.raises(ValueError):
+        generate_onehot(240, 8, n_partitions=4, n_fields=12)
+
+
+def test_onehot_sparse_agc_trains():
+    """The covtype-shaped sparse path end-to-end in miniature: one-hot CSR
+    -> PaddedRows slot stacks -> AGC trainer -> loss decreases."""
+    ds = generate_onehot(720, 180, n_partitions=6, n_fields=12, seed=0)
+    cfg = RunConfig(
+        scheme="approx", n_workers=6, n_stragglers=1, num_collect=4,
+        rounds=8, n_rows=720, n_cols=180, dataset="covtype",
+        lr_schedule=2.0, add_delay=True, seed=0,
     )
     res = trainer.train(cfg, ds)
     ev = evaluate.replay(

@@ -1,0 +1,119 @@
+"""Canonical-scale SPARSE benchmark: covtype-shaped AGC on real TPU.
+
+VERDICT r1 item 4: the reference's actual flagship workload is sparse one-hot
+covtype — 396112 rows x 15509 one-hot columns (run_approx_coding.sh:26-28,
+src/arrange_real_data.py:145-205) — and round 1 never ran the PaddedRows
+path at that scale. This runs the AGC trainer on a covtype-shaped synthetic
+one-hot CSR dataset (identical structure: nnz_per_row=12, 15509 categories;
+the Kaggle/UCI raws are absent in this environment) at the canonical
+W=30 / s=2 / collect=15 / AGD / 100-round configuration, on whatever
+accelerator is live, and prints ONE JSON line with steps/sec.
+
+Usage: python tools/bench_sparse.py [--rows 396090] [--cols 15509] [--light]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+ROUNDS = 100
+W, S, COLLECT = 30, 2, 15
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    # canonical covtype rows, trimmed to a multiple of W (the reference's
+    # integer division drops the remainder rows the same way, coded.py:23)
+    ap.add_argument("--rows", type=int, default=396112 // W * W)
+    ap.add_argument("--cols", type=int, default=15509)
+    ap.add_argument("--nnz", type=int, default=12)
+    ap.add_argument("--rounds", type=int, default=ROUNDS)
+    ap.add_argument(
+        "--light", action="store_true",
+        help="1/30-scale smoke run (CI / CPU)",
+    )
+    args = ap.parse_args()
+    if args.light:
+        args.rows, args.cols, args.rounds = 13200, 1551, 10
+
+    import jax
+
+    from erasurehead_tpu.data.synthetic import generate_onehot
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    platform = jax.devices()[0].platform
+    print(
+        f"bench_sparse: platform={platform} rows={args.rows} "
+        f"cols={args.cols} nnz={args.nnz} W={W} s={S} collect={COLLECT} "
+        f"rounds={args.rounds}",
+        file=sys.stderr,
+    )
+
+    t0 = time.perf_counter()
+    data = generate_onehot(
+        args.rows, args.cols, n_partitions=W, n_fields=args.nnz, seed=0
+    )
+    print(
+        f"bench_sparse: generated CSR in {time.perf_counter() - t0:.1f}s "
+        f"(nnz={data.X_train.nnz})",
+        file=sys.stderr,
+    )
+
+    cfg = RunConfig(
+        scheme="approx",
+        n_workers=W,
+        n_stragglers=S,
+        num_collect=COLLECT,
+        rounds=args.rounds,
+        n_rows=args.rows,
+        n_cols=args.cols,
+        update_rule="AGD",
+        dataset="covtype",  # lr_schedule=None -> covtype preset (main.py:40-46)
+        add_delay=True,
+        seed=0,
+    )
+    t0 = time.perf_counter()
+    result = trainer.train(cfg, data)
+    total = time.perf_counter() - t0
+
+    steps_per_sec = result.steps_per_sec
+    ref_rate = args.rounds / result.sim_total_time
+    # HBM traffic model for the PaddedRows step: the slot stack (int32
+    # indices + f32 values) streams twice per step (margin gather + scatter
+    # accumulate); beta gathers are absorbed in the same pass.
+    slot_rows = args.rows // W
+    stack_bytes = W * (S + 1) * slot_rows * args.nnz * 8
+    bytes_per_step = 2 * stack_bytes
+    achieved_gbps = bytes_per_step * steps_per_sec / 1e9
+
+    print(
+        f"bench_sparse: wall(total incl. compile)={total:.1f}s "
+        f"scan={result.wall_time:.3f}s ours={steps_per_sec:.1f} it/s "
+        f"ref_rate={ref_rate:.3f} it/s achieved={achieved_gbps:.1f} GB/s",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "AGC_logistic_sparse_covtype_shape_steps_per_sec",
+                "value": round(float(steps_per_sec), 3),
+                "unit": "iterations/sec",
+                "vs_baseline": round(float(steps_per_sec / ref_rate), 3),
+                "platform": platform,
+                "n_rows": args.rows,
+                "n_cols": args.cols,
+                "nnz_per_row": args.nnz,
+                "wall_time_s": round(float(result.wall_time), 4),
+                "bytes_per_step": bytes_per_step,
+                "achieved_gbps": round(float(achieved_gbps), 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

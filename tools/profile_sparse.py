@@ -1,0 +1,190 @@
+"""Micro-profile the PaddedRows hot ops at the covtype canonical shape on
+TPU, inside one dispatch (the relay's ~60ms round trip would otherwise
+swamp every number). Compares rmatvec lowerings to pick the fastest:
+
+  scatter      — current .at[idx].add (unsorted scatter-add)
+  sort-in-jit  — argsort the flat column ids per call (X is loop-invariant
+                 in the training scan, so XLA may hoist the sort)
+  presorted    — segment_sum with host-presorted ids (indices_are_sorted)
+
+Usage: python tools/profile_sparse.py [--slots 90] [--rows 13203]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def time_scanned(fn, args, iters=30, reps=3):
+    """Seconds/iteration inside one jitted scan; fn(carry, *args)->carry."""
+
+    @jax.jit
+    def many(c0):
+        def body(c, _):
+            return fn(c, *args), None
+
+        cN, _ = jax.lax.scan(body, c0, None, length=iters)
+        return cN
+
+    c0 = jnp.zeros(F, jnp.float32)  # carry is always the beta vector
+    jax.block_until_ready(many(c0))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(many(c0))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)) / iters
+
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--slots", type=int, default=90)
+ap.add_argument("--rows", type=int, default=13203)
+ap.add_argument("--nnz", type=int, default=12)
+ap.add_argument("--cols", type=int, default=15509)
+args = ap.parse_args()
+
+M, R, K, F = args.slots, args.rows, args.nnz, args.cols
+print(f"profile: {jax.devices()[0].platform} M={M} R={R} K={K} F={F}",
+      file=sys.stderr)
+
+rng = np.random.default_rng(0)
+idx = rng.integers(0, F, (M, R, K)).astype(np.int32)
+val = np.ones((M, R, K), np.float32)
+y = np.sign(rng.standard_normal((M, R))).astype(np.float32)
+idx_j, val_j, y_j = jnp.asarray(idx), jnp.asarray(val), jnp.asarray(y)
+
+# host-presorted flat ids per slot
+flat = idx.reshape(M, R * K)
+order = np.argsort(flat, axis=1, kind="stable").astype(np.int32)
+sorted_ids = np.take_along_axis(flat, order, axis=1)
+order_j, sorted_ids_j = jnp.asarray(order), jnp.asarray(sorted_ids)
+
+results = {}
+
+
+def dep(beta, g):
+    """Feed g back into beta so nothing is elided."""
+    return g / (jnp.linalg.norm(g) + 1.0)
+
+
+# --- margin gather only ----------------------------------------------------
+def margin(beta, idxs, vals, ys):
+    g = jax.vmap(
+        lambda i, v: jnp.sum(v * jnp.take(beta, i, axis=0), axis=1)
+    )(idxs, vals)
+    # reduce back to F so the carry shape survives: cheap bincount-free proxy
+    return beta * 0.999 + jnp.sum(g) / F
+
+
+results["margin_gather_ms"] = round(
+    time_scanned(margin, (idx_j, val_j, y_j)) * 1e3, 3
+)
+print(f"profile: margin {results['margin_gather_ms']}ms", file=sys.stderr)
+
+
+# --- rmatvec: current unsorted scatter ------------------------------------
+def scatter(beta, idxs, vals, ys):
+    def one(i, v, s):
+        contrib = (v * s[:, None]).reshape(-1)
+        return jnp.zeros(F, jnp.float32).at[i.reshape(-1)].add(contrib)
+
+    g = jax.vmap(one)(idxs, vals, ys).sum(0)
+    return dep(beta, g)
+
+
+results["scatter_ms"] = round(
+    time_scanned(scatter, (idx_j, val_j, y_j)) * 1e3, 3
+)
+print(f"profile: scatter {results['scatter_ms']}ms", file=sys.stderr)
+
+
+# --- rmatvec: sort inside jit (hoistable: ids are loop-invariant) ---------
+def sortjit(beta, idxs, vals, ys):
+    def one(i, v, s):
+        flat_i = i.reshape(-1)
+        o = jnp.argsort(flat_i)
+        contrib = (v * s[:, None]).reshape(-1)[o]
+        return jax.ops.segment_sum(
+            contrib, flat_i[o], num_segments=F, indices_are_sorted=True
+        )
+
+    g = jax.vmap(one)(idxs, vals, ys).sum(0)
+    return dep(beta, g)
+
+
+results["sort_in_jit_ms"] = round(
+    time_scanned(sortjit, (idx_j, val_j, y_j)) * 1e3, 3
+)
+print(f"profile: sort_in_jit {results['sort_in_jit_ms']}ms", file=sys.stderr)
+
+
+# --- rmatvec: host-presorted segment_sum ----------------------------------
+def presorted(beta, idxs, vals, ys, orders, sids):
+    def one(i, v, s, o, sid):
+        contrib = (v * s[:, None]).reshape(-1)[o]
+        return jax.ops.segment_sum(
+            contrib, sid, num_segments=F, indices_are_sorted=True
+        )
+
+    g = jax.vmap(one)(idxs, vals, ys, orders, sids).sum(0)
+    return dep(beta, g)
+
+
+results["presorted_ms"] = round(
+    time_scanned(presorted, (idx_j, val_j, y_j, order_j, sorted_ids_j)) * 1e3,
+    3,
+)
+print(f"profile: presorted {results['presorted_ms']}ms", file=sys.stderr)
+
+results["platform"] = jax.devices()[0].platform
+results["shape"] = [M, R, K, F]
+
+
+# --- margin via row-gather from a lane-replicated [F, L] table ------------
+def margin_rowgather_fn(L):
+    def f(beta, idxs, vals, ys):
+        table = jnp.broadcast_to(beta[:, None], (F, L))
+        def one(i, v):
+            g = jnp.take(table, i.reshape(-1), axis=0)  # [R*K, L]
+            return (v.reshape(-1, 1) * g).reshape(i.shape[0], -1, L).sum(1)
+        p = jax.vmap(one)(idxs, vals)  # [M, R, L]
+        return beta * 0.999 + jnp.sum(p[..., 0]) / F
+    return f
+
+
+for L in (8, 128):
+    results[f"margin_rowgather{L}_ms"] = round(
+        time_scanned(margin_rowgather_fn(L), (idx_j, val_j, y_j)) * 1e3, 3
+    )
+    print(f"profile: margin_rowgather{L} "
+          f"{results[f'margin_rowgather{L}_ms']}ms", file=sys.stderr)
+
+
+# --- rmatvec via row-scatter into [F, L] ----------------------------------
+def scatter_rows_fn(L):
+    def f(beta, idxs, vals, ys):
+        def one(i, v, s):
+            contrib = (v * s[:, None]).reshape(-1, 1)
+            rows = jnp.broadcast_to(contrib, (contrib.shape[0], L))
+            out = jnp.zeros((F, L), jnp.float32).at[i.reshape(-1)].add(rows)
+            return out[:, 0]
+        g = jax.vmap(one)(idxs, vals, ys).sum(0)
+        return dep(beta, g)
+    return f
+
+
+for L in (8, 128):
+    results[f"scatter_rows{L}_ms"] = round(
+        time_scanned(scatter_rows_fn(L), (idx_j, val_j, y_j)) * 1e3, 3
+    )
+    print(f"profile: scatter_rows{L} "
+          f"{results[f'scatter_rows{L}_ms']}ms", file=sys.stderr)
+
+print(json.dumps(results))
