@@ -136,6 +136,9 @@ def _flags_parser() -> argparse.ArgumentParser:
                    help="measured: time each worker's real per-round "
                         "gradient compute and collect on those arrivals "
                         "(trainer.train_measured)")
+    p.add_argument("--sparse-lanes", type=int, default=None,
+                   help="PaddedRows gather/scatter lane width (power of "
+                        "two; TPU scalar-gather workaround)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--trace-dir", default=None,
                    help="capture a jax.profiler device trace here")
@@ -175,6 +178,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         use_pallas=ns.use_pallas,
         dtype=ns.dtype,
         arrival_mode=ns.arrival_mode,
+        sparse_lanes=ns.sparse_lanes,
         seed=ns.seed,
     )
 

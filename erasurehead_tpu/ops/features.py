@@ -100,11 +100,66 @@ class PaddedRows:
 
 Features = Union[jnp.ndarray, PaddedRows]
 
+# Sparse gather/scatter lane width. TPU scalar gather/scatter throughput is
+# ~7 ns/element (measured, tools/profile_sparse.py) — each of the nnz
+# lookups moves 4 bytes through a path sized for 512-byte vector rows. With
+# lanes=L, matvec gathers L-wide rows from a lane-replicated [F, L] table
+# and rmatvec scatter-adds L-wide rows into a [F, L] accumulator (all lanes
+# identical; lane 0 is the answer), trading L x memory traffic for
+# vectorized addressing. None = plain scalar lowering (CPU default; exact
+# same arithmetic).
+_SPARSE_LANES: Optional[int] = None
+
+
+def validate_lanes(L: Optional[int]) -> Optional[int]:
+    """Normalize/validate a lane width: None, or a power of two in [1, 1024].
+    Single home for the rule — RunConfig validation calls this too."""
+    if L is None:
+        return None
+    L = int(L)
+    if L < 1 or L > 1024 or (L & (L - 1)):
+        raise ValueError(
+            f"sparse lane width must be a power of two in [1, 1024], got {L}"
+        )
+    return L
+
+
+def set_sparse_lanes(L: Optional[int]) -> None:
+    """Set the PaddedRows gather/scatter lane width (None = scalar path).
+
+    L must be a power of two: the lane reduction ``sum(lanes) / L`` is then
+    exactly a single lane's value (all lanes are identical; summing L equal
+    f32 values is an exponent shift). The full op still agrees with the
+    scalar path only to f32 reduction tolerance — XLA may reassociate the
+    per-row contraction differently per shape. A lane-0 slice instead of
+    the reduction would invite XLA to narrow the gather back into the
+    scalar form this path exists to avoid.
+    """
+    global _SPARSE_LANES
+    _SPARSE_LANES = validate_lanes(L)
+
+
+def get_sparse_lanes() -> Optional[int]:
+    return _SPARSE_LANES
+
 
 def matvec(X: Features, v: jnp.ndarray, precision=None) -> jnp.ndarray:
     """X @ v for dense [n, F] or PaddedRows; v may also be a matrix [F, H]."""
     precision = precision if precision is not None else _DEFAULT_PRECISION
     if isinstance(X, PaddedRows):
+        L = _SPARSE_LANES
+        if L is not None and v.ndim == 1:
+            # lane-replicated table; the barrier keeps XLA from simplifying
+            # gather-of-broadcast back into the scalar gather being avoided
+            table = jax.lax.optimization_barrier(
+                jnp.broadcast_to(v[:, None], (v.shape[0], L))
+            )
+            g = jnp.take(table, X.indices, axis=0)  # [n, nnz, L]
+            per_lane = jnp.einsum(
+                "nk,nkl->nl", X.values, g, precision=precision
+            )
+            # exact: lanes are identical and L is a power of two
+            return per_lane.sum(axis=1) * (1.0 / L)
         gathered = jnp.take(v, X.indices, axis=0)  # [n, nnz] or [n, nnz, H]
         if v.ndim == 1:
             return jnp.sum(X.values * gathered, axis=1)
@@ -116,6 +171,19 @@ def rmatvec(X: Features, r: jnp.ndarray, precision=None) -> jnp.ndarray:
     """X.T @ r (scatter-add for PaddedRows); r is [n] or [n, H]."""
     precision = precision if precision is not None else _DEFAULT_PRECISION
     if isinstance(X, PaddedRows):
+        L = _SPARSE_LANES
+        if L is not None and r.ndim == 1:
+            contrib = (X.values * r[:, None]).reshape(-1, 1)  # [n*nnz, 1]
+            rows = jax.lax.optimization_barrier(
+                jnp.broadcast_to(contrib, (contrib.shape[0], L))
+            )
+            out = (
+                jnp.zeros((X.n_cols, L), contrib.dtype)
+                .at[X.indices.reshape(-1)]
+                .add(rows)
+            )
+            # exact: every lane accumulated the identical add sequence
+            return out.sum(axis=1) * (1.0 / L)
         if r.ndim == 1:
             contrib = (X.values * r[:, None]).reshape(-1)  # [n*nnz]
             return jnp.zeros(X.n_cols, contrib.dtype).at[

@@ -21,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
-from functools import partial
+from functools import partial, wraps
 from typing import Any, Optional
 
 import jax
@@ -111,6 +111,30 @@ class _RunSetup:
     n_train: int
 
 
+def _with_run_sparse_lanes(fn):
+    """Scope cfg.sparse_lanes to the trainer call: set the features-module
+    lane width for the run's traces, restore the previous value on exit.
+    Without the restore the global would leak into every later
+    matvec/rmatvec — e.g. cli.run's evaluate.replay over the FULL training
+    set, where an L-lane gather's [n, nnz, L] intermediate is L x the
+    memory (19 GB at the covtype shape with L=1024). All jitted fns inside
+    the trainers are per-run closures, so the flip always retraces.
+    """
+
+    @wraps(fn)
+    def wrapper(cfg, dataset, *args, **kwargs):
+        from erasurehead_tpu.ops import features as features_lib
+
+        prev = features_lib.get_sparse_lanes()
+        features_lib.set_sparse_lanes(cfg.sparse_lanes)
+        try:
+            return fn(cfg, dataset, *args, **kwargs)
+        finally:
+            features_lib.set_sparse_lanes(prev)
+
+    return wrapper
+
+
 def _setup_run(
     cfg: RunConfig,
     dataset: Dataset,
@@ -185,6 +209,7 @@ class TrainResult:
     layout: codes.CodingLayout = None
 
 
+@_with_run_sparse_lanes
 def train(
     cfg: RunConfig,
     dataset: Dataset,
@@ -373,6 +398,7 @@ def train(
     )
 
 
+@_with_run_sparse_lanes
 def train_measured(
     cfg: RunConfig,
     dataset: Dataset,
@@ -542,6 +568,7 @@ def train_measured(
     )
 
 
+@_with_run_sparse_lanes
 def train_dynamic(cfg: RunConfig, dataset: Dataset, mesh=None) -> TrainResult:
     """Fully on-device run: arrivals, collection masks, and decode are
     traced values inside ONE jitted scan (parallel/dynamic.py) — no host

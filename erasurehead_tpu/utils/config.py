@@ -137,6 +137,10 @@ class RunConfig:
     # feed those arrivals to the collection rule (trainer.train_measured —
     # worker_timeset becomes a measurement, like src/naive.py:106).
     arrival_mode: str = "simulated"
+    # PaddedRows gather/scatter lane width (ops/features.set_sparse_lanes):
+    # None = scalar lowering; a power of two widens every sparse lookup to
+    # an L-lane row, the TPU workaround for ~7ns/element scalar gathers.
+    sparse_lanes: Optional[int] = None
 
     @classmethod
     def for_dataset(cls, dataset: str, **overrides) -> "RunConfig":
@@ -165,6 +169,9 @@ class RunConfig:
                 f"arrival_mode must be simulated/measured, got "
                 f"{self.arrival_mode!r}"
             )
+        from erasurehead_tpu.ops.features import validate_lanes
+
+        self.sparse_lanes = validate_lanes(self.sparse_lanes)
         if self.num_collect is None:
             self.num_collect = self.n_workers
         if self.dataset not in DATASET_PRESETS:

@@ -213,3 +213,31 @@ def test_onehot_sparse_agc_trains():
     )
     assert np.isfinite(ev.training_loss).all()
     assert ev.training_loss[-1] < ev.training_loss[0]
+
+
+def test_sparse_lanes_and_dedup_train_same():
+    """config.sparse_lanes and compute_mode='deduped' are pure lowering
+    choices: the training trajectory on sparse data must match the scalar
+    faithful path to f32 tolerance, and the knob must reset between runs."""
+    from erasurehead_tpu.ops import features
+
+    ds = generate_onehot(480, 120, n_partitions=6, n_fields=8, seed=1)
+    base = dict(
+        scheme="approx", n_workers=6, n_stragglers=1, num_collect=4,
+        rounds=6, n_rows=480, n_cols=120, dataset="covtype",
+        lr_schedule=2.0, add_delay=True, seed=0,
+    )
+    ref = trainer.train(RunConfig(**base), ds)
+    assert features.get_sparse_lanes() is None
+    lanes = trainer.train(RunConfig(**base, sparse_lanes=8), ds)
+    # the knob is scoped to the run: it must NOT leak into post-run
+    # callers (evaluate.replay's full-train-set gather would be L x the
+    # memory at scale)
+    assert features.get_sparse_lanes() is None
+    dedup = trainer.train(
+        RunConfig(**base, compute_mode="deduped", sparse_lanes=128), ds
+    )
+    assert features.get_sparse_lanes() is None
+    h_ref = np.asarray(ref.params_history)
+    assert np.allclose(np.asarray(lanes.params_history), h_ref, atol=1e-5)
+    assert np.allclose(np.asarray(dedup.params_history), h_ref, atol=1e-5)

@@ -156,3 +156,36 @@ def test_auc_jittable():
     y = jnp.asarray(np.where(rng.standard_normal(100) > 0, 1.0, -1.0))
     s = jnp.asarray(rng.standard_normal(100))
     assert np.isclose(jax.jit(metrics.auc)(y, s), metrics.auc(y, s))
+
+
+def test_sparse_lanes_matches_scalar_path():
+    """The lane-replicated gather/scatter lowering (features.set_sparse_lanes,
+    the TPU scalar-gather workaround) must agree with the scalar path to
+    f32 reduction tolerance at every lane width. (Not bit-exact: the lane
+    reduction itself is an exact exponent shift over identical lanes, but
+    XLA may reassociate the row contraction differently per shape.)"""
+    from erasurehead_tpu.ops import features
+
+    rng = np.random.default_rng(5)
+    dense = sps.random(60, 45, density=0.15, random_state=3, format="csr")
+    P = PaddedRows.from_scipy(dense)
+    v = jnp.asarray(rng.standard_normal(45).astype(np.float32))
+    r = jnp.asarray(rng.standard_normal(60).astype(np.float32))
+    base_mv = np.asarray(matvec(P, v))
+    base_rmv = np.asarray(rmatvec(P, r))
+    try:
+        for L in (1, 8, 128):
+            features.set_sparse_lanes(L)
+            assert np.allclose(matvec(P, v), base_mv, atol=1e-5), L
+            assert np.allclose(rmatvec(P, r), base_rmv, atol=1e-5), L
+        # matrix RHS keeps the scalar path regardless of the knob
+        V = jnp.asarray(rng.standard_normal((45, 3)).astype(np.float32))
+        features.set_sparse_lanes(8)
+        assert np.allclose(matvec(P, V), matvec(jnp.asarray(dense.toarray()), V),
+                           atol=1e-4)
+    finally:
+        features.set_sparse_lanes(None)
+    with pytest.raises(ValueError):
+        features.set_sparse_lanes(12)  # not a power of two
+    with pytest.raises(ValueError):
+        features.set_sparse_lanes(2048)

@@ -35,6 +35,15 @@ def main() -> None:
         "--light", action="store_true",
         help="1/30-scale smoke run (CI / CPU)",
     )
+    ap.add_argument(
+        "--mode", default="faithful", choices=["faithful", "deduped"],
+        help="deduped computes each partition once (the framework's "
+             "optimization; bit-comparable gradients, 1/(s+1) the lookups)",
+    )
+    ap.add_argument(
+        "--lanes", type=int, default=None,
+        help="PaddedRows gather/scatter lane width (power of two)",
+    )
     args = ap.parse_args()
     if args.light:
         args.rows, args.cols, args.rounds = 13200, 1551, 10
@@ -74,6 +83,8 @@ def main() -> None:
         update_rule="AGD",
         dataset="covtype",  # lr_schedule=None -> covtype preset (main.py:40-46)
         add_delay=True,
+        compute_mode=args.mode,
+        sparse_lanes=args.lanes,
         seed=0,
     )
     t0 = time.perf_counter()
@@ -82,11 +93,17 @@ def main() -> None:
 
     steps_per_sec = result.steps_per_sec
     ref_rate = args.rounds / result.sim_total_time
-    # HBM traffic model for the PaddedRows step: the slot stack (int32
-    # indices + f32 values) streams twice per step (margin gather + scatter
-    # accumulate); beta gathers are absorbed in the same pass.
+    # HBM traffic model for the PaddedRows step: per nonzero, each pass
+    # moves a 4-byte index plus the value payload — 4 bytes scalar, or an
+    # L-lane row (4*L bytes) under --lanes (that traffic amplification is
+    # the lowering's explicit trade, ops/features.py). Two passes per step
+    # (margin gather + scatter accumulate); beta gathers are absorbed in
+    # the same pass. Deduped mode touches each partition once instead of
+    # (s+1) redundant slots.
     slot_rows = args.rows // W
-    stack_bytes = W * (S + 1) * slot_rows * args.nnz * 8
+    n_stacks = W * (S + 1) if args.mode == "faithful" else W
+    payload = 4 * (args.lanes or 1)
+    stack_bytes = n_stacks * slot_rows * args.nnz * (4 + payload)
     bytes_per_step = 2 * stack_bytes
     achieved_gbps = bytes_per_step * steps_per_sec / 1e9
 
@@ -104,6 +121,8 @@ def main() -> None:
                 "unit": "iterations/sec",
                 "vs_baseline": round(float(steps_per_sec / ref_rate), 3),
                 "platform": platform,
+                "mode": args.mode,
+                "lanes": args.lanes,
                 "n_rows": args.rows,
                 "n_cols": args.cols,
                 "nnz_per_row": args.nnz,
