@@ -136,6 +136,36 @@ def _attempts():
         yield "cpu-fallback", _cpu_env(), RUN_TIMEOUT
 
 
+_LAST_TPU_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LAST.json"
+)
+
+
+def _record_or_annotate(payload: dict) -> dict:
+    """On a TPU result: persist it as the committed last-known-TPU artifact.
+    On a fallback: attach that artifact (clearly labeled as a PRIOR
+    measurement, never substituted into value/platform) so a wedged relay
+    doesn't erase the evidence that a TPU number exists."""
+    try:
+        if payload.get("platform") in ("tpu", "axon"):
+            record = dict(payload)
+            record["recorded_unix"] = int(time.time())
+            # atomic replace: a bench killed mid-write (the wedged-relay
+            # timeouts this script defends against) must not leave a
+            # truncated artifact poisoning later fallback runs
+            tmp = _LAST_TPU_PATH + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+                f.write("\n")
+            os.replace(tmp, _LAST_TPU_PATH)
+        elif os.path.exists(_LAST_TPU_PATH):
+            with open(_LAST_TPU_PATH) as f:
+                payload["last_tpu_result"] = json.load(f)
+    except (OSError, ValueError) as e:  # ValueError covers JSONDecodeError
+        print(f"bench: last-TPU artifact io failed: {e}", file=sys.stderr)
+    return payload
+
+
 def main() -> None:
     # Each attempt: cheap backend probe first (so a hung relay costs
     # PROBE_TIMEOUT, not RUN_TIMEOUT), then the real run under its timeout.
@@ -158,7 +188,7 @@ def main() -> None:
             "platform": "none",
             "error": "all bench attempts failed or timed out",
         }
-    print(json.dumps(payload))
+    print(json.dumps(_record_or_annotate(payload)))
 
 
 def child() -> None:

@@ -175,8 +175,18 @@ def baseline_suite(
     linear-model data for least-squares) at ``scale`` x a canonical size, and
     the suite labels record the substitution. Returns {config_name: summaries}.
     """
-    from erasurehead_tpu.data.synthetic import generate_gmm, generate_linear
+    from erasurehead_tpu.data.synthetic import (
+        generate_gmm,
+        generate_linear,
+        generate_onehot,
+    )
     from erasurehead_tpu.utils.config import ModelKind
+
+    # reference nnz/row of the real one-hot matrices: covtype's binned
+    # one-hot has 12 active categories per row (arrange_real_data.py:145-205
+    # structure), amazon's hashed-interaction encoding has 44
+    # (arrange_real_data.py:34-91; pinned in tests/test_data.py)
+    ONEHOT_NNZ = {"covtype": 12, "amazon": 44}
 
     def _rows(rows, parts):
         n = max(parts * 8, int(rows * scale))
@@ -201,11 +211,21 @@ def baseline_suite(
                 _cache[key] = (ds, name)
                 return _cache[key]
         rows, cols = fallback
-        maker = (
-            generate_linear if name in ("kc_house_data", "synthetic-linear")
-            else generate_gmm
-        )
-        ds = maker(_rows(rows, parts), cols, parts, seed=0)
+        if name in ONEHOT_NNZ:
+            # structure-matched sparse stand-in: one-hot CSR with the real
+            # dataset's nnz/row, so the suite exercises the PaddedRows path
+            # the actual workload would take
+            nnz = min(ONEHOT_NNZ[name], cols)
+            ds = generate_onehot(
+                _rows(rows, parts), cols, parts, n_fields=nnz, seed=0
+            )
+        else:
+            maker = (
+                generate_linear
+                if name in ("kc_house_data", "synthetic-linear")
+                else generate_gmm
+            )
+            ds = maker(_rows(rows, parts), cols, parts, seed=0)
         _cache[key] = (ds, f"synthetic({name}-shaped)")
         return _cache[key]
 
