@@ -140,6 +140,14 @@ def _flags_parser() -> argparse.ArgumentParser:
                    help="PaddedRows gather/scatter lane width (power of "
                         "two; TPU scalar-gather workaround)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="save optimizer state here every --checkpoint-every "
+                        "rounds (orbax)")
+    p.add_argument("--checkpoint-every", type=int, default=None)
+    p.add_argument("--resume", action="store_true",
+                   help="restart from the latest checkpoint in "
+                        "--checkpoint-dir; artifacts cover the resumed "
+                        "window [start_round, rounds)")
     p.add_argument("--trace-dir", default=None,
                    help="capture a jax.profiler device trace here")
     p.add_argument("--quiet", action="store_true")
@@ -232,16 +240,32 @@ def run(
     output_dir: str | None = None,
     quiet: bool = False,
     trace_dir: str | None = None,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int | None = None,
+    resume: bool = False,
 ):
     initialize_distributed()
     dataset = load_dataset(cfg)
     from erasurehead_tpu.utils.tracing import device_trace
 
+    if (checkpoint_dir or resume) and cfg.arrival_mode == "measured":
+        raise ValueError(
+            "checkpoint/resume is implemented for the scan trainer only; "
+            "unset --arrival-mode measured"
+        )
     with device_trace(trace_dir):
         if cfg.arrival_mode == "measured":
             result = trainer.train_measured(cfg, dataset)
         else:
-            result = trainer.train(cfg, dataset)
+            # a resumed run's artifacts cover [start_round, rounds) — the
+            # loss curve is the resumed window, aligned by artifacts.py
+            result = trainer.train(
+                cfg,
+                dataset,
+                checkpoint_dir=checkpoint_dir,
+                checkpoint_every=checkpoint_every,
+                resume=resume,
+            )
     model = trainer.build_model(cfg)
     n = result.n_train
     ev = evaluate.replay(
@@ -271,13 +295,33 @@ def main(argv: list[str] | None = None) -> int:
         cfg = _legacy_to_config(argv)
         run(cfg)
         return 0
-    ns = _flags_parser().parse_args(argv)
+    parser = _flags_parser()
+    ns = parser.parse_args(argv)
+    # interdependent checkpoint flags: fail fast with a proper CLI
+    # diagnostic, before backend init / dataset load
+    if ns.resume and not ns.checkpoint_dir:
+        parser.error("--resume requires --checkpoint-dir")
+    if ns.checkpoint_every is not None and ns.checkpoint_every < 1:
+        parser.error("--checkpoint-every must be >= 1")
+    if ns.checkpoint_dir and not ns.resume and ns.checkpoint_every is None:
+        parser.error(
+            "--checkpoint-dir without --checkpoint-every never saves; "
+            "pass --checkpoint-every N"
+        )
+    if (ns.checkpoint_dir or ns.resume) and ns.arrival_mode == "measured":
+        parser.error(
+            "checkpoint/resume is implemented for the scan trainer only; "
+            "unset --arrival-mode measured"
+        )
     cfg = _flags_to_config(ns)
     run(
         cfg,
         output_dir=ns.output_dir,
         quiet=ns.quiet,
         trace_dir=ns.trace_dir,
+        checkpoint_dir=ns.checkpoint_dir,
+        checkpoint_every=ns.checkpoint_every,
+        resume=ns.resume,
     )
     return 0
 

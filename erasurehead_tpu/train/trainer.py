@@ -335,6 +335,10 @@ def train(
         final_state, history, wall = state0, empty_hist, 0.0
     else:
         # chunk boundaries: [start, start+every, ..., rounds]
+        if checkpoint_every is not None and checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every}"
+            )
         step_len = checkpoint_every or (cfg.rounds - start_round)
         bounds = list(range(start_round, cfg.rounds, step_len)) + [cfg.rounds]
 

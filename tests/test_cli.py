@@ -63,3 +63,61 @@ def test_cli_legacy_13_args(tmp_path):
         ]
     )
     assert rc == 0
+
+
+def test_cli_checkpoint_resume(tmp_path):
+    """Interrupt-and-resume through the CLI: a checkpointed run stopped at
+    round 6 (latest checkpoint at round 3 — the completed run's final state
+    is deliberately not checkpointed), resumed to 10, must produce
+    artifacts covering the resumed window [3, 10) whose loss values equal
+    the corresponding tail of an uninterrupted 10-round run (the
+    control-plane clocks are deterministic, so the resumed trajectory is
+    aligned)."""
+    data_dir = str(tmp_path / "data")
+    ck = str(tmp_path / "ck")
+    base = [
+        "--scheme", "approx", "--workers", "6", "--stragglers", "1",
+        "--num-collect", "4", "--rows", "240", "--cols", "16",
+        "--update-rule", "AGD", "--lr", "1.0", "--add-delay",
+        "--input-dir", data_dir, "--quiet",
+    ]
+    # full uninterrupted run -> reference loss curve
+    assert cli.main(base + ["--rounds", "10"]) == 0
+    results = os.path.join(data_dir, "artificial-data", "240x16", "6", "results")
+    loss_file = next(
+        f for f in os.listdir(results) if "training_loss" in f
+    )
+    full = np.loadtxt(os.path.join(results, loss_file))
+    # checkpointed run stopped at 6 rounds, then resumed to 10
+    assert cli.main(
+        base + ["--rounds", "6", "--checkpoint-dir", ck,
+                "--checkpoint-every", "3"]
+    ) == 0
+    assert cli.main(
+        base + ["--rounds", "10", "--checkpoint-dir", ck,
+                "--checkpoint-every", "3", "--resume"]
+    ) == 0
+    resumed = np.loadtxt(os.path.join(results, loss_file))
+    # resumed artifacts cover [3, 10): 7 rows matching the full run's tail
+    assert resumed.shape[0] == 7
+    assert np.allclose(resumed, full[3:], atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "argv,msg",
+    [
+        (["--resume"], "--resume requires"),
+        (["--checkpoint-dir", "ck"], "--checkpoint-every"),
+        (["--checkpoint-dir", "ck", "--checkpoint-every", "0"], ">= 1"),
+        (["--checkpoint-dir", "ck", "--checkpoint-every", "2",
+          "--arrival-mode", "measured"], None),
+    ],
+)
+def test_cli_checkpoint_flag_validation(capsys, argv, msg):
+    """Interdependent checkpoint flags fail fast as argparse errors (exit
+    code 2) before any backend init or dataset load."""
+    with pytest.raises(SystemExit) as e:
+        cli.main(["--scheme", "naive", "--rows", "64", "--cols", "8"] + argv)
+    assert e.value.code == 2
+    if msg:
+        assert msg in capsys.readouterr().err
