@@ -244,15 +244,15 @@ def run(
     checkpoint_every: int | None = None,
     resume: bool = False,
 ):
-    initialize_distributed()
-    dataset = load_dataset(cfg)
-    from erasurehead_tpu.utils.tracing import device_trace
-
+    # argument-only check: fail before backend init / dataset load
     if (checkpoint_dir or resume) and cfg.arrival_mode == "measured":
         raise ValueError(
             "checkpoint/resume is implemented for the scan trainer only; "
             "unset --arrival-mode measured"
         )
+    initialize_distributed()
+    dataset = load_dataset(cfg)
+    from erasurehead_tpu.utils.tracing import device_trace
     with device_trace(trace_dir):
         if cfg.arrival_mode == "measured":
             result = trainer.train_measured(cfg, dataset)
@@ -308,6 +308,8 @@ def main(argv: list[str] | None = None) -> int:
             "--checkpoint-dir without --checkpoint-every never saves; "
             "pass --checkpoint-every N"
         )
+    if ns.checkpoint_every is not None and not ns.checkpoint_dir:
+        parser.error("--checkpoint-every requires --checkpoint-dir")
     if (ns.checkpoint_dir or ns.resume) and ns.arrival_mode == "measured":
         parser.error(
             "checkpoint/resume is implemented for the scan trainer only; "

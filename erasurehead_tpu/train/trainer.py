@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import sys
 import time
 from functools import partial, wraps
 from typing import Any, Optional
@@ -315,12 +316,26 @@ def train(
             partial(body, Xa, ya), state, (lr_c, w_c, it_c)
         )
 
+    if checkpoint_every is not None and checkpoint_every < 1:
+        raise ValueError(
+            f"checkpoint_every must be >= 1, got {checkpoint_every}"
+        )
     start_round = 0
     if resume and checkpoint_dir:
         from erasurehead_tpu.train import checkpoint as ckpt_lib
 
         path = ckpt_lib.latest(checkpoint_dir)
-        if path is not None:
+        if path is None:
+            # loud, not fatal: restart loops (k8s JobSet, tpu_fleet
+            # launch_run) legitimately pass resume=True on the FIRST
+            # attempt, before any checkpoint exists. A typo'd dir gets the
+            # same message rather than silently overwriting prior artifacts.
+            print(
+                f"train: resume requested but no checkpoint found under "
+                f"{checkpoint_dir!r}; starting from round 0",
+                file=sys.stderr,
+            )
+        else:
             state0, start_round = ckpt_lib.restore(path, state0)
             state0 = jax.tree.map(
                 lambda l: put_global(np.asarray(l), replicated(mesh)),
@@ -335,10 +350,6 @@ def train(
         final_state, history, wall = state0, empty_hist, 0.0
     else:
         # chunk boundaries: [start, start+every, ..., rounds]
-        if checkpoint_every is not None and checkpoint_every < 1:
-            raise ValueError(
-                f"checkpoint_every must be >= 1, got {checkpoint_every}"
-            )
         step_len = checkpoint_every or (cfg.rounds - start_round)
         bounds = list(range(start_round, cfg.rounds, step_len)) + [cfg.rounds]
 

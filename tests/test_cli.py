@@ -121,3 +121,11 @@ def test_cli_checkpoint_flag_validation(capsys, argv, msg):
     assert e.value.code == 2
     if msg:
         assert msg in capsys.readouterr().err
+
+
+def test_cli_checkpoint_every_requires_dir(capsys):
+    with pytest.raises(SystemExit) as e:
+        cli.main(["--scheme", "naive", "--rows", "64", "--cols", "8",
+                  "--checkpoint-every", "2"])
+    assert e.value.code == 2
+    assert "--checkpoint-dir" in capsys.readouterr().err
