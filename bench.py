@@ -64,6 +64,12 @@ PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
 RUN_TIMEOUT = int(os.environ.get("BENCH_RUN_TIMEOUT", "900"))
 RETRY_TIMEOUT = int(os.environ.get("BENCH_RETRY_TIMEOUT", "420"))
 
+# data dtype sweep knob; validated up front so a typo can't burn every
+# timed attempt before failing deep inside the child
+_DTYPE_ITEMSIZE = {"float32": 4, "bfloat16": 2}
+DATA_DTYPE = os.environ.get("BENCH_DTYPE", "float32")
+METRIC_SUFFIX = "" if DATA_DTYPE == "float32" else f"_{DATA_DTYPE}"
+
 
 def _cpu_env() -> dict:
     """Env that bypasses the remote-TPU relay entirely (sitecustomize skips
@@ -146,8 +152,10 @@ def _record_or_annotate(payload: dict) -> dict:
     On a fallback: attach that artifact (clearly labeled as a PRIOR
     measurement, never substituted into value/platform) so a wedged relay
     doesn't erase the evidence that a TPU number exists."""
+    on_tpu = payload.get("platform") in ("tpu", "axon")
+    canonical = payload.get("dtype", "float32") == "float32"
     try:
-        if payload.get("platform") in ("tpu", "axon"):
+        if on_tpu and canonical:
             record = dict(payload)
             record["recorded_unix"] = int(time.time())
             # atomic replace: a bench killed mid-write (the wedged-relay
@@ -158,9 +166,11 @@ def _record_or_annotate(payload: dict) -> dict:
                 json.dump(record, f)
                 f.write("\n")
             os.replace(tmp, _LAST_TPU_PATH)
-        elif os.path.exists(_LAST_TPU_PATH):
+        elif not on_tpu and os.path.exists(_LAST_TPU_PATH):
             with open(_LAST_TPU_PATH) as f:
                 payload["last_tpu_result"] = json.load(f)
+        # (a non-canonical TPU run, e.g. BENCH_DTYPE=bfloat16, is a real TPU
+        # number: neither recorded as the canonical artifact nor annotated)
     except (OSError, ValueError) as e:  # ValueError covers JSONDecodeError
         print(f"bench: last-TPU artifact io failed: {e}", file=sys.stderr)
     return payload
@@ -181,11 +191,14 @@ def main() -> None:
     # 3) never a traceback: emit an explicit failure record as valid JSON
     if payload is None:
         payload = {
-            "metric": "AGC_logistic_steps_per_sec_30w_s2_collect15",
+            "metric": (
+                f"AGC_logistic_steps_per_sec_30w_s2_collect15{METRIC_SUFFIX}"
+            ),
             "value": 0.0,
             "unit": "iterations/sec",
             "vs_baseline": 0.0,
             "platform": "none",
+            "dtype": DATA_DTYPE,
             "error": "all bench attempts failed or timed out",
         }
     print(json.dumps(_record_or_annotate(payload)))
@@ -199,6 +212,10 @@ def child() -> None:
     # accelerator, a light slice on CPU fallback so the bench terminates
     on_accel = platform not in ("cpu",)
     n_rows = 132_000 if on_accel else 13_200
+    # BENCH_DTYPE=bfloat16 measures the halved-HBM-traffic data mode
+    # (params/updates stay f32 — utils/config.py); the metric name carries
+    # the dtype so a bf16 number can never masquerade as the canonical f32
+    data_dtype = DATA_DTYPE
 
     from erasurehead_tpu.data.synthetic import generate_gmm
     from erasurehead_tpu.train import trainer
@@ -215,6 +232,7 @@ def child() -> None:
         update_rule="AGD",
         lr_schedule=1.0,
         add_delay=True,
+        dtype=data_dtype,
         seed=0,
     )
     print(
@@ -235,7 +253,7 @@ def child() -> None:
     # ---- hardware roofline (see module docstring + BASELINE.md) ----------
     # faithful mode streams the [W, s+1, rows/W, F] slot stack twice/step
     slot_rows = n_rows // W
-    x_bytes = W * (S + 1) * slot_rows * N_COLS * 4  # f32 data dtype
+    x_bytes = W * (S + 1) * slot_rows * N_COLS * _DTYPE_ITEMSIZE[data_dtype]
     bytes_per_step = 2 * x_bytes
     flops_per_step = 4 * W * (S + 1) * slot_rows * N_COLS
     achieved_gbps = bytes_per_step * steps_per_sec / 1e9
@@ -254,11 +272,15 @@ def child() -> None:
     print(
         json.dumps(
             {
-                "metric": "AGC_logistic_steps_per_sec_30w_s2_collect15",
+                "metric": (
+                    f"AGC_logistic_steps_per_sec_30w_s2_collect15"
+                    f"{METRIC_SUFFIX}"
+                ),
                 "value": round(float(steps_per_sec), 3),
                 "unit": "iterations/sec",
                 "vs_baseline": round(float(steps_per_sec / ref_steps_per_sec), 3),
                 "platform": platform,
+                "dtype": data_dtype,
                 "n_rows": n_rows,
                 "wall_time_s": round(float(result.wall_time), 4),
                 "flops_per_step": flops_per_step,
@@ -271,6 +293,23 @@ def child() -> None:
 
 
 if __name__ == "__main__":
+    if DATA_DTYPE not in _DTYPE_ITEMSIZE:
+        print(
+            json.dumps(
+                {
+                    "metric": "AGC_logistic_steps_per_sec_30w_s2_collect15",
+                    "value": 0.0,
+                    "unit": "iterations/sec",
+                    "vs_baseline": 0.0,
+                    "platform": "none",
+                    "error": (
+                        f"BENCH_DTYPE must be one of "
+                        f"{sorted(_DTYPE_ITEMSIZE)}, got {DATA_DTYPE!r}"
+                    ),
+                }
+            )
+        )
+        sys.exit(0 if "--child" not in sys.argv else 1)
     if "--child" in sys.argv:
         child()
     else:

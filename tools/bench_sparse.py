@@ -25,11 +25,16 @@ W, S, COLLECT = 30, 2, 15
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    # canonical covtype rows, trimmed to a multiple of W (the reference's
+    # canonical rows are trimmed to a multiple of W (the reference's
     # integer division drops the remainder rows the same way, coded.py:23)
-    ap.add_argument("--rows", type=int, default=396112 // W * W)
-    ap.add_argument("--cols", type=int, default=15509)
-    ap.add_argument("--nnz", type=int, default=12)
+    ap.add_argument(
+        "--shape", default="covtype", choices=["covtype", "amazon"],
+        help="canonical dataset shape preset (run_approx_coding.sh:26-36): "
+             "covtype 396112x15509 nnz=12, amazon 26210x241915 nnz=44",
+    )
+    ap.add_argument("--rows", type=int, default=None)
+    ap.add_argument("--cols", type=int, default=None)
+    ap.add_argument("--nnz", type=int, default=None)
     ap.add_argument("--rounds", type=int, default=ROUNDS)
     ap.add_argument(
         "--light", action="store_true",
@@ -45,8 +50,16 @@ def main() -> None:
         help="PaddedRows gather/scatter lane width (power of two)",
     )
     args = ap.parse_args()
+    presets = {
+        "covtype": (396112 // W * W, 15509, 12),
+        "amazon": (26210 // W * W, 241915, 44),
+    }
+    rows0, cols0, nnz0 = presets[args.shape]
+    args.rows = args.rows if args.rows is not None else rows0
+    args.cols = args.cols if args.cols is not None else cols0
+    args.nnz = args.nnz if args.nnz is not None else nnz0
     if args.light:
-        args.rows, args.cols, args.rounds = 13200, 1551, 10
+        args.rows, args.cols, args.rounds = rows0 // 30 // W * W, cols0 // 10, 10
 
     import jax
 
@@ -81,7 +94,9 @@ def main() -> None:
         n_rows=args.rows,
         n_cols=args.cols,
         update_rule="AGD",
-        dataset="covtype",  # lr_schedule=None -> covtype preset (main.py:40-46)
+        # lr_schedule=None -> the shape's own dataset preset (main.py:37-46;
+        # amazon's canonical lr is 100x covtype's)
+        dataset=args.shape,
         add_delay=True,
         compute_mode=args.mode,
         sparse_lanes=args.lanes,
@@ -116,7 +131,10 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "AGC_logistic_sparse_covtype_shape_steps_per_sec",
+                "metric": (
+                    f"AGC_logistic_sparse_{args.shape}_shape_steps_per_sec"
+                    f"{'_light' if args.light else ''}"
+                ),
                 "value": round(float(steps_per_sec), 3),
                 "unit": "iterations/sec",
                 "vs_baseline": round(float(steps_per_sec / ref_rate), 3),

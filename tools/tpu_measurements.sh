@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# The full on-TPU measurement program, one command — run when the relay is
+# healthy. Appends every JSON result line to tools/measurements.jsonl with
+# a tag, so a flaky relay costs only the remaining entries on rerun.
+#
+#   bash tools/tpu_measurements.sh [out.jsonl]
+#
+# Covers: canonical dense bench (f32 + bfloat16 data), the pallas kernel
+# race, the sparse canonical shapes (covtype + amazon) across
+# faithful/deduped x scalar/lanes lowerings, and the rmatvec profile.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-tools/measurements.jsonl}"
+export PYTHONPATH="${PYTHONPATH:-}:$(pwd)"
+
+run() { # run <tag> <cmd...>
+  local tag="$1"; shift
+  echo "=== $tag: $*" >&2
+  local line
+  line="$("$@" 2>/dev/null | tail -1)"
+  if [ -n "$line" ]; then
+    printf '{"tag": "%s", "result": %s}\n' "$tag" "$line" >> "$OUT"
+    echo "$tag -> $line" >&2
+  else
+    echo "$tag -> FAILED (no output)" >&2
+  fi
+}
+
+run dense_f32        python bench.py
+run dense_bf16       env BENCH_DTYPE=bfloat16 python bench.py
+run kernel_race      python tools/kernel_race.py
+run sparse_profile   python tools/profile_sparse.py
+
+for shape in covtype amazon; do
+  run "sparse_${shape}_faithful"        python tools/bench_sparse.py --shape "$shape"
+  run "sparse_${shape}_deduped"         python tools/bench_sparse.py --shape "$shape" --mode deduped
+  run "sparse_${shape}_faithful_lanes8" python tools/bench_sparse.py --shape "$shape" --lanes 8
+  run "sparse_${shape}_deduped_lanes8"  python tools/bench_sparse.py --shape "$shape" --mode deduped --lanes 8
+  run "sparse_${shape}_deduped_lanes128" python tools/bench_sparse.py --shape "$shape" --mode deduped --lanes 128
+done
+
+echo "measurements appended to $OUT" >&2
