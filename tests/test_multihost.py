@@ -48,8 +48,19 @@ _CHILD = textwrap.dedent(
     data = generate_gmm(cfg.n_rows, cfg.n_cols, n_partitions=%(W)d, seed=0)
     res = trainer.train(cfg, data, mesh=worker_mesh(4), measure=False)
     hist = np.asarray(res.params_history)
+
+    # sparse PaddedRows stacks sharded across BOTH processes (the
+    # covtype/amazon one-hot path under multi-controller put_global)
+    from erasurehead_tpu.data.synthetic import generate_onehot
+
+    sdata = generate_onehot(
+        cfg.n_rows, cfg.n_cols, n_partitions=%(W)d, n_fields=4, seed=0
+    )
+    sres = trainer.train(cfg, sdata, mesh=worker_mesh(4), measure=False)
+    shist = np.asarray(sres.params_history)
     if info["process_index"] == 0:
         np.save(os.environ["EH_OUT"], hist)
+        np.save(os.environ["EH_OUT_SPARSE"], shist)
     """
     % {"W": W, "ROUNDS": ROUNDS, "COLS": COLS}
 )
@@ -64,12 +75,14 @@ def _free_port() -> int:
 def test_two_process_cpu_cluster_matches_single_process(tmp_path):
     port = _free_port()
     out = str(tmp_path / "hist.npy")
+    out_sparse = str(tmp_path / "hist_sparse.npy")
     env = {
         **os.environ,
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
         "EH_COORD": f"127.0.0.1:{port}",
         "EH_OUT": out,
+        "EH_OUT_SPARSE": out_sparse,
     }
     # children must not dial the axon TPU tunnel (sitecustomize registers it
     # whenever PALLAS_AXON_POOL_IPS is set, before any user code runs)
@@ -105,3 +118,14 @@ def test_two_process_cpu_cluster_matches_single_process(tmp_path):
 
     got = np.load(out)
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+    # sparse path: same cluster, PaddedRows stacks spanning both processes
+    from erasurehead_tpu.data.synthetic import generate_onehot
+
+    sdata = generate_onehot(cfg.n_rows, cfg.n_cols, n_partitions=W,
+                            n_fields=4, seed=0)
+    sres = trainer.train(cfg, sdata, mesh=worker_mesh(4), measure=False)
+    np.testing.assert_allclose(
+        np.load(out_sparse), np.asarray(sres.params_history),
+        rtol=1e-6, atol=1e-7,
+    )

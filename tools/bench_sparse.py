@@ -35,7 +35,7 @@ def main() -> None:
     ap.add_argument("--rows", type=int, default=None)
     ap.add_argument("--cols", type=int, default=None)
     ap.add_argument("--nnz", type=int, default=None)
-    ap.add_argument("--rounds", type=int, default=ROUNDS)
+    ap.add_argument("--rounds", type=int, default=None)
     ap.add_argument(
         "--light", action="store_true",
         help="1/30-scale smoke run (CI / CPU)",
@@ -55,11 +55,13 @@ def main() -> None:
         "amazon": (26210 // W * W, 241915, 44),
     }
     rows0, cols0, nnz0 = presets[args.shape]
+    rounds0 = ROUNDS
+    if args.light:  # shrink the DEFAULTS only: explicit flags still win
+        rows0, cols0, rounds0 = rows0 // 30 // W * W, cols0 // 10, 10
     args.rows = args.rows if args.rows is not None else rows0
     args.cols = args.cols if args.cols is not None else cols0
     args.nnz = args.nnz if args.nnz is not None else nnz0
-    if args.light:
-        args.rows, args.cols, args.rounds = rows0 // 30 // W * W, cols0 // 10, 10
+    args.rounds = args.rounds if args.rounds is not None else rounds0
 
     import jax
 

@@ -13,30 +13,33 @@ cd "$(dirname "$0")/.."
 OUT="${1:-tools/measurements.jsonl}"
 export PYTHONPATH="${PYTHONPATH:-}:$(pwd)"
 
-run() { # run <tag> <cmd...>
-  local tag="$1"; shift
-  echo "=== $tag: $*" >&2
+run() { # run <tag> <timeout_s> <cmd...> — per-entry timeout so a relay
+        # wedge mid-program costs one entry, not the rest of the sweep;
+        # stderr goes to a per-tag log so failures keep their diagnostics
+  local tag="$1" tmo="$2"; shift 2
+  echo "=== $tag ($tmo s): $*" >&2
   local line
-  line="$("$@" 2>/dev/null | tail -1)"
+  line="$(timeout "$tmo" "$@" 2>"$OUT.$tag.log" | tail -1)"
   if [ -n "$line" ]; then
     printf '{"tag": "%s", "result": %s}\n' "$tag" "$line" >> "$OUT"
     echo "$tag -> $line" >&2
   else
-    echo "$tag -> FAILED (no output)" >&2
+    echo "$tag -> FAILED (see $OUT.$tag.log)" >&2
   fi
 }
 
-run dense_f32        python bench.py
-run dense_bf16       env BENCH_DTYPE=bfloat16 python bench.py
-run kernel_race      python tools/kernel_race.py
-run sparse_profile   python tools/profile_sparse.py
+# bench.py manages wedge-probing internally — give it its full budget
+run dense_f32      1800 python bench.py
+run dense_bf16     1800 env BENCH_DTYPE=bfloat16 python bench.py
+run kernel_race    900  python tools/kernel_race.py
+run sparse_profile 900  python tools/profile_sparse.py
 
 for shape in covtype amazon; do
-  run "sparse_${shape}_faithful"        python tools/bench_sparse.py --shape "$shape"
-  run "sparse_${shape}_deduped"         python tools/bench_sparse.py --shape "$shape" --mode deduped
-  run "sparse_${shape}_faithful_lanes8" python tools/bench_sparse.py --shape "$shape" --lanes 8
-  run "sparse_${shape}_deduped_lanes8"  python tools/bench_sparse.py --shape "$shape" --mode deduped --lanes 8
-  run "sparse_${shape}_deduped_lanes128" python tools/bench_sparse.py --shape "$shape" --mode deduped --lanes 128
+  run "sparse_${shape}_faithful"         900 python tools/bench_sparse.py --shape "$shape"
+  run "sparse_${shape}_deduped"          900 python tools/bench_sparse.py --shape "$shape" --mode deduped
+  run "sparse_${shape}_faithful_lanes8"  900 python tools/bench_sparse.py --shape "$shape" --lanes 8
+  run "sparse_${shape}_deduped_lanes8"   900 python tools/bench_sparse.py --shape "$shape" --mode deduped --lanes 8
+  run "sparse_${shape}_deduped_lanes128" 900 python tools/bench_sparse.py --shape "$shape" --mode deduped --lanes 128
 done
 
 echo "measurements appended to $OUT" >&2
