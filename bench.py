@@ -71,6 +71,20 @@ DATA_DTYPE = os.environ.get("BENCH_DTYPE", "float32")
 METRIC_SUFFIX = "" if DATA_DTYPE == "float32" else f"_{DATA_DTYPE}"
 
 
+def _failure_record(error: str) -> dict:
+    """A valid one-line JSON payload for any can't-measure outcome — the
+    module's hard contract is ONE parseable line, never a traceback."""
+    return {
+        "metric": f"AGC_logistic_steps_per_sec_30w_s2_collect15{METRIC_SUFFIX}",
+        "value": 0.0,
+        "unit": "iterations/sec",
+        "vs_baseline": 0.0,
+        "platform": "none",
+        "dtype": DATA_DTYPE,
+        "error": error,
+    }
+
+
 def _cpu_env() -> dict:
     """Env that bypasses the remote-TPU relay entirely (sitecustomize skips
     dialing when PALLAS_AXON_POOL_IPS is unset)."""
@@ -190,17 +204,7 @@ def main() -> None:
         print(f"bench: {name}: run failed", file=sys.stderr)
     # 3) never a traceback: emit an explicit failure record as valid JSON
     if payload is None:
-        payload = {
-            "metric": (
-                f"AGC_logistic_steps_per_sec_30w_s2_collect15{METRIC_SUFFIX}"
-            ),
-            "value": 0.0,
-            "unit": "iterations/sec",
-            "vs_baseline": 0.0,
-            "platform": "none",
-            "dtype": DATA_DTYPE,
-            "error": "all bench attempts failed or timed out",
-        }
+        payload = _failure_record("all bench attempts failed or timed out")
     print(json.dumps(_record_or_annotate(payload)))
 
 
@@ -212,10 +216,6 @@ def child() -> None:
     # accelerator, a light slice on CPU fallback so the bench terminates
     on_accel = platform not in ("cpu",)
     n_rows = 132_000 if on_accel else 13_200
-    # BENCH_DTYPE=bfloat16 measures the halved-HBM-traffic data mode
-    # (params/updates stay f32 — utils/config.py); the metric name carries
-    # the dtype so a bf16 number can never masquerade as the canonical f32
-    data_dtype = DATA_DTYPE
 
     from erasurehead_tpu.data.synthetic import generate_gmm
     from erasurehead_tpu.train import trainer
@@ -232,7 +232,7 @@ def child() -> None:
         update_rule="AGD",
         lr_schedule=1.0,
         add_delay=True,
-        dtype=data_dtype,
+        dtype=DATA_DTYPE,  # BENCH_DTYPE: bf16 data halves HBM traffic
         seed=0,
     )
     print(
@@ -253,7 +253,7 @@ def child() -> None:
     # ---- hardware roofline (see module docstring + BASELINE.md) ----------
     # faithful mode streams the [W, s+1, rows/W, F] slot stack twice/step
     slot_rows = n_rows // W
-    x_bytes = W * (S + 1) * slot_rows * N_COLS * _DTYPE_ITEMSIZE[data_dtype]
+    x_bytes = W * (S + 1) * slot_rows * N_COLS * _DTYPE_ITEMSIZE[DATA_DTYPE]
     bytes_per_step = 2 * x_bytes
     flops_per_step = 4 * W * (S + 1) * slot_rows * N_COLS
     achieved_gbps = bytes_per_step * steps_per_sec / 1e9
@@ -280,7 +280,7 @@ def child() -> None:
                 "unit": "iterations/sec",
                 "vs_baseline": round(float(steps_per_sec / ref_steps_per_sec), 3),
                 "platform": platform,
-                "dtype": data_dtype,
+                "dtype": DATA_DTYPE,
                 "n_rows": n_rows,
                 "wall_time_s": round(float(result.wall_time), 4),
                 "flops_per_step": flops_per_step,
@@ -296,17 +296,10 @@ if __name__ == "__main__":
     if DATA_DTYPE not in _DTYPE_ITEMSIZE:
         print(
             json.dumps(
-                {
-                    "metric": "AGC_logistic_steps_per_sec_30w_s2_collect15",
-                    "value": 0.0,
-                    "unit": "iterations/sec",
-                    "vs_baseline": 0.0,
-                    "platform": "none",
-                    "error": (
-                        f"BENCH_DTYPE must be one of "
-                        f"{sorted(_DTYPE_ITEMSIZE)}, got {DATA_DTYPE!r}"
-                    ),
-                }
+                _failure_record(
+                    f"BENCH_DTYPE must be one of "
+                    f"{sorted(_DTYPE_ITEMSIZE)}, got {DATA_DTYPE!r}"
+                )
             )
         )
         sys.exit(0 if "--child" not in sys.argv else 1)

@@ -235,6 +235,27 @@ def load_dataset(cfg: RunConfig) -> Dataset:
     return generate_gmm(cfg.n_rows, cfg.n_cols, n_partitions, cfg.seed)
 
 
+def _validate_checkpoint_flags(parser, ns) -> None:
+    """Interdependent checkpoint flags: fail fast with a proper CLI
+    diagnostic (exit code 2), before backend init / dataset load."""
+    if ns.resume and not ns.checkpoint_dir:
+        parser.error("--resume requires --checkpoint-dir")
+    if ns.checkpoint_every is not None and ns.checkpoint_every < 1:
+        parser.error("--checkpoint-every must be >= 1")
+    if ns.checkpoint_dir and not ns.resume and ns.checkpoint_every is None:
+        parser.error(
+            "--checkpoint-dir without --checkpoint-every never saves; "
+            "pass --checkpoint-every N"
+        )
+    if ns.checkpoint_every is not None and not ns.checkpoint_dir:
+        parser.error("--checkpoint-every requires --checkpoint-dir")
+    if (ns.checkpoint_dir or ns.resume) and ns.arrival_mode == "measured":
+        parser.error(
+            "checkpoint/resume is implemented for the scan trainer only; "
+            "unset --arrival-mode measured"
+        )
+
+
 def run(
     cfg: RunConfig,
     output_dir: str | None = None,
@@ -297,24 +318,7 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     parser = _flags_parser()
     ns = parser.parse_args(argv)
-    # interdependent checkpoint flags: fail fast with a proper CLI
-    # diagnostic, before backend init / dataset load
-    if ns.resume and not ns.checkpoint_dir:
-        parser.error("--resume requires --checkpoint-dir")
-    if ns.checkpoint_every is not None and ns.checkpoint_every < 1:
-        parser.error("--checkpoint-every must be >= 1")
-    if ns.checkpoint_dir and not ns.resume and ns.checkpoint_every is None:
-        parser.error(
-            "--checkpoint-dir without --checkpoint-every never saves; "
-            "pass --checkpoint-every N"
-        )
-    if ns.checkpoint_every is not None and not ns.checkpoint_dir:
-        parser.error("--checkpoint-every requires --checkpoint-dir")
-    if (ns.checkpoint_dir or ns.resume) and ns.arrival_mode == "measured":
-        parser.error(
-            "checkpoint/resume is implemented for the scan trainer only; "
-            "unset --arrival-mode measured"
-        )
+    _validate_checkpoint_flags(parser, ns)
     cfg = _flags_to_config(ns)
     run(
         cfg,

@@ -292,10 +292,12 @@ def train(
 
     update_fn = setup.update_fn
 
-    state0 = jax.tree.map(
-        lambda l: put_global(np.asarray(l), replicated(mesh)),
-        setup.state0,
-    )
+    def replicate(state):
+        return jax.tree.map(
+            lambda l: put_global(np.asarray(l), replicated(mesh)), state
+        )
+
+    state0 = replicate(setup.state0)
 
     lr_seq = jnp.asarray(lr, dtype)
     iters = jnp.arange(cfg.rounds, dtype=dtype)
@@ -337,10 +339,7 @@ def train(
             )
         else:
             state0, start_round = ckpt_lib.restore(path, state0)
-            state0 = jax.tree.map(
-                lambda l: put_global(np.asarray(l), replicated(mesh)),
-                state0,
-            )
+            state0 = replicate(state0)
 
     if start_round >= cfg.rounds:
         # the checkpoint already covers the requested rounds: nothing to run
