@@ -68,7 +68,13 @@ RETRY_TIMEOUT = int(os.environ.get("BENCH_RETRY_TIMEOUT", "420"))
 # timed attempt before failing deep inside the child
 _DTYPE_ITEMSIZE = {"float32": 4, "bfloat16": 2}
 DATA_DTYPE = os.environ.get("BENCH_DTYPE", "float32")
-METRIC_SUFFIX = "" if DATA_DTYPE == "float32" else f"_{DATA_DTYPE}"
+# suffix only for KNOWN non-f32 dtypes: an invalid value's failure record
+# keeps the bare canonical metric name (not a garbage-derived one)
+METRIC_SUFFIX = (
+    f"_{DATA_DTYPE}"
+    if DATA_DTYPE in _DTYPE_ITEMSIZE and DATA_DTYPE != "float32"
+    else ""
+)
 
 
 def _failure_record(error: str) -> dict:

@@ -187,4 +187,61 @@ for L in (8, 128):
     print(f"profile: scatter_rows{L} "
           f"{results[f'scatter_rows{L}_ms']}ms", file=sys.stderr)
 
+
+# --- packed-row variants: table reshaped [F/P, P], gather row idx//P and
+# select lane idx%P via a fused one-hot — vectorized addressing without the
+# lane-replication's P x table blowup; scatter accumulates masked P-wide
+# rows into a beta-sized [F/P, P] accumulator -------------------------------
+def margin_packed_fn(P):
+    Fp = -(-F // P) * P
+
+    def f(beta, idxs, vals, ys):
+        table = jnp.pad(beta, (0, Fp - F)).reshape(Fp // P, P)
+
+        def one(i, v):
+            flat = i.reshape(-1)
+            rows = jnp.take(table, flat // P, axis=0)  # [RK, P]
+            sel = jax.nn.one_hot(flat % P, P, dtype=jnp.float32)
+            g = jnp.sum(rows * sel, axis=1).reshape(i.shape)
+            return jnp.sum(v * g, axis=1)
+
+        p = jax.vmap(one)(idxs, vals)
+        return beta * 0.999 + jnp.sum(p) / F
+
+    return f
+
+
+def scatter_packed_fn(P):
+    Fp = -(-F // P) * P
+
+    def f(beta, idxs, vals, ys):
+        def one(i, v, s):
+            flat = i.reshape(-1)
+            contrib = (v * s[:, None]).reshape(-1, 1)
+            rows = contrib * jax.nn.one_hot(flat % P, P, dtype=jnp.float32)
+            out = (
+                jnp.zeros((Fp // P, P), jnp.float32)
+                .at[flat // P]
+                .add(rows)
+            )
+            return out.reshape(Fp)[:F]
+
+        g = jax.vmap(one)(idxs, vals, ys).sum(0)
+        return dep(beta, g)
+
+    return f
+
+
+for P in (8, 128):
+    results[f"margin_packed{P}_ms"] = round(
+        time_scanned(margin_packed_fn(P), (idx_j, val_j, y_j)) * 1e3, 3
+    )
+    print(f"profile: margin_packed{P} "
+          f"{results[f'margin_packed{P}_ms']}ms", file=sys.stderr)
+    results[f"scatter_packed{P}_ms"] = round(
+        time_scanned(scatter_packed_fn(P), (idx_j, val_j, y_j)) * 1e3, 3
+    )
+    print(f"profile: scatter_packed{P} "
+          f"{results[f'scatter_packed{P}_ms']}ms", file=sys.stderr)
+
 print(json.dumps(results))
