@@ -1,0 +1,90 @@
+"""Ring attention (parallel/ring.py): sequence-parallel exact attention on
+the 8-virtual-device CPU mesh, pinned against the single-device oracle."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from erasurehead_tpu.parallel import ring
+
+T, D = 64, 16
+
+
+def _seq_mesh(n):
+    devs = jax.devices()[:n]
+    return Mesh(np.asarray(devs), (ring.SEQ_AXIS,))
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (T, D), jnp.float32),
+        jax.random.normal(kk, (T, D), jnp.float32),
+        jax.random.normal(kv, (T, D), jnp.float32),
+    )
+
+
+@pytest.mark.parametrize("n_devices", [2, 4, 8])
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full_attention(qkv, n_devices, causal):
+    """The N-step ring (ppermute + online softmax) must reproduce full
+    softmax(QK^T/sqrt(d))V for every shard count, causal and not."""
+    q, k, v = qkv
+    mesh = _seq_mesh(n_devices)
+    out = ring.make_ring_attention_fn(mesh, causal=causal)(q, k, v)
+    want = ring.reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(want), rtol=2e-5, atol=2e-6
+    )
+
+
+def test_ring_is_sequence_sharded(qkv):
+    """Output keeps the sequence sharding: each device owns T/N rows."""
+    q, k, v = qkv
+    mesh = _seq_mesh(4)
+    out = ring.make_ring_attention_fn(mesh)(q, k, v)
+    shard_rows = {s.data.shape[0] for s in out.addressable_shards}
+    assert shard_rows == {T // 4}
+
+
+def test_ring_heads_vmap(qkv):
+    """vmap over a heads axis composes with the sharded ring (the
+    multi-head form), matching per-head oracles."""
+    q, k, v = qkv
+    H = 3
+    key = jax.random.PRNGKey(11)
+    qs = jnp.stack([q * (h + 1) for h in range(H)])
+    ks = jnp.stack([k + h for h in range(H)])
+    vs = jnp.stack([v - h for h in range(H)])
+    mesh = _seq_mesh(4)
+    fn = ring.make_ring_attention_fn(mesh, causal=True)
+    out = jax.vmap(fn)(qs, ks, vs)
+    for h in range(H):
+        want = ring.reference_attention(qs[h], ks[h], vs[h], causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out[h]), np.asarray(want), rtol=2e-5, atol=2e-6
+        )
+
+
+def test_ring_long_sequence_memory_shape():
+    """A longer sequence still runs with per-chip score blocks of
+    (T/N)^2, not T^2 — the point of the ring. (Shape-level check: the
+    jitted program compiles and is finite at T=512 on 8 devices.)"""
+    key = jax.random.PRNGKey(3)
+    T2 = 512
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (T2, D), jnp.float32)
+    k = jax.random.normal(kk, (T2, D), jnp.float32)
+    v = jax.random.normal(kv, (T2, D), jnp.float32)
+    mesh = _seq_mesh(8)
+    out = ring.make_ring_attention_fn(mesh, causal=True)(q, k, v)
+    assert np.isfinite(np.asarray(out)).all()
+    want = ring.reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(want), rtol=2e-5, atol=2e-6
+    )
