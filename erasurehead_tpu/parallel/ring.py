@@ -72,6 +72,7 @@ def ring_attention_shard(
     Tq, d = q.shape
     Tk = k.shape[0]
     scale = (d ** -0.5) if scale is None else scale
+    in_dtype = q.dtype
     q = q.astype(jnp.float32) * scale
 
     # global positions for causal masking (shards are contiguous slices)
@@ -109,7 +110,7 @@ def ring_attention_shard(
     )
     # fully-masked rows (none exist for causal contiguous shards, but keep
     # the division total) normalize to 0 rather than NaN
-    return (acc / jnp.maximum(l, 1e-30)[:, None]).astype(q.dtype)
+    return (acc / jnp.maximum(l, 1e-30)[:, None]).astype(in_dtype)
 
 
 def make_ring_attention_fn(mesh: Mesh, *, causal: bool = False):
@@ -120,6 +121,59 @@ def make_ring_attention_fn(mesh: Mesh, *, causal: bool = False):
 
     fn = shard_map(
         partial(ring_attention_shard, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=P(axis_name),
+    )
+    return jax.jit(fn)
+
+
+def ulysses_attention_shard(
+    q: jnp.ndarray,  # [Tq, H, d] this device's sequence shard, all heads
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str = SEQ_AXIS,
+    causal: bool = False,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """All-to-all ("Ulysses") sequence parallelism: the other canonical SP
+    pattern. Instead of rotating K/V around a ring, ONE all_to_all over the
+    stacked [3, T/N, H, d] q/k/v re-shards sequence-sharded inputs into
+    head-sharded full sequences [3, T, H/N, d], each chip runs plain
+    attention for its own heads, and a second all_to_all restores sequence
+    sharding — two collectives total per call vs the ring's N ppermute
+    hops. Cheaper on all-to-all-friendly fabrics when H is divisible by
+    the axis size; the ring wins when T is long and H is small. Both
+    produce exact attention; tests pin them to each other and the oracle.
+    """
+    n = lax.axis_size(axis_name)
+    H = q.shape[1]
+    if H % n:
+        raise ValueError(f"heads={H} must be divisible by axis size {n}")
+
+    # tiled=True: split/concat within the existing axes instead of
+    # inserting a new leading device dimension
+    qkv = jnp.stack([q, k, v])  # [3, T/N, H, d]
+    qh, kh, vh = lax.all_to_all(
+        qkv, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )  # [3, T, H/N, d]
+    per_head = jax.vmap(
+        partial(reference_attention, causal=causal, scale=scale),
+        in_axes=1,
+        out_axes=1,
+    )
+    return lax.all_to_all(
+        per_head(qh, kh, vh), axis_name, split_axis=0, concat_axis=1,
+        tiled=True,
+    )
+
+
+def make_ulysses_attention_fn(mesh: Mesh, *, causal: bool = False):
+    """Sharded entry point: [T, H, d] arrays sequence-sharded on dim 0."""
+    (axis_name,) = mesh.axis_names
+    fn = shard_map(
+        partial(ulysses_attention_shard, axis_name=axis_name, causal=causal),
         mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), P(axis_name)),
         out_specs=P(axis_name),

@@ -88,3 +88,47 @@ def test_ring_long_sequence_memory_shape():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(want), rtol=2e-5, atol=2e-6
     )
+
+
+@pytest.mark.parametrize("n_devices", [2, 4, 8])
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full_attention(n_devices, causal):
+    """The all-to-all SP form: two collectives re-shard seq->heads and
+    back; must equal the oracle (and therefore the ring) per head."""
+    H = 8
+    rng = jax.random.PRNGKey(5)
+    r1, r2, r3 = jax.random.split(rng, 3)
+    qs = jax.random.normal(r1, (T, H, D), jnp.float32)
+    ks = jax.random.normal(r2, (T, H, D), jnp.float32)
+    vs = jax.random.normal(r3, (T, H, D), jnp.float32)
+    mesh = _seq_mesh(n_devices)
+    out = ring.make_ulysses_attention_fn(mesh, causal=causal)(qs, ks, vs)
+    for h in range(H):
+        want = ring.reference_attention(
+            qs[:, h], ks[:, h], vs[:, h], causal=causal
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[:, h]), np.asarray(want), rtol=2e-5, atol=2e-6
+        )
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = _seq_mesh(4)
+    q = jnp.zeros((T, 6, D), jnp.float32)  # 6 heads on 4 devices
+    with pytest.raises(ValueError, match="divisible"):
+        ring.make_ulysses_attention_fn(mesh)(q, q, q)
+
+
+def test_ring_preserves_input_dtype(qkv):
+    """bf16 in -> bf16 out (mixed-precision pipelines rely on
+    dtype-preserving attention); accumulation still runs in f32."""
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+    mesh = _seq_mesh(4)
+    out = ring.make_ring_attention_fn(mesh)(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    want = ring.reference_attention(q, k, v)
+    assert want.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(want, np.float32),
+        rtol=0.05, atol=0.05,
+    )
