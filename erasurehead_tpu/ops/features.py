@@ -164,6 +164,15 @@ def matvec(X: Features, v: jnp.ndarray, precision=None) -> jnp.ndarray:
         if v.ndim == 1:
             return jnp.sum(X.values * gathered, axis=1)
         return jnp.einsum("nk,nkh->nh", X.values, gathered, precision=precision)
+    if X.dtype == jnp.bfloat16 and v.dtype != X.dtype:
+        # bf16 DATA mode: keep the streamed operand bf16 — promoting X to
+        # match f32 params would make XLA materialize (and re-read) an f32
+        # copy of the whole stack, voiding the mode's halved-HBM-traffic
+        # point. Cast the tiny vector operand down instead; the MXU
+        # accumulates natively in f32 (preferred_element_type).
+        return jnp.matmul(
+            X, v.astype(X.dtype), preferred_element_type=jnp.float32
+        )
     return jnp.matmul(X, v, precision=precision)
 
 
@@ -194,6 +203,12 @@ def rmatvec(X: Features, r: jnp.ndarray, precision=None) -> jnp.ndarray:
             jnp.zeros((X.n_cols, r.shape[1]), contrib.dtype)
             .at[X.indices.reshape(-1)]
             .add(contrib.reshape(-1, r.shape[1]))
+        )
+    if X.dtype == jnp.bfloat16 and r.dtype != X.dtype:
+        # see matvec: stream X as stored, cast the small operand down,
+        # accumulate f32 on the MXU
+        return jnp.matmul(
+            X.T, r.astype(X.dtype), preferred_element_type=jnp.float32
         )
     return jnp.matmul(X.T, r, precision=precision)
 

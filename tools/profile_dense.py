@@ -1,0 +1,117 @@
+"""Micro-profile the dense GLM gradient's lowering variants at the bench
+shape on TPU, inside one dispatch. The bench measured ~215 GB/s (26% of
+v5e HBM peak) for the two-pass gradient; this attributes where the other
+74% goes and what buys it back:
+
+  two_pass_highest — the production lowering (margin + transpose einsums,
+                     precision=HIGHEST; science-exact)
+  two_pass_default — same with default (bf16-rounded MXU) precision: an
+                     upper bound showing what precision costs (science-
+                     INVALID for convex-GLM curves, measurement only)
+  bf16_data        — bf16 X/y with bf16-cast vector operands and f32 MXU
+                     accumulation — the production cfg.dtype=bfloat16
+                     lowering (ops/features.py): halves HBM traffic
+  margin_only      — one pass, to split the two passes' costs
+
+Usage: python tools/profile_dense.py [--slots 90] [--rows 4400] [--cols 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def time_scanned(fn, beta0, iters=50, reps=5):
+    @jax.jit
+    def many(b0):
+        def body(b, _):
+            g = fn(b)
+            return g / (jnp.linalg.norm(g) + 1.0), None
+
+        bN, _ = lax.scan(body, b0, None, length=iters)
+        return bN
+
+    jax.block_until_ready(many(beta0))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(many(beta0))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)) / iters
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--slots", type=int, default=90)
+    ap.add_argument("--rows", type=int, default=4400)
+    ap.add_argument("--cols", type=int, default=128)
+    args = ap.parse_args()
+    M, R, F = args.slots, args.rows, args.cols
+
+    platform = jax.devices()[0].platform
+    print(f"dense profile: {platform} M={M} R={R} F={F}", file=sys.stderr)
+
+    key = jax.random.PRNGKey(0)
+    kx, ky, kw, kb = jax.random.split(key, 4)
+    X = jax.random.normal(kx, (M, R, F), jnp.float32)
+    y = jnp.sign(jax.random.normal(ky, (M, R), jnp.float32))
+    w = jax.random.uniform(kw, (M,), jnp.float32)
+    beta0 = jax.random.normal(kb, (F,), jnp.float32)
+    Xb, yb = X.astype(jnp.bfloat16), y.astype(jnp.bfloat16)
+
+    def grad(Xa, ya, prec):
+        def f(beta):
+            # cast the tiny vector operand to the DATA dtype so the big
+            # stack streams as stored (the production features.py rule —
+            # promoting Xa would let XLA hoist an f32 copy out of the scan)
+            p = jnp.einsum(
+                "mrf,f->mr", Xa, beta.astype(Xa.dtype),
+                precision=prec, preferred_element_type=jnp.float32,
+            )
+            yf = ya.astype(jnp.float32)
+            s = (-yf / (jnp.exp(p * yf) + 1.0)) * w[:, None]
+            return jnp.einsum(
+                "mrf,mr->f", Xa, s.astype(Xa.dtype),
+                precision=prec, preferred_element_type=jnp.float32,
+            )
+
+        return f
+
+    HI, DEF = lax.Precision.HIGHEST, lax.Precision.DEFAULT
+    results = {"platform": platform, "shape": [M, R, F]}
+
+    cases = {
+        "two_pass_highest": (grad(X, y, HI), 2 * X.nbytes),
+        "two_pass_default": (grad(X, y, DEF), 2 * X.nbytes),
+        "bf16_data": (grad(Xb, yb, DEF), 2 * Xb.nbytes),
+    }
+
+    def margin_only(beta):
+        p = jnp.einsum("mrf,f->mr", X, beta, precision=HI)
+        # a nonlinear consumer: sum(X@b) alone is reassociable to
+        # (sum X)@b, which XLA would hoist out of the scan entirely
+        return beta * 0.999 + jnp.sum(jnp.tanh(p)) / F
+
+    cases["margin_only"] = (margin_only, X.nbytes)
+
+    for name, (fn, traffic) in cases.items():
+        ms = time_scanned(fn, beta0) * 1e3
+        gbps = traffic / (ms / 1e3) / 1e9
+        results[f"{name}_ms"] = round(ms, 4)
+        results[f"{name}_gbps"] = round(gbps, 1)
+        print(f"dense profile: {name} {ms:.3f}ms {gbps:.0f}GB/s",
+              file=sys.stderr)
+
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

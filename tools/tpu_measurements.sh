@@ -6,8 +6,9 @@
 #   bash tools/tpu_measurements.sh [out.jsonl]
 #
 # Covers: canonical dense bench (f32 + bfloat16 data), the pallas kernel
-# race, the sparse canonical shapes (covtype + amazon) across
-# faithful/deduped x scalar/lanes lowerings, and the rmatvec profile.
+# race, the dense-lowering profile (precision/bf16/pass split), the sparse
+# canonical shapes (covtype + amazon) across faithful/deduped x
+# scalar/lanes lowerings, and the sparse rmatvec profile.
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-tools/measurements.jsonl}"
@@ -33,6 +34,7 @@ run dense_f32      1800 python bench.py
 run dense_bf16     1800 env BENCH_DTYPE=bfloat16 python bench.py
 run kernel_race    900  python tools/kernel_race.py
 run sparse_profile 900  python tools/profile_sparse.py
+run dense_profile  900  python tools/profile_dense.py
 
 for shape in covtype amazon; do
   run "sparse_${shape}_faithful"         900 python tools/bench_sparse.py --shape "$shape"
