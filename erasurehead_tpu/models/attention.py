@@ -1,0 +1,79 @@
+"""Single-block attention classifier: the sequence-model stretch family.
+
+The reference trains only convex GLMs (SURVEY.md §2.2); the MLP showed the
+coded-DP machinery is model-agnostic for pytree params. This model closes
+the remaining loop: a TRANSFORMER-STYLE model — embedding, one self-attention
+block (parallel/ring.py's oracle form), mean pooling, logistic head — trained
+under the exact same gradient-coding protocol, because its summed loss is
+additive over row shards like every other model here.
+
+Each data row is a sequence: the flat feature vector [F] reshapes to
+[T, D] with T = F // d_in tokens (no change to the Dataset/sharding layers;
+the reference's row-sharded DP carries over unchanged). DP shards rows
+across workers; when a single sequence must span chips instead, the
+attention inside is exactly what parallel/ring.py's ring/Ulysses primitives
+shard — composing SP with this DP is the documented scale-out path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from erasurehead_tpu.parallel.ring import reference_attention
+
+
+class AttentionModel:
+    name = "attention"
+
+    def __init__(self, d_in: int = 8, d_model: int = 16):
+        self.d_in = d_in
+        self.d_model = d_model
+
+    def init_params(self, key: jax.Array, n_features: int):
+        if n_features % self.d_in:
+            raise ValueError(
+                f"n_features={n_features} must be divisible by d_in={self.d_in} "
+                f"(rows reshape to [T, {self.d_in}] token sequences)"
+            )
+        ks = jax.random.split(key, 5)
+        d, m = self.d_in, self.d_model
+        s_in = 1.0 / jnp.sqrt(d)
+        s_m = 1.0 / jnp.sqrt(m)
+        return {
+            "embed": s_in * jax.random.normal(ks[0], (d, m)),
+            "wq": s_m * jax.random.normal(ks[1], (m, m)),
+            "wk": s_m * jax.random.normal(ks[2], (m, m)),
+            "wv": s_m * jax.random.normal(ks[3], (m, m)),
+            "w_out": s_m * jax.random.normal(ks[4], (m,)),
+            "b_out": jnp.zeros(()),
+        }
+
+    def predict(self, params, X):
+        Xd = jnp.asarray(X).astype(jnp.float32)  # dense path only
+        n, F = Xd.shape
+        tokens = Xd.reshape(n, F // self.d_in, self.d_in)
+        h = tokens @ params["embed"]  # [n, T, m]
+
+        def attend(hseq):
+            q, k, v = (
+                hseq @ params["wq"],
+                hseq @ params["wk"],
+                hseq @ params["wv"],
+            )
+            return reference_attention(q, k, v)
+
+        a = jax.vmap(attend)(h)  # [n, T, m]
+        pooled = (h + a).mean(axis=1)  # residual + mean pool, [n, m]
+        return pooled @ params["w_out"] + params["b_out"]
+
+    def loss_sum(self, params, X, y):
+        return jnp.sum(jax.nn.softplus(-y * self.predict(params, X)))
+
+    def loss_mean(self, params, X, y):
+        return self.loss_sum(params, X, y) / y.shape[0]
+
+    def grad_sum(self, params, X, y):
+        return jax.grad(self.loss_sum)(params, X, y)
+
+    grad_sum_auto = grad_sum

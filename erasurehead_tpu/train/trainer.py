@@ -78,6 +78,10 @@ def build_model(cfg: RunConfig):
         return LinearModel()
     if cfg.model == ModelKind.MLP:
         return MLPModel()
+    if cfg.model == ModelKind.ATTENTION:
+        from erasurehead_tpu.models.attention import AttentionModel
+
+        return AttentionModel()
     raise ValueError(f"unknown model {cfg.model}")
 
 

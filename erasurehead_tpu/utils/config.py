@@ -44,6 +44,7 @@ class ModelKind(str, enum.Enum):
     LOGISTIC = "logistic"
     LINEAR = "linear"
     MLP = "mlp"  # 2-layer MLP stretch config (BASELINE.json configs[4])
+    ATTENTION = "attention"  # single-block attention classifier (models/attention.py)
 
 
 class ComputeMode(str, enum.Enum):

@@ -189,3 +189,34 @@ def test_sparse_lanes_matches_scalar_path():
         features.set_sparse_lanes(12)  # not a power of two
     with pytest.raises(ValueError):
         features.set_sparse_lanes(2048)
+
+
+def test_attention_model_grad_additivity():
+    """grad_sum additivity over row-disjoint shards — the property all
+    gradient coding rests on — holds for the attention-classifier pytree
+    (models/attention.py) like the GLM/MLP families above."""
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.models.attention import AttentionModel
+
+    model = AttentionModel()
+    ds = generate_gmm(32, 64, n_partitions=2, seed=1)
+    X = jnp.asarray(ds.X_train)
+    y = jnp.asarray(ds.y_train)
+    params = model.init_params(jax.random.key(0), 64)
+    g_full = model.grad_sum(params, X, y)
+    g_split = jax.tree.map(
+        lambda a, b: a + b,
+        model.grad_sum(params, X[:16], y[:16]),
+        model.grad_sum(params, X[16:], y[16:]),
+    )
+    for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_split)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_attention_model_rejects_bad_feature_dim():
+    from erasurehead_tpu.models.attention import AttentionModel
+
+    with pytest.raises(ValueError, match="divisible"):
+        AttentionModel(d_in=8).init_params(jax.random.key(0), 60)

@@ -289,3 +289,33 @@ def test_adam_trains_mlp(gmm):
     l0 = float(model.loss_mean(first, Xt, yt))
     l1 = float(model.loss_mean(last, Xt, yt))
     assert np.isfinite(l1) and l1 < l0 * 0.8
+
+
+def test_attention_model_trains_under_agc():
+    """The single-block attention classifier (models/attention.py) trains
+    under AGC gradient coding exactly like the GLM/MLP families: pytree
+    grads, additive over row shards, loss decreases."""
+    import jax
+    import jax.numpy as jnp
+
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.models.attention import AttentionModel
+    from erasurehead_tpu.utils.config import RunConfig
+
+    Wa, F = 4, 64  # rows reshape to [8 tokens, 8 dims]
+    ds = generate_gmm(64 * Wa, F, n_partitions=Wa, seed=0)
+    cfg = RunConfig(
+        scheme="approx", model="attention", n_workers=Wa, n_stragglers=1,
+        num_collect=3, rounds=20, n_rows=64 * Wa, n_cols=F,
+        lr_schedule=0.5, update_rule="ADAM", add_delay=True, seed=0,
+    )
+    res = trainer.train(cfg, ds)
+    model = AttentionModel()
+    Xt = jnp.asarray(ds.X_train)
+    yt = jnp.asarray(ds.y_train)
+    first = jax.tree.map(lambda l: l[0], res.params_history)
+    last = jax.tree.map(lambda l: l[-1], res.params_history)
+    l0 = float(model.loss_mean(first, Xt, yt))
+    l1 = float(model.loss_mean(last, Xt, yt))
+    assert np.isfinite(l1)
+    assert l1 < l0, (l0, l1)
