@@ -20,10 +20,12 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from erasurehead_tpu.models.glm import MarginClassifierBase
+from erasurehead_tpu.ops.features import PaddedRows
 from erasurehead_tpu.parallel.ring import reference_attention
 
 
-class AttentionModel:
+class AttentionModel(MarginClassifierBase):
     name = "attention"
 
     def __init__(self, d_in: int = 8, d_model: int = 16):
@@ -50,7 +52,12 @@ class AttentionModel:
         }
 
     def predict(self, params, X):
-        Xd = jnp.asarray(X).astype(jnp.float32)  # dense path only
+        if isinstance(X, PaddedRows):
+            raise TypeError(
+                "the attention model requires dense features (rows reshape "
+                "to token sequences); sparse PaddedRows data is not supported"
+            )
+        Xd = jnp.asarray(X).astype(jnp.float32)
         n, F = Xd.shape
         tokens = Xd.reshape(n, F // self.d_in, self.d_in)
         h = tokens @ params["embed"]  # [n, T, m]
@@ -66,14 +73,3 @@ class AttentionModel:
         a = jax.vmap(attend)(h)  # [n, T, m]
         pooled = (h + a).mean(axis=1)  # residual + mean pool, [n, m]
         return pooled @ params["w_out"] + params["b_out"]
-
-    def loss_sum(self, params, X, y):
-        return jnp.sum(jax.nn.softplus(-y * self.predict(params, X)))
-
-    def loss_mean(self, params, X, y):
-        return self.loss_sum(params, X, y) / y.shape[0]
-
-    def grad_sum(self, params, X, y):
-        return jax.grad(self.loss_sum)(params, X, y)
-
-    grad_sum_auto = grad_sum

@@ -52,6 +52,24 @@ class Model(Protocol):
     def loss_mean(self, params: Params, X, y) -> jnp.ndarray: ...
 
 
+class MarginClassifierBase:
+    """Shared logistic-margin loss machinery for non-GLM classifier
+    families (MLP, attention): softplus loss on ``predict``'s margin and
+    jax.grad gradients. One home so the loss definition cannot diverge
+    across model families."""
+
+    def loss_sum(self, params, X, y):
+        return jnp.sum(jax.nn.softplus(-y * self.predict(params, X)))
+
+    def loss_mean(self, params, X, y):
+        return self.loss_sum(params, X, y) / y.shape[0]
+
+    def grad_sum(self, params, X, y):
+        return jax.grad(self.loss_sum)(params, X, y)
+
+    grad_sum_auto = grad_sum
+
+
 class _GLMBase:
     def init_params(self, key: jax.Array, n_features: int) -> jnp.ndarray:
         """Standard-normal init.

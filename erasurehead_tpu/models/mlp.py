@@ -15,10 +15,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from erasurehead_tpu.models.glm import MarginClassifierBase
 from erasurehead_tpu.ops.features import matvec
 
 
-class MLPModel:
+class MLPModel(MarginClassifierBase):
     name = "mlp"
 
     def __init__(self, hidden: int = 64):
@@ -38,14 +39,3 @@ class MLPModel:
         h = jnp.tanh(matvec(X, params["W1"]) + params["b1"])
         return matvec(h, params["w2"]) + params["b2"]
 
-    def loss_sum(self, params, X, y):
-        margins = self.predict(params, X)
-        return jnp.sum(jax.nn.softplus(-y * margins))
-
-    def loss_mean(self, params, X, y):
-        return self.loss_sum(params, X, y) / y.shape[0]
-
-    def grad_sum(self, params, X, y):
-        return jax.grad(self.loss_sum)(params, X, y)
-
-    grad_sum_auto = grad_sum

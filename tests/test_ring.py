@@ -132,4 +132,3 @@ def test_ring_preserves_input_dtype(qkv):
         np.asarray(out, np.float32), np.asarray(want, np.float32),
         rtol=0.05, atol=0.05,
     )
-
