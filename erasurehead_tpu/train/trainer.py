@@ -212,6 +212,9 @@ class TrainResult:
     start_round: int = 0
     config: RunConfig = None
     layout: codes.CodingLayout = None
+    # full optimizer state at the end of the run (params + momentum/Adam
+    # leaves) — what elastic restart hands to the survivor run
+    final_state: Any = None
 
 
 @_with_run_sparse_lanes
@@ -225,6 +228,8 @@ def train(
     checkpoint_every: Optional[int] = None,
     resume: bool = False,
     measure: bool = True,
+    initial_state: Optional[Any] = None,
+    initial_round: int = 0,
 ) -> TrainResult:
     """Run one full training run for ``cfg`` on ``dataset``.
 
@@ -233,6 +238,12 @@ def train(
     scan in chunks; ``resume=True`` restarts from the latest checkpoint —
     ``params_history`` then covers only the resumed rounds (the control-plane
     arrays still cover the full run; they are precomputed and deterministic).
+
+    ``initial_state``/``initial_round`` start the run mid-schedule from an
+    in-memory optimizer state instead of a checkpoint file — the elastic
+    restart hook (parallel/failures.train_elastic): round ``initial_round``
+    onward runs with THIS config's layout/mesh while the optimizer state
+    carries over (its leaves are worker-count independent).
     """
     faithful = cfg.compute_mode == ComputeMode.FAITHFUL
     setup = _setup_run(cfg, dataset, mesh, faithful=faithful)
@@ -301,7 +312,9 @@ def train(
             lambda l: put_global(np.asarray(l), replicated(mesh)), state
         )
 
-    state0 = replicate(setup.state0)
+    # host-side until the initial_state/resume resolution below picks the
+    # actual starting state — replicate exactly once, after that
+    state0 = setup.state0
 
     lr_seq = jnp.asarray(lr, dtype)
     iters = jnp.arange(cfg.rounds, dtype=dtype)
@@ -327,6 +340,15 @@ def train(
             f"checkpoint_every must be >= 1, got {checkpoint_every}"
         )
     start_round = 0
+    if initial_state is not None:
+        if resume:
+            raise ValueError("pass either initial_state or resume, not both")
+        if not 0 <= initial_round < cfg.rounds:
+            raise ValueError(
+                f"initial_round={initial_round} outside [0, {cfg.rounds})"
+            )
+        state0 = initial_state
+        start_round = initial_round
     if resume and checkpoint_dir:
         from erasurehead_tpu.train import checkpoint as ckpt_lib
 
@@ -343,7 +365,8 @@ def train(
             )
         else:
             state0, start_round = ckpt_lib.restore(path, state0)
-            state0 = replicate(state0)
+
+    state0 = replicate(state0)
 
     if start_round >= cfg.rounds:
         # the checkpoint already covers the requested rounds: nothing to run
@@ -413,6 +436,7 @@ def train(
         start_round=start_round,
         config=cfg,
         layout=layout,
+        final_state=final_state,
     )
 
 
@@ -574,6 +598,7 @@ def train_measured(
     return TrainResult(
         params_history=jax.tree.map(lambda *xs: jnp.stack(xs), *history),
         final_params=state.params,
+        final_state=state,
         timeset=timeset,
         worker_times=worker_times,
         collected=collected,
@@ -645,6 +670,7 @@ def train_dynamic(cfg: RunConfig, dataset: Dataset, mesh=None) -> TrainResult:
     return TrainResult(
         params_history=hist,
         final_params=final_state.params,
+        final_state=final_state,
         timeset=sim,
         worker_times=np.asarray(wtimes, np.float64),
         collected=np.asarray(collected),
