@@ -37,6 +37,7 @@ import numpy as np
 
 from erasurehead_tpu.data import io as data_io
 from erasurehead_tpu.data.synthetic import Dataset, generate_gmm
+from erasurehead_tpu.parallel import failures
 from erasurehead_tpu.parallel.backend import initialize_distributed
 from erasurehead_tpu.train import artifacts, evaluate, trainer
 from erasurehead_tpu.utils.config import ModelKind, RunConfig, Scheme
@@ -150,6 +151,18 @@ def _flags_parser() -> argparse.ArgumentParser:
                         "window [start_round, rounds)")
     p.add_argument("--trace-dir", default=None,
                    help="capture a jax.profiler device trace here")
+    p.add_argument("--kill-workers", default=None, metavar="W:R[,W:R...]",
+                   help="fault injection: kill worker W permanently at "
+                        "round R (e.g. 6:10,7:12)")
+    p.add_argument("--on-death", default="error",
+                   choices=["error", "failover", "elastic"],
+                   help="error: raise where the reference would hang; "
+                        "failover: degrade infeasible rounds' decode "
+                        "(needs --death-timeout); elastic: re-shard onto "
+                        "the survivors and continue (failures.train_elastic)")
+    p.add_argument("--death-timeout", type=float, default=None,
+                   help="simulated seconds before the master presumes a "
+                        "worker dead (failover mode)")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -254,6 +267,33 @@ def _validate_checkpoint_flags(parser, ns) -> None:
             "checkpoint/resume is implemented for the scan trainer only; "
             "unset --arrival-mode measured"
         )
+    # fault-injection flags: --on-death/--death-timeout only mean anything
+    # with --kill-workers; silently ignoring them would let a typo'd run
+    # masquerade as a recovery experiment
+    if ns.on_death != "error" and not ns.kill_workers:
+        parser.error("--on-death requires --kill-workers")
+    if ns.death_timeout is not None and ns.on_death != "failover":
+        parser.error("--death-timeout only applies to --on-death failover")
+    if ns.kill_workers and ns.on_death == "failover" and ns.death_timeout is None:
+        parser.error("--on-death failover requires --death-timeout")
+    if ns.kill_workers and (ns.checkpoint_dir or ns.resume):
+        parser.error("--kill-workers does not compose with checkpointing")
+    if ns.kill_workers and ns.arrival_mode == "measured":
+        parser.error("--kill-workers needs the simulated-arrival trainer")
+
+
+def _parse_deaths(spec: str) -> dict[int, int]:
+    """'6:10,7:12' -> {6: 10, 7: 12} (worker: death round)."""
+    out: dict[int, int] = {}
+    for part in spec.split(","):
+        w, _, r = part.partition(":")
+        try:
+            out[int(w)] = int(r)
+        except ValueError:
+            raise ValueError(
+                f"bad --kill-workers entry {part!r}; want worker:round"
+            ) from None
+    return out
 
 
 def run(
@@ -264,19 +304,58 @@ def run(
     checkpoint_dir: str | None = None,
     checkpoint_every: int | None = None,
     resume: bool = False,
+    kill_workers: str | None = None,
+    on_death: str = "error",
+    death_timeout: float | None = None,
 ):
-    # argument-only check: fail before backend init / dataset load
+    # argument-only checks: fail before backend init / dataset load
     if (checkpoint_dir or resume) and cfg.arrival_mode == "measured":
         raise ValueError(
             "checkpoint/resume is implemented for the scan trainer only; "
             "unset --arrival-mode measured"
         )
+    deaths = _parse_deaths(kill_workers) if kill_workers else None
+    if deaths and cfg.arrival_mode == "measured":
+        raise ValueError("--kill-workers needs the simulated-arrival trainer")
+    if deaths and (checkpoint_dir or resume):
+        raise ValueError("--kill-workers does not compose with checkpointing")
+    if deaths and on_death == "failover" and death_timeout is None:
+        raise ValueError("--on-death failover requires --death-timeout")
     initialize_distributed()
     dataset = load_dataset(cfg)
     from erasurehead_tpu.utils.tracing import device_trace
     with device_trace(trace_dir):
         if cfg.arrival_mode == "measured":
             result = trainer.train_measured(cfg, dataset)
+        elif deaths and on_death == "elastic":
+            result, report = failures.train_elastic(cfg, dataset, deaths)
+            if not quiet:
+                print(
+                    f"elastic restart at round {report.death_round}: "
+                    f"{report.n_workers_before} -> "
+                    f"{report.n_workers_after} workers "
+                    f"(dead: {list(report.dead_workers)})"
+                )
+        elif deaths:
+            # error|failover: inject the deaths into the arrival schedule
+            # and plan the run; "error" raises where the reference's
+            # master would block in Waitany forever
+            arrivals = failures.inject_worker_death(
+                trainer.default_arrivals(cfg), deaths
+            )
+            sched, _ = failures.plan_run(
+                cfg.scheme,
+                trainer.build_layout(cfg),
+                arrivals,
+                num_collect=cfg.num_collect,
+                timeout=(
+                    death_timeout if death_timeout is not None else np.inf
+                ),
+                on_infeasible=on_death,
+            )
+            result = trainer.train(
+                cfg, dataset, arrivals=arrivals, schedule=sched
+            )
         else:
             # a resumed run's artifacts cover [start_round, rounds) — the
             # loss curve is the resumed window, aligned by artifacts.py
@@ -328,6 +407,9 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_dir=ns.checkpoint_dir,
         checkpoint_every=ns.checkpoint_every,
         resume=ns.resume,
+        kill_workers=ns.kill_workers,
+        on_death=ns.on_death,
+        death_timeout=ns.death_timeout,
     )
     return 0
 

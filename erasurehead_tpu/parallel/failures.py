@@ -265,11 +265,14 @@ def train_elastic(
     layout of the same scheme, the optimizer state (params + momentum)
     carries over unchanged, and training continues to ``cfg.rounds`` on
     the same lr schedule — so the loss curve is continuous through the
-    failure and every sample keeps contributing afterwards (nothing is
-    erased, unlike failover's dropped groups).
+    failure and every partition keeps contributing afterwards (nothing is
+    erased, unlike failover's dropped groups; each phase still truncates
+    rows to its own partition-count multiple, so up to W-1 tail rows can
+    differ between phases — the merged n_train reports the common prefix).
 
     ``deaths``: {worker_id: round}. All deaths re-shard at the EARLIEST
-    round (one restart); workers dying later simply leave earlier.
+    round (one restart); workers dying later simply leave earlier. Deaths
+    at rounds >= cfg.rounds never occur inside the run and are ignored.
     ``survivor_overrides``: optional RunConfig field overrides for the
     survivor phase (e.g. a smaller n_stragglers when W' breaks the FRC
     divisibility requirement). Returns (TrainResult, ElasticReport); the
@@ -281,13 +284,20 @@ def train_elastic(
     from erasurehead_tpu.train import trainer
 
     W = cfg.n_workers
-    dead = sorted(deaths)
-    if not dead:
+    if not deaths:
         raise ValueError("deaths is empty — nothing to recover from")
-    if not all(0 <= w < W for w in dead):
-        raise ValueError(f"dead workers {dead} outside [0, {W})")
-    death_round = min(deaths.values())
-    if not 0 < death_round < cfg.rounds:
+    if not all(0 <= w < W for w in deaths):
+        raise ValueError(f"dead workers {sorted(deaths)} outside [0, {W})")
+    # a death at round >= cfg.rounds never happens inside this run: that
+    # worker survives the whole horizon and must NOT be evicted
+    effective = {w: r for w, r in deaths.items() if r < cfg.rounds}
+    if not effective:
+        raise ValueError(
+            f"no death occurs before rounds={cfg.rounds}; nothing to recover"
+        )
+    dead = sorted(effective)
+    death_round = min(effective.values())
+    if death_round < 1:
         raise ValueError(
             f"earliest death round {death_round} must be in (0, rounds)"
         )
@@ -361,7 +371,9 @@ def train_elastic(
             if (phase1.wall_time + phase2.wall_time) > 0
             else 0.0
         ),
-        n_train=phase1.n_train,
+        # the phases truncate rows to their own partition multiples; the
+        # merged loss replay is honest over the common prefix of rows
+        n_train=min(phase1.n_train, phase2.n_train),
         config=cfg,
         layout=phase1.layout,
         final_state=phase2.final_state,

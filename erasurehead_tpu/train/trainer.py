@@ -174,6 +174,16 @@ def _setup_run(
     )
 
 
+def default_arrivals(cfg: RunConfig) -> np.ndarray:
+    """The run's default straggler arrival schedule — single home shared by
+    train(), the CLI's fault-injection path, and the determinism audit, so
+    the arrival construction cannot drift between them."""
+    return straggler.arrival_schedule(
+        cfg.rounds, cfg.n_workers, cfg.add_delay, cfg.delay_mean,
+        arrival_model=straggler.model_from_config(cfg),
+    )
+
+
 def _hard_sync(x) -> None:
     """Wait until the computation that produced ``x`` has really finished.
 
@@ -251,10 +261,7 @@ def train(
 
     # ---- control plane (host, float64) ------------------------------------
     if arrivals is None:
-        arrivals = straggler.arrival_schedule(
-            cfg.rounds, cfg.n_workers, cfg.add_delay, cfg.delay_mean,
-            arrival_model=straggler.model_from_config(cfg),
-        )
+        arrivals = default_arrivals(cfg)
     if schedule is None:
         # a custom schedule (e.g. parallel/failures.plan_run's failover
         # rewrite) overrides the scheme's plain collection rule

@@ -47,18 +47,15 @@ def _compare(a, b, what: str) -> AuditResult:
 def audit_schedule_determinism(cfg) -> AuditResult:
     """The control plane (arrivals -> collection weights) must replay
     bit-for-bit — the analogue of the reference's seeded delay replay."""
-    from erasurehead_tpu.parallel import collect, straggler
-    from erasurehead_tpu.train.trainer import build_layout
+    from erasurehead_tpu.parallel import collect
+    from erasurehead_tpu.train.trainer import build_layout, default_arrivals
 
     outs = []
     for _ in range(2):
         layout = build_layout(cfg)
-        t = straggler.arrival_schedule(
-            cfg.rounds, cfg.n_workers, cfg.add_delay, cfg.delay_mean,
-            # same arrival model train() uses — a heterogeneous-cluster
-            # config must audit the schedule it actually runs
-            arrival_model=straggler.model_from_config(cfg),
-        )
+        # same arrival construction train() uses — a heterogeneous-cluster
+        # config must audit the schedule it actually runs
+        t = default_arrivals(cfg)
         s = collect.build_schedule(
             cfg.scheme, t, layout, num_collect=cfg.num_collect
         )

@@ -129,3 +129,65 @@ def test_cli_checkpoint_every_requires_dir(capsys):
                   "--checkpoint-every", "2"])
     assert e.value.code == 2
     assert "--checkpoint-dir" in capsys.readouterr().err
+
+
+def test_cli_kill_workers_elastic(tmp_path):
+    """Fault injection through the CLI: two workers die, elastic recovery
+    re-shards onto the survivors, and the artifacts cover every round."""
+    data_dir = str(tmp_path / "data")
+    rc = cli.main([
+        "--scheme", "approx", "--workers", "8", "--stragglers", "1",
+        "--num-collect", "6", "--rounds", "12", "--rows", "384",
+        "--cols", "16", "--lr", "1.0", "--add-delay",
+        "--kill-workers", "6:5,7:5", "--on-death", "elastic",
+        "--input-dir", data_dir, "--quiet",
+    ])
+    assert rc == 0
+    results = os.path.join(data_dir, "artificial-data", "384x16", "8", "results")
+    loss_file = next(f for f in os.listdir(results) if "training_loss" in f)
+    losses = np.loadtxt(os.path.join(results, loss_file))
+    assert losses.shape[0] == 12 and np.isfinite(losses).all()
+    wt_file = next(f for f in os.listdir(results) if "worker_timeset" in f)
+    wt = np.loadtxt(os.path.join(results, wt_file))
+    assert wt.shape == (12, 8)
+    assert (wt[5:, 6:] == -1.0).all()  # dead columns carry the -1 sentinel
+
+
+def test_cli_kill_workers_failover(tmp_path):
+    """Failover mode degrades the infeasible rounds' decode instead of
+    resharding; requires a finite --death-timeout."""
+    data_dir = str(tmp_path / "data")
+    rc = cli.main([
+        "--scheme", "avoidstragg", "--workers", "6", "--stragglers", "1",
+        "--rounds", "8", "--rows", "240", "--cols", "12", "--lr", "1.0",
+        "--add-delay", "--kill-workers", "4:2,5:2", "--on-death", "failover",
+        "--death-timeout", "10.0", "--input-dir", data_dir, "--quiet",
+    ])
+    assert rc == 0
+
+
+def test_cli_kill_workers_error_mode_raises(tmp_path):
+    """Default on-death=error raises where the reference's master would
+    block in Waitany forever (naive needs all workers)."""
+    from erasurehead_tpu.parallel.failures import InfeasibleRunError
+
+    with pytest.raises(InfeasibleRunError):
+        cli.main([
+            "--scheme", "naive", "--workers", "4", "--rounds", "6",
+            "--rows", "64", "--cols", "8", "--lr", "1.0", "--add-delay",
+            "--kill-workers", "3:2",
+            "--input-dir", str(tmp_path / "d"), "--quiet",
+        ])
+
+
+def test_cli_kill_workers_validation():
+    from erasurehead_tpu.utils.config import RunConfig
+
+    with pytest.raises(ValueError, match="death-timeout"):
+        cli.run(
+            RunConfig(scheme="naive", n_workers=4, rounds=4, n_rows=64,
+                      n_cols=8, lr_schedule=1.0),
+            kill_workers="1:2", on_death="failover", quiet=True,
+        )
+    with pytest.raises(ValueError, match="worker:round"):
+        cli._parse_deaths("1-2")

@@ -264,3 +264,20 @@ def test_elastic_restart_with_array_lr_schedule():
     assert rep.n_workers_after == 3
     hist = np.asarray(res.params_history)
     assert hist.shape[0] == R2 and np.isfinite(hist).all()
+
+
+def test_elastic_ignores_deaths_beyond_horizon():
+    """A death scheduled at round >= cfg.rounds never happens inside the
+    run: that worker must NOT be evicted (regression: it used to be)."""
+    from erasurehead_tpu.data.synthetic import generate_gmm
+
+    ds = generate_gmm(32 * 4, 12, n_partitions=4, seed=0)
+    cfg = RunConfig(
+        scheme="naive", n_workers=4, n_stragglers=0, rounds=8,
+        n_rows=32 * 4, n_cols=12, lr_schedule=1.0, add_delay=True, seed=0,
+    )
+    res, rep = failures.train_elastic(cfg, ds, {3: 4, 2: 100}, measure=False)
+    assert rep.dead_workers == (3,)  # worker 2 outlives the run
+    assert rep.n_workers_after == 3
+    with pytest.raises(ValueError, match="no death occurs"):
+        failures.train_elastic(cfg, ds, {2: 100})
