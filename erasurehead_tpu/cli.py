@@ -288,11 +288,17 @@ def _parse_deaths(spec: str) -> dict[int, int]:
     for part in spec.split(","):
         w, _, r = part.partition(":")
         try:
-            out[int(w)] = int(r)
+            wi, ri = int(w), int(r)
         except ValueError:
             raise ValueError(
                 f"bad --kill-workers entry {part!r}; want worker:round"
             ) from None
+        if wi in out:
+            raise ValueError(
+                f"--kill-workers lists worker {wi} twice "
+                f"({out[wi]} and {ri}) — likely a typo"
+            )
+        out[wi] = ri
     return out
 
 
@@ -315,12 +321,21 @@ def run(
             "unset --arrival-mode measured"
         )
     deaths = _parse_deaths(kill_workers) if kill_workers else None
+    if on_death != "error" and not deaths:
+        raise ValueError("on_death requires kill_workers")
+    if death_timeout is not None and on_death != "failover":
+        raise ValueError("death_timeout only applies to on_death='failover'")
     if deaths and cfg.arrival_mode == "measured":
         raise ValueError("--kill-workers needs the simulated-arrival trainer")
     if deaths and (checkpoint_dir or resume):
         raise ValueError("--kill-workers does not compose with checkpointing")
     if deaths and on_death == "failover" and death_timeout is None:
         raise ValueError("--on-death failover requires --death-timeout")
+    if deaths and not all(0 <= w < cfg.n_workers for w in deaths):
+        raise ValueError(
+            f"--kill-workers ids {sorted(deaths)} outside "
+            f"[0, {cfg.n_workers})"
+        )
     initialize_distributed()
     dataset = load_dataset(cfg)
     from erasurehead_tpu.utils.tracing import device_trace

@@ -191,3 +191,18 @@ def test_cli_kill_workers_validation():
         )
     with pytest.raises(ValueError, match="worker:round"):
         cli._parse_deaths("1-2")
+
+
+def test_cli_kill_workers_more_validation():
+    from erasurehead_tpu.utils.config import RunConfig
+
+    base = RunConfig(scheme="naive", n_workers=4, rounds=4, n_rows=64,
+                     n_cols=8, lr_schedule=1.0)
+    with pytest.raises(ValueError, match="twice"):
+        cli._parse_deaths("6:10,6:3")
+    with pytest.raises(ValueError, match="requires kill_workers"):
+        cli.run(base, on_death="elastic", quiet=True)
+    with pytest.raises(ValueError, match="only applies"):
+        cli.run(base, kill_workers="1:2", death_timeout=5.0, quiet=True)
+    with pytest.raises(ValueError, match="outside"):
+        cli.run(base, kill_workers="9:2", quiet=True)

@@ -1,0 +1,83 @@
+"""Summarize a tools/tpu_measurements.sh JSONL file into a markdown table.
+
+Usage: python tools/summarize_measurements.py [tools/measurements.jsonl]
+
+Groups the tagged entries: benches (steps/sec + vs_baseline + bandwidth),
+profiles (per-variant milliseconds), and the kernel race — the digest that
+goes into BASELINE.md's "Measured results" after a sweep.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else "tools/measurements.jsonl"
+    try:
+        lines = [
+            json.loads(ln)
+            for ln in open(path)
+            if ln.strip()
+        ]
+    except FileNotFoundError:
+        print(f"no measurements at {path}; run tools/tpu_measurements.sh")
+        return
+    except json.JSONDecodeError as e:
+        print(f"corrupt line in {path}: {e}")
+        return
+
+    benches, profiles, races = [], [], []
+    for entry in lines:
+        tag, res = entry.get("tag", "?"), entry.get("result", {})
+        if "value" in res:
+            benches.append((tag, res))
+        elif {"logistic", "linear"} & res.keys():
+            races.append((tag, res))
+        else:
+            profiles.append((tag, res))
+
+    if benches:
+        print("## Benches (steps/sec)\n")
+        print("| tag | platform | value | vs_baseline | GB/s | extras |")
+        print("|---|---|---|---|---|---|")
+        for tag, r in benches:
+            extras = ", ".join(
+                f"{k}={r[k]}"
+                for k in ("mode", "lanes", "dtype", "pct_roofline")
+                if r.get(k) is not None
+            )
+            print(
+                f"| {tag} | {r.get('platform')} | {r.get('value')} "
+                f"| {r.get('vs_baseline')} | {r.get('achieved_gbps', '')} "
+                f"| {extras} |"
+            )
+        print()
+
+    for tag, r in races:
+        print(f"## Kernel race ({tag}, platform={r.get('platform')})\n")
+        for kind in ("logistic", "linear"):
+            if kind in r:
+                k = r[kind]
+                print(
+                    f"- {kind}: pallas {k.get('pallas_ms')}ms vs "
+                    f"XLA {k.get('xla_ms')}ms (speedup {k.get('speedup')})"
+                )
+        print()
+
+    for tag, r in profiles:
+        ms = {k: v for k, v in r.items() if k.endswith("_ms")}
+        if not ms:
+            continue
+        print(f"## Profile ({tag}, platform={r.get('platform')}, "
+              f"shape={r.get('shape')})\n")
+        best = min(ms, key=ms.get)
+        for k, v in sorted(ms.items(), key=lambda kv: kv[1]):
+            mark = "  <- fastest" if k == best else ""
+            print(f"- {k[:-3]}: {v} ms{mark}")
+        print()
+
+
+if __name__ == "__main__":
+    main()
