@@ -110,6 +110,9 @@ def _flags_parser() -> argparse.ArgumentParser:
     p.add_argument("--workers", type=int, default=8)
     p.add_argument("--stragglers", type=int, default=1)
     p.add_argument("--num-collect", type=int, default=None)
+    p.add_argument("--deadline", type=float, default=None,
+                   help="per-round collection deadline in simulated "
+                        "seconds (scheme=deadline)")
     p.add_argument("--rounds", type=int, default=100)
     p.add_argument("--dataset", default="artificial")
     p.add_argument("--rows", type=int, default=4096)
@@ -181,6 +184,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         n_workers=ns.workers,
         n_stragglers=ns.stragglers,
         num_collect=ns.num_collect,
+        deadline=ns.deadline,
         rounds=ns.rounds,
         add_delay=ns.add_delay,
         delay_mean=ns.delay_mean,
@@ -363,6 +367,7 @@ def run(
                 trainer.build_layout(cfg),
                 arrivals,
                 num_collect=cfg.num_collect,
+                deadline=cfg.deadline,
                 timeout=(
                     death_timeout if death_timeout is not None else np.inf
                 ),

@@ -205,6 +205,31 @@ def collect_avoidstragg(t: np.ndarray, n_stragglers: int) -> CollectionSchedule:
     )
 
 
+def collect_deadline(t: np.ndarray, deadline: float) -> CollectionSchedule:
+    """Deadline-based collection (beyond the reference): the master takes
+    every gradient that arrived by ``deadline`` simulated seconds into the
+    round and rescales by W/collected for unbiasedness (the avoidstragg
+    rescale, src/avoidstragg.py:116, with a data-dependent count). A round
+    where ALL workers arrive early stops at the last arrival; otherwise
+    the master must wait out the full deadline (it cannot know nothing
+    else is coming). A round with zero arrivals applies a zero gradient
+    and costs the deadline — inherently failure-tolerant: dead workers
+    (t = inf) simply never make the cutoff.
+    """
+    R, W = t.shape
+    collected = t <= deadline
+    cnt = collected.sum(axis=1)
+    weights = collected * (W / np.maximum(cnt, 1)[:, None])
+    all_in = cnt == W
+    sim = np.where(all_in, t.max(axis=1, initial=-np.inf), deadline)
+    return CollectionSchedule(
+        message_weights=weights,
+        sim_time=sim,
+        worker_times=_stamp(t, collected),
+        collected=collected,
+    )
+
+
 def collect_partial(
     t: np.ndarray,
     layout: CodingLayout,
@@ -279,9 +304,14 @@ def build_schedule(
     t: np.ndarray,
     layout: CodingLayout,
     num_collect: int | None = None,
+    deadline: float | None = None,
 ) -> CollectionSchedule:
     """Dispatch to the scheme's collection rule (the reference's dispatch is
     main.py:62-92)."""
+    if scheme == Scheme.DEADLINE:
+        if deadline is None:
+            raise ValueError("deadline scheme needs a deadline")
+        return collect_deadline(t, deadline)
     if scheme == Scheme.NAIVE:
         return collect_all(t)
     if scheme == Scheme.CYCLIC_MDS:

@@ -94,6 +94,18 @@ def collect_avoidstragg_jnp(t: jnp.ndarray, n_stragglers: int) -> RoundSchedule:
     )
 
 
+def collect_deadline_jnp(t: jnp.ndarray, deadline: float) -> RoundSchedule:
+    """Deadline collection (collect.collect_deadline, jnp): take whatever
+    arrived by the cutoff, rescale W/collected; zero-arrival rounds apply a
+    zero gradient and cost the full deadline."""
+    W = t.shape[0]
+    mask = t <= deadline
+    cnt = mask.sum()
+    weights = mask * (W / jnp.maximum(cnt, 1))
+    sim = jnp.where(cnt == W, t.max(), deadline)
+    return RoundSchedule(weights.astype(jnp.float32), sim, mask)
+
+
 def collect_agc_jnp(
     t: jnp.ndarray, onehot: jnp.ndarray, num_collect: int
 ) -> RoundSchedule:
@@ -181,6 +193,7 @@ def make_round_schedule_fn(
     num_collect: int | None = None,
     delay_mean: float = 0.5,
     add_delay: bool = True,
+    deadline: float | None = None,
 ) -> Callable[[jax.Array], RoundSchedule]:
     """(per-round key) -> RoundSchedule, fully traceable.
 
@@ -201,7 +214,11 @@ def make_round_schedule_fn(
             return jnp.zeros(W)
         return delay_mean * jax.random.exponential(key, (W,))
 
-    if scheme == Scheme.NAIVE:
+    if scheme == Scheme.DEADLINE:
+        if deadline is None:
+            raise ValueError("deadline scheme needs a deadline")
+        rule = lambda t: collect_deadline_jnp(t, deadline)
+    elif scheme == Scheme.NAIVE:
         rule = collect_all_jnp
     elif scheme == Scheme.CYCLIC_MDS:
         rule = lambda t: collect_first_k_mds_jnp(t, B, layout.n_stragglers)

@@ -118,7 +118,12 @@ def analyze(
             axis=1,
         )  # [R, G]
         all_groups_alive = group_alive.all(axis=1)
-    if scheme == Scheme.NAIVE:
+    if scheme == Scheme.DEADLINE:
+        # the master always exits at the deadline; zero-arrival rounds
+        # apply a zero gradient rather than blocking
+        feasible = np.ones(arrivals.shape[0], dtype=bool)
+        reason = "deadline collection always completes"
+    elif scheme == Scheme.NAIVE:
         feasible, reason = alive_cnt == W, "needs all W workers"
     elif scheme in (Scheme.CYCLIC_MDS, Scheme.AVOID_STRAGGLERS):
         feasible, reason = alive_cnt >= W - s, f"needs first {W - s} arrivals"
@@ -207,6 +212,7 @@ def plan_run(
     num_collect: int | None = None,
     timeout: float = np.inf,
     on_infeasible: str = "error",  # "error" | "failover"
+    deadline: float | None = None,
 ) -> tuple[collect.CollectionSchedule, FeasibilityReport]:
     """Build the run's collection schedule with failure handling.
 
@@ -223,7 +229,8 @@ def plan_run(
         )
     report = analyze(scheme, layout, arrivals, num_collect, timeout)
     schedule = collect.build_schedule(
-        Scheme(scheme), arrivals, layout, num_collect=num_collect
+        Scheme(scheme), arrivals, layout, num_collect=num_collect,
+        deadline=deadline,
     )
     if report.all_feasible:
         return schedule, report

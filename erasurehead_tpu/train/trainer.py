@@ -54,6 +54,8 @@ def build_layout(cfg: RunConfig) -> codes.CodingLayout:
     W, s = cfg.n_workers, cfg.n_stragglers
     if cfg.scheme == Scheme.NAIVE:
         return codes.uncoded_layout(W)  # waits for everyone: s plays no role
+    if cfg.scheme == Scheme.DEADLINE:
+        return codes.uncoded_layout(W)  # uncoded; the deadline does the work
     if cfg.scheme == Scheme.AVOID_STRAGGLERS:
         return codes.uncoded_layout(W, n_stragglers=s)
     if cfg.scheme == Scheme.CYCLIC_MDS:
@@ -266,7 +268,8 @@ def train(
         # a custom schedule (e.g. parallel/failures.plan_run's failover
         # rewrite) overrides the scheme's plain collection rule
         schedule = collect.build_schedule(
-            cfg.scheme, arrivals, layout, num_collect=cfg.num_collect
+            cfg.scheme, arrivals, layout, num_collect=cfg.num_collect,
+            deadline=cfg.deadline,
         )
     lr = setup.lr
     alpha = setup.alpha
@@ -580,7 +583,8 @@ def train_measured(
             msgs.append(m)
         arrivals = (t_row + delays[r])[None, :]
         sched = collect.build_schedule(
-            cfg.scheme, arrivals, layout, num_collect=cfg.num_collect
+            cfg.scheme, arrivals, layout, num_collect=cfg.num_collect,
+            deadline=cfg.deadline,
         )
         slot_w = np.asarray(
             step_lib.expand_slot_weights(
@@ -634,7 +638,8 @@ def train_dynamic(cfg: RunConfig, dataset: Dataset, mesh=None) -> TrainResult:
     setup = _setup_run(cfg, dataset, mesh, faithful=True)
     layout, model, mesh, data = setup.layout, setup.model, setup.mesh, setup.data
     sched_fn = dynamic_lib.make_round_schedule_fn(
-        cfg.scheme, layout, cfg.num_collect, cfg.delay_mean, cfg.add_delay
+        cfg.scheme, layout, cfg.num_collect, cfg.delay_mean, cfg.add_delay,
+        deadline=cfg.deadline,
     )
     grad_fn = step_lib.make_faithful_grad_fn(model, mesh)
     update_fn = setup.update_fn

@@ -57,7 +57,8 @@ def audit_schedule_determinism(cfg) -> AuditResult:
         # config must audit the schedule it actually runs
         t = default_arrivals(cfg)
         s = collect.build_schedule(
-            cfg.scheme, t, layout, num_collect=cfg.num_collect
+            cfg.scheme, t, layout, num_collect=cfg.num_collect,
+            deadline=cfg.deadline,
         )
         outs.append(
             np.concatenate(

@@ -30,6 +30,11 @@ class Scheme(str, enum.Enum):
     # decoding — arXiv 1711.06771 + 2006.09638 (PAPERS.md); same s+1
     # storage overhead as FRC/cyclic, lower erasure error at equal budget
     RANDOM_REGULAR = "randreg"
+    # beyond the reference: deadline-based collection — the master takes
+    # whatever arrived by a fixed per-round deadline and rescales for
+    # unbiasedness; inherently failure-tolerant (a dead worker just never
+    # arrives) and the practical form async-SGD systems deploy
+    DEADLINE = "deadline"
 
 
 class UpdateRule(str, enum.Enum):
@@ -142,6 +147,8 @@ class RunConfig:
     # None = scalar lowering; a power of two widens every sparse lookup to
     # an L-lane row, the TPU workaround for ~7ns/element scalar gathers.
     sparse_lanes: Optional[int] = None
+    # per-round collection deadline in simulated seconds (scheme="deadline")
+    deadline: Optional[float] = None
 
     @classmethod
     def for_dataset(cls, dataset: str, **overrides) -> "RunConfig":
@@ -183,6 +190,12 @@ class RunConfig:
             if self.partitions_per_worker < self.n_stragglers + 2:
                 raise ValueError(
                     "partial schemes need partitions_per_worker >= n_stragglers+2"
+                )
+        if self.scheme == Scheme.DEADLINE:
+            if self.deadline is None or self.deadline <= 0:
+                raise ValueError(
+                    "scheme='deadline' needs a positive deadline "
+                    f"(got {self.deadline!r})"
                 )
 
     @property

@@ -65,3 +65,18 @@ def test_schedule_audit_uses_heterogeneous_model(monkeypatch):
         np.testing.assert_array_equal(
             model.worker_speed, expected.worker_speed
         )
+
+
+def test_audit_covers_deadline_scheme():
+    """Regression: the determinism audit must handle scheme='deadline'
+    (build_schedule needs the deadline threaded through)."""
+    from erasurehead_tpu.utils import audit
+    from erasurehead_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        scheme="deadline", deadline=1.0, n_workers=4, n_stragglers=0,
+        rounds=5, n_rows=64, n_cols=8, lr_schedule=1.0, add_delay=True,
+        seed=0,
+    )
+    res = audit.audit_schedule_determinism(cfg)
+    assert res.bitwise_equal

@@ -259,3 +259,40 @@ def test_control_plane_scales_to_10k_rounds():
         # measured ~0.2s/rule on a dev host; 5s still rules out O(R)-Python
         # regressions while leaving headroom for loaded CI machines
         assert took < 5.0, f"{name} control plane took {took:.2f}s at R={R10}"
+
+
+def test_deadline_collection_rule():
+    """Deadline scheme (beyond the reference): collect what arrived by the
+    cutoff, unbiased W/collected rescale; early stop only when everyone
+    arrived; zero-arrival rounds apply a zero gradient at full deadline
+    cost; dead workers (inf) never make the cutoff."""
+    t = np.array([
+        [0.1, 0.2, 0.3, 0.4],   # all in by 1.0 -> stop at 0.4
+        [0.1, 0.2, 5.0, 9.0],   # two in -> rescale 4/2, sim = deadline
+        [3.0, 5.0, 7.0, 9.0],   # none in -> zero gradient, sim = deadline
+        [0.1, np.inf, 0.5, np.inf],  # dead workers never collected
+    ])
+    s = collect.collect_deadline(t, deadline=1.0)
+    assert np.allclose(s.sim_time, [0.4, 1.0, 1.0, 1.0])
+    assert s.collected.tolist() == [
+        [True, True, True, True],
+        [True, True, False, False],
+        [False, False, False, False],
+        [True, False, True, False],
+    ]
+    assert np.allclose(s.message_weights[0], 1.0)
+    assert np.allclose(s.message_weights[1], [2.0, 2.0, 0.0, 0.0])
+    assert np.allclose(s.message_weights[2], 0.0)
+    assert np.allclose(s.message_weights[3], [2.0, 0.0, 2.0, 0.0])
+    # unbiasedness: weights sum to W over collected rounds
+    assert np.allclose(s.message_weights[1].sum(), 4.0)
+    # -1 sentinel for uncollected
+    assert s.worker_times[1, 2] == collect.NEVER
+    # dispatch path
+    from erasurehead_tpu.ops import codes
+    s2 = collect.build_schedule(
+        Scheme.DEADLINE, t, codes.uncoded_layout(4), deadline=1.0
+    )
+    assert np.allclose(s2.message_weights, s.message_weights)
+    with pytest.raises(ValueError, match="deadline"):
+        collect.build_schedule(Scheme.DEADLINE, t, codes.uncoded_layout(4))

@@ -136,6 +136,7 @@ def test_partial_mds_matches_host(arrivals):
     ("approx", dict(num_collect=8)),
     ("cyccoded", {}),
     ("naive", {}),
+    ("deadline", dict(deadline=1.5)),
     ("partialrepcoded", dict(partitions_per_worker=S + 2)),
     ("partialcyccoded", dict(partitions_per_worker=S + 2)),
 ])
@@ -161,3 +162,16 @@ def test_train_dynamic_end_to_end(scheme, kw):
     first = float(model.loss_mean(jnp.asarray(hist[0]), Xt, yt))
     last = float(model.loss_mean(jnp.asarray(hist[-1]), Xt, yt))
     assert last < first * 0.8
+
+
+def test_deadline_rule_matches_host_control_plane():
+    """collect_deadline_jnp pinned per-round against collect_deadline."""
+    rng = np.random.default_rng(3)
+    arrivals = rng.exponential(0.5, size=(R, W))
+    arrivals[2] += 10.0  # a round where nobody makes the cutoff
+    rule = lambda t: dynamic.collect_deadline_jnp(t, 1.0)
+    w, sim, col = _per_round(rule, arrivals)
+    ref = collect.collect_deadline(arrivals, 1.0)
+    np.testing.assert_array_equal(col, ref.collected)
+    np.testing.assert_allclose(sim, ref.sim_time, rtol=1e-6)
+    np.testing.assert_allclose(w, ref.message_weights, rtol=1e-6)

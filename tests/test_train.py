@@ -319,3 +319,33 @@ def test_attention_model_trains_under_agc():
     l1 = float(model.loss_mean(last, Xt, yt))
     assert np.isfinite(l1)
     assert l1 < l0, (l0, l1)
+
+
+def test_deadline_scheme_trains_and_tolerates_death(gmm):
+    """scheme='deadline' end to end: converges under straggling, and a
+    permanently dead worker needs NO failover plan — the rule is
+    inherently failure-tolerant (it just never collects the dead)."""
+    from erasurehead_tpu.parallel import failures
+
+    cfg = RunConfig(
+        scheme="deadline", deadline=1.0, n_workers=W, n_stragglers=0,
+        rounds=30, n_rows=N_ROWS, n_cols=N_COLS, lr_schedule=1.0,
+        update_rule="AGD", add_delay=True, seed=0,
+    )
+    res = trainer.train(cfg, gmm, mesh=worker_mesh(4))
+    hist = np.asarray(res.params_history)
+    assert np.isfinite(hist).all()
+    # under Exp(0.5) delays and deadline 1.0, rounds are capped at 1.0
+    assert (res.timeset <= 1.0 + 1e-9).all()
+    # a dead worker: the run stays feasible with no plan rewrite
+    arrivals = failures.inject_worker_death(
+        trainer.default_arrivals(cfg), {W - 1: 3}
+    )
+    sched, report = failures.plan_run(
+        cfg.scheme, trainer.build_layout(cfg), arrivals,
+        deadline=cfg.deadline,
+    )
+    assert report.all_feasible
+    res2 = trainer.train(cfg, gmm, arrivals=arrivals, schedule=sched)
+    assert not res2.collected[3:, W - 1].any()
+    assert np.isfinite(np.asarray(res2.params_history)).all()
