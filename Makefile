@@ -17,6 +17,7 @@ PY            ?= python
 N_WORKERS     ?= 30
 N_STRAGGLERS  ?= 2
 N_COLLECT     ?= 15
+DEADLINE      ?= 1.0
 ROUNDS        ?= 100
 UPDATE_RULE   ?= AGD
 # synthetic GMM shape (reference Makefile:19-20 uses 54000x100-class sizes)
@@ -36,7 +37,7 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 	--dataset $(DATASET) --input-dir $(DATA_DIR) $(ADD_DELAY)
 
 .PHONY: naive cyccoded repcoded avoidstragg approxcoded \
-	partialrepcoded partialcyccoded randreg \
+	partialrepcoded partialcyccoded randreg deadline \
 	generate_random_data arrange_real_data \
 	test bench compare dryrun clean
 
@@ -63,6 +64,9 @@ partialrepcoded:  ## two-part partial FRC scheme (src/partial_replication.py)
 
 randreg:          ## beyond-reference: random-regular code + optimal decode
 	$(RUN) --scheme randreg --num-collect $(N_COLLECT)
+
+deadline:         ## beyond-reference: fixed per-round deadline collection
+	$(RUN) --scheme deadline --deadline $(DEADLINE)
 
 generate_random_data:  ## synthetic GMM partitions (src/generate_data.py)
 	$(PY) -m erasurehead_tpu.data.prepare synthetic --rows $(N_ROWS) \

@@ -51,6 +51,7 @@ SCHEME_PREFIX = {
     "partialcyccoded": "partialcoded",
     "partialrepcoded": "partialreplication",
     "randreg": "randreg_acc",  # beyond-reference scheme, own prefix
+    "deadline": "deadline_acc",  # beyond-reference scheme, own prefix
 }
 
 

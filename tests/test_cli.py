@@ -206,3 +206,22 @@ def test_cli_kill_workers_more_validation():
         cli.run(base, kill_workers="1:2", death_timeout=5.0, quiet=True)
     with pytest.raises(ValueError, match="outside"):
         cli.run(base, kill_workers="9:2", quiet=True)
+
+
+def test_cli_deadline_scheme_artifacts(tmp_path):
+    """scheme=deadline end to end through the CLI: artifacts carry the
+    scheme's own prefix (regression: run_prefix lacked the new scheme)."""
+    data_dir = str(tmp_path / "data")
+    rc = cli.main([
+        "--scheme", "deadline", "--deadline", "1.0", "--workers", "6",
+        "--rounds", "6", "--rows", "240", "--cols", "12", "--lr", "1.0",
+        "--add-delay", "--input-dir", data_dir, "--quiet",
+    ])
+    assert rc == 0
+    results = os.path.join(data_dir, "artificial-data", "240x12", "6", "results")
+    files = os.listdir(results)
+    assert any(f.startswith("deadline_acc") for f in files), files
+    ts = np.loadtxt(os.path.join(
+        results, next(f for f in files if "timeset" in f and "worker" not in f)
+    ))
+    assert (ts <= 1.0 + 1e-9).all()
