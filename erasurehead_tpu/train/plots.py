@@ -31,6 +31,7 @@ SCHEME_COLORS = {
     "partialcyccoded": "#008300",
     "partialrepcoded": "#4a3aa7",
     "randreg": "#e34948",
+    "deadline": "#7a5f3a",
 }
 _FALLBACK = "#6b6a60"  # neutral "Other" gray for unknown labels
 _INK = "#1a1a19"
