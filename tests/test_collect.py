@@ -296,3 +296,45 @@ def test_deadline_collection_rule():
     assert np.allclose(s2.message_weights, s.message_weights)
     with pytest.raises(ValueError, match="deadline"):
         collect.build_schedule(Scheme.DEADLINE, t, codes.uncoded_layout(4))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_all_schemes_schedule_invariants(seed):
+    """Structural invariants every scheme's schedule must satisfy, fuzzed
+    over random arrival matrices: collected workers carry their true
+    arrival stamp, uncollected carry the -1 sentinel and zero decode
+    weight, and the round clock is at least the latest collected arrival
+    (the master cannot finish before its last used message)."""
+    from erasurehead_tpu.ops import codes
+
+    rng = np.random.default_rng(seed)
+    Wf = 12
+    t = rng.exponential(0.5, size=(8, Wf))
+    cases = [
+        (Scheme.NAIVE, codes.uncoded_layout(Wf), {}),
+        (Scheme.AVOID_STRAGGLERS, codes.uncoded_layout(Wf, n_stragglers=2), {}),
+        (Scheme.CYCLIC_MDS, codes.cyclic_mds_layout(Wf, 2, seed=0), {}),
+        (Scheme.FRC, codes.frc_layout(Wf, 2), {}),
+        (Scheme.APPROX, codes.frc_layout(Wf, 2), dict(num_collect=7)),
+        (Scheme.RANDOM_REGULAR, codes.random_regular_layout(Wf, 2, seed=0),
+         dict(num_collect=8)),
+        (Scheme.DEADLINE, codes.uncoded_layout(Wf), dict(deadline=0.7)),
+        (Scheme.PARTIAL_CYCLIC, codes.partial_cyclic_layout(Wf, 4, 2, seed=0), {}),
+        (Scheme.PARTIAL_FRC, codes.partial_frc_layout(Wf, 4, 2), {}),
+    ]
+    for scheme, layout, kw in cases:
+        s = collect.build_schedule(scheme, t, layout, **kw)
+        col = s.collected
+        # stamps: true arrival where collected, NEVER where not
+        np.testing.assert_allclose(
+            s.worker_times, np.where(col, t, collect.NEVER), err_msg=scheme
+        )
+        # no decode weight on uncollected messages
+        assert (np.asarray(s.message_weights)[~col] == 0).all(), scheme
+        # the clock cannot precede the last collected arrival (partial
+        # schemes' uncoded first-parts arrive at a fraction of t, but the
+        # coded second part still bounds the round end)
+        last_used = np.where(col, t, -np.inf).max(axis=1)
+        if scheme in (Scheme.PARTIAL_CYCLIC, Scheme.PARTIAL_FRC):
+            last_used = np.where(col, layout.uncoded_frac * t, -np.inf).max(axis=1)
+        assert (s.sim_time >= last_used - 1e-9).all(), scheme
