@@ -305,8 +305,6 @@ def test_all_schemes_schedule_invariants(seed):
     arrival stamp, uncollected carry the -1 sentinel and zero decode
     weight, and the round clock is at least the latest collected arrival
     (the master cannot finish before its last used message)."""
-    from erasurehead_tpu.ops import codes
-
     rng = np.random.default_rng(seed)
     Wf = 12
     t = rng.exponential(0.5, size=(8, Wf))
@@ -331,10 +329,8 @@ def test_all_schemes_schedule_invariants(seed):
         )
         # no decode weight on uncollected messages
         assert (np.asarray(s.message_weights)[~col] == 0).all(), scheme
-        # the clock cannot precede the last collected arrival (partial
-        # schemes' uncoded first-parts arrive at a fraction of t, but the
-        # coded second part still bounds the round end)
+        # the clock cannot precede the last collected arrival — including
+        # partial schemes, where "collected" means the coded second part
+        # (at time t) was processed at or before the stop event
         last_used = np.where(col, t, -np.inf).max(axis=1)
-        if scheme in (Scheme.PARTIAL_CYCLIC, Scheme.PARTIAL_FRC):
-            last_used = np.where(col, layout.uncoded_frac * t, -np.inf).max(axis=1)
         assert (s.sim_time >= last_used - 1e-9).all(), scheme
