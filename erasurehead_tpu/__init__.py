@@ -11,10 +11,11 @@ lstsq decode becomes an on-device masked solve + einsum.
 Layout:
   ops/       coding-theory core (layouts, generator matrices, decode weights)
              and TPU-friendly sparse feature ops
-  models/    per-partition gradient kernels: logistic / linear GLMs, MLP;
-             losses and metrics
+  models/    per-partition gradient kernels: logistic / linear GLMs, MLP,
+             attention classifier; losses and metrics
   parallel/  mesh + collective step, straggler arrival simulation, collection
-             rules (the scheme layer), distributed backend init
+             rules (the scheme layer), failure handling / elastic recovery,
+             ring + all-to-all sequence parallelism, distributed backend init
   data/      synthetic GMM + real-dataset preprocessing, partitioning, disk IO
   train/     GD/AGD optimizer, scan-based trainer, post-hoc evaluation replay,
              result artifacts, checkpointing
@@ -45,3 +46,19 @@ def train_dynamic(cfg, dataset, **kw):
     from erasurehead_tpu.train import trainer
 
     return trainer.train_dynamic(cfg, dataset, **kw)
+
+
+def train_measured(cfg, dataset, **kw):
+    """Convenience re-export of train.trainer.train_measured (real
+    per-worker arrival timing feeding the collection rules)."""
+    from erasurehead_tpu.train import trainer
+
+    return trainer.train_measured(cfg, dataset, **kw)
+
+
+def train_elastic(cfg, dataset, deaths, **kw):
+    """Convenience re-export of parallel.failures.train_elastic (re-shard
+    onto the survivors after permanent worker deaths and keep training)."""
+    from erasurehead_tpu.parallel import failures
+
+    return failures.train_elastic(cfg, dataset, deaths, **kw)
