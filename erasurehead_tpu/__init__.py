@@ -19,7 +19,7 @@ Layout:
   data/      synthetic GMM + real-dataset preprocessing, partitioning, disk IO
   train/     GD/AGD optimizer, scan-based trainer, post-hoc evaluation replay,
              result artifacts, checkpointing
-  utils/     config, logging, timing
+  utils/     typed config, determinism audit, profiler tracing
 """
 
 __version__ = "0.1.0"
