@@ -102,6 +102,49 @@ def main() -> None:
 
     cases["margin_only"] = (margin_only, X.nbytes)
 
+    # --- what is the chip's actual achievable stream rate in-scan? A pure
+    # elementwise read+reduce of the stack, no contraction structure at all:
+    # the honest denominator for "percent of roofline" claims. The beta-
+    # dependent multiply keeps the reduction loop-variant (unhoistable).
+    def raw_stream(beta):
+        return beta * 0.999 + jnp.sum(X * beta[0]) / F
+
+    cases["raw_stream"] = (raw_stream, X.nbytes)
+
+    def raw_stream_bf16(beta):
+        return beta * 0.999 + jnp.sum(Xb * beta[0].astype(jnp.bfloat16)) / F
+
+    cases["raw_stream_bf16"] = (raw_stream_bf16, Xb.nbytes)
+
+    # --- margin lowering variants: is the mrf,f->mr contraction (reduce
+    # over the minor/lane dim) what keeps the stream at ~120 GB/s, and does
+    # a different shape for the same math fix it?
+    X2 = X.reshape(M * R, F)
+
+    def margin_matmul2d(beta):
+        p = jnp.matmul(X2, beta, precision=HI)
+        return beta * 0.999 + jnp.sum(jnp.tanh(p)) / F
+
+    cases["margin_matmul2d"] = (margin_matmul2d, X.nbytes)
+
+    def margin_cols8(beta):
+        # replicate beta to [F, 8] so the product is a real matmul with an
+        # (8,128)-tileable output; column 0 is the answer. Trades an 8x
+        # output write (tiny vs X) for MXU-shaped lowering.
+        bt = lax.optimization_barrier(jnp.broadcast_to(beta[:, None], (F, 8)))
+        p = jnp.matmul(X2, bt, precision=HI)
+        return beta * 0.999 + jnp.sum(jnp.tanh(p[:, 0])) / F
+
+    cases["margin_cols8"] = (margin_cols8, X.nbytes)
+
+    def margin_dot_bf16ops(beta):
+        # stream f32 X but contract with DEFAULT (bf16-pass) precision —
+        # isolates whether the HIGHEST 6-pass MXU recombination is the cost
+        p = jnp.matmul(X2, beta, precision=DEF)
+        return beta * 0.999 + jnp.sum(jnp.tanh(p)) / F
+
+    cases["margin_default_prec"] = (margin_dot_bf16ops, X.nbytes)
+
     for name, (fn, traffic) in cases.items():
         ms = time_scanned(fn, beta0) * 1e3
         gbps = traffic / (ms / 1e3) / 1e9

@@ -148,13 +148,20 @@ results["shape"] = [M, R, K, F]
 
 
 # --- margin via row-gather from a lane-replicated [F, L] table ------------
+# lax.map (not vmap) over slots: vmapping fuses all M slots' [R*K, L]
+# gathers into one materialized [M, R, K, L] temp — 9 GB at L=128, an
+# instant OOM. A memory-bounded production lowering walks slots
+# sequentially, keeping the live temp at [R*K, L] (~77 MB).
 def margin_rowgather_fn(L):
     def f(beta, idxs, vals, ys):
         table = jnp.broadcast_to(beta[:, None], (F, L))
-        def one(i, v):
+
+        def one(iv):
+            i, v = iv
             g = jnp.take(table, i.reshape(-1), axis=0)  # [R*K, L]
             return (v.reshape(-1, 1) * g).reshape(i.shape[0], -1, L).sum(1)
-        p = jax.vmap(one)(idxs, vals)  # [M, R, L]
+
+        p = jax.lax.map(one, (idxs, vals))  # [M, R, L]
         return beta * 0.999 + jnp.sum(p[..., 0]) / F
     return f
 
@@ -167,15 +174,16 @@ for L in (8, 128):
           f"{results[f'margin_rowgather{L}_ms']}ms", file=sys.stderr)
 
 
-# --- rmatvec via row-scatter into [F, L] ----------------------------------
+# --- rmatvec via row-scatter into [F, L] (lax.map: same OOM story) --------
 def scatter_rows_fn(L):
     def f(beta, idxs, vals, ys):
-        def one(i, v, s):
+        def one(ivs):
+            i, v, s = ivs
             contrib = (v * s[:, None]).reshape(-1, 1)
             rows = jnp.broadcast_to(contrib, (contrib.shape[0], L))
             out = jnp.zeros((F, L), jnp.float32).at[i.reshape(-1)].add(rows)
             return out[:, 0]
-        g = jax.vmap(one)(idxs, vals, ys).sum(0)
+        g = jax.lax.map(one, (idxs, vals, ys)).sum(0)
         return dep(beta, g)
     return f
 
@@ -198,14 +206,15 @@ def margin_packed_fn(P):
     def f(beta, idxs, vals, ys):
         table = jnp.pad(beta, (0, Fp - F)).reshape(Fp // P, P)
 
-        def one(i, v):
+        def one(iv):
+            i, v = iv
             flat = i.reshape(-1)
             rows = jnp.take(table, flat // P, axis=0)  # [RK, P]
             sel = jax.nn.one_hot(flat % P, P, dtype=jnp.float32)
             g = jnp.sum(rows * sel, axis=1).reshape(i.shape)
             return jnp.sum(v * g, axis=1)
 
-        p = jax.vmap(one)(idxs, vals)
+        p = jax.lax.map(one, (idxs, vals))
         return beta * 0.999 + jnp.sum(p) / F
 
     return f
@@ -215,7 +224,8 @@ def scatter_packed_fn(P):
     Fp = -(-F // P) * P
 
     def f(beta, idxs, vals, ys):
-        def one(i, v, s):
+        def one(ivs):
+            i, v, s = ivs
             flat = i.reshape(-1)
             contrib = (v * s[:, None]).reshape(-1, 1)
             rows = contrib * jax.nn.one_hot(flat % P, P, dtype=jnp.float32)
@@ -226,7 +236,7 @@ def scatter_packed_fn(P):
             )
             return out.reshape(Fp)[:F]
 
-        g = jax.vmap(one)(idxs, vals, ys).sum(0)
+        g = jax.lax.map(one, (idxs, vals, ys)).sum(0)
         return dep(beta, g)
 
     return f
@@ -283,7 +293,8 @@ if K % 2 == 0 and B >= 2:
           file=sys.stderr)
 
     def scatter_pairs(beta, pidx, ys):
-        def one(pi, s):
+        def one(ps):
+            pi, s = ps
             gs = []
             for pr in range(K // 2):
                 acc = jnp.zeros(B * B, jnp.float32).at[pi[:, pr]].add(s)
@@ -292,7 +303,7 @@ if K % 2 == 0 and B >= 2:
                 gs.append(t.sum(axis=0))  # field 2*pr + 1 marginal
             return jnp.concatenate(gs)
 
-        g = jax.vmap(one)(pidx, ys).sum(0)
+        g = jax.lax.map(one, (pidx, ys)).sum(0)
         return dep(beta, jnp.pad(g, (0, F - K * B)))
 
     results["scatter_pairs_ms"] = round(
