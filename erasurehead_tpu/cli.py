@@ -143,6 +143,10 @@ def _flags_parser() -> argparse.ArgumentParser:
     p.add_argument("--sparse-lanes", type=int, default=None,
                    help="PaddedRows gather/scatter lane width (power of "
                         "two; TPU scalar-gather workaround)")
+    p.add_argument("--sparse-format", default="padded",
+                   choices=["padded", "fields", "auto"],
+                   help="sparse stack representation: fields = FieldOnehot "
+                        "fused pair-table lowering (one-hot data only)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--checkpoint-dir", default=None,
                    help="save optimizer state here every --checkpoint-every "
@@ -204,6 +208,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         dtype=ns.dtype,
         arrival_mode=ns.arrival_mode,
         sparse_lanes=ns.sparse_lanes,
+        sparse_format=ns.sparse_format,
         seed=ns.seed,
     )
 

@@ -27,7 +27,12 @@ import scipy.sparse as sps
 
 from erasurehead_tpu.data.synthetic import Dataset
 from erasurehead_tpu.ops.codes import CodingLayout
-from erasurehead_tpu.ops.features import Features, PaddedRows
+from erasurehead_tpu.ops.features import (
+    Features,
+    FieldOnehot,
+    PaddedRows,
+    infer_field_sizes,
+)
 from erasurehead_tpu.parallel import mesh as mesh_lib
 
 
@@ -42,8 +47,14 @@ class ShardedData:
     n_train: int  # rows actually trained on (P * rows_per_partition)
 
 
-def partition_stack(dataset: Dataset, n_partitions: int):
-    """[P, rows, F] + [P, rows] partition-major arrays (host)."""
+def partition_stack(dataset: Dataset, n_partitions: int, sparse_format="padded"):
+    """[P, rows, F] + [P, rows] partition-major arrays (host).
+
+    ``sparse_format`` picks the sparse stack representation (RunConfig
+    docs): "padded" (PaddedRows), "fields" (FieldOnehot; raises when the
+    data is not exactly-one-hot-per-field), or "auto" (fields when the
+    structure allows, else padded).
+    """
     n = dataset.n_samples
     rows = n // n_partitions
     if rows == 0:
@@ -51,13 +62,39 @@ def partition_stack(dataset: Dataset, n_partitions: int):
     X, y = dataset.X_train, dataset.y_train
     if sps.issparse(X):
         X = X[: rows * n_partitions]
+        # field structure is a whole-matrix property: infer once so every
+        # partition shares the same block offsets (tables must agree)
+        sizes = None
+        if sparse_format in ("fields", "auto"):
+            sizes = infer_field_sizes(X)
+            if sizes is None and sparse_format == "fields":
+                raise ValueError(
+                    "sparse_format='fields' requires exactly-one-hot-per-"
+                    "field data (uniform nnz/row, unit values, disjoint "
+                    "ordered field blocks); use 'auto' or 'padded'"
+                )
         parts = [X[i * rows : (i + 1) * rows] for i in range(n_partitions)]
-        nnz = max(int(np.diff(p.indptr).max()) for p in parts)
-        Xp = jax.tree.map(
-            lambda *leaves: np.stack(leaves),
-            *[_padded_host(p, nnz) for p in parts],
-        )
+        if sizes is not None:
+            # from_scipy returns host numpy leaves, so this stays on host
+            Xp = jax.tree.map(
+                lambda *leaves: np.stack(leaves),
+                *[
+                    FieldOnehot.from_scipy(p, field_sizes=sizes)
+                    for p in parts
+                ],
+            )
+        else:
+            nnz = max(int(np.diff(p.indptr).max()) for p in parts)
+            Xp = jax.tree.map(
+                lambda *leaves: np.stack(leaves),
+                *[_padded_host(p, nnz) for p in parts],
+            )
     else:
+        if sparse_format == "fields":
+            raise ValueError(
+                "sparse_format='fields' requires sparse (CSR) features; "
+                "this dataset is dense — use 'auto' or 'padded'"
+            )
         Xp = X[: rows * n_partitions].reshape(n_partitions, rows, -1)
     yp = y[: rows * n_partitions].reshape(n_partitions, rows)
     return Xp, yp
@@ -72,7 +109,7 @@ def worker_stack(layout: CodingLayout, Xp, yp):
     """Gather the redundant worker-major stacks through the assignment."""
     take = lambda A: (
         jax.tree.map(lambda leaf: leaf[layout.assignment], A)
-        if isinstance(A, PaddedRows)
+        if isinstance(A, (PaddedRows, FieldOnehot))
         else A[layout.assignment]
     )
     return take(Xp), yp[layout.assignment]
@@ -101,6 +138,7 @@ def shard_run_data(
     mesh,
     faithful: bool,
     dtype=np.float32,
+    sparse_format: str = "padded",
 ) -> ShardedData:
     """Build and device_put the stack the compute mode needs.
 
@@ -113,7 +151,9 @@ def shard_run_data(
     state stay float32 — trainer-side mixed precision). Integer leaves
     (PaddedRows indices) are never cast.
     """
-    Xp_h, yp_h = partition_stack(dataset, layout.n_partitions)
+    Xp_h, yp_h = partition_stack(
+        dataset, layout.n_partitions, sparse_format=sparse_format
+    )
     sharding = mesh_lib.worker_sharding(mesh)
     dtype = np.dtype(dtype) if not hasattr(dtype, "itemsize") else dtype
 

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from erasurehead_tpu.models.glm import MarginClassifierBase
-from erasurehead_tpu.ops.features import PaddedRows
+from erasurehead_tpu.ops.features import FieldOnehot, PaddedRows
 from erasurehead_tpu.parallel.ring import reference_attention
 
 
@@ -52,10 +52,10 @@ class AttentionModel(MarginClassifierBase):
         }
 
     def predict(self, params, X):
-        if isinstance(X, PaddedRows):
+        if isinstance(X, (PaddedRows, FieldOnehot)):
             raise TypeError(
                 "the attention model requires dense features (rows reshape "
-                "to token sequences); sparse PaddedRows data is not supported"
+                "to token sequences); sparse data is not supported"
             )
         Xd = jnp.asarray(X).astype(jnp.float32)
         n, F = Xd.shape

@@ -98,7 +98,163 @@ class PaddedRows:
         return out.at[rows, self.indices.reshape(-1)].add(self.values.reshape(-1))
 
 
-Features = Union[jnp.ndarray, PaddedRows]
+# Max entries of one fused pair table (f32: 16 MB). Pairs whose table would
+# exceed this fall back to per-field single gathers — covtype-class
+# cardinalities (~1.3k/field) pair comfortably; amazon-class (~5.5k hashed
+# categories/field) would need 30M-entry tables and stays on singles.
+# Gather side only: the table depends on beta alone, so under the trainer's
+# per-slot vmap XLA hoists ONE copy out of the batch.
+PAIR_TABLE_CAP = 1 << 22
+
+# The scatter side's pair accumulators are per-slot state — a vmapped
+# grad_sum materializes [n_slots, Bi*Bj] before marginalizing, so the cap
+# must budget the batch: 2M entries = 8 MB/slot = ~720 MB transient at the
+# faithful covtype stack's 90 slots (covtype's ~1292^2 = 1.67M fits; the
+# deduped mode's 30 slots cut it to ~240 MB). Oversized pairs scatter
+# per-field instead (same count as PaddedRows but no value multiply).
+PAIR_SCATTER_CAP = 1 << 21
+
+
+def _greedy_pairing(field_sizes, cap=PAIR_TABLE_CAP):
+    """Static pairing plan: adjacent fields fuse when their pair table fits.
+
+    Returns a tuple of ("pair", i, j) / ("single", i) entries covering every
+    field exactly once. Computed once per (field_sizes, cap) — the plan is
+    static python structure baked into the jitted program.
+    """
+    plan, k, K = [], 0, len(field_sizes)
+    while k < K:
+        if k + 1 < K and field_sizes[k] * field_sizes[k + 1] <= cap:
+            plan.append(("pair", k, k + 1))
+            k += 2
+        else:
+            plan.append(("single", k))
+            k += 1
+    return tuple(plan)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class FieldOnehot:
+    """Exactly-one-hot-per-field sparse rows: the structure of the
+    reference's real workloads (covtype bins every column into one-hot
+    categories, src/arrange_real_data.py:145-205; amazon one-hot-encodes
+    hashed interaction terms, :34-91). Row r activates exactly one column
+    (value 1.0) inside each of K disjoint field blocks.
+
+    Exploiting the structure beats the generic PaddedRows lowering twice
+    over on TPU, where the measured bound is scalar-lookup *count*
+    (~7 ns/element, tools/profile_sparse.py), not HBM:
+
+      - storage halves: ``local[r, k]`` (category within field k) is the
+        only array — no values payload (all ones) and no global indices;
+      - the margin needs K/2 gathers per row instead of K: fields are
+        fused pairwise into per-iteration sum tables
+        ``T[a, b] = beta_i[a] + beta_j[b]`` (a vectorized outer add, tiny
+        vs the gathers it replaces), indexed by the fused code
+        ``local_i * B_j + local_j``; the gradient scatter likewise targets
+        pair accumulators then marginalizes (row/col sums).
+
+    ``field_sizes`` are static (part of the pytree aux data): the pairing
+    plan and every table shape are baked into the compiled program.
+    Numerics: pair-table sums reassociate the per-row adds, so results
+    agree with PaddedRows to float tolerance, not bitwise.
+    """
+
+    local: jnp.ndarray  # [n, K] int32, category index within field k
+    field_sizes: tuple  # static, len K
+    n_cols: int
+
+    @property
+    def offsets(self):
+        return np.concatenate([[0], np.cumsum(self.field_sizes)]).astype(int)
+
+    @property
+    def shape(self):
+        return (self.local.shape[0], self.n_cols)
+
+    def tree_flatten(self):
+        return (self.local,), (tuple(self.field_sizes), self.n_cols)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], aux[0], aux[1])
+
+    @classmethod
+    def from_scipy(cls, csr, field_sizes=None) -> "FieldOnehot":
+        """Build from a CSR matrix; infers the field blocks when not given.
+
+        Raises ValueError if the matrix is not exactly-one-hot-per-field
+        (callers wanting graceful fallback use :func:`infer_field_sizes`
+        first).
+        """
+        csr = csr.tocsr()
+        csr.sum_duplicates()
+        if field_sizes is None:
+            field_sizes = infer_field_sizes(csr)
+            if field_sizes is None:
+                raise ValueError(
+                    "matrix is not field-structured one-hot "
+                    "(uniform nnz/row, all-ones values, k-th entry of every "
+                    "row inside the k-th disjoint column block)"
+                )
+        sizes = tuple(int(b) for b in field_sizes)
+        K = len(sizes)
+        n = csr.shape[0]
+        counts = np.diff(csr.indptr)
+        if not np.all(counts == K):
+            raise ValueError(f"every row must have exactly {K} entries")
+        idx = np.sort(csr.indices.reshape(n, K), axis=1)
+        offs = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+        local = idx - offs[:-1][None, :]
+        if (local < 0).any() or (local >= np.asarray(sizes)[None, :]).any():
+            raise ValueError("row entries fall outside their field blocks")
+        if not np.all(csr.data == 1.0):
+            raise ValueError("field-structured one-hot requires unit values")
+        # host numpy leaf: data prep must not bounce partitions through the
+        # device — the stack's single sharded device_put happens later
+        # (data/sharding.put_global), same as the PaddedRows path
+        return cls(np.asarray(local, np.int32), sizes, int(csr.shape[1]))
+
+    def to_dense(self) -> jnp.ndarray:
+        n, K = self.local.shape
+        out = jnp.zeros((n, self.n_cols), jnp.float32)
+        offs = self.offsets
+        cols = self.local + jnp.asarray(offs[:-1], jnp.int32)[None, :]
+        rows = jnp.repeat(jnp.arange(n), K)
+        return out.at[rows, cols.reshape(-1)].add(1.0)
+
+
+def infer_field_sizes(csr) -> Optional[tuple]:
+    """Detect the one-hot field structure of a CSR matrix, or None.
+
+    Checks: uniform nnz/row K, all values 1.0, and (after per-row sorting)
+    the k-th entry of every row lives in a column range disjoint from and
+    left of the (k+1)-th's. Observed ranges become the field blocks — a
+    tighter cover than the encoder's true blocks is fine (local indices and
+    table sizes shrink; any column no row touches carries zero gradient).
+    Returns field block sizes measured from offset 0 (leading unused
+    columns fold into field 0's block).
+    """
+    csr = csr.tocsr()
+    n = csr.shape[0]
+    if n == 0 or csr.nnz == 0 or csr.nnz % n:
+        return None
+    K = csr.nnz // n
+    counts = np.diff(csr.indptr)
+    if not np.all(counts == K) or not np.all(csr.data == 1.0):
+        return None
+    idx = np.sort(csr.indices.reshape(n, K), axis=1)
+    lo, hi = idx.min(axis=0), idx.max(axis=0)
+    if np.any(hi[:-1] >= lo[1:]):
+        return None
+    # block k spans [prev_hi+1 .. hi[k]]: gaps between observed ranges are
+    # dead columns and fold left so the blocks tile [0, hi[-1]]
+    bounds = np.concatenate([[-1], hi])
+    return tuple(int(b) for b in np.diff(bounds))
+
+
+Features = Union[jnp.ndarray, PaddedRows, FieldOnehot]
 
 # Sparse gather/scatter lane width. TPU scalar gather/scatter throughput is
 # ~7 ns/element (measured, tools/profile_sparse.py) — each of the nnz
@@ -143,9 +299,71 @@ def get_sparse_lanes() -> Optional[int]:
     return _SPARSE_LANES
 
 
+def _fields_matvec(X: "FieldOnehot", v: jnp.ndarray) -> jnp.ndarray:
+    """sum_k v[off_k + local[:, k]] via fused pair tables (see FieldOnehot)."""
+    offs = X.offsets
+    sizes = X.field_sizes
+    if v.ndim > 1:
+        # matrix rhs (MLP first layer): pair tables would be [Bi*Bj, H] —
+        # the table build then rivals the gathers. Per-field row gathers
+        # of H-wide rows are already vectorized; use them directly.
+        out = 0.0
+        for k in range(len(sizes)):
+            out = out + jnp.take(
+                v[offs[k] : offs[k + 1]], X.local[:, k], axis=0
+            )
+        return out
+    out = 0.0
+    for entry in _greedy_pairing(sizes):
+        if entry[0] == "pair":
+            _, i, j = entry
+            bi = v[offs[i] : offs[i + 1]]
+            bj = v[offs[j] : offs[j + 1]]
+            table = (bi[:, None] + bj[None, :]).reshape(-1)
+            code = X.local[:, i] * sizes[j] + X.local[:, j]
+            out = out + jnp.take(table, code, axis=0)
+        else:
+            _, i = entry
+            out = out + jnp.take(
+                v[offs[i] : offs[i + 1]], X.local[:, i], axis=0
+            )
+    return out
+
+
+def _fields_rmatvec(X: "FieldOnehot", r: jnp.ndarray) -> jnp.ndarray:
+    """X.T @ r: scatter into per-pair accumulators, then marginalize."""
+    offs = X.offsets
+    sizes = X.field_sizes
+    if r.ndim > 1:
+        out = jnp.zeros((X.n_cols, r.shape[1]), r.dtype)
+        for k in range(len(sizes)):
+            blk = jnp.zeros((sizes[k], r.shape[1]), r.dtype).at[
+                X.local[:, k]
+            ].add(r)
+            out = out.at[offs[k] : offs[k + 1]].add(blk)
+        return out
+    out = jnp.zeros(X.n_cols, r.dtype)
+    for entry in _greedy_pairing(sizes, cap=PAIR_SCATTER_CAP):
+        if entry[0] == "pair":
+            _, i, j = entry
+            code = X.local[:, i] * sizes[j] + X.local[:, j]
+            acc = jnp.zeros(sizes[i] * sizes[j], r.dtype).at[code].add(r)
+            t = acc.reshape(sizes[i], sizes[j])
+            out = out.at[offs[i] : offs[i + 1]].add(t.sum(axis=1))
+            out = out.at[offs[j] : offs[j + 1]].add(t.sum(axis=0))
+        else:
+            _, i = entry
+            blk = jnp.zeros(sizes[i], r.dtype).at[X.local[:, i]].add(r)
+            out = out.at[offs[i] : offs[i + 1]].add(blk)
+    return out
+
+
 def matvec(X: Features, v: jnp.ndarray, precision=None) -> jnp.ndarray:
-    """X @ v for dense [n, F] or PaddedRows; v may also be a matrix [F, H]."""
+    """X @ v for dense [n, F], PaddedRows, or FieldOnehot; v may also be a
+    matrix [F, H]."""
     precision = precision if precision is not None else _DEFAULT_PRECISION
+    if isinstance(X, FieldOnehot):
+        return _fields_matvec(X, v)
     if isinstance(X, PaddedRows):
         L = _SPARSE_LANES
         if L is not None and v.ndim == 1:
@@ -177,8 +395,10 @@ def matvec(X: Features, v: jnp.ndarray, precision=None) -> jnp.ndarray:
 
 
 def rmatvec(X: Features, r: jnp.ndarray, precision=None) -> jnp.ndarray:
-    """X.T @ r (scatter-add for PaddedRows); r is [n] or [n, H]."""
+    """X.T @ r (scatter-add for PaddedRows/FieldOnehot); r is [n] or [n, H]."""
     precision = precision if precision is not None else _DEFAULT_PRECISION
+    if isinstance(X, FieldOnehot):
+        return _fields_rmatvec(X, r)
     if isinstance(X, PaddedRows):
         L = _SPARSE_LANES
         if L is not None and r.ndim == 1:

@@ -159,7 +159,8 @@ def _setup_run(
             else _auto_mesh(layout.n_workers if faithful else layout.n_partitions)
         )
     data = shard_run_data(
-        dataset, layout, mesh, faithful=faithful, dtype=jnp.dtype(cfg.dtype)
+        dataset, layout, mesh, faithful=faithful, dtype=jnp.dtype(cfg.dtype),
+        sparse_format=cfg.sparse_format,
     )
     params0 = _init_params_f32(cfg, model, dataset.n_features)
     state0 = optimizer.init_state(params0, cfg.update_rule)

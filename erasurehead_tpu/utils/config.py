@@ -149,6 +149,12 @@ class RunConfig:
     sparse_lanes: Optional[int] = None
     # per-round collection deadline in simulated seconds (scheme="deadline")
     deadline: Optional[float] = None
+    # sparse training-stack representation (ops/features.py):
+    #   "padded" — generic PaddedRows gather/scatter (default);
+    #   "fields" — FieldOnehot fused pair-table lowering (requires
+    #              exactly-one-hot-per-field data; errors otherwise);
+    #   "auto"   — FieldOnehot when the data's structure allows, else padded.
+    sparse_format: str = "padded"
 
     @classmethod
     def for_dataset(cls, dataset: str, **overrides) -> "RunConfig":
@@ -180,6 +186,21 @@ class RunConfig:
         from erasurehead_tpu.ops.features import validate_lanes
 
         self.sparse_lanes = validate_lanes(self.sparse_lanes)
+        if self.sparse_format not in ("padded", "fields", "auto"):
+            raise ValueError(
+                f"sparse_format must be padded/fields/auto, got "
+                f"{self.sparse_format!r}"
+            )
+        if self.sparse_format == "auto" and self.sparse_lanes is not None:
+            # an explicit lane request pins the PaddedRows lowering: "auto"
+            # resolving to FieldOnehot would silently ignore the lanes and
+            # misattribute any lane-width measurement
+            self.sparse_format = "padded"
+        if self.sparse_format == "fields" and self.sparse_lanes is not None:
+            raise ValueError(
+                "sparse_lanes applies to the PaddedRows lowering only; "
+                "sparse_format='fields' uses pair tables instead"
+            )
         if self.num_collect is None:
             self.num_collect = self.n_workers
         if self.dataset not in DATASET_PRESETS:

@@ -1,0 +1,313 @@
+"""FieldOnehot: the fused pair-table lowering for one-hot field-structured
+sparse data (ops/features.py) — the structure of the reference's real
+workloads (src/arrange_real_data.py:145-205 covtype one-hot binning,
+:34-91 amazon one-hot interactions).
+
+Pins: structure inference, pair/single planning under the table cap,
+matvec/rmatvec equality against dense for vector and matrix operands, the
+sharding integration, and end-to-end trainer equality against the
+PaddedRows path in both compute modes.
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+import jax.numpy as jnp
+
+from erasurehead_tpu.ops import features
+from erasurehead_tpu.ops.features import (
+    FieldOnehot,
+    PaddedRows,
+    _greedy_pairing,
+    infer_field_sizes,
+    matvec,
+    rmatvec,
+)
+
+
+def _onehot_csr(n, sizes, seed=0, values=None):
+    """Random exactly-one-hot-per-field CSR with the given block sizes."""
+    rng = np.random.default_rng(seed)
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    local = np.stack(
+        [rng.integers(0, b, n) for b in sizes], axis=1
+    ).astype(np.int64)
+    cols = (local + offs[:-1][None, :]).reshape(-1)
+    rows = np.repeat(np.arange(n), len(sizes))
+    data = np.ones(cols.size, np.float32) if values is None else values
+    return sps.csr_matrix(
+        (data, (rows, cols)), shape=(n, int(offs[-1]))
+    )
+
+
+class TestInference:
+    def test_infers_block_sizes(self):
+        csr = _onehot_csr(64, (5, 1, 9, 3))
+        sizes = infer_field_sizes(csr)
+        assert sizes is not None and len(sizes) == 4
+        # observed blocks tile [0, max_col]; each inferred block is no
+        # wider than the true one and the representation round-trips
+        fo = FieldOnehot.from_scipy(csr, field_sizes=sizes)
+        np.testing.assert_array_equal(
+            np.asarray(fo.to_dense()), csr.toarray()
+        )
+
+    def test_rejects_nonuniform_rows(self):
+        csr = _onehot_csr(16, (4, 4))
+        csr.data[0] = 0.0
+        csr.eliminate_zeros()  # row 0 loses an entry
+        assert infer_field_sizes(csr) is None
+
+    def test_rejects_non_unit_values(self):
+        csr = _onehot_csr(16, (4, 4))
+        csr.data[3] = 2.0
+        assert infer_field_sizes(csr) is None
+
+    def test_rejects_overlapping_blocks(self):
+        # two "fields" drawing from the same column range
+        rng = np.random.default_rng(0)
+        n, B = 32, 6
+        c1, c2 = rng.integers(0, B, n), rng.integers(0, B, n)
+        c2 = np.where(c2 == c1, (c2 + 1) % B, c2)  # keep entries distinct
+        rows = np.repeat(np.arange(n), 2)
+        cols = np.stack([c1, c2], 1).reshape(-1)
+        csr = sps.csr_matrix(
+            (np.ones(2 * n, np.float32), (rows, cols)), shape=(n, B)
+        )
+        assert infer_field_sizes(csr) is None
+
+    def test_from_scipy_raises_on_unstructured(self):
+        rng = np.random.default_rng(1)
+        csr = sps.random(
+            32, 40, density=0.1, format="csr", random_state=np.random.RandomState(1)
+        )
+        with pytest.raises(ValueError):
+            FieldOnehot.from_scipy(csr)
+
+
+class TestPairing:
+    def test_pairs_small_fields(self):
+        plan = _greedy_pairing((4, 4, 4, 4))
+        assert plan == (("pair", 0, 1), ("pair", 2, 3))
+
+    def test_odd_field_count_leaves_a_single(self):
+        plan = _greedy_pairing((4, 4, 4))
+        assert plan == (("pair", 0, 1), ("single", 2))
+
+    def test_cap_forces_singles(self):
+        big = int(np.sqrt(features.PAIR_TABLE_CAP)) + 1
+        # adjacent oversized pair splits; the greedy plan may still fuse a
+        # big field with a small neighbor (big*4 fits the cap)
+        plan = _greedy_pairing((big, big, 4, 4))
+        assert plan == (("single", 0), ("pair", 1, 2), ("single", 3))
+        assert _greedy_pairing((big, big)) == (("single", 0), ("single", 1))
+
+    def test_every_field_covered_once_and_cap_respected(self):
+        rng = np.random.default_rng(2)
+        for _ in range(20):
+            sizes = tuple(int(s) for s in rng.integers(1, 3000, rng.integers(1, 9)))
+            seen = []
+            for e in _greedy_pairing(sizes):
+                seen.extend(e[1:])
+                if e[0] == "pair":
+                    assert sizes[e[1]] * sizes[e[2]] <= features.PAIR_TABLE_CAP
+            assert sorted(seen) == list(range(len(sizes)))
+
+
+class TestOps:
+    @pytest.mark.parametrize(
+        "sizes", [(7, 3, 5, 1, 8, 2), (4, 4, 4), (11,), (1, 1, 6000, 5)]
+    )
+    def test_matvec_rmatvec_match_dense(self, sizes):
+        n = 48
+        csr = _onehot_csr(n, sizes, seed=3)
+        fo = FieldOnehot.from_scipy(csr)
+        dense = jnp.asarray(csr.toarray())
+        rng = np.random.default_rng(4)
+        v = jnp.asarray(rng.standard_normal(csr.shape[1]).astype(np.float32))
+        r = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(matvec(fo, v)), np.asarray(matvec(dense, v)),
+            rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(rmatvec(fo, r)), np.asarray(rmatvec(dense, r)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_matrix_operands(self):
+        sizes = (5, 3, 4)
+        n, H = 32, 6
+        csr = _onehot_csr(n, sizes, seed=5)
+        fo = FieldOnehot.from_scipy(csr)
+        dense = jnp.asarray(csr.toarray())
+        rng = np.random.default_rng(6)
+        V = jnp.asarray(
+            rng.standard_normal((csr.shape[1], H)).astype(np.float32)
+        )
+        R = jnp.asarray(rng.standard_normal((n, H)).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(matvec(fo, V)), np.asarray(matvec(dense, V)),
+            rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(rmatvec(fo, R)), np.asarray(rmatvec(dense, R)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_matches_padded_rows(self):
+        sizes = (9, 2, 6)
+        csr = _onehot_csr(40, sizes, seed=7)
+        fo = FieldOnehot.from_scipy(csr)
+        pr = PaddedRows.from_scipy(csr)
+        rng = np.random.default_rng(8)
+        v = jnp.asarray(rng.standard_normal(csr.shape[1]).astype(np.float32))
+        r = jnp.asarray(rng.standard_normal(40).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(matvec(fo, v)), np.asarray(matvec(pr, v)),
+            rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(rmatvec(fo, r)), np.asarray(rmatvec(pr, r)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_pytree_roundtrip_and_vmap(self):
+        import jax
+
+        sizes = (4, 6)
+        csr = _onehot_csr(24, sizes, seed=9)
+        fo = FieldOnehot.from_scipy(csr)
+        leaves, treedef = jax.tree.flatten(fo)
+        fo2 = jax.tree.unflatten(treedef, leaves)
+        assert fo2.field_sizes == fo.field_sizes
+        # batched leaves + vmap'd matvec: the trainer's per-slot pattern
+        batched = FieldOnehot(
+            jnp.stack([fo.local, fo.local]), fo.field_sizes, fo.n_cols
+        )
+        v = jnp.ones(fo.n_cols, jnp.float32)
+        out = jax.vmap(lambda X: matvec(X, v))(batched)
+        np.testing.assert_allclose(
+            np.asarray(out[0]), np.asarray(matvec(fo, v)), rtol=1e-6
+        )
+
+
+class TestTrainingIntegration:
+    def _cfg(self, **kw):
+        from erasurehead_tpu.utils.config import RunConfig
+
+        base = dict(
+            scheme="approx",
+            n_workers=6,
+            n_stragglers=1,
+            num_collect=4,
+            rounds=6,
+            dataset="artificial",
+            update_rule="AGD",
+            add_delay=True,
+            seed=0,
+        )
+        base.update(kw)
+        return RunConfig(**base)
+
+    def _data(self, n_parts=6):
+        from erasurehead_tpu.data.synthetic import generate_onehot
+
+        return generate_onehot(240, 60, n_parts, n_fields=6, seed=0)
+
+    @pytest.mark.parametrize("mode", ["faithful", "deduped"])
+    def test_fields_matches_padded_trajectory(self, mode):
+        from erasurehead_tpu.train import trainer
+
+        ds = self._data()
+        n, c = ds.X_train.shape
+        pad = trainer.train(
+            self._cfg(compute_mode=mode, n_rows=n, n_cols=c), ds
+        )
+        fld = trainer.train(
+            self._cfg(
+                compute_mode=mode, n_rows=n, n_cols=c,
+                sparse_format="fields",
+            ),
+            ds,
+        )
+        np.testing.assert_allclose(
+            np.asarray(pad.params_history[-1]),
+            np.asarray(fld.params_history[-1]),
+            rtol=2e-4, atol=2e-5,
+        )
+
+    def test_auto_falls_back_on_unstructured(self):
+        from erasurehead_tpu.data import sharding
+        from erasurehead_tpu.data.synthetic import Dataset
+
+        rng = np.random.default_rng(10)
+        X = sps.random(
+            48, 30, density=0.2, format="csr",
+            random_state=np.random.RandomState(10),
+        )
+        ds = Dataset(
+            X_train=X,
+            y_train=np.sign(rng.standard_normal(48)).astype(np.float32),
+            X_test=X[:8],
+            y_test=np.ones(8, np.float32),
+        )
+        Xp, _ = sharding.partition_stack(ds, 4, sparse_format="auto")
+        assert isinstance(Xp, PaddedRows)
+        with pytest.raises(ValueError, match="one-hot"):
+            sharding.partition_stack(ds, 4, sparse_format="fields")
+
+    def test_fields_selected_for_onehot(self):
+        from erasurehead_tpu.data import sharding
+
+        ds = self._data()
+        Xp, _ = sharding.partition_stack(ds, 6, sparse_format="auto")
+        assert isinstance(Xp, FieldOnehot)
+        assert Xp.local.shape[0] == 6  # partition-major leading dim
+
+    def test_fields_on_dense_data_rejected(self):
+        from erasurehead_tpu.data import sharding
+        from erasurehead_tpu.data.synthetic import generate_gmm
+
+        ds = generate_gmm(64, 8, 4, seed=0)  # dense features
+        with pytest.raises(ValueError, match="dense"):
+            sharding.partition_stack(ds, 4, sparse_format="fields")
+        Xp, _ = sharding.partition_stack(ds, 4, sparse_format="auto")
+        assert isinstance(Xp, np.ndarray)
+
+    def test_scatter_cap_tighter_than_gather_cap(self):
+        # a pair whose table fits the gather budget but not the per-slot
+        # scatter budget: fused on the margin side, per-field on the
+        # gradient side (ops/features.py cap rationale)
+        sizes = (2048, 1200)
+        assert sizes[0] * sizes[1] <= features.PAIR_TABLE_CAP
+        assert sizes[0] * sizes[1] > features.PAIR_SCATTER_CAP
+        assert _greedy_pairing(sizes)[0][0] == "pair"
+        assert _greedy_pairing(sizes, cap=features.PAIR_SCATTER_CAP) == (
+            ("single", 0),
+            ("single", 1),
+        )
+
+    def test_from_scipy_returns_host_arrays(self):
+        csr = _onehot_csr(16, (4, 4))
+        fo = FieldOnehot.from_scipy(csr)
+        assert isinstance(fo.local, np.ndarray)  # no device round-trip in prep
+
+    def test_lanes_and_fields_conflict(self):
+        with pytest.raises(ValueError, match="sparse_lanes"):
+            self._cfg(sparse_format="fields", sparse_lanes=8)
+
+    def test_auto_with_lanes_resolves_to_padded(self):
+        # lanes pin the PaddedRows lowering — auto must not silently
+        # swallow the lane request by picking FieldOnehot
+        cfg = self._cfg(sparse_format="auto", sparse_lanes=8)
+        assert cfg.sparse_format == "padded"
+        assert cfg.sparse_lanes == 8
+
+    def test_infer_rejects_zero_nnz(self):
+        assert infer_field_sizes(sps.csr_matrix((5, 10))) is None
+
+    def test_bad_format_rejected(self):
+        with pytest.raises(ValueError, match="sparse_format"):
+            self._cfg(sparse_format="pairs")

@@ -42,6 +42,11 @@ for shape in covtype amazon; do
   run "sparse_${shape}_faithful_lanes8"  900 python tools/bench_sparse.py --shape "$shape" --lanes 8
   run "sparse_${shape}_deduped_lanes8"   900 python tools/bench_sparse.py --shape "$shape" --mode deduped --lanes 8
   run "sparse_${shape}_deduped_lanes128" 900 python tools/bench_sparse.py --shape "$shape" --mode deduped --lanes 128
+  # FieldOnehot pair-table lowering (halves the lookup count; amazon's
+  # 5.5k-category fields exceed the pair cap and fall back to singles,
+  # which still drops the value payload)
+  run "sparse_${shape}_faithful_fields"  900 python tools/bench_sparse.py --shape "$shape" --format fields
+  run "sparse_${shape}_deduped_fields"   900 python tools/bench_sparse.py --shape "$shape" --mode deduped --format fields
 done
 
 echo "measurements appended to $OUT" >&2
