@@ -98,21 +98,19 @@ class PaddedRows:
         return out.at[rows, self.indices.reshape(-1)].add(self.values.reshape(-1))
 
 
-# Max entries of one fused pair table (f32: 16 MB). Pairs whose table would
-# exceed this fall back to per-field single gathers — covtype-class
-# cardinalities (~1.3k/field) pair comfortably; amazon-class (~5.5k hashed
-# categories/field) would need 30M-entry tables and stays on singles.
-# Gather side only: the table depends on beta alone, so under the trainer's
-# per-slot vmap XLA hoists ONE copy out of the batch.
-PAIR_TABLE_CAP = 1 << 22
-
-# The scatter side's pair accumulators are per-slot state — a vmapped
-# grad_sum materializes [n_slots, Bi*Bj] before marginalizing, so the cap
-# must budget the batch: 2M entries = 8 MB/slot = ~720 MB transient at the
+# Max entries of one fused pair table, applied to BOTH directions. The
+# binding constraint is the scatter side: pair accumulators are per-slot
+# state, so a vmapped grad_sum materializes [n_slots, Bi*Bj] before
+# marginalizing — 2M entries = 8 MB/slot = ~720 MB transient at the
 # faithful covtype stack's 90 slots (covtype's ~1292^2 = 1.67M fits; the
-# deduped mode's 30 slots cut it to ~240 MB). Oversized pairs scatter
-# per-field instead (same count as PaddedRows but no value multiply).
-PAIR_SCATTER_CAP = 1 << 21
+# deduped mode's 30 slots cut it to ~240 MB). The gather side's tables are
+# beta-only and hoist out of the slot vmap, but jax.grad of the forward
+# matvec (grad_sum_auto, any future model family) turns each gather into
+# exactly the per-slot scatter the budget exists for — one shared cap
+# keeps every differentiation path inside it. Oversized pairs fall back to
+# per-field singles (same lookup count as PaddedRows, no value payload):
+# amazon-class ~5.5k-category fields (30M-entry tables) always do.
+PAIR_TABLE_CAP = 1 << 21
 
 
 def _greedy_pairing(field_sizes, cap=PAIR_TABLE_CAP):
@@ -188,7 +186,9 @@ class FieldOnehot:
         (callers wanting graceful fallback use :func:`infer_field_sizes`
         first).
         """
-        csr = csr.tocsr()
+        # copy before canonicalizing: tocsr() on a CSR returns the same
+        # object, and sum_duplicates would mutate the caller's matrix
+        csr = csr.tocsr().copy()
         csr.sum_duplicates()
         if field_sizes is None:
             field_sizes = infer_field_sizes(csr)
@@ -343,7 +343,7 @@ def _fields_rmatvec(X: "FieldOnehot", r: jnp.ndarray) -> jnp.ndarray:
             out = out.at[offs[k] : offs[k + 1]].add(blk)
         return out
     out = jnp.zeros(X.n_cols, r.dtype)
-    for entry in _greedy_pairing(sizes, cap=PAIR_SCATTER_CAP):
+    for entry in _greedy_pairing(sizes):
         if entry[0] == "pair":
             _, i, j = entry
             code = X.local[:, i] * sizes[j] + X.local[:, j]

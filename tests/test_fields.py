@@ -156,6 +156,27 @@ class TestOps:
             rtol=1e-5, atol=1e-5,
         )
 
+    def test_grad_through_pair_tables_matches_closed_form(self):
+        # grad_sum_auto differentiates THROUGH the fused gather; it must
+        # agree with the hand-written rmatvec gradient
+        from erasurehead_tpu.models.glm import LogisticModel
+
+        sizes = (7, 3, 5, 4)
+        n = 40
+        csr = _onehot_csr(n, sizes, seed=11)
+        fo = FieldOnehot.from_scipy(csr)
+        rng = np.random.default_rng(12)
+        beta = jnp.asarray(
+            rng.standard_normal(csr.shape[1]).astype(np.float32)
+        )
+        y = jnp.asarray(np.sign(rng.standard_normal(n)).astype(np.float32))
+        m = LogisticModel()
+        np.testing.assert_allclose(
+            np.asarray(m.grad_sum(beta, fo, y)),
+            np.asarray(m.grad_sum_auto(beta, fo, y)),
+            rtol=1e-5, atol=1e-5,
+        )
+
     def test_matches_padded_rows(self):
         sizes = (9, 2, 6)
         csr = _onehot_csr(40, sizes, seed=7)
@@ -276,23 +297,32 @@ class TestTrainingIntegration:
         Xp, _ = sharding.partition_stack(ds, 4, sparse_format="auto")
         assert isinstance(Xp, np.ndarray)
 
-    def test_scatter_cap_tighter_than_gather_cap(self):
-        # a pair whose table fits the gather budget but not the per-slot
-        # scatter budget: fused on the margin side, per-field on the
-        # gradient side (ops/features.py cap rationale)
+    def test_one_cap_governs_both_directions(self):
+        # the shared cap budgets the per-slot scatter accumulators that
+        # BOTH the hand-written rmatvec and jax.grad of the forward matvec
+        # materialize (ops/features.py cap rationale): a pair over the cap
+        # must go single in the matvec plan too
         sizes = (2048, 1200)
-        assert sizes[0] * sizes[1] <= features.PAIR_TABLE_CAP
-        assert sizes[0] * sizes[1] > features.PAIR_SCATTER_CAP
-        assert _greedy_pairing(sizes)[0][0] == "pair"
-        assert _greedy_pairing(sizes, cap=features.PAIR_SCATTER_CAP) == (
-            ("single", 0),
-            ("single", 1),
-        )
+        assert sizes[0] * sizes[1] > features.PAIR_TABLE_CAP
+        assert _greedy_pairing(sizes) == (("single", 0), ("single", 1))
+        # covtype-class fields stay fused
+        assert _greedy_pairing((1292, 1292))[0][0] == "pair"
 
     def test_from_scipy_returns_host_arrays(self):
         csr = _onehot_csr(16, (4, 4))
         fo = FieldOnehot.from_scipy(csr)
         assert isinstance(fo.local, np.ndarray)  # no device round-trip in prep
+
+    def test_from_scipy_does_not_mutate_caller(self):
+        # two 0.5 entries at one position: canonicalization must happen on
+        # a copy, not the caller's matrix
+        rows = np.array([0, 0, 0, 1, 1])
+        cols = np.array([1, 1, 3, 0, 2])
+        data = np.array([0.5, 0.5, 1.0, 1.0, 1.0], np.float32)
+        csr = sps.csr_matrix((data, (rows, cols)), shape=(2, 4))
+        nnz_before = csr.nnz
+        FieldOnehot.from_scipy(csr, field_sizes=(2, 2))
+        assert csr.nnz == nnz_before
 
     def test_lanes_and_fields_conflict(self):
         with pytest.raises(ValueError, match="sparse_lanes"):
