@@ -147,6 +147,10 @@ def _flags_parser() -> argparse.ArgumentParser:
                    choices=["padded", "fields", "auto"],
                    help="sparse stack representation: fields = FieldOnehot "
                         "fused pair-table lowering (one-hot data only)")
+    p.add_argument("--seq-shards", type=int, default=1,
+                   help="sequence-parallel shards for the attention model: "
+                        ">1 builds a 2-D (workers, seq) mesh and runs ring "
+                        "attention over the seq axis")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--checkpoint-dir", default=None,
                    help="save optimizer state here every --checkpoint-every "
@@ -209,6 +213,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         arrival_mode=ns.arrival_mode,
         sparse_lanes=ns.sparse_lanes,
         sparse_format=ns.sparse_format,
+        seq_shards=ns.seq_shards,
         seed=ns.seed,
     )
 

@@ -10,27 +10,53 @@ additive over row shards like every other model here.
 Each data row is a sequence: the flat feature vector [F] reshapes to
 [T, D] with T = F // d_in tokens (no change to the Dataset/sharding layers;
 the reference's row-sharded DP carries over unchanged). DP shards rows
-across workers; when a single sequence must span chips instead, the
-attention inside is exactly what parallel/ring.py's ring/Ulysses primitives
-shard — composing SP with this DP is the documented scale-out path.
+across workers; ``seq_axis`` composes SP with that DP on a 2-D mesh
+(parallel/mesh.worker_seq_mesh): each seq member takes its token slice of
+the locally-sharded rows, attention runs as ring attention around the seq
+axis (lax.ppermute under lax.scan, parallel/ring.py), the mean pool psums
+partial token sums, and gradients psum over seq. The SPMD gradient trick:
+the per-member loss is scaled by 1/axis_size, so after the seq psum BOTH
+replicated-path leaves (head weights, which every member computes in full
+from the psum'd pooled activations) and partitioned-path leaves (embed/
+q/k/v, which each member touches only through its token slice) come out
+exactly right — pinned against the single-device oracle in tests/test_ring.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from erasurehead_tpu.models.glm import MarginClassifierBase
 from erasurehead_tpu.ops.features import FieldOnehot, PaddedRows
-from erasurehead_tpu.parallel.ring import reference_attention
+from erasurehead_tpu.parallel.ring import (
+    reference_attention,
+    ring_attention_shard,
+)
 
 
 class AttentionModel(MarginClassifierBase):
     name = "attention"
 
-    def __init__(self, d_in: int = 8, d_model: int = 16):
+    def __init__(
+        self, d_in: int = 8, d_model: int = 16, seq_axis: str | None = None
+    ):
         self.d_in = d_in
         self.d_model = d_model
+        # when set, predict/grad_sum must run inside a shard_map whose mesh
+        # carries this axis (the trainer's for_mesh hook arranges it)
+        self.seq_axis = seq_axis
+
+    def for_mesh(self, mesh):
+        """Trainer hook: a sequence-parallel copy when the mesh has a seq
+        axis, self otherwise (train/trainer.py applies this to the model
+        used for step construction only — eval replay stays unsharded)."""
+        from erasurehead_tpu.parallel.ring import SEQ_AXIS
+
+        if SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1:
+            return AttentionModel(self.d_in, self.d_model, seq_axis=SEQ_AXIS)
+        return self
 
     def init_params(self, key: jax.Array, n_features: int):
         if n_features % self.d_in:
@@ -59,7 +85,10 @@ class AttentionModel(MarginClassifierBase):
             )
         Xd = jnp.asarray(X).astype(jnp.float32)
         n, F = Xd.shape
-        tokens = Xd.reshape(n, F // self.d_in, self.d_in)
+        T = F // self.d_in
+        tokens = Xd.reshape(n, T, self.d_in)
+        if self.seq_axis is not None:
+            return self._predict_seq(params, tokens, T)
         h = tokens @ params["embed"]  # [n, T, m]
 
         def attend(hseq):
@@ -73,3 +102,48 @@ class AttentionModel(MarginClassifierBase):
         a = jax.vmap(attend)(h)  # [n, T, m]
         pooled = (h + a).mean(axis=1)  # residual + mean pool, [n, m]
         return pooled @ params["w_out"] + params["b_out"]
+
+    def _predict_seq(self, params, tokens, T):
+        """Sequence-parallel forward: this seq member embeds and projects
+        only its token slice; ring attention supplies the full-sequence
+        context; the pooled activations psum over the axis (identical
+        margins on every member)."""
+        ax = self.seq_axis
+        s = lax.axis_size(ax)
+        if T % s:
+            raise ValueError(
+                f"T={T} tokens must divide over {s} sequence shards"
+            )
+        Tl = T // s
+        i = lax.axis_index(ax)
+        tok_l = lax.dynamic_slice_in_dim(tokens, i * Tl, Tl, axis=1)
+        h_l = tok_l @ params["embed"]  # [n, Tl, m]
+        q = h_l @ params["wq"]
+        k = h_l @ params["wk"]
+        v = h_l @ params["wv"]
+        a_l = jax.vmap(
+            lambda qr, kr, vr: ring_attention_shard(qr, kr, vr, axis_name=ax)
+        )(q, k, v)  # [n, Tl, m]
+        pooled = lax.psum((h_l + a_l).sum(axis=1), ax) / T  # [n, m]
+        return pooled @ params["w_out"] + params["b_out"]
+
+    # loss_sum stays the PLAIN unscaled sum (MarginClassifierBase): the
+    # sharded step differentiates it directly (step._weighted_loss_grad)
+    # and shard_map's vma rules alone produce exact gradients — invariant
+    # head-param cotangents need no reduction, seq-varying embed/qkv
+    # cotangents get the implicit replicated-param psum.
+
+    def grad_sum(self, params, X, y):
+        """Plain gradient (host/oracle use). Standalone inside a seq-axis
+        shard_map the recipe is scale-the-loss-by-1/axis_size then psum:
+        replicated-path leaves (head) arrive full-per-member and the psum
+        undoes the scaling; partitioned-path leaves (embed/qkv) arrive as
+        member slices and the psum assembles them — pinned against the
+        unsharded oracle in tests/test_ring.py."""
+        if self.seq_axis is None:
+            return jax.grad(self.loss_sum)(params, X, y)
+        ax = self.seq_axis
+        scaled = lambda p: self.loss_sum(p, X, y) / lax.axis_size(ax)
+        return lax.psum(jax.grad(scaled)(params), ax)
+
+    grad_sum_auto = grad_sum

@@ -56,7 +56,16 @@ class MarginClassifierBase:
     """Shared logistic-margin loss machinery for non-GLM classifier
     families (MLP, attention): softplus loss on ``predict``'s margin and
     jax.grad gradients. One home so the loss definition cannot diverge
-    across model families."""
+    across model families.
+
+    ``grads_via_loss``: under the sharded step these models' gradients are
+    taken as ONE jax.grad of the weighted scalar loss per device — jax.grad
+    w.r.t. replicated params inside shard_map implicitly psums cotangents
+    across the mesh, so per-slot grad calls there would double-count (see
+    parallel/step._grads_via_loss). ``grad_sum`` itself remains the plain
+    unsharded gradient for host/oracle use."""
+
+    grads_via_loss = True
 
     def loss_sum(self, params, X, y):
         return jnp.sum(jax.nn.softplus(-y * self.predict(params, X)))

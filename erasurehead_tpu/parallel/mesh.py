@@ -36,8 +36,33 @@ def worker_mesh(
     return Mesh(np.asarray(devs), (WORKER_AXIS,))
 
 
+def worker_seq_mesh(
+    seq_shards: int,
+    workers_devices: int,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """2-D mesh (workers, seq): coded-DP over dim 0 composed with sequence
+    parallelism over dim 1 (parallel/ring.py's axis). Row stacks shard over
+    ``workers`` and replicate over ``seq``; a sequence-parallel model (the
+    attention family's ``seq_axis`` mode) splits each row's token axis over
+    ``seq``, runs ring attention around it, and psums its gradients over it.
+    """
+    from erasurehead_tpu.parallel.ring import SEQ_AXIS
+
+    devs = list(devices if devices is not None else jax.devices())
+    need = workers_devices * seq_shards
+    if need > len(devs):
+        raise ValueError(
+            f"mesh {workers_devices}x{seq_shards} needs {need} devices, "
+            f"have {len(devs)}"
+        )
+    grid = np.asarray(devs[:need]).reshape(workers_devices, seq_shards)
+    return Mesh(grid, (WORKER_AXIS, SEQ_AXIS))
+
+
 def worker_sharding(mesh: Mesh) -> NamedSharding:
-    """Shard dim 0 (the worker / partition axis) across the mesh."""
+    """Shard dim 0 (the worker / partition axis) across the mesh's worker
+    axis; any other mesh axes (seq) replicate."""
     return NamedSharding(mesh, P(WORKER_AXIS))
 
 
@@ -46,9 +71,14 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def check_divisible(n: int, mesh: Mesh, what: str) -> None:
-    d = mesh.devices.size
+    # the sharded axis is WORKER_AXIS; other axes (seq) replicate the data
+    d = (
+        mesh.shape[WORKER_AXIS]
+        if WORKER_AXIS in mesh.axis_names
+        else mesh.devices.size
+    )
     if n % d:
         raise ValueError(
-            f"{what}={n} must be divisible by the mesh's {d} devices; "
-            f"pick n_workers as a multiple of the device count"
+            f"{what}={n} must be divisible by the mesh's {d} worker-axis "
+            f"devices; pick n_workers as a multiple of the device count"
         )

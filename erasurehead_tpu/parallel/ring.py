@@ -96,15 +96,14 @@ def ring_attention_shard(
         return (acc, m, l, k_buf, v_buf), None
 
     # initial accumulators are constants, but every later carry value varies
-    # across the mesh (it depends on axis_index) — mark them varying so the
-    # scan carry type is stable under shard_map's vma checking
-    acc0 = lax.pcast(
-        jnp.zeros((Tq, d), jnp.float32), axis_name, to="varying"
-    )
-    m0 = lax.pcast(
-        jnp.full((Tq,), _NEG_INF, jnp.float32), axis_name, to="varying"
-    )
-    l0 = lax.pcast(jnp.zeros((Tq,), jnp.float32), axis_name, to="varying")
+    # across the mesh (it depends on axis_index and on q/k/v). Deriving the
+    # zeros from q makes them inherit q's exact varying-axes set, keeping
+    # the scan carry type stable under shard_map's vma checking on ANY
+    # enclosing mesh — a seq-only mesh here, or the trainer's 2-D
+    # (workers, seq) mesh where the data varies over both axes.
+    acc0 = q * 0.0  # [Tq, d] f32 (q was upcast above)
+    m0 = q[:, 0] * 0.0 + _NEG_INF
+    l0 = q[:, 0] * 0.0
     (acc, m, l, _, _), _ = lax.scan(
         step, (acc0, m0, l0, k, v), jnp.arange(n)
     )

@@ -53,6 +53,35 @@ def _weighted_tree_sum(weights: jnp.ndarray, grads: Any, contract: str) -> Any:
     )
 
 
+def _grads_via_loss(model) -> bool:
+    """Autodiff models (MLP/attention — MarginClassifierBase) must NOT have
+    per-slot jax.grad calls under the shard_map: differentiating w.r.t. the
+    replicated params implicitly psums cotangents across the mesh (the vma
+    rule that a replicated primal's cotangent is the mesh-wide sum), so the
+    per-slot-grads + weighted-contraction + explicit-psum pipeline the
+    closed-form GLMs use would double-count — and under vmap the implicit
+    psum runs per slot POSITION, silently mixing different workers' slots.
+    These models instead expose the weighted scalar loss and take ONE
+    jax.grad per device, letting the implicit psum produce the global
+    decoded gradient directly (no explicit psum)."""
+    return getattr(model, "grads_via_loss", False)
+
+
+def _weighted_loss_grad(model, params, Xs, ys, ws, contract: str):
+    """grad of sum_slots w_slot * loss(params, X_slot, y_slot) over THIS
+    device's slots; the implicit replicated-param psum makes the result the
+    mesh-global decoded gradient, replicated."""
+    nvmap = len(contract)  # "ws" = [Wl, S, ...] stacks, "p" = [Pl, ...]
+
+    def L(p):
+        per = model.loss_sum
+        for _ in range(nvmap):
+            per = jax.vmap(per, in_axes=(None, 0, 0))
+        return jnp.sum(ws.astype(jnp.float32) * per(p, Xs, ys))
+
+    return jax.grad(L)(params)
+
+
 def make_faithful_grad_fn(model, mesh: Mesh) -> GradFn:
     """Every logical worker computes all of its (redundant) slot gradients.
 
@@ -69,6 +98,8 @@ def make_faithful_grad_fn(model, mesh: Mesh) -> GradFn:
     """
 
     def local(params, Xw, yw, slot_weights):
+        if _grads_via_loss(model):
+            return _weighted_loss_grad(model, params, Xw, yw, slot_weights, "ws")
         per_slot = jax.vmap(
             jax.vmap(lambda X, y: model.grad_sum(params, X, y))
         )(Xw, yw)  # leaves [Wl, S, ...]
@@ -98,6 +129,8 @@ def make_deduped_grad_fn(model, mesh: Mesh) -> GradFn:
     """
 
     def local(params, Xp, yp, part_weights):
+        if _grads_via_loss(model):
+            return _weighted_loss_grad(model, params, Xp, yp, part_weights, "p")
         per_part = jax.vmap(lambda X, y: model.grad_sum(params, X, y))(Xp, yp)
         g = _weighted_tree_sum(part_weights, per_part, "p")
         return lax.psum(g, WORKER_AXIS)

@@ -95,6 +95,21 @@ def _auto_mesh(need: int):
     return worker_mesh(max(d for d in range(1, avail + 1) if need % d == 0))
 
 
+def _auto_seq_mesh(need: int, seq_shards: int):
+    """2-D (workers, seq) mesh: seq_shards devices per sequence group, the
+    worker dim the largest divisor of ``need`` that fits the rest."""
+    from erasurehead_tpu.parallel.mesh import worker_seq_mesh
+
+    avail = len(jax.devices())
+    if seq_shards > avail:
+        raise ValueError(
+            f"seq_shards={seq_shards} exceeds the {avail} available devices"
+        )
+    per_seq = avail // seq_shards
+    wd = max(d for d in range(1, per_seq + 1) if need % d == 0)
+    return worker_seq_mesh(seq_shards, wd)
+
+
 def _init_params_f32(cfg: RunConfig, model, n_features: int):
     p = model.init_params(jax.random.key(cfg.seed), n_features)
     return jax.tree.map(lambda x: x.astype(jnp.float32), p)
@@ -153,11 +168,33 @@ def _setup_run(
     layout = build_layout(cfg)
     model = build_model(cfg)
     if mesh is None:
-        mesh = (
-            worker_mesh(1)  # per-worker dispatches do their own placement
-            if single_device
-            else _auto_mesh(layout.n_workers if faithful else layout.n_partitions)
-        )
+        need = layout.n_workers if faithful else layout.n_partitions
+        if single_device:
+            mesh = worker_mesh(1)  # per-worker dispatches place themselves
+        elif cfg.seq_shards > 1:
+            mesh = _auto_seq_mesh(need, cfg.seq_shards)
+        else:
+            mesh = _auto_mesh(need)
+    if cfg.seq_shards > 1 and not single_device:
+        # an explicit mesh must actually carry the requested seq axis —
+        # SP is parity-preserving, so silently running without it would
+        # LOOK right while testing nothing
+        from erasurehead_tpu.parallel.ring import SEQ_AXIS
+
+        if (
+            SEQ_AXIS not in mesh.axis_names
+            or mesh.shape[SEQ_AXIS] != cfg.seq_shards
+        ):
+            raise ValueError(
+                f"seq_shards={cfg.seq_shards} but the mesh axes are "
+                f"{dict(mesh.shape)}; pass mesh=None (auto) or a "
+                f"worker_seq_mesh with a matching '{SEQ_AXIS}' axis"
+            )
+    # sequence-parallel models swap themselves in when the mesh carries a
+    # seq axis (models/attention.for_mesh); eval replay builds its own
+    # unsharded model from the config, so this scopes to step construction
+    if hasattr(model, "for_mesh"):
+        model = model.for_mesh(mesh)
     data = shard_run_data(
         dataset, layout, mesh, faithful=faithful, dtype=jnp.dtype(cfg.dtype),
         sparse_format=cfg.sparse_format,

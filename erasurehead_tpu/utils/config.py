@@ -149,6 +149,11 @@ class RunConfig:
     sparse_lanes: Optional[int] = None
     # per-round collection deadline in simulated seconds (scheme="deadline")
     deadline: Optional[float] = None
+    # sequence-parallel shards for the attention family: >1 builds a 2-D
+    # (workers, seq) mesh; each row's token axis splits over seq and
+    # attention runs as ring attention around it (parallel/ring.py,
+    # models/attention._predict_seq)
+    seq_shards: int = 1
     # sparse training-stack representation (ops/features.py):
     #   "padded" — generic PaddedRows gather/scatter (default);
     #   "fields" — FieldOnehot fused pair-table lowering (requires
@@ -186,6 +191,20 @@ class RunConfig:
         from erasurehead_tpu.ops.features import validate_lanes
 
         self.sparse_lanes = validate_lanes(self.sparse_lanes)
+        if self.seq_shards < 1:
+            raise ValueError(f"seq_shards must be >= 1, got {self.seq_shards}")
+        if self.seq_shards > 1:
+            if self.model != ModelKind.ATTENTION:
+                raise ValueError(
+                    "seq_shards > 1 requires model='attention' (the only "
+                    "family with a sequence axis to shard)"
+                )
+            if self.arrival_mode != "simulated":
+                raise ValueError(
+                    "seq_shards > 1 runs under the simulated-arrival "
+                    "trainer only (measured mode dispatches per-worker on "
+                    "single devices)"
+                )
         if self.sparse_format not in ("padded", "fields", "auto"):
             raise ValueError(
                 f"sparse_format must be padded/fields/auto, got "

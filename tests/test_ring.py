@@ -132,3 +132,118 @@ def test_ring_preserves_input_dtype(qkv):
         np.asarray(out, np.float32), np.asarray(want, np.float32),
         rtol=0.05, atol=0.05,
     )
+
+
+class TestSeqParallelComposition:
+    """SP x DP: the attention family trained on a 2-D (workers, seq) mesh
+    with its token axis sharded over seq (models/attention._predict_seq,
+    trainer seq_shards)."""
+
+    def _cfg(self, seq_shards, **kw):
+        from erasurehead_tpu.utils.config import RunConfig
+
+        base = dict(
+            scheme="approx",
+            model="attention",
+            n_workers=4,
+            n_stragglers=1,
+            num_collect=3,
+            rounds=5,
+            n_rows=192,
+            n_cols=64,  # d_in=8 -> T=8 tokens, divisible by 2 and 4 shards
+            dataset="artificial",
+            update_rule="GD",
+            add_delay=True,
+            seed=0,
+        )
+        base.update(kw)
+        return RunConfig(**base, seq_shards=seq_shards)
+
+    def _data(self):
+        from erasurehead_tpu.data.synthetic import generate_gmm
+
+        return generate_gmm(192, 64, 4, seed=0)
+
+    def test_seq_grad_matches_oracle(self):
+        """grad_sum inside a seq-only shard_map == the unsharded oracle —
+        validating the 1/axis_size loss scaling + seq psum recipe for both
+        replicated-path (head) and partitioned-path (embed/qkv) leaves."""
+        from functools import partial
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from erasurehead_tpu.models.attention import AttentionModel
+
+        n, F = 24, 64
+        key = jax.random.PRNGKey(3)
+        kx, ky, kp = jax.random.split(key, 3)
+        X = jax.random.normal(kx, (n, F), jnp.float32)
+        y = jnp.sign(jax.random.normal(ky, (n,)))
+        oracle_model = AttentionModel()
+        params = oracle_model.init_params(kp, F)
+        want = oracle_model.grad_sum(params, X, y)
+
+        mesh = _seq_mesh(4)
+        sp_model = AttentionModel(seq_axis=ring.SEQ_AXIS)
+        got = shard_map(
+            partial(sp_model.grad_sum),
+            mesh=mesh,
+            in_specs=(P(), P(), P()),
+            out_specs=P(),
+        )(params, X, y)
+        for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+            )
+
+    @pytest.mark.parametrize("seq_shards", [2, 4])
+    def test_training_trajectory_matches_unsharded(self, seq_shards):
+        from erasurehead_tpu.train import trainer
+
+        ds = self._data()
+        base = trainer.train(self._cfg(1), ds)
+        sp = trainer.train(self._cfg(seq_shards), ds)
+        np.testing.assert_allclose(
+            np.asarray(jax.tree.leaves(base.params_history)[0][-1]),
+            np.asarray(jax.tree.leaves(sp.params_history)[0][-1]),
+            rtol=2e-4, atol=2e-5,
+        )
+
+    def test_auto_seq_mesh_shape(self):
+        from erasurehead_tpu.train import trainer
+        from erasurehead_tpu.parallel.mesh import WORKER_AXIS
+
+        mesh = trainer._auto_seq_mesh(4, 2)  # 4 workers, 2 seq shards
+        assert dict(mesh.shape) == {WORKER_AXIS: 4, ring.SEQ_AXIS: 2}
+        mesh = trainer._auto_seq_mesh(4, 4)  # only 2 devices left per seq
+        assert dict(mesh.shape) == {WORKER_AXIS: 2, ring.SEQ_AXIS: 4}
+
+    def test_explicit_mesh_must_match_seq_shards(self):
+        """A worker-only mesh with seq_shards>1 must refuse, not silently
+        run without sequence parallelism (SP is parity-preserving, so the
+        numbers would look right while testing nothing)."""
+        from erasurehead_tpu.parallel.mesh import worker_mesh
+        from erasurehead_tpu.train import trainer
+
+        with pytest.raises(ValueError, match="seq_shards"):
+            trainer.train(self._cfg(2), self._data(), mesh=worker_mesh(4))
+
+    def test_indivisible_tokens_rejected(self):
+        from erasurehead_tpu.train import trainer
+
+        # n_cols=56 -> T=7 tokens, not divisible by 2 seq shards
+        ds_cfg = self._cfg(2, n_cols=56, n_rows=112)
+        from erasurehead_tpu.data.synthetic import generate_gmm
+
+        ds = generate_gmm(112, 56, 4, seed=0)
+        with pytest.raises(ValueError, match="sequence shards"):
+            trainer.train(ds_cfg, ds)
+
+    def test_seq_requires_attention_model(self):
+        with pytest.raises(ValueError, match="attention"):
+            self._cfg(2, model="logistic")
+
+    def test_seq_requires_simulated_arrivals(self):
+        with pytest.raises(ValueError, match="simulated"):
+            self._cfg(2, arrival_mode="measured")

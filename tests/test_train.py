@@ -307,7 +307,11 @@ def test_attention_model_trains_under_agc():
     cfg = RunConfig(
         scheme="approx", model="attention", n_workers=Wa, n_stragglers=1,
         num_collect=3, rounds=20, n_rows=64 * Wa, n_cols=F,
-        lr_schedule=0.5, update_rule="ADAM", add_delay=True, seed=0,
+        # lr 0.1: Adam at 0.5 overshoots with CORRECT sharded grads (the
+        # step's old per-slot jax.grad-under-vmap path silently mixed
+        # workers' slots on multi-device meshes — fixed by
+        # step._weighted_loss_grad, pinned in test_step_grads_* below)
+        lr_schedule=0.1, update_rule="ADAM", add_delay=True, seed=0,
     )
     res = trainer.train(cfg, ds)
     model = AttentionModel()
@@ -369,3 +373,62 @@ def test_ten_thousand_round_run_end_to_end(gmm):
     h = np.asarray(res.params_history)
     assert h.shape[0] == 10_000 and np.isfinite(h).all()
     assert took < 90, took  # ~4.5s measured; huge headroom for loaded CI
+
+
+def test_step_grads_match_oracle_multidevice():
+    """The sharded step's decoded gradient == the host weighted sum of
+    per-slot grads, for BOTH model classes, on multi-device meshes.
+
+    Regression pin for a silent-corruption bug: per-slot jax.grad calls
+    under vmap inside shard_map psum cotangents of the replicated params
+    across the mesh PER SLOT POSITION, so every device got the same mixed
+    gradient (device-0-looking values) — closed-form GLM grads were immune,
+    autodiff models (MLP/attention) trained on wrong directions whenever
+    the worker mesh had >1 device. step._weighted_loss_grad fixes them by
+    differentiating ONE weighted scalar loss per device and letting the
+    implicit replicated-param psum produce the global decoded gradient."""
+    import jax
+    import jax.numpy as jnp
+
+    from erasurehead_tpu.models.attention import AttentionModel
+    from erasurehead_tpu.models.mlp import MLPModel
+    from erasurehead_tpu.parallel import step as step_lib
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+
+    W, S, rows, F = 4, 2, 12, 64
+    key = jax.random.PRNGKey(0)
+    kx, ky, kp, kw = jax.random.split(key, 4)
+    Xw = jax.random.normal(kx, (W, S, rows, F), jnp.float32)
+    yw = jnp.sign(jax.random.normal(ky, (W, S, rows)))
+    wts = jax.random.uniform(kw, (W, S), jnp.float32)
+    for model in (MLPModel(), AttentionModel()):
+        params = model.init_params(kp, F)
+        per = jax.vmap(jax.vmap(lambda X, y: model.grad_sum(params, X, y)))(
+            Xw, yw
+        )
+        want = jax.tree.map(
+            lambda G: jnp.einsum("ws,ws...->...", wts, G), per
+        )
+        for ndev in (1, 2, 4):
+            got = step_lib.make_faithful_grad_fn(model, worker_mesh(ndev))(
+                params, Xw, yw, wts
+            )
+            for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+                    err_msg=f"{model.name} ndev={ndev}",
+                )
+        # deduped path: partition-major stacks, folded weights
+        pw = jax.random.uniform(kw, (W,), jnp.float32)
+        perp = jax.vmap(lambda X, y: model.grad_sum(params, X, y))(
+            Xw[:, 0], yw[:, 0]
+        )
+        wantp = jax.tree.map(lambda G: jnp.einsum("p,p...->...", pw, G), perp)
+        gotp = step_lib.make_deduped_grad_fn(model, worker_mesh(4))(
+            params, Xw[:, 0], yw[:, 0], pw
+        )
+        for a, b in zip(jax.tree.leaves(wantp), jax.tree.leaves(gotp)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+                err_msg=f"{model.name} deduped",
+            )
