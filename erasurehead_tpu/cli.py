@@ -149,8 +149,11 @@ def _flags_parser() -> argparse.ArgumentParser:
                         "fused pair-table lowering (one-hot data only)")
     p.add_argument("--seq-shards", type=int, default=1,
                    help="sequence-parallel shards for the attention model: "
-                        ">1 builds a 2-D (workers, seq) mesh and runs ring "
-                        "attention over the seq axis")
+                        ">1 builds a 2-D (workers, seq) mesh and spans the "
+                        "token axis over it")
+    p.add_argument("--sp-form", default="ring", choices=["ring", "ulysses"],
+                   help="SP form carrying the attention: ppermute ring or "
+                        "all-to-all head sharding")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--checkpoint-dir", default=None,
                    help="save optimizer state here every --checkpoint-every "
@@ -214,6 +217,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         sparse_lanes=ns.sparse_lanes,
         sparse_format=ns.sparse_format,
         seq_shards=ns.seq_shards,
+        sp_form=ns.sp_form,
         seed=ns.seed,
     )
 

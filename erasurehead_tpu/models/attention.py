@@ -12,14 +12,16 @@ Each data row is a sequence: the flat feature vector [F] reshapes to
 the reference's row-sharded DP carries over unchanged). DP shards rows
 across workers; ``seq_axis`` composes SP with that DP on a 2-D mesh
 (parallel/mesh.worker_seq_mesh): each seq member takes its token slice of
-the locally-sharded rows, attention runs as ring attention around the seq
-axis (lax.ppermute under lax.scan, parallel/ring.py), the mean pool psums
-partial token sums, and gradients psum over seq. The SPMD gradient trick:
-the per-member loss is scaled by 1/axis_size, so after the seq psum BOTH
-replicated-path leaves (head weights, which every member computes in full
-from the psum'd pooled activations) and partitioned-path leaves (embed/
-q/k/v, which each member touches only through its token slice) come out
-exactly right — pinned against the single-device oracle in tests/test_ring.
+the locally-sharded rows, attention spans the seq axis in either canonical
+SP form (``sp_form``) — "ring" (K/V rotate via lax.ppermute under
+lax.scan) or "ulysses" (one all_to_all to head-sharded full sequences,
+plain attention per head, one back; needs n_heads % seq_shards == 0) —
+and the mean pool psums partial token sums so margins are identical on
+every member. Gradients under the coded step come from ONE jax.grad of
+the weighted scalar loss per device (parallel/step._weighted_loss_grad):
+shard_map's replicated-param cotangent rules assemble the global decoded
+gradient with no explicit reduction. Everything pinned against the
+single-device oracle in tests/test_ring.py.
 """
 
 from __future__ import annotations
@@ -33,6 +35,7 @@ from erasurehead_tpu.ops.features import FieldOnehot, PaddedRows
 from erasurehead_tpu.parallel.ring import (
     reference_attention,
     ring_attention_shard,
+    ulysses_attention_shard,
 )
 
 
@@ -40,13 +43,24 @@ class AttentionModel(MarginClassifierBase):
     name = "attention"
 
     def __init__(
-        self, d_in: int = 8, d_model: int = 16, seq_axis: str | None = None
+        self,
+        d_in: int = 8,
+        d_model: int = 16,
+        n_heads: int = 2,
+        seq_axis: str | None = None,
+        sp_form: str = "ring",
     ):
+        if d_model % n_heads:
+            raise ValueError(f"{d_model=} must be divisible by {n_heads=}")
+        if sp_form not in ("ring", "ulysses"):
+            raise ValueError(f"sp_form must be ring/ulysses, got {sp_form!r}")
         self.d_in = d_in
         self.d_model = d_model
+        self.n_heads = n_heads
         # when set, predict/grad_sum must run inside a shard_map whose mesh
         # carries this axis (the trainer's for_mesh hook arranges it)
         self.seq_axis = seq_axis
+        self.sp_form = sp_form
 
     def for_mesh(self, mesh):
         """Trainer hook: a sequence-parallel copy when the mesh has a seq
@@ -55,8 +69,20 @@ class AttentionModel(MarginClassifierBase):
         from erasurehead_tpu.parallel.ring import SEQ_AXIS
 
         if SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1:
-            return AttentionModel(self.d_in, self.d_model, seq_axis=SEQ_AXIS)
+            return AttentionModel(
+                self.d_in, self.d_model, self.n_heads,
+                seq_axis=SEQ_AXIS, sp_form=self.sp_form,
+            )
         return self
+
+    def _heads(self, x):
+        """[..., m] -> [..., H, m/H] per-head split (concat-projection
+        convention: wq/wk/wv stay [m, m]; heads are views)."""
+        H = self.n_heads
+        return x.reshape(*x.shape[:-1], H, self.d_model // H)
+
+    def _merge(self, x):
+        return x.reshape(*x.shape[:-2], self.d_model)
 
     def init_params(self, key: jax.Array, n_features: int):
         if n_features % self.d_in:
@@ -92,12 +118,11 @@ class AttentionModel(MarginClassifierBase):
         h = tokens @ params["embed"]  # [n, T, m]
 
         def attend(hseq):
-            q, k, v = (
-                hseq @ params["wq"],
-                hseq @ params["wk"],
-                hseq @ params["wv"],
-            )
-            return reference_attention(q, k, v)
+            q = self._heads(hseq @ params["wq"])  # [T, H, dh]
+            k = self._heads(hseq @ params["wk"])
+            v = self._heads(hseq @ params["wv"])
+            per_head = jax.vmap(reference_attention, in_axes=1, out_axes=1)
+            return self._merge(per_head(q, k, v))
 
         a = jax.vmap(attend)(h)  # [n, T, m]
         pooled = (h + a).mean(axis=1)  # residual + mean pool, [n, m]
@@ -118,13 +143,29 @@ class AttentionModel(MarginClassifierBase):
         i = lax.axis_index(ax)
         tok_l = lax.dynamic_slice_in_dim(tokens, i * Tl, Tl, axis=1)
         h_l = tok_l @ params["embed"]  # [n, Tl, m]
-        q = h_l @ params["wq"]
-        k = h_l @ params["wk"]
-        v = h_l @ params["wv"]
-        a_l = jax.vmap(
-            lambda qr, kr, vr: ring_attention_shard(qr, kr, vr, axis_name=ax)
-        )(q, k, v)  # [n, Tl, m]
-        pooled = lax.psum((h_l + a_l).sum(axis=1), ax) / T  # [n, m]
+        q = self._heads(h_l @ params["wq"])  # [n, Tl, H, dh]
+        k = self._heads(h_l @ params["wk"])
+        v = self._heads(h_l @ params["wv"])
+        if self.sp_form == "ulysses":
+            # one all_to_all to head-sharded full sequences and back
+            # (ulysses_attention_shard validates n_heads % axis_size)
+            a_l = jax.vmap(
+                lambda qr, kr, vr: ulysses_attention_shard(
+                    qr, kr, vr, axis_name=ax
+                )
+            )(q, k, v)  # [n, Tl, H, dh]
+        else:
+            a_l = jax.vmap(
+                jax.vmap(
+                    lambda qr, kr, vr: ring_attention_shard(
+                        qr, kr, vr, axis_name=ax
+                    ),
+                    in_axes=1, out_axes=1,  # per-row [Tl, H, dh]: head axis
+                )
+            )(q, k, v)  # rows x heads around the ring
+        pooled = lax.psum(
+            (h_l + self._merge(a_l)).sum(axis=1), ax
+        ) / T  # [n, m]
         return pooled @ params["w_out"] + params["b_out"]
 
     # loss_sum stays the PLAIN unscaled sum (MarginClassifierBase): the

@@ -83,7 +83,7 @@ def build_model(cfg: RunConfig):
     if cfg.model == ModelKind.ATTENTION:
         from erasurehead_tpu.models.attention import AttentionModel
 
-        return AttentionModel()
+        return AttentionModel(sp_form=cfg.sp_form)
     raise ValueError(f"unknown model {cfg.model}")
 
 

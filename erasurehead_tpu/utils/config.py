@@ -151,9 +151,12 @@ class RunConfig:
     deadline: Optional[float] = None
     # sequence-parallel shards for the attention family: >1 builds a 2-D
     # (workers, seq) mesh; each row's token axis splits over seq and
-    # attention runs as ring attention around it (parallel/ring.py,
-    # models/attention._predict_seq)
+    # attention spans it (parallel/ring.py, models/attention._predict_seq)
     seq_shards: int = 1
+    # which canonical SP form carries the attention: "ring" (ppermute ring,
+    # long-T friendly) or "ulysses" (two all_to_alls, head-sharded; needs
+    # n_heads divisible by seq_shards)
+    sp_form: str = "ring"
     # sparse training-stack representation (ops/features.py):
     #   "padded" — generic PaddedRows gather/scatter (default);
     #   "fields" — FieldOnehot fused pair-table lowering (requires
@@ -193,6 +196,10 @@ class RunConfig:
         self.sparse_lanes = validate_lanes(self.sparse_lanes)
         if self.seq_shards < 1:
             raise ValueError(f"seq_shards must be >= 1, got {self.seq_shards}")
+        if self.sp_form not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sp_form must be ring/ulysses, got {self.sp_form!r}"
+            )
         if self.seq_shards > 1:
             if self.model != ModelKind.ATTENTION:
                 raise ValueError(

@@ -197,18 +197,36 @@ class TestSeqParallelComposition:
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
             )
 
+    @pytest.mark.parametrize("sp_form", ["ring", "ulysses"])
     @pytest.mark.parametrize("seq_shards", [2, 4])
-    def test_training_trajectory_matches_unsharded(self, seq_shards):
+    def test_training_trajectory_matches_unsharded(self, seq_shards, sp_form):
+        """Both canonical SP forms must be exactly parity-preserving under
+        the coded-DP trainer (n_heads=2 default: ulysses at 4 shards would
+        need 4 heads, so skip that cell)."""
         from erasurehead_tpu.train import trainer
 
+        if sp_form == "ulysses" and seq_shards == 4:
+            pytest.skip("default n_heads=2 not divisible by 4 seq shards")
         ds = self._data()
-        base = trainer.train(self._cfg(1), ds)
-        sp = trainer.train(self._cfg(seq_shards), ds)
+        # sp_form is inert at seq_shards=1, so one unsharded baseline
+        # serves every parametrized cell
+        if not hasattr(TestSeqParallelComposition, "_base_cache"):
+            TestSeqParallelComposition._base_cache = trainer.train(
+                self._cfg(1), ds
+            )
+        base = TestSeqParallelComposition._base_cache
+        sp = trainer.train(self._cfg(seq_shards, sp_form=sp_form), ds)
         np.testing.assert_allclose(
             np.asarray(jax.tree.leaves(base.params_history)[0][-1]),
             np.asarray(jax.tree.leaves(sp.params_history)[0][-1]),
             rtol=2e-4, atol=2e-5,
         )
+
+    def test_ulysses_rejects_indivisible_head_count(self):
+        from erasurehead_tpu.train import trainer
+
+        with pytest.raises(ValueError, match="divisible"):
+            trainer.train(self._cfg(4, sp_form="ulysses"), self._data())
 
     def test_auto_seq_mesh_shape(self):
         from erasurehead_tpu.train import trainer
