@@ -58,9 +58,32 @@ _CHILD = textwrap.dedent(
     )
     sres = trainer.train(cfg, sdata, mesh=worker_mesh(4), measure=False)
     shist = np.asarray(sres.params_history)
+
+    # FieldOnehot pair-table stacks under multi-controller put_global
+    import dataclasses
+    fcfg = dataclasses.replace(cfg, sparse_format="fields")
+    fres = trainer.train(fcfg, sdata, mesh=worker_mesh(4), measure=False)
+    fhist = np.asarray(fres.params_history)
+
+    # SP x DP with the seq axis SPANNING the process boundary: a 1x4
+    # (workers, seq) mesh puts ring attention's ppermute hops on the
+    # cross-process link — the DCN analogue of a multi-host pod
+    from erasurehead_tpu.parallel.mesh import worker_seq_mesh
+    acfg = dataclasses.replace(
+        cfg, model="attention", seq_shards=4, n_cols=32,
+        update_rule="GD", lr_schedule=0.1,
+    )
+    adata = generate_gmm(acfg.n_rows, 32, n_partitions=%(W)d, seed=0)
+    ares = trainer.train(
+        acfg, adata, mesh=worker_seq_mesh(4, 1), measure=False
+    )
+    aleaves = [np.asarray(l) for l in jax.tree.leaves(ares.params_history)]
+
     if info["process_index"] == 0:
         np.save(os.environ["EH_OUT"], hist)
         np.save(os.environ["EH_OUT_SPARSE"], shist)
+        np.save(os.environ["EH_OUT_FIELDS"], fhist)
+        np.savez(os.environ["EH_OUT_ATTN"], *aleaves)
     """
     % {"W": W, "ROUNDS": ROUNDS, "COLS": COLS}
 )
@@ -76,6 +99,8 @@ def test_two_process_cpu_cluster_matches_single_process(tmp_path):
     port = _free_port()
     out = str(tmp_path / "hist.npy")
     out_sparse = str(tmp_path / "hist_sparse.npy")
+    out_fields = str(tmp_path / "hist_fields.npy")
+    out_attn = str(tmp_path / "hist_attn.npz")
     env = {
         **os.environ,
         "JAX_PLATFORMS": "cpu",
@@ -83,6 +108,8 @@ def test_two_process_cpu_cluster_matches_single_process(tmp_path):
         "EH_COORD": f"127.0.0.1:{port}",
         "EH_OUT": out,
         "EH_OUT_SPARSE": out_sparse,
+        "EH_OUT_FIELDS": out_fields,
+        "EH_OUT_ATTN": out_attn,
     }
     # children must not dial the axon TPU tunnel (sitecustomize registers it
     # whenever PALLAS_AXON_POOL_IPS is set, before any user code runs)
@@ -97,7 +124,12 @@ def test_two_process_cpu_cluster_matches_single_process(tmp_path):
         )
         for pid in (0, 1)
     ]
-    logs = [p.communicate(timeout=300)[0].decode() for p in procs]
+    try:
+        logs = [p.communicate(timeout=300)[0].decode() for p in procs]
+    finally:
+        for p in procs:  # a timeout must not orphan the other child
+            if p.poll() is None:
+                p.kill()
     for p, log in zip(procs, logs):
         assert p.returncode == 0, f"child failed:\n{log}"
 
@@ -129,3 +161,30 @@ def test_two_process_cpu_cluster_matches_single_process(tmp_path):
         np.load(out_sparse), np.asarray(sres.params_history),
         rtol=1e-6, atol=1e-7,
     )
+
+    # FieldOnehot stacks: cluster == single-process
+    import dataclasses
+
+    fcfg = dataclasses.replace(cfg, sparse_format="fields")
+    fres = trainer.train(fcfg, sdata, mesh=worker_mesh(4), measure=False)
+    np.testing.assert_allclose(
+        np.load(out_fields), np.asarray(fres.params_history),
+        rtol=1e-6, atol=1e-7,
+    )
+
+    # SP x DP with cross-process ring hops == the unsharded trajectory
+    # (looser tolerance: the ring's online softmax reassociates f32)
+    import jax
+
+    acfg = dataclasses.replace(
+        cfg, model="attention", n_cols=32, update_rule="GD",
+        lr_schedule=0.1,
+    )
+    adata = generate_gmm(acfg.n_rows, 32, n_partitions=W, seed=0)
+    ares = trainer.train(acfg, adata, mesh=worker_mesh(4), measure=False)
+    with np.load(out_attn) as got_attn:
+        got_leaves = [got_attn[k] for k in got_attn.files]
+    want_leaves = [np.asarray(l) for l in jax.tree.leaves(ares.params_history)]
+    assert len(got_leaves) == len(want_leaves)
+    for g, w in zip(got_leaves, want_leaves):
+        np.testing.assert_allclose(g, w, rtol=2e-4, atol=2e-5)
