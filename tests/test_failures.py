@@ -281,3 +281,38 @@ def test_elastic_ignores_deaths_beyond_horizon():
     assert rep.n_workers_after == 3
     with pytest.raises(ValueError, match="no death occurs"):
         failures.train_elastic(cfg, ds, {2: 100})
+
+
+def test_elastic_restart_mlp():
+    """Elastic recovery with an autodiff (pytree-params) model: the
+    optimizer state's leaves are worker-count independent, so the MLP's
+    params+momentum must carry across the re-shard exactly like the GLM
+    beta — and the post-fix sharded gradients (step._weighted_loss_grad)
+    must hold on the survivor mesh too. Loss continuous through the death."""
+    import jax
+
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.models.mlp import MLPModel
+
+    W = 8
+    ds = generate_gmm(64 * W, 32, n_partitions=W, seed=0)
+    cfg = RunConfig(
+        scheme="approx", model="mlp", n_workers=W, n_stragglers=1,
+        num_collect=6, rounds=16, n_rows=64 * W, n_cols=32,
+        lr_schedule=0.5, update_rule="GD", add_delay=True, seed=0,
+    )
+    # two deaths so the 6 survivors still satisfy (s+1) | W
+    res, rep = failures.train_elastic(cfg, ds, {6: 8, 7: 10})
+    assert rep.death_round == 8 and rep.n_workers_after == 6
+    hist = res.params_history
+    leaves = jax.tree.leaves(hist)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    assert all(l.shape[0] == cfg.rounds for l in leaves)
+    # training kept improving after the re-shard
+    model = MLPModel()
+    Xt, yt = ds.X_train, ds.y_train
+    l_at_death = float(model.loss_mean(
+        jax.tree.map(lambda l: l[8], hist), Xt, yt))
+    l_end = float(model.loss_mean(
+        jax.tree.map(lambda l: l[-1], hist), Xt, yt))
+    assert l_end < l_at_death, (l_at_death, l_end)
