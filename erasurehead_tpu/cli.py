@@ -154,6 +154,10 @@ def _flags_parser() -> argparse.ArgumentParser:
     p.add_argument("--sp-form", default="ring", choices=["ring", "ulysses"],
                    help="SP form carrying the attention: ppermute ring or "
                         "all-to-all head sharding")
+    p.add_argument("--tp-shards", type=int, default=1,
+                   help="tensor-parallel shards for the MLP model: >1 "
+                        "builds a 2-D (workers, model) mesh and splits the "
+                        "hidden dimension over it")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--checkpoint-dir", default=None,
                    help="save optimizer state here every --checkpoint-every "
@@ -218,6 +222,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         sparse_format=ns.sparse_format,
         seq_shards=ns.seq_shards,
         sp_form=ns.sp_form,
+        tp_shards=ns.tp_shards,
         seed=ns.seed,
     )
 

@@ -8,12 +8,24 @@ gradient *pytrees* with the same weights it uses for GLM gradient vectors.
 Architecture: margins = tanh(X W1 + b1) @ w2 + b2, binary labels in {-1, +1},
 logistic loss on the margin — so it drops into the same training/eval harness
 (loss curves, AUC) as logistic regression.
+
+``tp_axis`` composes tensor parallelism with the coded DP on a 2-D
+(workers, model) mesh (parallel/mesh.worker_tp_mesh, ``--tp-shards``): the
+Megatron split for a 2-layer block — W1 column-sharded, the tanh applied
+per local hidden slice (elementwise, so the split is exact), w2
+row-sharded, partial margins psum'd over the model axis — margins
+identical on every member. Gradients under the coded step come from ONE
+jax.grad of the weighted scalar loss per device (step._weighted_loss_grad);
+shard_map's replicated-param cotangent rules assemble exact global
+gradients for the sliced and replicated paths alike, the same mechanics
+the attention family's seq mode uses.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from erasurehead_tpu.models.glm import MarginClassifierBase
 from erasurehead_tpu.ops.features import matvec
@@ -22,8 +34,21 @@ from erasurehead_tpu.ops.features import matvec
 class MLPModel(MarginClassifierBase):
     name = "mlp"
 
-    def __init__(self, hidden: int = 64):
+    def __init__(self, hidden: int = 64, tp_axis: str | None = None):
         self.hidden = hidden
+        # when set, predict must run inside a shard_map whose mesh carries
+        # this axis (the trainer's for_mesh hook arranges it)
+        self.tp_axis = tp_axis
+
+    def for_mesh(self, mesh):
+        """Trainer hook: a tensor-parallel copy when the mesh has a model
+        axis, self otherwise (scoped to step construction — eval replay
+        stays unsharded)."""
+        from erasurehead_tpu.parallel.mesh import MODEL_AXIS
+
+        if MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1:
+            return MLPModel(self.hidden, tp_axis=MODEL_AXIS)
+        return self
 
     def init_params(self, key: jax.Array, n_features: int):
         k1, k2 = jax.random.split(key)
@@ -36,6 +61,24 @@ class MLPModel(MarginClassifierBase):
         }
 
     def predict(self, params, X):
+        if self.tp_axis is not None:
+            return self._predict_tp(params, X)
         h = jnp.tanh(matvec(X, params["W1"]) + params["b1"])
         return matvec(h, params["w2"]) + params["b2"]
+
+    def _predict_tp(self, params, X):
+        """Tensor-parallel forward: this member computes its hidden slice
+        only; partial margins psum over the model axis."""
+        ax = self.tp_axis
+        p = lax.axis_size(ax)
+        H = params["b1"].shape[0]
+        if H % p:
+            raise ValueError(f"hidden={H} must divide over {p} tp shards")
+        Hl = H // p
+        i = lax.axis_index(ax)
+        W1_l = lax.dynamic_slice_in_dim(params["W1"], i * Hl, Hl, axis=1)
+        b1_l = lax.dynamic_slice_in_dim(params["b1"], i * Hl, Hl, axis=0)
+        w2_l = lax.dynamic_slice_in_dim(params["w2"], i * Hl, Hl, axis=0)
+        h_l = jnp.tanh(matvec(X, W1_l) + b1_l)  # [n, H/p]
+        return lax.psum(matvec(h_l, w2_l), ax) + params["b2"]
 

@@ -18,6 +18,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 WORKER_AXIS = "workers"
+# tensor-parallel axis: the MLP family's hidden dimension splits over it
+# (models/mlp._predict_tp); same 2-D-mesh composition pattern as the
+# sequence axis (parallel/ring.SEQ_AXIS)
+MODEL_AXIS = "model"
 
 
 def worker_mesh(
@@ -36,28 +40,48 @@ def worker_mesh(
     return Mesh(np.asarray(devs), (WORKER_AXIS,))
 
 
+def worker_plus_axis_mesh(
+    axis_name: str,
+    shards: int,
+    workers_devices: int,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """2-D mesh (workers, <axis>): coded-DP over dim 0 composed with a
+    second model-internal parallelism axis over dim 1. Row stacks shard
+    over ``workers`` and replicate over the second axis; the model splits
+    its own internal dimension over it (token axis for seq, hidden units
+    for tensor parallelism) and psums where the math requires."""
+    devs = list(devices if devices is not None else jax.devices())
+    need = workers_devices * shards
+    if need > len(devs):
+        raise ValueError(
+            f"mesh {workers_devices}x{shards} needs {need} devices, "
+            f"have {len(devs)}"
+        )
+    grid = np.asarray(devs[:need]).reshape(workers_devices, shards)
+    return Mesh(grid, (WORKER_AXIS, axis_name))
+
+
 def worker_seq_mesh(
     seq_shards: int,
     workers_devices: int,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """2-D mesh (workers, seq): coded-DP over dim 0 composed with sequence
-    parallelism over dim 1 (parallel/ring.py's axis). Row stacks shard over
-    ``workers`` and replicate over ``seq``; a sequence-parallel model (the
-    attention family's ``seq_axis`` mode) splits each row's token axis over
-    ``seq``, runs ring attention around it, and psums its gradients over it.
-    """
+    """(workers, seq): sequence parallelism for the attention family
+    (parallel/ring.py's axis; models/attention._predict_seq)."""
     from erasurehead_tpu.parallel.ring import SEQ_AXIS
 
-    devs = list(devices if devices is not None else jax.devices())
-    need = workers_devices * seq_shards
-    if need > len(devs):
-        raise ValueError(
-            f"mesh {workers_devices}x{seq_shards} needs {need} devices, "
-            f"have {len(devs)}"
-        )
-    grid = np.asarray(devs[:need]).reshape(workers_devices, seq_shards)
-    return Mesh(grid, (WORKER_AXIS, SEQ_AXIS))
+    return worker_plus_axis_mesh(SEQ_AXIS, seq_shards, workers_devices, devices)
+
+
+def worker_tp_mesh(
+    tp_shards: int,
+    workers_devices: int,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """(workers, model): tensor parallelism for the MLP family — hidden
+    units split over the model axis (models/mlp._predict_tp)."""
+    return worker_plus_axis_mesh(MODEL_AXIS, tp_shards, workers_devices, devices)
 
 
 def worker_sharding(mesh: Mesh) -> NamedSharding:

@@ -95,19 +95,35 @@ def _auto_mesh(need: int):
     return worker_mesh(max(d for d in range(1, avail + 1) if need % d == 0))
 
 
-def _auto_seq_mesh(need: int, seq_shards: int):
-    """2-D (workers, seq) mesh: seq_shards devices per sequence group, the
-    worker dim the largest divisor of ``need`` that fits the rest."""
-    from erasurehead_tpu.parallel.mesh import worker_seq_mesh
+def _model_axis_request(cfg: RunConfig):
+    """(axis_name, shards) for the config's model-internal parallelism
+    axis — seq for attention, model for MLP tensor parallelism — or None.
+    Config validation guarantees at most one exceeds 1."""
+    if cfg.seq_shards > 1:
+        from erasurehead_tpu.parallel.ring import SEQ_AXIS
+
+        return SEQ_AXIS, cfg.seq_shards
+    if cfg.tp_shards > 1:
+        from erasurehead_tpu.parallel.mesh import MODEL_AXIS
+
+        return MODEL_AXIS, cfg.tp_shards
+    return None
+
+
+def _auto_2d_mesh(need: int, axis_name: str, shards: int):
+    """2-D (workers, <axis>) mesh: ``shards`` devices per model-parallel
+    group, the worker dim the largest divisor of ``need`` that fits."""
+    from erasurehead_tpu.parallel.mesh import worker_plus_axis_mesh
 
     avail = len(jax.devices())
-    if seq_shards > avail:
+    if shards > avail:
         raise ValueError(
-            f"seq_shards={seq_shards} exceeds the {avail} available devices"
+            f"{axis_name} shards={shards} exceeds the {avail} available "
+            f"devices"
         )
-    per_seq = avail // seq_shards
-    wd = max(d for d in range(1, per_seq + 1) if need % d == 0)
-    return worker_seq_mesh(seq_shards, wd)
+    per = avail // shards
+    wd = max(d for d in range(1, per + 1) if need % d == 0)
+    return worker_plus_axis_mesh(axis_name, shards, wd)
 
 
 def _init_params_f32(cfg: RunConfig, model, n_features: int):
@@ -167,32 +183,30 @@ def _setup_run(
 ) -> _RunSetup:
     layout = build_layout(cfg)
     model = build_model(cfg)
+    axis_req = _model_axis_request(cfg)
     if mesh is None:
         need = layout.n_workers if faithful else layout.n_partitions
         if single_device:
             mesh = worker_mesh(1)  # per-worker dispatches place themselves
-        elif cfg.seq_shards > 1:
-            mesh = _auto_seq_mesh(need, cfg.seq_shards)
+        elif axis_req is not None:
+            mesh = _auto_2d_mesh(need, *axis_req)
         else:
             mesh = _auto_mesh(need)
-    if cfg.seq_shards > 1 and not single_device:
-        # an explicit mesh must actually carry the requested seq axis —
-        # SP is parity-preserving, so silently running without it would
-        # LOOK right while testing nothing
-        from erasurehead_tpu.parallel.ring import SEQ_AXIS
-
-        if (
-            SEQ_AXIS not in mesh.axis_names
-            or mesh.shape[SEQ_AXIS] != cfg.seq_shards
-        ):
+    if axis_req is not None and not single_device:
+        # an explicit mesh must actually carry the requested axis — these
+        # modes are parity-preserving, so silently running without them
+        # would LOOK right while testing nothing
+        ax, shards = axis_req
+        if ax not in mesh.axis_names or mesh.shape[ax] != shards:
             raise ValueError(
-                f"seq_shards={cfg.seq_shards} but the mesh axes are "
-                f"{dict(mesh.shape)}; pass mesh=None (auto) or a "
-                f"worker_seq_mesh with a matching '{SEQ_AXIS}' axis"
+                f"requested {shards} '{ax}' shards but the mesh axes are "
+                f"{dict(mesh.shape)}; pass mesh=None (auto) or a 2-D mesh "
+                f"with a matching '{ax}' axis"
             )
-    # sequence-parallel models swap themselves in when the mesh carries a
-    # seq axis (models/attention.for_mesh); eval replay builds its own
-    # unsharded model from the config, so this scopes to step construction
+    # model-parallel families swap themselves in when the mesh carries
+    # their axis — attention for seq (models/attention.for_mesh), MLP for
+    # the tensor-parallel model axis (models/mlp.for_mesh); eval replay
+    # builds its own unsharded model, so this scopes to step construction
     if hasattr(model, "for_mesh"):
         model = model.for_mesh(mesh)
     data = shard_run_data(

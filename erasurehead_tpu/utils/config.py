@@ -157,6 +157,10 @@ class RunConfig:
     # long-T friendly) or "ulysses" (two all_to_alls, head-sharded; needs
     # n_heads divisible by seq_shards)
     sp_form: str = "ring"
+    # tensor-parallel shards for the MLP family: >1 builds a 2-D
+    # (workers, model) mesh; the hidden dimension splits over the model
+    # axis (Megatron column/row split, models/mlp._predict_tp)
+    tp_shards: int = 1
     # sparse training-stack representation (ops/features.py):
     #   "padded" — generic PaddedRows gather/scatter (default);
     #   "fields" — FieldOnehot fused pair-table lowering (requires
@@ -211,6 +215,24 @@ class RunConfig:
                     "seq_shards > 1 runs under the simulated-arrival "
                     "trainer only (measured mode dispatches per-worker on "
                     "single devices)"
+                )
+        if self.tp_shards < 1:
+            raise ValueError(f"tp_shards must be >= 1, got {self.tp_shards}")
+        if self.tp_shards > 1:
+            if self.model != ModelKind.MLP:
+                raise ValueError(
+                    "tp_shards > 1 requires model='mlp' (the only family "
+                    "with a hidden dimension to split)"
+                )
+            if self.seq_shards > 1:
+                raise ValueError(
+                    "tp_shards and seq_shards cannot both exceed 1 (each "
+                    "belongs to a different model family)"
+                )
+            if self.arrival_mode != "simulated":
+                raise ValueError(
+                    "tp_shards > 1 runs under the simulated-arrival "
+                    "trainer only"
                 )
         if self.sparse_format not in ("padded", "fields", "auto"):
             raise ValueError(

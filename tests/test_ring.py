@@ -232,9 +232,9 @@ class TestSeqParallelComposition:
         from erasurehead_tpu.train import trainer
         from erasurehead_tpu.parallel.mesh import WORKER_AXIS
 
-        mesh = trainer._auto_seq_mesh(4, 2)  # 4 workers, 2 seq shards
+        mesh = trainer._auto_2d_mesh(4, ring.SEQ_AXIS, 2)  # 4 workers, 2 seq
         assert dict(mesh.shape) == {WORKER_AXIS: 4, ring.SEQ_AXIS: 2}
-        mesh = trainer._auto_seq_mesh(4, 4)  # only 2 devices left per seq
+        mesh = trainer._auto_2d_mesh(4, ring.SEQ_AXIS, 4)  # 2 devices left per seq
         assert dict(mesh.shape) == {WORKER_AXIS: 2, ring.SEQ_AXIS: 4}
 
     def test_explicit_mesh_must_match_seq_shards(self):
@@ -244,7 +244,7 @@ class TestSeqParallelComposition:
         from erasurehead_tpu.parallel.mesh import worker_mesh
         from erasurehead_tpu.train import trainer
 
-        with pytest.raises(ValueError, match="seq_shards"):
+        with pytest.raises(ValueError, match="'seq' shards"):
             trainer.train(self._cfg(2), self._data(), mesh=worker_mesh(4))
 
     def test_indivisible_tokens_rejected(self):
