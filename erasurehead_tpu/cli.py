@@ -158,6 +158,10 @@ def _flags_parser() -> argparse.ArgumentParser:
                    help="tensor-parallel shards for the MLP model: >1 "
                         "builds a 2-D (workers, model) mesh and splits the "
                         "hidden dimension over it")
+    p.add_argument("--pp-shards", type=int, default=1,
+                   help="pipeline stages for the deepmlp model: >1 builds "
+                        "a 2-D (workers, pipe) mesh and streams GPipe "
+                        "microbatches through the layer stages")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--checkpoint-dir", default=None,
                    help="save optimizer state here every --checkpoint-every "
@@ -223,6 +227,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         seq_shards=ns.seq_shards,
         sp_form=ns.sp_form,
         tp_shards=ns.tp_shards,
+        pp_shards=ns.pp_shards,
         seed=ns.seed,
     )
 

@@ -1,0 +1,164 @@
+"""Deep MLP: the pipeline-parallel stretch family.
+
+The reference trains convex GLMs only (SURVEY.md §2.2); the 2-layer MLP
+showed pytree-params models ride the coded-DP machinery unchanged, and the
+attention/MLP families composed SP and TP with it. This family supplies the
+remaining classic axis: **pipeline parallelism**. ``n_layers`` uniform tanh
+layers (input projection F→H, then L hidden H→H transforms, then a linear
+head) split contiguously across a ``pipe`` mesh axis; a GPipe-style
+microbatch schedule streams M microbatches through the stages under ONE
+``lax.scan`` — at step t, stage i holds the activations of microbatch
+t−i, ``lax.ppermute`` hands each stage's output to its successor, stage 0
+injects microbatch t, and the last stage emits margins which psum-gather
+to every member (so the loss is pipe-invariant). Gradients under the coded
+step come from one jax.grad of the weighted scalar loss per device
+(parallel/step._weighted_loss_grad): AD runs the pipeline in reverse
+through the transposed ppermutes, and shard_map's replicated-param
+cotangent rules assemble exact global gradients — pinned against the
+unsharded oracle in tests (same method as the seq/TP modes).
+
+Like those modes, this is compute/activation pipelining with replicated
+parameters (each member holds the full stack but applies only its stage's
+layers): the composition and schedule are real; param/optimizer-state
+sharding is out of scope for this framework's model sizes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from erasurehead_tpu.models.glm import MarginClassifierBase
+from erasurehead_tpu.ops.features import matvec
+
+PIPE_AXIS = "pipe"
+
+
+class DeepMLPModel(MarginClassifierBase):
+    name = "deepmlp"
+
+    def __init__(
+        self,
+        hidden: int = 32,
+        n_layers: int = 4,
+        microbatches: int = 0,  # 0 => pipe axis size (one per stage)
+        pp_axis: str | None = None,
+    ):
+        self.hidden = hidden
+        self.n_layers = n_layers
+        self.microbatches = microbatches
+        # when set, predict must run inside a shard_map whose mesh carries
+        # this axis (the trainer's for_mesh hook arranges it)
+        self.pp_axis = pp_axis
+
+    def for_mesh(self, mesh):
+        """Trainer hook: a pipeline-parallel copy when the mesh has a pipe
+        axis (scoped to step construction; eval replay stays unsharded)."""
+        if PIPE_AXIS in mesh.axis_names and mesh.shape[PIPE_AXIS] > 1:
+            return DeepMLPModel(
+                self.hidden, self.n_layers, self.microbatches,
+                pp_axis=PIPE_AXIS,
+            )
+        return self
+
+    def init_params(self, key: jax.Array, n_features: int):
+        ks = jax.random.split(key, 3)
+        H, L = self.hidden, self.n_layers
+        return {
+            "W_in": jax.random.normal(ks[0], (n_features, H))
+            / jnp.sqrt(n_features),
+            "b_in": jnp.zeros(H),
+            # the L hidden transforms, stacked [L, H, H] so a stage can
+            # dynamic-slice its contiguous block
+            "W": jax.random.normal(ks[1], (L, H, H)) / jnp.sqrt(H),
+            "b": jnp.zeros((L, H)),
+            "w_out": jax.random.normal(ks[2], (H,)) / jnp.sqrt(H),
+            "b_out": jnp.zeros(()),
+        }
+
+    def _apply_layers(self, params, h, lo, count):
+        """tanh hidden transforms lo..lo+count-1 (count static)."""
+        for j in range(count):
+            W = lax.dynamic_index_in_dim(params["W"], lo + j, keepdims=False)
+            b = lax.dynamic_index_in_dim(params["b"], lo + j, keepdims=False)
+            h = jnp.tanh(h @ W + b)
+        return h
+
+    def _embed(self, params, X):
+        """Input projection through ops/features.matvec so dense ndarray,
+        PaddedRows, and FieldOnehot inputs all work (only this layer
+        touches X; everything after is dense-on-dense)."""
+        return jnp.tanh(matvec(X, params["W_in"]) + params["b_in"])
+
+    def predict(self, params, X):
+        if self.pp_axis is not None:
+            return self._predict_pp(params, X)
+        h = self._apply_layers(params, self._embed(params, X), 0, self.n_layers)
+        return h @ params["w_out"] + params["b_out"]
+
+    def _predict_pp(self, params, X):
+        """GPipe-schedule forward over the pipe axis (module docstring).
+
+        The input projection runs up front on the full local batch (every
+        member computes it — replicated stage-0 preamble, which also keeps
+        sparse feature containers out of the microbatch indexing); the
+        pipeline streams its dense [mb, H] activations."""
+        ax = self.pp_axis
+        p = lax.axis_size(ax)
+        i = lax.axis_index(ax)
+        L = self.n_layers
+        if L % p:
+            raise ValueError(f"n_layers={L} must divide over {p} pp stages")
+        per_stage = L // p
+        n = X.shape[0]
+        M = self.microbatches or p
+        if n % M:
+            raise ValueError(
+                f"{n} rows must divide into {M} pipeline microbatches"
+            )
+        mb = n // M
+        H = self.hidden
+        Hmb = self._embed(params, X).reshape(M, mb, H)
+        perm = [(s, s + 1) for s in range(p - 1)]  # stage s -> s+1
+
+        def stage_fn(x_in):
+            return self._apply_layers(params, x_in, i * per_stage, per_stage)
+
+        def step(carry, t):
+            act, out = carry
+            # hand the previous step's activations to the next stage;
+            # stage 0 has no predecessor and ppermute leaves zeros there
+            received = lax.ppermute(act, ax, perm)
+            # stage 0 injects microbatch t (zeros once the input drains)
+            inject = jnp.where(
+                t < M, Hmb[jnp.minimum(t, M - 1)], jnp.zeros((mb, H))
+            )
+            x_in = jnp.where(i == 0, inject, received)
+            act_new = stage_fn(x_in)
+            # microbatch t-(p-1) exits the last stage at step t
+            m_out = act_new @ params["w_out"] + params["b_out"]  # [mb]
+            slot = t - (p - 1)
+            valid = jnp.logical_and(slot >= 0, i == p - 1)
+            out = lax.dynamic_update_index_in_dim(
+                out,
+                jnp.where(valid, m_out, out[jnp.maximum(slot, 0)]),
+                jnp.maximum(slot, 0),
+                axis=0,
+            )
+            return (act_new, out), None
+
+        # initial carries: zeros that must carry BOTH the data's varying
+        # axes (inherited by deriving from the embedded batch — workers
+        # under the trainer) AND the pipe axis (explicit pcast: every
+        # later carry depends on axis_index), keeping the scan carry type
+        # stable under vma checking
+        act0 = lax.pcast(Hmb[0] * 0.0, ax, to="varying")
+        out0 = jnp.zeros((M, mb)) + act0[:, 0] * 0.0
+        (_, out), _ = lax.scan(
+            step, (act0, out0), jnp.arange(M + p - 1)
+        )
+        # margins live on the last stage; gather them to every member so
+        # the loss is identical (pipe-invariant) everywhere
+        margins = lax.psum(jnp.where(i == p - 1, out, 0.0), ax)
+        return margins.reshape(n)

@@ -84,6 +84,10 @@ def build_model(cfg: RunConfig):
         from erasurehead_tpu.models.attention import AttentionModel
 
         return AttentionModel(sp_form=cfg.sp_form)
+    if cfg.model == ModelKind.DEEPMLP:
+        from erasurehead_tpu.models.deep_mlp import DeepMLPModel
+
+        return DeepMLPModel()
     raise ValueError(f"unknown model {cfg.model}")
 
 
@@ -107,6 +111,10 @@ def _model_axis_request(cfg: RunConfig):
         from erasurehead_tpu.parallel.mesh import MODEL_AXIS
 
         return MODEL_AXIS, cfg.tp_shards
+    if cfg.pp_shards > 1:
+        from erasurehead_tpu.models.deep_mlp import PIPE_AXIS
+
+        return PIPE_AXIS, cfg.pp_shards
     return None
 
 

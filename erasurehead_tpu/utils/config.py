@@ -50,6 +50,7 @@ class ModelKind(str, enum.Enum):
     LINEAR = "linear"
     MLP = "mlp"  # 2-layer MLP stretch config (BASELINE.json configs[4])
     ATTENTION = "attention"  # single-block attention classifier (models/attention.py)
+    DEEPMLP = "deepmlp"  # n-layer MLP, the pipeline-parallel family (models/deep_mlp.py)
 
 
 class ComputeMode(str, enum.Enum):
@@ -161,6 +162,11 @@ class RunConfig:
     # (workers, model) mesh; the hidden dimension splits over the model
     # axis (Megatron column/row split, models/mlp._predict_tp)
     tp_shards: int = 1
+    # pipeline-parallel stages for the deepmlp family: >1 builds a 2-D
+    # (workers, pipe) mesh; layers split contiguously across stages and a
+    # GPipe microbatch schedule streams the rows through them
+    # (models/deep_mlp._predict_pp)
+    pp_shards: int = 1
     # sparse training-stack representation (ops/features.py):
     #   "padded" — generic PaddedRows gather/scatter (default);
     #   "fields" — FieldOnehot fused pair-table lowering (requires
@@ -200,6 +206,14 @@ class RunConfig:
         self.sparse_lanes = validate_lanes(self.sparse_lanes)
         if self.seq_shards < 1:
             raise ValueError(f"seq_shards must be >= 1, got {self.seq_shards}")
+        axes_over_one = sum(
+            v > 1 for v in (self.seq_shards, self.tp_shards, self.pp_shards)
+        )
+        if axes_over_one > 1:
+            raise ValueError(
+                "at most one of seq_shards/tp_shards/pp_shards may exceed 1 "
+                "(each belongs to a different model family)"
+            )
         if self.sp_form not in ("ring", "ulysses"):
             raise ValueError(
                 f"sp_form must be ring/ulysses, got {self.sp_form!r}"
@@ -224,14 +238,22 @@ class RunConfig:
                     "tp_shards > 1 requires model='mlp' (the only family "
                     "with a hidden dimension to split)"
                 )
-            if self.seq_shards > 1:
-                raise ValueError(
-                    "tp_shards and seq_shards cannot both exceed 1 (each "
-                    "belongs to a different model family)"
-                )
             if self.arrival_mode != "simulated":
                 raise ValueError(
                     "tp_shards > 1 runs under the simulated-arrival "
+                    "trainer only"
+                )
+        if self.pp_shards < 1:
+            raise ValueError(f"pp_shards must be >= 1, got {self.pp_shards}")
+        if self.pp_shards > 1:
+            if self.model != ModelKind.DEEPMLP:
+                raise ValueError(
+                    "pp_shards > 1 requires model='deepmlp' (the only "
+                    "family with a layer pipeline)"
+                )
+            if self.arrival_mode != "simulated":
+                raise ValueError(
+                    "pp_shards > 1 runs under the simulated-arrival "
                     "trainer only"
                 )
         if self.sparse_format not in ("padded", "fields", "auto"):
