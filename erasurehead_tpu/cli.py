@@ -162,6 +162,10 @@ def _flags_parser() -> argparse.ArgumentParser:
                    help="pipeline stages for the deepmlp model: >1 builds "
                         "a 2-D (workers, pipe) mesh and streams GPipe "
                         "microbatches through the layer stages")
+    p.add_argument("--ep-shards", type=int, default=1,
+                   help="expert-parallel shards for the moe model: >1 "
+                        "builds a 2-D (workers, expert) mesh and splits "
+                        "the experts over it")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--checkpoint-dir", default=None,
                    help="save optimizer state here every --checkpoint-every "
@@ -228,6 +232,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         sp_form=ns.sp_form,
         tp_shards=ns.tp_shards,
         pp_shards=ns.pp_shards,
+        ep_shards=ns.ep_shards,
         seed=ns.seed,
     )
 

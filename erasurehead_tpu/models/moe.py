@@ -1,0 +1,108 @@
+"""Mixture-of-experts classifier: the expert-parallel stretch family.
+
+The last classic parallelism axis the reference lacks a model for
+(SURVEY.md §2.2 lists EP absent — no MoE anywhere). This family supplies
+one: ``n_experts`` small tanh expert MLPs plus a learned softmax gate,
+margins = sum_e gate_e(x) * expert_e(x) — the dense ("soft") MoE form, so
+the decoded gradient stays exact and every-scheme-compatible (hard top-k
+routing drops experts per row, which would break the coded-DP exactness
+story this framework's tests pin; the EP *sharding* pattern is identical).
+
+``ep_axis`` composes expert parallelism with the coded DP on a 2-D
+(workers, expert) mesh (``--ep-shards``): expert parameters are stacked
+[E, ...] and each member computes only its contiguous block of experts'
+outputs, weighted by the (replicated, tiny) gate; partial margins psum
+over the expert axis — identical margins on every member, so gradients
+ride the same weighted-scalar-loss path as the seq/TP/PP modes
+(parallel/step._weighted_loss_grad) and come out exact by shard_map's
+replicated-param cotangent rules. Pinned against the unsharded oracle and
+trajectory-equal in tests, like every other composed axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from erasurehead_tpu.models.glm import MarginClassifierBase
+from erasurehead_tpu.ops.features import matvec
+
+EXPERT_AXIS = "expert"
+
+
+class MoEModel(MarginClassifierBase):
+    name = "moe"
+
+    def __init__(
+        self,
+        hidden: int = 16,
+        n_experts: int = 4,
+        ep_axis: str | None = None,
+    ):
+        self.hidden = hidden
+        self.n_experts = n_experts
+        # when set, predict must run inside a shard_map whose mesh carries
+        # this axis (the trainer's for_mesh hook arranges it)
+        self.ep_axis = ep_axis
+
+    def for_mesh(self, mesh):
+        """Trainer hook: an expert-parallel copy when the mesh has an
+        expert axis (scoped to step construction; eval stays unsharded)."""
+        if EXPERT_AXIS in mesh.axis_names and mesh.shape[EXPERT_AXIS] > 1:
+            return MoEModel(self.hidden, self.n_experts, ep_axis=EXPERT_AXIS)
+        return self
+
+    def init_params(self, key: jax.Array, n_features: int):
+        ks = jax.random.split(key, 4)
+        E, H = self.n_experts, self.hidden
+        return {
+            # per-expert 2-layer MLPs, stacked on the expert dim
+            "W1": jax.random.normal(ks[0], (E, n_features, H))
+            / jnp.sqrt(n_features),
+            "b1": jnp.zeros((E, H)),
+            "w2": jax.random.normal(ks[1], (E, H)) / jnp.sqrt(H),
+            "b2": jnp.zeros(E),
+            # the gate is tiny and replicated everywhere
+            "Wg": jax.random.normal(ks[2], (n_features, E))
+            / jnp.sqrt(n_features),
+            "bg": jnp.zeros(E),
+        }
+
+    def _expert_margins(self, params, X, lo, count):
+        """[n, count] margins of experts lo..lo+count-1 (count static)."""
+        outs = []
+        for j in range(count):
+            W1 = lax.dynamic_index_in_dim(params["W1"], lo + j, keepdims=False)
+            b1 = lax.dynamic_index_in_dim(params["b1"], lo + j, keepdims=False)
+            w2 = lax.dynamic_index_in_dim(params["w2"], lo + j, keepdims=False)
+            b2 = lax.dynamic_index_in_dim(params["b2"], lo + j, keepdims=False)
+            h = jnp.tanh(matvec(X, W1) + b1)
+            outs.append(h @ w2 + b2)
+        return jnp.stack(outs, axis=1)
+
+    def _gate(self, params, X):
+        return jax.nn.softmax(matvec(X, params["Wg"]) + params["bg"], axis=1)
+
+    def predict(self, params, X):
+        E = self.n_experts
+        if self.ep_axis is not None:
+            return self._predict_ep(params, X)
+        gate = self._gate(params, X)  # [n, E]
+        margins_e = self._expert_margins(params, X, 0, E)  # [n, E]
+        return jnp.sum(gate * margins_e, axis=1)
+
+    def _predict_ep(self, params, X):
+        """Expert-parallel forward: this member evaluates only its block
+        of experts; gate-weighted partial margins psum over the axis."""
+        ax = self.ep_axis
+        p = lax.axis_size(ax)
+        E = self.n_experts
+        if E % p:
+            raise ValueError(f"n_experts={E} must divide over {p} ep shards")
+        per = E // p
+        i = lax.axis_index(ax)
+        gate = self._gate(params, X)  # [n, E] (tiny, replicated compute)
+        gate_l = lax.dynamic_slice_in_dim(gate, i * per, per, axis=1)
+        margins_l = self._expert_margins(params, X, i * per, per)  # [n, per]
+        return lax.psum(jnp.sum(gate_l * margins_l, axis=1), ax)

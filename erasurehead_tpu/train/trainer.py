@@ -88,6 +88,10 @@ def build_model(cfg: RunConfig):
         from erasurehead_tpu.models.deep_mlp import DeepMLPModel
 
         return DeepMLPModel()
+    if cfg.model == ModelKind.MOE:
+        from erasurehead_tpu.models.moe import MoEModel
+
+        return MoEModel()
     raise ValueError(f"unknown model {cfg.model}")
 
 
@@ -115,6 +119,10 @@ def _model_axis_request(cfg: RunConfig):
         from erasurehead_tpu.models.deep_mlp import PIPE_AXIS
 
         return PIPE_AXIS, cfg.pp_shards
+    if cfg.ep_shards > 1:
+        from erasurehead_tpu.models.moe import EXPERT_AXIS
+
+        return EXPERT_AXIS, cfg.ep_shards
     return None
 
 

@@ -51,6 +51,7 @@ class ModelKind(str, enum.Enum):
     MLP = "mlp"  # 2-layer MLP stretch config (BASELINE.json configs[4])
     ATTENTION = "attention"  # single-block attention classifier (models/attention.py)
     DEEPMLP = "deepmlp"  # n-layer MLP, the pipeline-parallel family (models/deep_mlp.py)
+    MOE = "moe"  # mixture-of-experts classifier, the expert-parallel family (models/moe.py)
 
 
 class ComputeMode(str, enum.Enum):
@@ -167,6 +168,10 @@ class RunConfig:
     # GPipe microbatch schedule streams the rows through them
     # (models/deep_mlp._predict_pp)
     pp_shards: int = 1
+    # expert-parallel shards for the moe family: >1 builds a 2-D
+    # (workers, expert) mesh; experts split contiguously across it
+    # (models/moe._predict_ep)
+    ep_shards: int = 1
     # sparse training-stack representation (ops/features.py):
     #   "padded" — generic PaddedRows gather/scatter (default);
     #   "fields" — FieldOnehot fused pair-table lowering (requires
@@ -207,12 +212,16 @@ class RunConfig:
         if self.seq_shards < 1:
             raise ValueError(f"seq_shards must be >= 1, got {self.seq_shards}")
         axes_over_one = sum(
-            v > 1 for v in (self.seq_shards, self.tp_shards, self.pp_shards)
+            v > 1
+            for v in (
+                self.seq_shards, self.tp_shards, self.pp_shards,
+                self.ep_shards,
+            )
         )
         if axes_over_one > 1:
             raise ValueError(
-                "at most one of seq_shards/tp_shards/pp_shards may exceed 1 "
-                "(each belongs to a different model family)"
+                "at most one of seq_shards/tp_shards/pp_shards/ep_shards "
+                "may exceed 1 (each belongs to a different model family)"
             )
         if self.sp_form not in ("ring", "ulysses"):
             raise ValueError(
@@ -254,6 +263,19 @@ class RunConfig:
             if self.arrival_mode != "simulated":
                 raise ValueError(
                     "pp_shards > 1 runs under the simulated-arrival "
+                    "trainer only"
+                )
+        if self.ep_shards < 1:
+            raise ValueError(f"ep_shards must be >= 1, got {self.ep_shards}")
+        if self.ep_shards > 1:
+            if self.model != ModelKind.MOE:
+                raise ValueError(
+                    "ep_shards > 1 requires model='moe' (the only family "
+                    "with experts to shard)"
+                )
+            if self.arrival_mode != "simulated":
+                raise ValueError(
+                    "ep_shards > 1 runs under the simulated-arrival "
                     "trainer only"
                 )
         if self.sparse_format not in ("padded", "fields", "auto"):
