@@ -782,3 +782,43 @@ class TestExpertParallelComposition:
     def test_ep_requires_moe_model(self):
         with pytest.raises(ValueError, match="moe"):
             self._cfg(2, model="logistic")
+
+
+@pytest.mark.parametrize(
+    "scheme,extra,model,axis_kw",
+    [
+        # scheme variety x parallelism-axis variety: the decode-weight
+        # tensors of every scheme family must compose with the
+        # weighted-scalar-loss gradient path of every sharded model axis
+        ("cyccoded", dict(n_stragglers=2), "mlp", dict(tp_shards=2)),
+        ("repcoded", dict(n_stragglers=1), "deepmlp", dict(pp_shards=2)),
+        ("randreg", dict(n_stragglers=1, num_collect=3), "moe",
+         dict(ep_shards=2)),
+        ("deadline", dict(deadline=1.0), "attention", dict(seq_shards=2)),
+        ("avoidstragg", dict(n_stragglers=1), "moe", dict(ep_shards=4)),
+        ("approx", dict(n_stragglers=1, num_collect=3), "deepmlp",
+         dict(pp_shards=4, compute_mode="deduped")),
+    ],
+)
+def test_parallelism_matrix_trajectory_fuzz(scheme, extra, model, axis_kw):
+    """Cross-matrix invariant: ANY (scheme x model family x parallelism
+    axis) combination must be trajectory-equal to its unsharded run —
+    sharding is a lowering decision, never a semantics change."""
+    cols = 64 if model == "attention" else 16
+    base = dict(
+        scheme=scheme, model=model, n_workers=4, rounds=4, n_rows=192,
+        n_cols=cols, dataset="artificial", update_rule="GD",
+        lr_schedule=0.2, add_delay=True, seed=0, **extra,
+    )
+    ds = generate_gmm(192, cols, 4, seed=0)
+    ref = trainer.train(RunConfig(**base), ds)
+    sharded = trainer.train(RunConfig(**base, **axis_kw), ds)
+    for a, b in zip(
+        jax.tree.leaves(ref.params_history),
+        jax.tree.leaves(sharded.params_history),
+    ):
+        # the FULL per-round history, not just the final iterate
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
+            err_msg=f"{scheme}/{model}/{axis_kw}",
+        )
