@@ -11,8 +11,10 @@ lstsq decode becomes an on-device masked solve + einsum.
 Layout:
   ops/       coding-theory core (layouts, generator matrices, decode weights)
              and TPU-friendly sparse feature ops
-  models/    per-partition gradient kernels: logistic / linear GLMs, MLP,
-             attention classifier; losses and metrics
+  models/    per-partition gradient kernels: logistic / linear GLMs, MLP
+             (tensor-parallel), attention classifier (sequence-parallel),
+             deep MLP (pipeline-parallel), soft MoE (expert-parallel);
+             losses and metrics
   parallel/  mesh + collective step, straggler arrival simulation, collection
              rules (the scheme layer), failure handling / elastic recovery,
              ring + all-to-all sequence parallelism, distributed backend init
