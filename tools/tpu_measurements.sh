@@ -16,8 +16,15 @@ export PYTHONPATH="${PYTHONPATH:-}:$(pwd)"
 
 run() { # run <tag> <timeout_s> <cmd...> — per-entry timeout so a relay
         # wedge mid-program costs one entry, not the rest of the sweep;
-        # stderr goes to a per-tag log so failures keep their diagnostics
+        # stderr goes to a per-tag log so failures keep their diagnostics.
+        # Already-captured tags are skipped, so a rerun after a mid-sweep
+        # wedge resumes at the first missing entry (RERUN_ALL=1 overrides).
   local tag="$1" tmo="$2"; shift 2
+  if [ -z "${RERUN_ALL:-}" ] && [ -f "$OUT" ] \
+     && grep -q "\"tag\": \"$tag\"" "$OUT"; then
+    echo "=== $tag: already captured, skipping (RERUN_ALL=1 to redo)" >&2
+    return
+  fi
   echo "=== $tag ($tmo s): $*" >&2
   local line
   line="$(timeout "$tmo" "$@" 2>"$OUT.$tag.log" | tail -1)"
