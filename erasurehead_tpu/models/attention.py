@@ -26,6 +26,8 @@ single-device oracle in tests/test_ring.py.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -66,9 +68,10 @@ class AttentionModel(MarginClassifierBase):
         """Trainer hook: a sequence-parallel copy when the mesh has a seq
         axis, self otherwise (train/trainer.py applies this to the model
         used for step construction only — eval replay stays unsharded)."""
+        from erasurehead_tpu.parallel.mesh import axis_active
         from erasurehead_tpu.parallel.ring import SEQ_AXIS
 
-        if SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1:
+        if axis_active(mesh, SEQ_AXIS):
             return AttentionModel(
                 self.d_in, self.d_model, self.n_heads,
                 seq_axis=SEQ_AXIS, sp_form=self.sp_form,
@@ -150,16 +153,12 @@ class AttentionModel(MarginClassifierBase):
             # one all_to_all to head-sharded full sequences and back
             # (ulysses_attention_shard validates n_heads % axis_size)
             a_l = jax.vmap(
-                lambda qr, kr, vr: ulysses_attention_shard(
-                    qr, kr, vr, axis_name=ax
-                )
+                partial(ulysses_attention_shard, axis_name=ax)
             )(q, k, v)  # [n, Tl, H, dh]
         else:
             a_l = jax.vmap(
                 jax.vmap(
-                    lambda qr, kr, vr: ring_attention_shard(
-                        qr, kr, vr, axis_name=ax
-                    ),
+                    partial(ring_attention_shard, axis_name=ax),
                     in_axes=1, out_axes=1,  # per-row [Tl, H, dh]: head axis
                 )
             )(q, k, v)  # rows x heads around the ring

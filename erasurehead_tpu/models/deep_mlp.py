@@ -55,7 +55,9 @@ class DeepMLPModel(MarginClassifierBase):
     def for_mesh(self, mesh):
         """Trainer hook: a pipeline-parallel copy when the mesh has a pipe
         axis (scoped to step construction; eval replay stays unsharded)."""
-        if PIPE_AXIS in mesh.axis_names and mesh.shape[PIPE_AXIS] > 1:
+        from erasurehead_tpu.parallel.mesh import axis_active
+
+        if axis_active(mesh, PIPE_AXIS):
             return DeepMLPModel(
                 self.hidden, self.n_layers, self.microbatches,
                 pp_axis=PIPE_AXIS,

@@ -44,9 +44,9 @@ class MLPModel(MarginClassifierBase):
         """Trainer hook: a tensor-parallel copy when the mesh has a model
         axis, self otherwise (scoped to step construction — eval replay
         stays unsharded)."""
-        from erasurehead_tpu.parallel.mesh import MODEL_AXIS
+        from erasurehead_tpu.parallel.mesh import MODEL_AXIS, axis_active
 
-        if MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1:
+        if axis_active(mesh, MODEL_AXIS):
             return MLPModel(self.hidden, tp_axis=MODEL_AXIS)
         return self
 

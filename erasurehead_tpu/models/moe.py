@@ -49,7 +49,9 @@ class MoEModel(MarginClassifierBase):
     def for_mesh(self, mesh):
         """Trainer hook: an expert-parallel copy when the mesh has an
         expert axis (scoped to step construction; eval stays unsharded)."""
-        if EXPERT_AXIS in mesh.axis_names and mesh.shape[EXPERT_AXIS] > 1:
+        from erasurehead_tpu.parallel.mesh import axis_active
+
+        if axis_active(mesh, EXPERT_AXIS):
             return MoEModel(self.hidden, self.n_experts, ep_axis=EXPERT_AXIS)
         return self
 
@@ -85,9 +87,9 @@ class MoEModel(MarginClassifierBase):
         return jax.nn.softmax(matvec(X, params["Wg"]) + params["bg"], axis=1)
 
     def predict(self, params, X):
-        E = self.n_experts
         if self.ep_axis is not None:
             return self._predict_ep(params, X)
+        E = self.n_experts
         gate = self._gate(params, X)  # [n, E]
         margins_e = self._expert_margins(params, X, 0, E)  # [n, E]
         return jnp.sum(gate * margins_e, axis=1)

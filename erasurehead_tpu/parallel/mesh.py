@@ -84,6 +84,13 @@ def worker_tp_mesh(
     return worker_plus_axis_mesh(MODEL_AXIS, tp_shards, workers_devices, devices)
 
 
+def axis_active(mesh: Mesh, axis_name: str) -> bool:
+    """Does this mesh carry a >1-sized ``axis_name`` axis? The single rule
+    the model families' ``for_mesh`` hooks use to decide whether to swap
+    in their model-parallel variant."""
+    return axis_name in mesh.axis_names and mesh.shape[axis_name] > 1
+
+
 def worker_sharding(mesh: Mesh) -> NamedSharding:
     """Shard dim 0 (the worker / partition axis) across the mesh's worker
     axis; any other mesh axes (seq) replicate."""
