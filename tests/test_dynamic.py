@@ -175,3 +175,32 @@ def test_deadline_rule_matches_host_control_plane():
     np.testing.assert_array_equal(col, ref.collected)
     np.testing.assert_allclose(sim, ref.sim_time, rtol=1e-6)
     np.testing.assert_allclose(w, ref.message_weights, rtol=1e-6)
+
+
+def test_train_dynamic_autodiff_model_multidevice():
+    """The fully on-device trainer with a jax.grad (pytree-params) model on
+    a multi-device mesh — the combination the per-slot-grad-under-vmap bug
+    silently corrupted before step._weighted_loss_grad. Dynamic and host
+    control planes share the grad path, so the MLP trajectory must track
+    the host trainer's loss behavior (both converge on the same data)."""
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.models.mlp import MLPModel
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+    from erasurehead_tpu.train import trainer
+
+    cfg = RunConfig(
+        scheme="approx", model="mlp", n_workers=W, n_stragglers=S,
+        num_collect=8, rounds=12, n_rows=16 * W, n_cols=16,
+        lr_schedule=1.0, update_rule="GD", add_delay=True, seed=0,
+    )
+    data = generate_gmm(cfg.n_rows, cfg.n_cols, n_partitions=W, seed=0)
+    res = trainer.train_dynamic(cfg, data, mesh=worker_mesh(4))
+    model = MLPModel()
+    Xt, yt = jnp.asarray(data.X_test), jnp.asarray(data.y_test)
+    leaves = jax.tree.leaves(res.params_history)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    first = jax.tree.map(lambda l: l[0], res.params_history)
+    last = jax.tree.map(lambda l: l[-1], res.params_history)
+    l0 = float(model.loss_mean(first, Xt, yt))
+    l1 = float(model.loss_mean(last, Xt, yt))
+    assert l1 < l0 * 0.9, (l0, l1)
