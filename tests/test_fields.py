@@ -341,3 +341,52 @@ class TestTrainingIntegration:
     def test_bad_format_rejected(self):
         with pytest.raises(ValueError, match="sparse_format"):
             self._cfg(sparse_format="pairs")
+
+
+class TestInferenceProperty:
+    """Hypothesis fuzz: inference + construction round-trips on arbitrary
+    field structures, and never mis-identifies perturbed matrices."""
+
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    @staticmethod
+    def _build(sizes, n, seed):
+        return _onehot_csr(n, tuple(sizes), seed=seed)
+
+    @given(
+        sizes=st.lists(st.integers(1, 9), min_size=1, max_size=6),
+        n=st.integers(2, 40),
+        seed=st.integers(0, 10_000),
+    )
+    @settings(max_examples=60, deadline=None)
+    def test_roundtrip_any_structure(self, sizes, n, seed):
+        csr = self._build(sizes, n, seed)
+        inferred = infer_field_sizes(csr)
+        assert inferred is not None
+        fo = FieldOnehot.from_scipy(csr, field_sizes=inferred)
+        np.testing.assert_array_equal(
+            np.asarray(fo.to_dense()), csr.toarray()
+        )
+        # matvec agrees with dense on the inferred representation
+        rng = np.random.default_rng(seed)
+        v = rng.standard_normal(csr.shape[1]).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(matvec(fo, jnp.asarray(v))),
+            csr.toarray() @ v,
+            rtol=1e-4, atol=1e-4,
+        )
+
+    @given(
+        sizes=st.lists(st.integers(2, 9), min_size=2, max_size=5),
+        n=st.integers(3, 30),
+        seed=st.integers(0, 10_000),
+        knock=st.integers(0, 10_000),
+    )
+    @settings(max_examples=60, deadline=None)
+    def test_perturbed_value_never_misidentified(self, sizes, n, seed, knock):
+        """Any non-unit value breaks the structure contract: inference must
+        refuse rather than build a representation that drops the value."""
+        csr = self._build(sizes, n, seed)
+        csr.data[knock % csr.nnz] = 0.5
+        assert infer_field_sizes(csr) is None
