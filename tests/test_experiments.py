@@ -160,3 +160,27 @@ def test_resumed_artifacts_stay_aligned(gmm, tmp_path):
     full_t = trainer.train(cfg, gmm).timeset
     np.testing.assert_allclose(np.loadtxt(paths["timeset"]), full_t[8:],
                                atol=5e-4)  # save_vector writes %5.3f-ish
+
+
+def test_resume_from_checkpoint_pytree_model(gmm, tmp_path):
+    """Checkpoint/resume with pytree params (MLP): optimizer-state leaves
+    restore structurally and the resumed tail bit-matches the full run —
+    the orbax path must be model-agnostic, not beta-vector-shaped."""
+    import jax
+
+    cfg = _base(rounds=12, model="mlp", update_rule="GD", lr_schedule=0.5)
+    full = trainer.train(cfg, gmm)
+    ckdir = str(tmp_path / "ckm")
+    trainer.train(cfg, gmm, checkpoint_dir=ckdir, checkpoint_every=4)
+    resumed = trainer.train(
+        cfg, gmm, checkpoint_dir=ckdir, checkpoint_every=4, resume=True
+    )
+    assert resumed.start_round == 8
+    for a, b in zip(
+        jax.tree.leaves(full.params_history),
+        jax.tree.leaves(resumed.params_history),
+    ):
+        assert np.asarray(b).shape[0] == 4
+        np.testing.assert_allclose(
+            np.asarray(a)[8:], np.asarray(b), atol=1e-5
+        )
