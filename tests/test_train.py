@@ -798,6 +798,12 @@ class TestExpertParallelComposition:
         ("avoidstragg", dict(n_stragglers=1), "moe", dict(ep_shards=4)),
         ("approx", dict(n_stragglers=1, num_collect=3), "deepmlp",
          dict(pp_shards=4, compute_mode="deduped")),
+        # the two-message partial schemes: two-part decode weights x
+        # sharded model axes
+        ("partialrepcoded", dict(n_stragglers=1, partitions_per_worker=3),
+         "mlp", dict(tp_shards=2)),
+        ("partialcyccoded", dict(n_stragglers=1, partitions_per_worker=3),
+         "moe", dict(ep_shards=2)),
     ],
 )
 def test_parallelism_matrix_trajectory_fuzz(scheme, extra, model, axis_kw):
