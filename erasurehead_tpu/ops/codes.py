@@ -54,6 +54,9 @@ __all__ = [
     "mds_decode_weights_host",
     "enumerate_decode_table",
     "straggler_pattern_index",
+    "MdsDecodeTable",
+    "build_decode_table",
+    "straggler_pattern_index_jnp",
 ]
 
 
@@ -495,6 +498,117 @@ def enumerate_decode_table(B: np.ndarray, n_stragglers: int) -> np.ndarray:
         live = np.setdiff1d(np.arange(W), stragglers)
         A[k, live] = np.linalg.lstsq(B[live, :].T, ones, rcond=None)[0]
     return A
+
+
+@dataclasses.dataclass(frozen=True)
+class MdsDecodeTable:
+    """Precomputed f64-solved decode weights for all straggler patterns of
+    size 0..max_stragglers, indexable from inside jit.
+
+    This is the production fix for the fp32 hazard documented on
+    :func:`mds_decode_weights_host`: at the reference's canonical W=30, some
+    straggler patterns of the random cyclic code are so ill-conditioned that
+    an on-device fp32 solve fails outright (~1.0 error). Here every pattern
+    is solved ONCE on host in float64 (≙ the reference's runtime-unused
+    ``getA``, src/util.py:85-103) and the per-round decode becomes a single
+    table-row gather keyed by the traced completion mask — exact arithmetic
+    replaced by indexing, which fp32 cannot corrupt.
+
+    Covers patterns with UP TO max_stragglers stragglers (not just exactly
+    s) so the partial schemes — whose completed set can exceed W-s when the
+    all-first-parts condition binds last (src/partial_coded.py:174-191) —
+    use the same table.
+    """
+
+    table: np.ndarray  # [sum_{r<=s} C(W,r), W] float64 decode weights
+    offsets: np.ndarray  # [s+1] int32; r-straggler block starts at offsets[r]
+    comb: np.ndarray  # [W+1, s+1] int32 binomial table for traced ranking
+    max_stragglers: int
+
+    def lookup(self, mask: jnp.ndarray) -> jnp.ndarray:
+        """Decode weights for a traced completion mask (True = collected)."""
+        stragglers = ~mask
+        s_cnt = stragglers.sum()
+        rank = straggler_pattern_index_jnp(
+            stragglers, self.max_stragglers, self.comb
+        )
+        row = jnp.asarray(self.offsets)[s_cnt] + rank
+        return jnp.asarray(self.table, jnp.float32)[row]
+
+
+def build_decode_table(
+    B: np.ndarray,
+    max_stragglers: int,
+    cap_rows: int = 20_000,
+    exact_only: bool = False,
+) -> Optional[MdsDecodeTable]:
+    """Build an :class:`MdsDecodeTable`, or None if it would exceed cap_rows.
+
+    ``exact_only`` builds just the exactly-max_stragglers block — the
+    first-k collection rules (cyccoded, randreg) always complete exactly
+    W-k workers, so the 0..s-1 blocks would be dead rows counted against
+    the cap (e.g. randreg W=27, k=23: C(27,4)=17,550 fits the cap while
+    the 0..4 sum does not). Partial schemes need the full 0..s range
+    (their completed sets can exceed W-s).
+
+    At the canonical W=30, s=3 the full table is 1+30+435+4060 = 4,526
+    rows (~540 KB f32 on device). C(W,s) growth makes the cap necessary:
+    e.g. randreg with num_collect=W/2 would need C(30,15) ≈ 155M rows.
+    """
+    W = B.shape[0]
+    counts = [
+        0 if (exact_only and r < max_stragglers) else math.comb(W, r)
+        for r in range(max_stragglers + 1)
+    ]
+    if sum(counts) > cap_rows:
+        return None
+    tables = [
+        np.zeros((0, W)) if n == 0 else enumerate_decode_table(B, r)
+        for r, n in enumerate(counts)
+    ]
+    offsets = np.cumsum([0] + [t.shape[0] for t in tables])[:-1]
+    comb = np.array(
+        [
+            [math.comb(n, r) for r in range(max_stragglers + 1)]
+            for n in range(W + 1)
+        ],
+        dtype=np.int32,
+    )
+    return MdsDecodeTable(
+        table=np.concatenate(tables, axis=0),
+        offsets=offsets.astype(np.int32),
+        comb=comb,
+        max_stragglers=max_stragglers,
+    )
+
+
+def straggler_pattern_index_jnp(
+    straggler_mask: jnp.ndarray, max_stragglers: int, comb_table: np.ndarray
+) -> jnp.ndarray:
+    """Traced combinatorial rank of a straggler set among same-size subsets.
+
+    jit-compatible equivalent of :func:`straggler_pattern_index` (≙ the
+    reference's lookup helpers, src/util.py:105-134) for any actual
+    straggler count <= max_stragglers. The per-position inner sum of the
+    host version telescopes via the hockey-stick identity to
+    ``C(W - prev_j - 1, r_j) - C(W - p_j, r_j)`` with ``r_j = s_cnt - j``,
+    turning the ranking into a fixed-shape gather + sum.
+    """
+    W = straggler_mask.shape[0]
+    s_max = max_stragglers
+    if s_max == 0:
+        return jnp.zeros((), jnp.int32)
+    idx = jnp.arange(W)
+    # ascending straggler positions, padded with sentinel W (sorts last)
+    pos = jnp.sort(jnp.where(straggler_mask, idx, W))[:s_max]
+    s_cnt = straggler_mask.sum()
+    prev = jnp.concatenate([jnp.array([-1]), pos[:-1]])
+    j = jnp.arange(s_max)
+    r = jnp.clip(s_cnt - j, 0, s_max)
+    ct = jnp.asarray(comb_table)
+    hi = ct[W - prev - 1, r]
+    lo = ct[jnp.clip(W - pos, 0, W), r]
+    return jnp.where(j < s_cnt, hi - lo, 0).sum()
 
 
 def straggler_pattern_index(straggler_mask: np.ndarray) -> int:

@@ -66,7 +66,8 @@ def _kernel(kind: str, b_ref, x_ref, y_ref, w_ref, o_ref):
     def _init():
         o_ref[...] = jnp.zeros_like(o_ref)
 
-    x = x_ref[0]  # (BR, F)
+    x = x_ref[0].astype(jnp.float32)  # (BR, F); upcast once per block so a
+    # bf16-stored stack streams at half the HBM bytes but contracts exactly
     y = y_ref[0]  # (BR, 1)
     w = w_ref[m, 0]  # scalar from SMEM, dynamic slot index
     # Both contractions run on the VPU (elementwise multiply + reduce) in
@@ -95,6 +96,7 @@ def fused_glm_grad(
 ) -> jnp.ndarray:
     """Decoded GLM gradient in one pass over X. Returns [F] float32."""
     M, R, F = X.shape
+    x_dtype = jnp.bfloat16 if X.dtype == jnp.bfloat16 else jnp.float32
     BR = block_rows or choose_block_rows(R, F)
     Rp = -(-R // BR) * BR
     if Rp != R:
@@ -119,7 +121,7 @@ def fused_glm_grad(
         out_specs=pl.BlockSpec((1, F), lambda m, rb: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((1, F), jnp.float32),
         interpret=interpret,
-    )(beta2, X.astype(jnp.float32), y3, w2)
+    )(beta2, X.astype(x_dtype), y3, w2)
     return out[0]
 
 

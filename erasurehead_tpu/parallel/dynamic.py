@@ -67,21 +67,34 @@ def collect_all_jnp(t: jnp.ndarray) -> RoundSchedule:
 
 
 def collect_first_k_mds_jnp(
-    t: jnp.ndarray, B: jnp.ndarray, n_stragglers: int
+    t: jnp.ndarray,
+    B: jnp.ndarray,
+    n_stragglers: int,
+    decode_table: codes.MdsDecodeTable | None = None,
 ) -> RoundSchedule:
-    return _first_k_lstsq_jnp(t, B, t.shape[0] - n_stragglers)
+    return _first_k_lstsq_jnp(
+        t, B, t.shape[0] - n_stragglers, decode_table=decode_table
+    )
 
 
-def _first_k_lstsq_jnp(t: jnp.ndarray, B: jnp.ndarray, k: int) -> RoundSchedule:
-    """Stop at the k-th arrival, lstsq-decode over the received rows of B
-    (exact MDS for k = W-s; optimal-decoding randreg for k = num_collect)."""
+def _first_k_lstsq_jnp(
+    t: jnp.ndarray,
+    B: jnp.ndarray,
+    k: int,
+    decode_table: codes.MdsDecodeTable | None = None,
+) -> RoundSchedule:
+    """Stop at the k-th arrival, decode over the received rows of B
+    (exact MDS for k = W-s; optimal-decoding randreg for k = num_collect).
+    With a decode_table, the per-round solve becomes an f64-precomputed
+    table gather (safe at any W); otherwise the on-device fp32 solve is
+    used (small-W only — see ops/codes.mds_decode_weights)."""
     ranks = _ranks(t)
     mask = ranks < k
-    return RoundSchedule(
-        codes.mds_decode_weights(B, mask),
-        _kth_arrival_time(t, ranks, k),
-        mask,
-    )
+    if decode_table is not None:
+        weights = decode_table.lookup(mask)
+    else:
+        weights = codes.mds_decode_weights(B, mask)
+    return RoundSchedule(weights, _kth_arrival_time(t, ranks, k), mask)
 
 
 def collect_avoidstragg_jnp(t: jnp.ndarray, n_stragglers: int) -> RoundSchedule:
@@ -140,6 +153,7 @@ def collect_partial_jnp(
     B: jnp.ndarray | None = None,  # [W, W], mds variant
     onehot: jnp.ndarray | None = None,  # [W, G], frc variant
     group_ids: jnp.ndarray | None = None,  # [W], frc variant
+    decode_table: codes.MdsDecodeTable | None = None,  # mds variant
 ) -> RoundSchedule:
     """Two-part schemes as a fixed-shape 2W-event sort + prefix scan
     (≙ collect.collect_partial's vectorized replay of the two-message
@@ -150,9 +164,10 @@ def collect_partial_jnp(
     W..2W-1 are coded parts (arriving at ``t``); the master's loop exits at
     the first event where all W uncoded parts are in AND the coded-part
     condition holds (>= W-s parts for MDS decode; one part per group for
-    FRC). Coded parts processed by then join the decode. The MDS weights
-    use the on-device fp32 solve — small-W only (see
-    ops/codes.mds_decode_weights)."""
+    FRC). Coded parts processed by then join the decode. MDS weights come
+    from the f64-precomputed decode_table when given (completed sets here
+    can exceed W-s, which the 0..s multi-pattern table covers); without one,
+    the on-device fp32 solve — small-W only (ops/codes.mds_decode_weights)."""
     W = t.shape[0]
     times = jnp.concatenate([frac * t, t])  # [2W]; argsort is stable, so
     order = jnp.argsort(times)  # ties process in (time, part, worker) order
@@ -174,7 +189,10 @@ def collect_partial_jnp(
         > 0
     )
     if variant == "mds":
-        weights = codes.mds_decode_weights(B, completed)
+        if decode_table is not None:
+            weights = decode_table.lookup(completed)
+        else:
+            weights = codes.mds_decode_weights(B, completed)
     else:
         # each group's first coded arrival, if completed (stable-rank argmin
         # == collect._group_winners' first-index tie-break)
@@ -208,6 +226,39 @@ def make_round_schedule_fn(
         None if layout.groups is None
         else jnp.asarray(_group_onehot(np.asarray(layout.groups)))
     )
+    # MDS schemes: precompute f64 decode weights for every <=s straggler
+    # pattern so the in-scan decode is a table gather, immune to the fp32
+    # conditioning hazard at canonical W=30 (ops/codes.MdsDecodeTable).
+    decode_table = None
+    if scheme == Scheme.CYCLIC_MDS:
+        decode_table = codes.build_decode_table(
+            np.asarray(layout.B), layout.n_stragglers, exact_only=True
+        )
+    elif scheme == Scheme.PARTIAL_CYCLIC:
+        # completed sets can exceed W-s here -> full 0..s pattern range
+        decode_table = codes.build_decode_table(
+            np.asarray(layout.B), layout.n_stragglers
+        )
+    elif scheme == Scheme.RANDOM_REGULAR and num_collect is not None:
+        decode_table = codes.build_decode_table(
+            np.asarray(layout.B), W - num_collect, exact_only=True
+        )
+    if (
+        decode_table is None
+        and scheme in (Scheme.CYCLIC_MDS, Scheme.PARTIAL_CYCLIC,
+                       Scheme.RANDOM_REGULAR)
+        and W > 16
+    ):
+        import warnings
+
+        warnings.warn(
+            f"{scheme.value}: C(W, s) too large for a decode table at W={W};"
+            " falling back to the on-device fp32 solve, which is UNRELIABLE"
+            " for ill-conditioned straggler patterns at this scale (see"
+            " ops/codes.mds_decode_weights_host). Prefer trainer.train()"
+            " (host f64 control plane) for science runs.",
+            stacklevel=2,
+        )
 
     def draw(key):
         if not add_delay:
@@ -221,7 +272,9 @@ def make_round_schedule_fn(
     elif scheme == Scheme.NAIVE:
         rule = collect_all_jnp
     elif scheme == Scheme.CYCLIC_MDS:
-        rule = lambda t: collect_first_k_mds_jnp(t, B, layout.n_stragglers)
+        rule = lambda t: collect_first_k_mds_jnp(
+            t, B, layout.n_stragglers, decode_table=decode_table
+        )
     elif scheme == Scheme.AVOID_STRAGGLERS:
         rule = lambda t: collect_avoidstragg_jnp(t, layout.n_stragglers)
     elif scheme == Scheme.FRC:
@@ -233,13 +286,16 @@ def make_round_schedule_fn(
     elif scheme == Scheme.RANDOM_REGULAR:
         if num_collect is None:
             raise ValueError("randreg needs num_collect")
-        rule = lambda t: _first_k_lstsq_jnp(t, B, num_collect)
+        rule = lambda t: _first_k_lstsq_jnp(
+            t, B, num_collect, decode_table=decode_table
+        )
     elif scheme in (Scheme.PARTIAL_CYCLIC, Scheme.PARTIAL_FRC):
         frac = layout.uncoded_frac
         if scheme == Scheme.PARTIAL_CYCLIC:
             rule = lambda t: collect_partial_jnp(
                 t, variant="mds", frac=frac,
                 n_stragglers=layout.n_stragglers, B=B,
+                decode_table=decode_table,
             )
         else:
             gids = jnp.asarray(np.asarray(layout.groups))

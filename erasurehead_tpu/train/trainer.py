@@ -537,6 +537,15 @@ def train_measured(
     Under real per-worker imbalance the collected set genuinely differs
     from the homogeneous schedule (tests/test_measured.py).
 
+    On a single device, workers are timed sequentially in isolation (pure
+    compute heterogeneity — concurrency on one chip would be fake). On a
+    >1-device ``mesh``, logical workers are pinned round-robin to devices
+    and each device's queue is replayed on its own clock: a worker's
+    measured arrival = queue wait behind the workers sharing its chip +
+    its own compute, so per-DEVICE load imbalance genuinely changes the
+    collected sets (VERDICT r2 item 6; tests/test_measured.py's
+    multidevice cases).
+
     The cost model is honest but slow: one dispatch per (round, worker) is
     inherent to measuring workers separately. Use :func:`train` (one scan)
     for throughput benchmarking; this mode is for heterogeneity diagnosis
@@ -585,10 +594,29 @@ def train_measured(
     update_fn = setup.update_fn
     state = setup.state0
 
-    # one worker's transmitted message: its per-slot gradient stack
-    @jax.jit
-    def worker_msg(params, Xs, ys):
-        return jax.vmap(lambda X, y: model.grad_sum(params, X, y))(Xs, ys)
+    # one worker's transmitted message: its per-slot gradient stack.
+    # ``n`` (the work multiplier) folds INSIDE the executable as a
+    # fori_loop — n x the device compute in ONE dispatch, with a
+    # bitwise-identical message. Repeating the dispatch instead would make
+    # Python dispatch overhead the "work", which on fast backends finishes
+    # before any ordering is observable. Each iteration consumes the
+    # previous message through a multiplier that is always exactly 1.0 but
+    # not provably so (an optimization_barrier chain measured elided on
+    # the CPU backend; this dependence survives — verified n-linear cost).
+    @partial(jax.jit, static_argnames="n")
+    def worker_msg(params, Xs, ys, n=1):
+        def one(p):
+            return jax.vmap(lambda X, y: model.grad_sum(p, X, y))(Xs, ys)
+
+        if n == 1:
+            return one(params)
+
+        def body(_, m):
+            s = jax.tree.leaves(m)[0].sum()
+            dep = jnp.where(jnp.isnan(s), 1.0, jnp.sign(jnp.abs(s) + 1.0))
+            return one(jax.tree.map(lambda l: l * dep, params))
+
+        return jax.lax.fori_loop(0, n - 1, body, one(params))
 
     @jax.jit
     def decode_update(st, per_slot, slot_w, eta, i):
@@ -607,14 +635,36 @@ def train_measured(
         cfg.rounds, W, cfg.add_delay, cfg.delay_mean
     )
 
-    # hoist the constant per-worker slices out of the timed loop, and warm
-    # up every per-worker executable so measured times are steady-state
-    # compute, not gather dispatch or compile/program-load
-    slices = [worker_slice(w) for w in range(W)]
+    devices = list(np.asarray(setup.mesh.devices).flat)
+    D = len(devices)
+    dev_of = [devices[w % D] for w in range(W)]
+    # hoist the constant per-worker slices out of the timed loop; on a
+    # multi-device mesh each logical worker's stack is pinned round-robin
+    # to its device so dispatches run concurrently across chips while
+    # workers sharing a chip contend for real
+    if D > 1:
+        slices = [
+            jax.device_put(worker_slice(w), dev_of[w]) for w in range(W)
+        ]
+    else:
+        slices = [worker_slice(w) for w in range(W)]
+    # warm up every per-worker executable (one per device) so measured
+    # times are steady-state compute, not gather dispatch or compile/load;
+    # committed-vs-uncommitted params placement must match the timed loop
+    # or jit would recompile inside the timed region
     m0 = None
-    for Xs, ys in slices:
-        m0 = worker_msg(state.params, Xs, ys)
-        _hard_sync(m0)
+    if D > 1:
+        for w, (Xs, ys) in enumerate(slices):
+            m0 = worker_msg(
+                jax.device_put(state.params, dev_of[w]), Xs, ys,
+                n=int(mult[w]),
+            )
+            _hard_sync(m0)
+        m0 = jax.device_put(m0, devices[0])
+    else:
+        for w, (Xs, ys) in enumerate(slices):
+            m0 = worker_msg(state.params, Xs, ys, n=int(mult[w]))
+            _hard_sync(m0)
     # warm decode_update too (same shapes as the loop's calls, zero decode
     # weights, result discarded): its first call would otherwise compile
     # inside the timed region and be charged to round 0's wall-clock
@@ -635,20 +685,54 @@ def train_measured(
     history = []
     wall0 = time.perf_counter()
     for r in range(cfg.rounds):
-        # async dispatch: make sure the previous round's decode_update is
-        # off the device stream before timing worker 0, or its cost would
-        # be misattributed as worker 0's compute every round
+        # make sure the previous round's decode_update is off the device
+        # stream before timing worker 0, or its cost would be
+        # misattributed as worker 0's compute every round
         _hard_sync(state)
         t_row = np.zeros(W)
-        msgs = []
-        for w in range(W):
-            Xs, ys = slices[w]
-            t0 = time.perf_counter()
-            for _ in range(int(mult[w])):
-                m = worker_msg(state.params, Xs, ys)
-            _hard_sync(m)
-            t_row[w] = time.perf_counter() - t0
-            msgs.append(m)
+        if D > 1:
+            # per-device queue replay: each device's worker queue is
+            # drained in dispatch order and timed on its OWN clock, so a
+            # worker's arrival = its device-queue wait + its own compute —
+            # a pod's semantics exactly (chips run concurrently and
+            # independently; within a chip, dispatches serialize). Devices
+            # are measured one after another because concurrent host-side
+            # timing of N virtual/tunneled devices measures thread-
+            # scheduling noise, not chips (the CPU test backend serializes
+            # executions globally — measured 2.0x for 2-device concurrent
+            # dispatch; the axon TPU tunnel is single-client). The params
+            # fan-out is staged and synced BEFORE each device's clock
+            # opens: decode_update leaves params resident on devices[0],
+            # so timing the transfer would charge devices 1..D-1 a d2d
+            # copy that device 0's workers never pay — a placement
+            # artifact, not worker heterogeneity.
+            msgs = [None] * W
+            params_on = [
+                jax.device_put(state.params, d) for d in devices
+            ]
+            for p_d in params_on:
+                _hard_sync(p_d)
+            for d_idx in range(D):
+                ws = range(d_idx, W, D)  # this device's queue, in order
+                t0 = time.perf_counter()
+                for w in ws:
+                    m = worker_msg(
+                        params_on[d_idx], *slices[w], n=int(mult[w])
+                    )
+                    _hard_sync(m)
+                    t_row[w] = time.perf_counter() - t0
+                    msgs[w] = m
+            # stage every message on the decode device before stacking
+            msgs = [jax.device_put(m, devices[0]) for m in msgs]
+        else:
+            msgs = []
+            for w in range(W):
+                Xs, ys = slices[w]
+                t0 = time.perf_counter()
+                m = worker_msg(state.params, Xs, ys, n=int(mult[w]))
+                _hard_sync(m)
+                t_row[w] = time.perf_counter() - t0
+                msgs.append(m)
         arrivals = (t_row + delays[r])[None, :]
         sched = collect.build_schedule(
             cfg.scheme, arrivals, layout, num_collect=cfg.num_collect,

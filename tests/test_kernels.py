@@ -38,6 +38,24 @@ def test_fused_matches_oracle(kind, shape):
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
 
 
+@pytest.mark.parametrize("kind", kernels.GLM_KINDS)
+def test_fused_bf16_stream_matches_f32_oracle(kind):
+    """bf16-stored stacks stream at half the HBM bytes but the kernel
+    upcasts each block once and contracts in exact f32 — so the result
+    must match the f32 oracle on the bf16-rounded data exactly (to f32
+    reduction tolerance), not to bf16 tolerance."""
+    b, X, y, w = _case(4, 33, 64)
+    Xb = X.astype(jnp.bfloat16)
+    got = kernels.fused_glm_grad(
+        b, Xb, y, w, kind, interpret=True, block_rows=16
+    )
+    want = kernels.reference_glm_grad(
+        b, Xb.astype(jnp.float32), y, w, kind
+    )
+    assert got.dtype == jnp.float32
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
 def test_zero_weight_slots_drop_out():
     """A slot with weight 0 (an erased/uncollected message) contributes
     nothing — the erasure semantics the decode weights encode."""

@@ -56,6 +56,11 @@ def main() -> None:
     ap.add_argument("--rows", type=int, default=4400)
     ap.add_argument("--cols", type=int, default=128)
     ap.add_argument("--iters", type=int, default=50)
+    # bfloat16 stores the stack in half the bytes: the one configuration
+    # where the single-pass kernel's halved traffic could beat XLA's
+    # (already well-fused) two-pass f32 lowering (VERDICT r2 item 8)
+    ap.add_argument("--dtype", choices=("float32", "bfloat16"),
+                    default="float32")
     args = ap.parse_args()
 
     from erasurehead_tpu.ops import kernels
@@ -66,17 +71,36 @@ def main() -> None:
 
     key = jax.random.PRNGKey(0)
     kx, ky, kb, kw = jax.random.split(key, 4)
-    X = jax.random.normal(kx, (M, R, F), jnp.float32)
+    dt = jnp.dtype(args.dtype)
+    X = jax.random.normal(kx, (M, R, F), jnp.float32).astype(dt)
     y = jnp.sign(jax.random.normal(ky, (M, R), jnp.float32))
     beta = jax.random.normal(kb, (F,), jnp.float32)
     w = jax.random.uniform(kw, (M,), jnp.float32)
 
+    def xla_bf16(b, X, y, w, kind):
+        # the production bf16-data lowering (ops/features.py rule): cast the
+        # tiny vector operands to the data dtype so the stack streams as
+        # stored, accumulate in f32 on the MXU
+        p = jnp.einsum("mrf,f->mr", X, b.astype(X.dtype),
+                       preferred_element_type=jnp.float32)
+        yf = y.astype(jnp.float32)
+        if kind == "logistic":
+            s = -yf / (jnp.exp(p * yf) + 1.0)
+        else:
+            s = -2.0 * (yf - p)
+        s = s * w[:, None]
+        return jnp.einsum("mrf,mr->f", X, s.astype(X.dtype),
+                          preferred_element_type=jnp.float32)
+
     results = {}
     for kind in ("logistic", "linear"):
         fused = lambda b, X, y, w, k=kind: kernels.fused_glm_grad(b, X, y, w, k)
-        xla_hi = lambda b, X, y, w, k=kind: kernels.reference_glm_grad(
-            b, X, y, w, k
-        )
+        if dt == jnp.bfloat16:
+            xla_hi = lambda b, X, y, w, k=kind: xla_bf16(b, X, y, w, k)
+        else:
+            xla_hi = lambda b, X, y, w, k=kind: kernels.reference_glm_grad(
+                b, X, y, w, k
+            )
         g_f = fused(beta, X, y, w)
         g_x = xla_hi(beta, X, y, w)
         rel = float(
@@ -92,10 +116,11 @@ def main() -> None:
         }
         print(f"race: {kind}: {results[kind]}", file=sys.stderr)
 
-    x_bytes = M * R * F * 4
+    x_bytes = M * R * F * dt.itemsize
     out = {
         "platform": platform,
         "shape": [M, R, F],
+        "dtype": str(dt),
         "x_mib": round(x_bytes / 2**20, 1),
         **results,
     }

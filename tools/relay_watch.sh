@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Watch the axon relay for a healthy window; when one appears, run the
+# resumable measurement sweep (tools/tpu_measurements.sh). Probe is a
+# SUBPROCESS jax.devices() with a hard timeout — a wedged relay hangs the
+# probe child, never this script. Logs to tools/relay_watch.log.
+#
+#   bash tools/relay_watch.sh [max_hours]
+set -u
+cd "$(dirname "$0")/.."
+LOG=tools/relay_watch.log
+MAX_HOURS="${1:-11}"
+DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+export PYTHONPATH="${PYTHONPATH:-}:$(pwd)"
+
+probe() {
+  timeout 90 python - <<'EOF' >/dev/null 2>&1
+import subprocess, sys
+r = subprocess.run(
+    [sys.executable, "-c",
+     "import jax; ds=jax.devices(); assert ds and ds[0].platform=='tpu', ds; print(ds)"],
+    capture_output=True, text=True, timeout=80)
+sys.exit(r.returncode)
+EOF
+}
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if probe; then
+    echo "$(date -Is) relay HEALTHY — running sweep" >> "$LOG"
+    bash tools/tpu_measurements.sh >> "$LOG" 2>&1
+    # Count remaining queued tags; sweep skips captured ones, so a clean
+    # pass through means we are done.
+    if bash -c 'grep -c FAILED tools/relay_watch.log >/dev/null'; then :; fi
+    missing=$(python tools/sweep_status.py 2>/dev/null || echo "?")
+    echo "$(date -Is) sweep pass done; missing entries: $missing" >> "$LOG"
+    if [ "$missing" = "0" ]; then
+      echo "$(date -Is) ALL ENTRIES CAPTURED — watcher exiting" >> "$LOG"
+      exit 0
+    fi
+  else
+    echo "$(date -Is) relay wedged/down (probe timeout)" >> "$LOG"
+  fi
+  sleep 240
+done
+echo "$(date -Is) watcher deadline reached" >> "$LOG"

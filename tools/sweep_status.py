@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Print the number of measurement-sweep tags not yet captured in
+tools/measurements.jsonl (0 means the sweep is complete). Tag list is
+parsed from tools/tpu_measurements.sh so the two never drift."""
+import json
+import pathlib
+import re
+import sys
+
+root = pathlib.Path(__file__).resolve().parent
+sh = (root / "tpu_measurements.sh").read_text()
+tags = []
+for line in sh.splitlines():
+    m = re.match(r'\s*run\s+"?([A-Za-z0-9_${}]+)"?\s+\d+', line)
+    if m:
+        tags.append(m.group(1))
+expanded = []
+for t in tags:
+    if "${shape}" in t:
+        for shape in ("covtype", "amazon"):
+            expanded.append(t.replace("${shape}", shape))
+    else:
+        expanded.append(t)
+captured = set()
+out = root / "measurements.jsonl"
+if out.exists():
+    for line in out.read_text().splitlines():
+        try:
+            captured.add(json.loads(line)["tag"])
+        except (json.JSONDecodeError, KeyError):
+            pass
+missing = [t for t in expanded if t not in captured]
+if "-v" in sys.argv[1:]:
+    for t in missing:
+        print("missing:", t, file=sys.stderr)
+print(len(missing))

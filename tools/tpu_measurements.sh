@@ -40,8 +40,14 @@ run() { # run <tag> <timeout_s> <cmd...> — per-entry timeout so a relay
 run dense_f32      1800 python bench.py
 run dense_bf16     1800 env BENCH_DTYPE=bfloat16 python bench.py
 run kernel_race    900  python tools/kernel_race.py
+# one targeted fusion-favorable retry (VERDICT r2 #8): tall rows, F=64,
+# bf16-stored stack — the kernel streams half the bytes in one pass
+run kernel_race_bf16_tallR 900 python tools/kernel_race.py \
+    --slots 30 --rows 26400 --cols 64 --dtype bfloat16
 run sparse_profile 900  python tools/profile_sparse.py
-run dense_profile  900  python tools/profile_dense.py
+# dense_profile_v2: the margin-lowering variants (matmul2d / cols8 /
+# default-prec / raw-stream probes) added after the r2 dense_profile capture
+run dense_profile_v2 900 python tools/profile_dense.py
 
 for shape in covtype amazon; do
   run "sparse_${shape}_faithful"         900 python tools/bench_sparse.py --shape "$shape"
