@@ -36,30 +36,42 @@ run() { # run <tag> <timeout_s> <cmd...> — per-entry timeout so a relay
   fi
 }
 
-# bench.py manages wedge-probing internally — give it its full budget
-run dense_f32      1800 python bench.py
-run dense_bf16     1800 env BENCH_DTYPE=bfloat16 python bench.py
-run kernel_race    900  python tools/kernel_race.py
+# Ordered by value-per-wedge-risk: the round-2 window died at the covtype
+# faithful+lanes8 entry ("TPU device error" wedging every later process),
+# so the entries that decide round-3 items run FIRST and the known-risky
+# lane benches run LAST.
+
+# dense_profile_v2: the margin-lowering variants (matmul2d / cols8 /
+# default-prec / raw-stream probes) added after the r2 dense_profile capture
+run dense_profile_v2 900 python tools/profile_dense.py
 # one targeted fusion-favorable retry (VERDICT r2 #8): tall rows, F=64,
 # bf16-stored stack — the kernel streams half the bytes in one pass
 run kernel_race_bf16_tallR 900 python tools/kernel_race.py \
     --slots 30 --rows 26400 --cols 64 --dtype bfloat16
 run sparse_profile 900  python tools/profile_sparse.py
-# dense_profile_v2: the margin-lowering variants (matmul2d / cols8 /
-# default-prec / raw-stream probes) added after the r2 dense_profile capture
-run dense_profile_v2 900 python tools/profile_dense.py
 
-for shape in covtype amazon; do
+# the flagship sparse shapes: FieldOnehot pair tables (halves the lookup
+# count; amazon's 5.5k-category fields exceed the pair cap and fall back
+# to singles, which still drops the value payload), then the plain benches
+for shape in amazon covtype; do
+  run "sparse_${shape}_faithful_fields"  900 python tools/bench_sparse.py --shape "$shape" --format fields
+  run "sparse_${shape}_deduped_fields"   900 python tools/bench_sparse.py --shape "$shape" --mode deduped --format fields
   run "sparse_${shape}_faithful"         900 python tools/bench_sparse.py --shape "$shape"
   run "sparse_${shape}_deduped"          900 python tools/bench_sparse.py --shape "$shape" --mode deduped
+done
+
+# bench.py manages wedge-probing internally — give it its full budget
+run dense_f32      1800 python bench.py
+run dense_bf16     1800 env BENCH_DTYPE=bfloat16 python bench.py
+run kernel_race    900  python tools/kernel_race.py
+
+# lane-replicated gather benches last: the [rows, nnz, L] gather temps are
+# the largest allocations in the program (the r2 wedge followed a lane-
+# temp OOM); a wedge here costs nothing already captured
+for shape in amazon covtype; do
   run "sparse_${shape}_faithful_lanes8"  900 python tools/bench_sparse.py --shape "$shape" --lanes 8
   run "sparse_${shape}_deduped_lanes8"   900 python tools/bench_sparse.py --shape "$shape" --mode deduped --lanes 8
   run "sparse_${shape}_deduped_lanes128" 900 python tools/bench_sparse.py --shape "$shape" --mode deduped --lanes 128
-  # FieldOnehot pair-table lowering (halves the lookup count; amazon's
-  # 5.5k-category fields exceed the pair cap and fall back to singles,
-  # which still drops the value payload)
-  run "sparse_${shape}_faithful_fields"  900 python tools/bench_sparse.py --shape "$shape" --format fields
-  run "sparse_${shape}_deduped_fields"   900 python tools/bench_sparse.py --shape "$shape" --mode deduped --format fields
 done
 
 echo "measurements appended to $OUT" >&2
