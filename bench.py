@@ -75,6 +75,20 @@ METRIC_SUFFIX = (
     if DATA_DTYPE in _DTYPE_ITEMSIZE and DATA_DTYPE != "float32"
     else ""
 )
+# margin-lowering sweep knob (ops/features.set_dense_margin_cols): tag the
+# metric so sweep entries with different lowerings never collide. Validated
+# up front like BENCH_DTYPE — a malformed value must fail HERE, not after
+# burning the probe/run/retry timeouts inside every child, and must never
+# produce a garbage-derived metric name.
+_MARGIN_COLS_ENV = os.environ.get("BENCH_MARGIN_COLS", "")
+MARGIN_COLS: "int | None" = None
+if _MARGIN_COLS_ENV:
+    try:
+        MARGIN_COLS = int(_MARGIN_COLS_ENV)
+    except ValueError:
+        MARGIN_COLS = -1  # flagged invalid; failure record keeps bare name
+    if MARGIN_COLS is not None and 2 <= MARGIN_COLS <= 128:
+        METRIC_SUFFIX += f"_margincols{MARGIN_COLS}"
 
 
 def _failure_record(error: str) -> dict:
@@ -173,7 +187,13 @@ def _record_or_annotate(payload: dict) -> dict:
     measurement, never substituted into value/platform) so a wedged relay
     doesn't erase the evidence that a TPU number exists."""
     on_tpu = payload.get("platform") in ("tpu", "axon")
-    canonical = payload.get("dtype", "float32") == "float32"
+    # canonical = the unmodified flagship config: variant knobs (bf16 data,
+    # margin-cols lowering) are real TPU numbers but must not replace the
+    # canonical last-known-TPU artifact
+    canonical = (
+        payload.get("dtype", "float32") == "float32"
+        and not _MARGIN_COLS_ENV
+    )
     try:
         if on_tpu and canonical:
             record = dict(payload)
@@ -239,6 +259,9 @@ def child() -> None:
         lr_schedule=1.0,
         add_delay=True,
         dtype=DATA_DTYPE,  # BENCH_DTYPE: bf16 data halves HBM traffic
+        # BENCH_MARGIN_COLS: measure the production path under the
+        # margin_cols lowering before deciding its default (VERDICT r2 #2)
+        dense_margin_cols=MARGIN_COLS,
         seed=0,
     )
     print(
@@ -305,6 +328,16 @@ if __name__ == "__main__":
                 _failure_record(
                     f"BENCH_DTYPE must be one of "
                     f"{sorted(_DTYPE_ITEMSIZE)}, got {DATA_DTYPE!r}"
+                )
+            )
+        )
+        sys.exit(0 if "--child" not in sys.argv else 1)
+    if MARGIN_COLS is not None and not (2 <= MARGIN_COLS <= 128):
+        print(
+            json.dumps(
+                _failure_record(
+                    f"BENCH_MARGIN_COLS must be an int in [2, 128], "
+                    f"got {_MARGIN_COLS_ENV!r}"
                 )
             )
         )

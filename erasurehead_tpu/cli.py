@@ -147,6 +147,10 @@ def _flags_parser() -> argparse.ArgumentParser:
                    choices=["padded", "fields", "auto"],
                    help="sparse stack representation: fields = FieldOnehot "
                         "fused pair-table lowering (one-hot data only)")
+    p.add_argument("--dense-margin-cols", type=int, default=None,
+                   help="dense margin matvec lowering width [2,128]: "
+                        "replicate beta behind a barrier so the margin "
+                        "lowers as a tileable matmul (exact; column 0)")
     p.add_argument("--seq-shards", type=int, default=1,
                    help="sequence-parallel shards for the attention model: "
                         ">1 builds a 2-D (workers, seq) mesh and spans the "
@@ -227,6 +231,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         dtype=ns.dtype,
         arrival_mode=ns.arrival_mode,
         sparse_lanes=ns.sparse_lanes,
+        dense_margin_cols=ns.dense_margin_cols,
         sparse_format=ns.sparse_format,
         seq_shards=ns.seq_shards,
         sp_form=ns.sp_form,

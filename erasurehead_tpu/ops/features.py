@@ -41,6 +41,39 @@ def get_default_precision():
     return _DEFAULT_PRECISION
 
 
+# Dense margin-matvec lowering width (profile_dense's margin_cols8
+# candidate): None = direct matvec (einsum rf,f->r). A width C replicates
+# the vector operand to [F, C] behind an optimization barrier so XLA must
+# lower a real (8,128)-tileable matmul instead of a cross-lane reduction;
+# column 0 is the answer. EXACT: every column computes the identical dot
+# product at the same precision, and the output slice costs C x a [rows]
+# vector write — noise next to streaming X. Off by default pending the
+# TPU measurement (tools/profile_dense.py margin variants, VERDICT r2
+# item 2); bench.py exposes BENCH_MARGIN_COLS to measure the full
+# production path.
+_DENSE_MARGIN_COLS: Optional[int] = None
+
+
+def validate_margin_cols(C: Optional[int]) -> Optional[int]:
+    """Normalize/validate a margin-cols width: None, or an int in [2, 128].
+    Single home for the rule — RunConfig validation calls this too."""
+    if C is None:
+        return None
+    C = int(C)
+    if C < 2 or C > 128:
+        raise ValueError(f"dense margin cols must be in [2, 128], got {C}")
+    return C
+
+
+def set_dense_margin_cols(C: Optional[int]) -> None:
+    global _DENSE_MARGIN_COLS
+    _DENSE_MARGIN_COLS = validate_margin_cols(C)
+
+
+def get_dense_margin_cols() -> Optional[int]:
+    return _DENSE_MARGIN_COLS
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class PaddedRows:
@@ -382,16 +415,28 @@ def matvec(X: Features, v: jnp.ndarray, precision=None) -> jnp.ndarray:
         if v.ndim == 1:
             return jnp.sum(X.values * gathered, axis=1)
         return jnp.einsum("nk,nkh->nh", X.values, gathered, precision=precision)
+    def _margin_matmul(vec, **matmul_kwargs):
+        """Margin matvec, optionally via the cols lowering: replicate the
+        vector operand to [F, C] behind a barrier so XLA lowers a
+        tileable matmul; column 0 is the exact answer."""
+        C = _DENSE_MARGIN_COLS
+        if C is not None and v.ndim == 1:
+            bt = lax.optimization_barrier(
+                jnp.broadcast_to(vec[:, None], (vec.shape[0], C))
+            )
+            return jnp.matmul(X, bt, **matmul_kwargs)[..., 0]
+        return jnp.matmul(X, vec, **matmul_kwargs)
+
     if X.dtype == jnp.bfloat16 and v.dtype != X.dtype:
         # bf16 DATA mode: keep the streamed operand bf16 — promoting X to
         # match f32 params would make XLA materialize (and re-read) an f32
         # copy of the whole stack, voiding the mode's halved-HBM-traffic
         # point. Cast the tiny vector operand down instead; the MXU
         # accumulates natively in f32 (preferred_element_type).
-        return jnp.matmul(
-            X, v.astype(X.dtype), preferred_element_type=jnp.float32
+        return _margin_matmul(
+            v.astype(X.dtype), preferred_element_type=jnp.float32
         )
-    return jnp.matmul(X, v, precision=precision)
+    return _margin_matmul(v, precision=precision)
 
 
 def rmatvec(X: Features, r: jnp.ndarray, precision=None) -> jnp.ndarray:

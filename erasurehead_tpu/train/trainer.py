@@ -166,13 +166,14 @@ class _RunSetup:
 
 
 def _with_run_sparse_lanes(fn):
-    """Scope cfg.sparse_lanes to the trainer call: set the features-module
-    lane width for the run's traces, restore the previous value on exit.
-    Without the restore the global would leak into every later
-    matvec/rmatvec — e.g. cli.run's evaluate.replay over the FULL training
-    set, where an L-lane gather's [n, nnz, L] intermediate is L x the
-    memory (19 GB at the covtype shape with L=1024). All jitted fns inside
-    the trainers are per-run closures, so the flip always retraces.
+    """Scope cfg's features-module lowering knobs (sparse_lanes,
+    dense_margin_cols) to the trainer call: set them for the run's traces,
+    restore the previous values on exit. Without the restore a global
+    would leak into every later matvec/rmatvec — e.g. cli.run's
+    evaluate.replay over the FULL training set, where an L-lane gather's
+    [n, nnz, L] intermediate is L x the memory (19 GB at the covtype
+    shape with L=1024). All jitted fns inside the trainers are per-run
+    closures, so the flips always retrace.
     """
 
     @wraps(fn)
@@ -180,11 +181,14 @@ def _with_run_sparse_lanes(fn):
         from erasurehead_tpu.ops import features as features_lib
 
         prev = features_lib.get_sparse_lanes()
+        prev_cols = features_lib.get_dense_margin_cols()
         features_lib.set_sparse_lanes(cfg.sparse_lanes)
+        features_lib.set_dense_margin_cols(cfg.dense_margin_cols)
         try:
             return fn(cfg, dataset, *args, **kwargs)
         finally:
             features_lib.set_sparse_lanes(prev)
+            features_lib.set_dense_margin_cols(prev_cols)
 
     return wrapper
 

@@ -149,6 +149,11 @@ class RunConfig:
     # None = scalar lowering; a power of two widens every sparse lookup to
     # an L-lane row, the TPU workaround for ~7ns/element scalar gathers.
     sparse_lanes: Optional[int] = None
+    # dense margin-matvec lowering width (ops/features.set_dense_margin_cols):
+    # None = direct matvec; C in [2,128] replicates beta to [F, C] behind a
+    # barrier so the margin lowers as a tileable matmul (the profile_dense
+    # margin_cols candidate for the measured cross-lane-reduction bound)
+    dense_margin_cols: Optional[int] = None
     # per-round collection deadline in simulated seconds (scheme="deadline")
     deadline: Optional[float] = None
     # sequence-parallel shards for the attention family: >1 builds a 2-D
@@ -209,6 +214,9 @@ class RunConfig:
         from erasurehead_tpu.ops.features import validate_lanes
 
         self.sparse_lanes = validate_lanes(self.sparse_lanes)
+        from erasurehead_tpu.ops.features import validate_margin_cols
+
+        self.dense_margin_cols = validate_margin_cols(self.dense_margin_cols)
         if self.seq_shards < 1:
             raise ValueError(f"seq_shards must be >= 1, got {self.seq_shards}")
         axes_over_one = sum(

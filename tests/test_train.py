@@ -272,6 +272,29 @@ def test_bfloat16_data_dtype(gmm):
     assert rel < 0.15  # bf16 quantization drift, not divergence
 
 
+def test_dense_margin_cols_trajectory_matches_direct(gmm):
+    """cfg.dense_margin_cols (the tileable-matmul margin lowering) is
+    exact — column 0 of the replicated-operand matmul is the same dot at
+    the same precision — so the trajectory must match the direct lowering
+    to f32 reduction tolerance, and the knob must not leak out of the run
+    (the _with_run_sparse_lanes scoping)."""
+    from erasurehead_tpu.ops import features
+    from erasurehead_tpu.utils.config import RunConfig
+
+    hists = {}
+    for cols in (None, 8):
+        cfg = RunConfig(
+            scheme="approx", n_workers=W, n_stragglers=1, num_collect=6,
+            rounds=5, n_rows=N_ROWS, n_cols=N_COLS,
+            lr_schedule=1.0, update_rule="AGD", add_delay=True, seed=0,
+            dense_margin_cols=cols,
+        )
+        res = trainer.train(cfg, gmm, mesh=worker_mesh(4))
+        hists[cols] = np.asarray(res.params_history, np.float32)
+    np.testing.assert_allclose(hists[8], hists[None], rtol=1e-5, atol=1e-6)
+    assert features.get_dense_margin_cols() is None  # restored after run
+
+
 def test_adam_trains_mlp(gmm):
     """Adam (beyond-reference rule) on the MLP under AGC coding."""
     cfg = RunConfig(

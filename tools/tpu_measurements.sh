@@ -49,6 +49,9 @@ run dense_profile_v2 900 python tools/profile_dense.py
 run kernel_race_bf16_tallR 900 python tools/kernel_race.py \
     --slots 30 --rows 26400 --cols 64 --dtype bfloat16
 run sparse_profile 900  python tools/profile_sparse.py
+# full production path under the margin_cols lowering — decides the
+# production default against the captured dense_f32 entry
+run dense_f32_margincols8 1800 env BENCH_MARGIN_COLS=8 python bench.py
 
 # the flagship sparse shapes: FieldOnehot pair tables (halves the lookup
 # count; amazon's 5.5k-category fields exceed the pair cap and fall back
