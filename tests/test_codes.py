@@ -55,6 +55,31 @@ def test_mds_host_decode_exact_at_scale(W, s):
     assert err < 1e-6, err
 
 
+@pytest.mark.parametrize("W,s", [(4, 1), (6, 2), (9, 3), (12, 2), (14, 3)])
+def test_decode_table_matches_host_across_shapes(W, s):
+    """MdsDecodeTable (full 0..s range AND exact-only) == the f64 host
+    solve for EVERY <=s / exactly-s straggler pattern at each shape — the
+    exhaustive small-shape sweep behind the W=30 spot checks in
+    test_dynamic."""
+    B = codes.cyclic_generator_matrix(W, s, seed=2)
+    table = codes.build_decode_table(B, s)
+    exact = codes.build_decode_table(B, s, exact_only=True)
+    for k in range(s + 1):
+        for mask in _all_live_masks(W, k):
+            want = codes.mds_decode_weights_host(B, mask[None])[0]
+            got = np.asarray(table.lookup(jnp.asarray(mask)))
+            np.testing.assert_allclose(
+                got, want.astype(np.float32), rtol=2e-4, atol=1e-4,
+                err_msg=f"full table {mask}",
+            )
+            if k == s:
+                got_e = np.asarray(exact.lookup(jnp.asarray(mask)))
+                np.testing.assert_allclose(
+                    got_e, want.astype(np.float32), rtol=2e-4, atol=1e-4,
+                    err_msg=f"exact table {mask}",
+                )
+
+
 def test_mds_recovery_of_actual_gradients():
     W, s, F = 8, 2, 5
     rng = np.random.default_rng(0)

@@ -234,6 +234,29 @@ def test_partial_mds_matches_host(arrivals):
     np.testing.assert_allclose(recon, np.ones((R, W)), atol=5e-3)
 
 
+def test_partial_mds_with_decode_table_matches_pinv_path(arrivals):
+    """The partial scheme's completed sets have <= s stragglers (not
+    exactly s); the 0..s multi-pattern table must agree with the on-device
+    solve at small W and reconstruct all-ones on every round."""
+    layout = codes.partial_cyclic_layout(W, S + 2, S, seed=0)
+    table = codes.build_decode_table(np.asarray(layout.B), S)
+    rule = lambda t: dynamic.collect_partial_jnp(
+        t, variant="mds", frac=layout.uncoded_frac,
+        n_stragglers=layout.n_stragglers,
+        B=jnp.asarray(layout.B, jnp.float32), decode_table=table,
+    )
+    w, sim, col = _per_round(rule, arrivals)
+    ref = collect.collect_partial(arrivals, layout, "mds")
+    np.testing.assert_array_equal(col, ref.collected)
+    np.testing.assert_allclose(sim, ref.sim_time, rtol=1e-6)
+    # the table IS the f64 host solve: pin the weights tightly (the recon
+    # check alone would accept any nearby-but-wrong table row)
+    np.testing.assert_allclose(
+        w, ref.message_weights.astype(np.float32), rtol=2e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(w @ layout.B, np.ones((R, W)), atol=5e-3)
+
+
 @pytest.mark.parametrize("scheme,kw", [
     ("approx", dict(num_collect=8)),
     ("cyccoded", {}),
