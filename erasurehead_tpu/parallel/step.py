@@ -25,7 +25,6 @@ honestly reported as such (BASELINE.md).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable
 
 import jax

@@ -12,11 +12,9 @@ recompile — the chunked scan is jitted once per chunk length).
 from __future__ import annotations
 
 import os
-from typing import Any, Optional, Tuple
+from typing import Optional, Tuple
 
-import jax
 import jax.numpy as jnp
-import numpy as np
 import orbax.checkpoint as ocp
 
 from erasurehead_tpu.train.optimizer import OptState

@@ -45,7 +45,7 @@ def main() -> None:
         for tag, r in benches:
             extras = ", ".join(
                 f"{k}={r[k]}"
-                for k in ("mode", "lanes", "dtype", "pct_roofline")
+                for k in ("mode", "lanes", "format", "dtype", "pct_roofline")
                 if r.get(k) is not None
             )
             print(
