@@ -39,7 +39,7 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 .PHONY: naive cyccoded repcoded avoidstragg approxcoded \
 	partialrepcoded partialcyccoded randreg deadline \
 	generate_random_data arrange_real_data \
-	test bench compare dryrun clean
+	test bench compare real_data dryrun clean
 
 naive:            ## uncoded wait-for-all baseline (src/naive.py)
 	$(RUN) --scheme naive
@@ -78,6 +78,9 @@ arrange_real_data:     ## real-dataset partitions (src/arrange_real_data.py); se
 
 compare:          ## AGC vs EGC vs uncoded sweep (BASELINE.json north star)
 	$(PY) -m erasurehead_tpu.train.experiments
+
+real_data:        ## canonical comparison on genuinely real (UCI) data
+	$(PY) tools/real_data_run.py
 
 test:
 	$(PY) -m pytest tests/ -x -q
