@@ -61,6 +61,11 @@ def main() -> None:
     # (already well-fused) two-pass f32 lowering (VERDICT r2 item 8)
     ap.add_argument("--dtype", choices=("float32", "bfloat16"),
                     default="float32")
+    # CPU rehearsal hook: run the pallas kernel in interpret mode so the
+    # whole script (arg surface, bf16 operand plumbing, result schema) can
+    # be validated off-TPU before spending a healthy relay window on it.
+    # Timings in this mode are meaningless; the JSON carries the flag.
+    ap.add_argument("--interpret", action="store_true")
     args = ap.parse_args()
 
     from erasurehead_tpu.ops import kernels
@@ -94,7 +99,9 @@ def main() -> None:
 
     results = {}
     for kind in ("logistic", "linear"):
-        fused = lambda b, X, y, w, k=kind: kernels.fused_glm_grad(b, X, y, w, k)
+        fused = lambda b, X, y, w, k=kind: kernels.fused_glm_grad(
+            b, X, y, w, k, interpret=args.interpret
+        )
         if dt == jnp.bfloat16:
             xla_hi = lambda b, X, y, w, k=kind: xla_bf16(b, X, y, w, k)
         else:
@@ -122,6 +129,7 @@ def main() -> None:
         "shape": [M, R, F],
         "dtype": str(dt),
         "x_mib": round(x_bytes / 2**20, 1),
+        **({"interpret": True} if args.interpret else {}),
         **results,
     }
     print(json.dumps(out))

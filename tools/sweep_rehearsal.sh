@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# CPU rehearsal of every still-queued tpu_measurements.sh entry, in light
+# form: validates the exact tool/flag surface each sweep command will use
+# so a healthy relay window is pure harvest, never debugging. Timings are
+# meaningless here — the point is that every command parses, runs, and
+# emits its JSON line. Writes tools/rehearsal.jsonl (committed as the
+# readiness record).
+#
+#   bash tools/sweep_rehearsal.sh [out.jsonl]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-tools/rehearsal.jsonl}"
+: > "$OUT"
+export PYTHONPATH="${PYTHONPATH:-}:$(pwd)"
+# scrub the axon tunnel (memory: a CPU process dialing the relay can wedge
+# a concurrent TPU job) and pin the virtual multi-device CPU platform
+SCRUB=(env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu
+       XLA_FLAGS=--xla_force_host_platform_device_count=8)
+
+run() { # run <sweep_tag> <timeout_s> <cmd...>
+  local tag="$1" tmo="$2"; shift 2
+  echo "=== rehearse $tag: $*" >&2
+  local line rc
+  line="$(timeout "$tmo" "${SCRUB[@]}" "$@" 2>"$OUT.$tag.log" | tail -1)"
+  rc=$?
+  # OK requires all three: command exited 0, produced a line, and the line
+  # is valid JSON — a crash that printed diagnostics to stdout must be
+  # recorded as the failure it is, not embedded in the readiness record
+  if [ "$rc" -eq 0 ] && [ -n "$line" ] \
+     && printf '%s' "$line" | python -m json.tool >/dev/null 2>&1; then
+    printf '{"tag": "%s", "result": %s}\n' "$tag" "$line" >> "$OUT"
+    echo "$tag OK" >&2
+  else
+    printf '{"tag": "%s", "result": {"error": "rehearsal failed, rc=%s"}}\n' \
+      "$tag" "$rc" >> "$OUT"
+    echo "$tag FAILED rc=$rc (see $OUT.$tag.log)" >&2
+  fi
+}
+
+run dense_profile_v2 600 python tools/profile_dense.py \
+    --slots 4 --rows 256 --cols 64
+run kernel_race_bf16_tallR 600 python tools/kernel_race.py \
+    --slots 2 --rows 128 --cols 64 --iters 2 --dtype bfloat16 --interpret
+run sparse_profile 600 python tools/profile_sparse.py \
+    --slots 4 --rows 256 --nnz 4 --cols 512
+run dense_f32_margincols8 600 env BENCH_MARGIN_COLS=8 python bench.py
+
+for shape in amazon covtype; do
+  run "sparse_${shape}_faithful_fields"  600 python tools/bench_sparse.py --shape "$shape" --format fields --light
+  run "sparse_${shape}_deduped_fields"   600 python tools/bench_sparse.py --shape "$shape" --mode deduped --format fields --light
+  run "sparse_${shape}_faithful"         600 python tools/bench_sparse.py --shape "$shape" --light
+  run "sparse_${shape}_deduped"          600 python tools/bench_sparse.py --shape "$shape" --mode deduped --light
+  run "sparse_${shape}_faithful_lanes8"  600 python tools/bench_sparse.py --shape "$shape" --lanes 8 --light
+  run "sparse_${shape}_deduped_lanes8"   600 python tools/bench_sparse.py --shape "$shape" --mode deduped --lanes 8 --light
+  run "sparse_${shape}_deduped_lanes128" 600 python tools/bench_sparse.py --shape "$shape" --mode deduped --lanes 128 --light
+done
+
+n_ok=$(wc -l < "$OUT")
+echo "rehearsal: $n_ok entries captured in $OUT" >&2
