@@ -208,6 +208,20 @@ def test_cli_kill_workers_more_validation():
         cli.run(base, kill_workers="9:2", quiet=True)
 
 
+def test_cli_dense_margin_cols_validation():
+    """The margin-cols lowering knob validates through RunConfig (shared
+    rule: features.validate_margin_cols) for both config and CLI values."""
+    from erasurehead_tpu.utils.config import RunConfig
+
+    for bad in (1, 0, 256, -8):
+        with pytest.raises(ValueError, match="margin cols"):
+            RunConfig(scheme="naive", n_workers=4, rounds=2, n_rows=64,
+                      n_cols=8, lr_schedule=1.0, dense_margin_cols=bad)
+    cfg = RunConfig(scheme="naive", n_workers=4, rounds=2, n_rows=64,
+                    n_cols=8, lr_schedule=1.0, dense_margin_cols="8")
+    assert cfg.dense_margin_cols == 8  # normalized to int
+
+
 def test_cli_deadline_scheme_artifacts(tmp_path):
     """scheme=deadline end to end through the CLI: artifacts carry the
     scheme's own prefix (regression: run_prefix lacked the new scheme)."""

@@ -7,7 +7,7 @@
 # readiness record).
 #
 #   bash tools/sweep_rehearsal.sh [out.jsonl]
-set -u
+set -u -o pipefail  # rc must be the rehearsed command's, not tail's
 cd "$(dirname "$0")/.."
 OUT="${1:-tools/rehearsal.jsonl}"
 : > "$OUT"

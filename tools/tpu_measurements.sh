@@ -9,7 +9,7 @@
 # race, the dense-lowering profile (precision/bf16/pass split), the sparse
 # canonical shapes (covtype + amazon) across faithful/deduped x
 # scalar/lanes lowerings, and the sparse rmatvec profile.
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 OUT="${1:-tools/measurements.jsonl}"
 export PYTHONPATH="${PYTHONPATH:-}:$(pwd)"
@@ -26,13 +26,24 @@ run() { # run <tag> <timeout_s> <cmd...> — per-entry timeout so a relay
     return
   fi
   echo "=== $tag ($tmo s): $*" >&2
-  local line
+  local line rc
   line="$(timeout "$tmo" "$@" 2>"$OUT.$tag.log" | tail -1)"
-  if [ -n "$line" ]; then
+  rc=$?
+  # Record ONLY exit-0 runs whose last line is valid JSON from a real TPU:
+  # garbage would corrupt the decision record, and — because the resume
+  # check greps for the tag — any recorded line marks the entry captured
+  # forever. In particular bench.py exits 0 with a platform:"cpu" fallback
+  # line when the relay wedges mid-sweep; that must stay un-captured so
+  # the next healthy window retries it. A failure appends nothing.
+  if [ "$rc" -eq 0 ] && [ -n "$line" ] \
+     && printf '%s' "$line" | python -c '
+import json, sys
+d = json.load(sys.stdin)
+sys.exit(1 if d.get("platform") in ("cpu", "none") else 0)' 2>/dev/null; then
     printf '{"tag": "%s", "result": %s}\n' "$tag" "$line" >> "$OUT"
     echo "$tag -> $line" >&2
   else
-    echo "$tag -> FAILED (see $OUT.$tag.log)" >&2
+    echo "$tag -> FAILED rc=$rc (see $OUT.$tag.log)" >&2
   fi
 }
 
