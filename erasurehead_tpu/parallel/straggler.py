@@ -35,18 +35,23 @@ import numpy as np
 
 
 def reference_delay_schedule(
-    rounds: int, n_workers: int, mean: float = 0.5
+    rounds: int, n_workers: int, mean: float = 0.5, seed_offset: int = 0
 ) -> np.ndarray:
     """[rounds, n_workers] delay matrix, bit-exact with the reference.
 
     The reference executes ``np.random.seed(i); np.random.exponential(0.5,
     n_workers)`` inside iteration i (src/naive.py:141-147);
     ``np.random.RandomState(i).exponential`` draws from the identical MT19937
-    stream.
+    stream. ``seed_offset`` selects an independent delay universe with the
+    same construction (0 = the reference's own schedule) — the variance
+    study's knob (tools/flagship_variance.py), kept here so the
+    reference-fidelity recipe has exactly one home.
     """
     out = np.empty((rounds, n_workers))
     for i in range(rounds):
-        out[i] = np.random.RandomState(i).exponential(mean, n_workers)
+        out[i] = np.random.RandomState(i + seed_offset).exponential(
+            mean, n_workers
+        )
     return out
 
 
