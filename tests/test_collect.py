@@ -208,6 +208,21 @@ def test_reference_delay_schedule_parity():
         assert np.array_equal(sched[i], expect)
 
 
+def test_reference_delay_schedule_seed_offset():
+    """seed_offset=0 is the reference's exact schedule; a nonzero offset
+    is an independent universe with the same MT19937 construction (the
+    variance study's knob, tools/flagship_variance.py)."""
+    base = straggler.reference_delay_schedule(4, W)
+    assert np.array_equal(
+        base, straggler.reference_delay_schedule(4, W, seed_offset=0)
+    )
+    other = straggler.reference_delay_schedule(4, W, seed_offset=1_000_003)
+    assert not np.array_equal(base, other)
+    for i in range(4):
+        np.random.seed(i + 1_000_003)
+        assert np.array_equal(other[i], np.random.exponential(0.5, W))
+
+
 def test_heterogeneous_arrival_model():
     """compute_time + worker_speed_spread shift arrivals per worker; the
     pure-delay reference regime (0/0) is unchanged."""
