@@ -199,6 +199,26 @@ def prepare_breast_cancer(input_dir: Optional[str] = None) -> Dataset:
     return ds
 
 
+def prepare_diabetes(input_dir: Optional[str] = None) -> Dataset:
+    """UCI diabetes regression — the genuinely real bundled counterpart of
+    kc_house_data for the LINEAR model family (442 rows x 10 standardized
+    clinical features; progression score target). Same pipeline shape as
+    prepare_kc_house (arrange_real_data.py:207-253): bias column, 80/20
+    split, one-hot of the label-encoded continuous columns, target scaled
+    to O(1) like the reference's price/1e6."""
+    from sklearn.datasets import load_diabetes
+
+    bunch = load_diabetes()
+    X = bunch.data
+    y = bunch.target / 100.0  # O(1) target, ≙ price/1e6 scaling
+    # like prepare_kc_house, raw values one-hot directly (the encoder's
+    # categories='auto' handles continuous columns; no label-encode pass)
+    X = np.hstack([X, np.ones((X.shape[0], 1))])
+    ds = _one_hot_split(X, y)
+    ds.name = "diabetes"
+    return ds
+
+
 PREPARERS: dict[str, Callable[..., Dataset]] = {
     "amazon": prepare_amazon,
     "amazon-dataset": prepare_amazon,  # the reference's directory name
@@ -209,6 +229,7 @@ PREPARERS: dict[str, Callable[..., Dataset]] = {
     "kc_house_data": prepare_kc_house,
     # real (non-synthetic) data available without network access
     "breast_cancer": prepare_breast_cancer,
+    "diabetes": prepare_diabetes,
 }
 
 

@@ -102,6 +102,22 @@ def test_breast_cancer_pipeline_real_data():
     assert (ds.X_train != ds2.X_train).nnz == 0
 
 
+def test_diabetes_pipeline_real_data():
+    """Real regression data with no network: sklearn's bundled UCI
+    diabetes set through the kc_house-style flow — the linear family's
+    real-data counterpart to breast_cancer."""
+    ds = real.prepare("diabetes", None)
+    assert sps.issparse(ds.X_train)
+    assert ds.X_train.shape[0] == 353 and ds.X_test.shape[0] == 89
+    # continuous regression target, O(1) scaled
+    assert ds.y_train.dtype == np.float64
+    assert 0 < np.abs(ds.y_train).mean() < 10
+    # 10 real features + bias, one-hot per column: exactly 11 nnz per row
+    assert (np.diff(ds.X_train.tocsr().indptr) == 11).all()
+    ds2 = real.prepare("diabetes", None)
+    assert (ds.X_train != ds2.X_train).nnz == 0
+
+
 def test_amazon_interaction_exclusions():
     X = np.arange(18).reshape(2, 9)
     feats = real.hashed_interactions(X, degree=2)

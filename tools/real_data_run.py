@@ -1,12 +1,15 @@
 #!/usr/bin/env python
-"""Train the canonical scheme comparison on REAL (non-synthetic) data.
+"""Train the canonical scheme comparisons on REAL (non-synthetic) data.
 
 The four reference datasets need network access (Kaggle CSVs / sklearn
-fetch), which this sandbox does not have; scikit-learn's bundled UCI
-breast-cancer set is genuinely real clinical data, so it stands in to
-prove the full preparer -> partition -> coded-training -> eval pipeline on
-non-synthetic value distributions (VERDICT r2 item 5). Writes
-artifacts/6_agc_breast_cancer[real-uci].{json,png}.
+fetch), which this sandbox does not have; scikit-learn's bundled UCI sets
+are genuinely real, so they stand in to prove the full preparer ->
+partition -> coded-training -> eval pipeline on non-synthetic value
+distributions (VERDICT r2 item 5): breast_cancer for the logistic family
+and diabetes for the linear (least-squares) family, mirroring the
+reference's covtype and kc_house_data configs. Writes
+artifacts/6_agc_breast_cancer[real-uci].{json,png} and
+artifacts/7_agc_linear_diabetes[real-uci].{json,png}.
 
 Usage: python tools/real_data_run.py [--rounds 60] [--out-dir artifacts]
 """
@@ -31,41 +34,65 @@ def main() -> int:
     from erasurehead_tpu.train import experiments, plots
     from erasurehead_tpu.utils.config import RunConfig
 
-    ds = real.prepare("breast_cancer", input_dir=None)
-    n_train, n_feat = ds.X_train.shape
-    print(
-        f"breast_cancer (real UCI): train {ds.X_train.shape}, "
-        f"test {ds.X_test.shape}, nnz/row "
-        f"{ds.X_train.nnz / n_train:.1f}",
-        file=sys.stderr,
-    )
-
     W = ns.workers
-    base = dict(
-        n_workers=W, rounds=ns.rounds, add_delay=True,
-        n_rows=n_train, n_cols=n_feat, update_rule="AGD",
-        lr_schedule=1.0, seed=0,
-    )
-    configs = {
-        "naive": RunConfig(scheme="naive", n_stragglers=0, **base),
-        "cyccoded_s2": RunConfig(scheme="cyccoded", n_stragglers=2, **base),
-        "agc_collect_N-3": RunConfig(
-            scheme="approx", n_stragglers=2, num_collect=W - 3, **base
-        ),
-        "avoidstragg_s2": RunConfig(
-            scheme="avoidstragg", n_stragglers=2, **base
-        ),
-    }
-    summaries = experiments.compare(configs, ds)
-    print(experiments.format_table(summaries))
-
     os.makedirs(ns.out_dir, exist_ok=True)
-    stem = os.path.join(ns.out_dir, "6_agc_breast_cancer[real-uci]")
-    experiments.save_summaries(summaries, stem + ".json")
-    fig = plots.save_comparison_figure(
-        summaries, stem + ".png", title="breast_cancer (real UCI data)"
+
+    def run_comparison(dataset_name, stem_name, title, scheme_specs, **cfg_kw):
+        """prepare -> compare -> table -> save: one home for both runs."""
+        ds = real.prepare(dataset_name, input_dir=None)
+        n_train, n_feat = ds.X_train.shape
+        print(
+            f"{dataset_name} (real UCI): train {ds.X_train.shape}, "
+            f"test {ds.X_test.shape}, nnz/row "
+            f"{ds.X_train.nnz / n_train:.1f}",
+            file=sys.stderr,
+        )
+        base = dict(
+            n_workers=W, rounds=ns.rounds, add_delay=True,
+            n_rows=n_train, n_cols=n_feat, update_rule="AGD", seed=0,
+            **cfg_kw,
+        )
+        configs = {
+            label: RunConfig(**{**base, **spec})
+            for label, spec in scheme_specs.items()
+        }
+        summaries = experiments.compare(configs, ds)
+        print(experiments.format_table(summaries))
+        stem = os.path.join(ns.out_dir, stem_name)
+        experiments.save_summaries(summaries, stem + ".json")
+        fig = plots.save_comparison_figure(summaries, stem + ".png",
+                                           title=title)
+        print(f"artifacts -> {stem}.json" + (f", {fig}" if fig else ""))
+
+    # logistic family on real clinical data (≙ the reference's covtype
+    # config, arrange_real_data.py:145-205)
+    run_comparison(
+        "breast_cancer", "6_agc_breast_cancer[real-uci]",
+        "breast_cancer (real UCI data)",
+        {
+            "naive": dict(scheme="naive", n_stragglers=0),
+            "cyccoded_s2": dict(scheme="cyccoded", n_stragglers=2),
+            "agc_collect_N-3": dict(
+                scheme="approx", n_stragglers=2, num_collect=W - 3
+            ),
+            "avoidstragg_s2": dict(scheme="avoidstragg", n_stragglers=2),
+        },
+        lr_schedule=1.0,
     )
-    print(f"artifacts -> {stem}.json" + (f", {fig}" if fig else ""))
+
+    # linear family on real regression data (≙ the reference's
+    # kc_house_data least-squares config, run_approx_coding.sh:31-36)
+    run_comparison(
+        "diabetes", "7_agc_linear_diabetes[real-uci]",
+        "diabetes linear regression (real UCI data)",
+        {
+            "naive": dict(scheme="naive", n_stragglers=0),
+            "agc_collect_N-3": dict(
+                scheme="approx", n_stragglers=2, num_collect=W - 3
+            ),
+        },
+        model="linear", lr_schedule=0.1,
+    )
     return 0
 
 
