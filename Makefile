@@ -39,7 +39,7 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 .PHONY: naive cyccoded repcoded avoidstragg approxcoded \
 	partialrepcoded partialcyccoded randreg deadline \
 	generate_random_data arrange_real_data \
-	test bench compare real_data dryrun clean
+	test bench sweep rehearse watch compare real_data dryrun clean
 
 naive:            ## uncoded wait-for-all baseline (src/naive.py)
 	$(RUN) --scheme naive
@@ -87,6 +87,15 @@ test:
 
 bench:
 	$(PY) bench.py
+
+sweep:            ## the full on-TPU measurement program (resumable, tagged)
+	bash tools/tpu_measurements.sh
+
+rehearse:         ## CPU rehearsal of every queued sweep entry (light form)
+	bash tools/sweep_rehearsal.sh
+
+watch:            ## probe the relay; run the sweep in the first healthy window
+	bash tools/relay_watch.sh
 
 dryrun:           ## validate the multi-chip sharding on a virtual 8-device CPU mesh
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
