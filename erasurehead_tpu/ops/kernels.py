@@ -41,12 +41,20 @@ _X_BLOCK_BYTES = 2 * 1024 * 1024
 _MAX_BLOCK_ROWS = 512
 
 
-def choose_block_rows(n_rows: int, n_features: int) -> int:
-    """Largest multiple-of-8 row block that fits the VMEM budget."""
+def choose_block_rows(
+    n_rows: int, n_features: int, sublane: int = 8
+) -> int:
+    """Largest multiple-of-``sublane`` row block that fits the VMEM budget.
+
+    ``sublane`` is the TPU tile's second-minor size for the streamed dtype:
+    8 for f32, 16 for bf16 — a bf16 block whose row count is not a
+    multiple of 16 would force Mosaic to retile."""
     by_vmem = _X_BLOCK_BYTES // max(1, 4 * n_features)
-    cap = min(_MAX_BLOCK_ROWS, max(8, by_vmem // 8 * 8))
-    padded8 = -(-n_rows // 8) * 8
-    return min(cap, padded8)
+    cap = min(
+        _MAX_BLOCK_ROWS, max(sublane, by_vmem // sublane * sublane)
+    )
+    padded = -(-n_rows // sublane) * sublane
+    return min(cap, padded)
 
 
 def _residual(kind: str, p, y):
@@ -96,7 +104,9 @@ def fused_glm_grad(
     """Decoded GLM gradient in one pass over X. Returns [F] float32."""
     M, R, F = X.shape
     x_dtype = jnp.bfloat16 if X.dtype == jnp.bfloat16 else jnp.float32
-    BR = block_rows or choose_block_rows(R, F)
+    BR = block_rows or choose_block_rows(
+        R, F, sublane=16 if x_dtype == jnp.bfloat16 else 8
+    )
     Rp = -(-R // BR) * BR
     if Rp != R:
         # zero rows contribute zero gradient for both residuals; XLA hoists

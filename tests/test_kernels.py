@@ -74,6 +74,23 @@ def test_choose_block_rows_bounds():
     assert kernels.choose_block_rows(5, 128) == 8  # padded-up tiny R
     big = kernels.choose_block_rows(10_000, 32_768)
     assert big >= 8 and big * 32_768 * 4 <= 2 * kernels._X_BLOCK_BYTES
+    # bf16 stacks need 16-row tile alignment (sublane=16): every shape,
+    # including non-multiples, must come back 16-aligned
+    for R, F in ((4400, 128), (40, 64), (17, 128), (5, 128)):
+        assert kernels.choose_block_rows(R, F, sublane=16) % 16 == 0, (R, F)
+
+
+def test_fused_bf16_auto_block_selection():
+    """The bf16 auto path (no explicit block_rows) must pick a 16-aligned
+    block and still match the f32 oracle — guards the Mosaic-retiling
+    hazard the sublane parameter exists to avoid."""
+    b, X, y, w = _case(3, 40, 64)  # R=40: 8-aligned but NOT 16-aligned
+    Xb = X.astype(jnp.bfloat16)
+    got = kernels.fused_glm_grad(b, Xb, y, w, "logistic", interpret=True)
+    want = kernels.reference_glm_grad(
+        b, Xb.astype(jnp.float32), y, w, "logistic"
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
 
 
 def test_supports_fused_gating():
