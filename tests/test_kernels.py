@@ -135,6 +135,36 @@ def test_trainer_pallas_path_matches_xla(scheme, compute_mode):
     )
 
 
+def test_trainer_pallas_bf16_data_matches_xla():
+    """use_pallas=on composed with dtype=bfloat16 (the half-traffic
+    streaming combination the kernel's bf16 path exists for): the fused
+    trajectory must track the XLA bf16 trajectory."""
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    W = 8
+    mesh = worker_mesh(4)
+    data = generate_gmm(16 * W, 32, n_partitions=W, seed=0)
+    histories = {}
+    for use in ("off", "on"):
+        cfg = RunConfig(
+            scheme="approx", n_workers=W, n_stragglers=1, num_collect=6,
+            rounds=4, n_rows=16 * W, n_cols=32, lr_schedule=1.0,
+            update_rule="AGD", add_delay=True, seed=0,
+            dtype="bfloat16", use_pallas=use,
+        )
+        res = trainer.train(cfg, data, mesh=mesh)
+        histories[use] = np.asarray(res.params_history, np.float32)
+    assert np.isfinite(histories["on"]).all()
+    # both paths stream bf16-rounded data; the kernel contracts in exact
+    # f32 while XLA's bf16 MXU pass rounds intermediates -> bf16-level drift
+    np.testing.assert_allclose(
+        histories["on"], histories["off"], rtol=2e-2, atol=2e-3
+    )
+
+
 def test_trainer_pallas_on_rejects_mlp():
     from erasurehead_tpu.data.synthetic import generate_gmm
     from erasurehead_tpu.parallel.mesh import worker_mesh
