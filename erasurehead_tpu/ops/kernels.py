@@ -154,10 +154,17 @@ def supports_fused(X, model_name: str, platform: str) -> bool:
         rounding also failed the science, see _kernel comment)
       - exact-f32 VPU variant (this file): logistic 2.60 ms vs XLA 1.87 ms,
         linear 2.58 ms vs XLA 1.90 ms (r2, slower)
+      - fusion-favorable retry at [30, 26400, 64] bf16-stored (tall rows,
+        narrow F, half the bytes/pass — the shape most generous to a
+        single-streaming-pass kernel): logistic pallas 3.48 ms vs XLA
+        1.87 ms, speedup 0.54x (r3, decisively slower)
     XLA's two-pass lowering overlaps the margin and transpose matvecs well
     enough that the single-streaming-pass VPU kernel cannot beat it — the
-    VPU multiply-reduce is the bottleneck, not HBM. The kernel stays as the
-    measured-and-lost alternative (and pallas reference pattern); force it
-    with use_pallas="on"; tests pin it to the XLA oracle in interpret mode.
+    VPU multiply-reduce is the bottleneck, not HBM, so halving HBM bytes
+    (bf16) widens XLA's lead rather than closing it. CLOSED as a measured
+    negative result (three independent races across three shapes/dtypes):
+    the kernel stays as the pallas reference pattern and correctness
+    alternative only — use_pallas="on" is NOT a performance option. Tests
+    pin it to the XLA oracle in interpret mode.
     """
     return False
