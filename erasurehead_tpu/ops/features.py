@@ -289,14 +289,15 @@ def infer_field_sizes(csr) -> Optional[tuple]:
 
 Features = Union[jnp.ndarray, PaddedRows, FieldOnehot]
 
-# Sparse gather/scatter lane width. TPU scalar gather/scatter throughput is
+# Sparse margin-gather lane width. TPU scalar gather/scatter throughput is
 # ~7 ns/element (measured, tools/profile_sparse.py) — each of the nnz
 # lookups moves 4 bytes through a path sized for 512-byte vector rows. With
 # lanes=L, matvec gathers L-wide rows from a lane-replicated [F, L] table
-# and rmatvec scatter-adds L-wide rows into a [F, L] accumulator (all lanes
-# identical; lane 0 is the answer), trading L x memory traffic for
-# vectorized addressing. None = plain scalar lowering (CPU default; exact
-# same arithmetic).
+# (all lanes identical; the lane reduction recovers the exact scalar
+# answer), trading L x gather traffic for vectorized addressing — measured
+# 2.6x on the margin at L=8. The scatter direction is deliberately scalar:
+# lane scatter measured as a net loss (see rmatvec). None = plain scalar
+# lowering (CPU default; exact same arithmetic).
 _SPARSE_LANES: Optional[int] = None
 
 
@@ -314,7 +315,12 @@ def validate_lanes(L: Optional[int]) -> Optional[int]:
 
 
 def set_sparse_lanes(L: Optional[int]) -> None:
-    """Set the PaddedRows gather/scatter lane width (None = scalar path).
+    """Set the PaddedRows margin-gather lane width (None = scalar path).
+
+    Applies to the matvec (margin) direction only: the v5e profile
+    (tools/profile_sparse.py) measured the lane gather at 2.6x the scalar
+    gather but the lane scatter as a net loss, so rmatvec always uses the
+    scalar scatter-add.
 
     L must be a power of two: the lane reduction ``sum(lanes) / L`` is then
     exactly a single lane's value (all lanes are identical; summing L equal
@@ -445,19 +451,13 @@ def rmatvec(X: Features, r: jnp.ndarray, precision=None) -> jnp.ndarray:
     if isinstance(X, FieldOnehot):
         return _fields_rmatvec(X, r)
     if isinstance(X, PaddedRows):
-        L = _SPARSE_LANES
-        if L is not None and r.ndim == 1:
-            contrib = (X.values * r[:, None]).reshape(-1, 1)  # [n*nnz, 1]
-            rows = jax.lax.optimization_barrier(
-                jnp.broadcast_to(contrib, (contrib.shape[0], L))
-            )
-            out = (
-                jnp.zeros((X.n_cols, L), contrib.dtype)
-                .at[X.indices.reshape(-1)]
-                .add(rows)
-            )
-            # exact: every lane accumulated the identical add sequence
-            return out.sum(axis=1) * (1.0 / L)
+        # Lanes deliberately do NOT apply here: v5e measurement
+        # (tools/profile_sparse.py, window 1 round 3) put the L=8 lane
+        # scatter at 112 ms vs 102 ms scalar at the covtype slot stack —
+        # the scatter-add's read-modify-write serializes on the accumulator
+        # row either way, so lane replication only adds traffic. The lane
+        # win is gather-side only (97 -> 37 ms), so set_sparse_lanes scopes
+        # to matvec.
         if r.ndim == 1:
             contrib = (X.values * r[:, None]).reshape(-1)  # [n*nnz]
             return jnp.zeros(X.n_cols, contrib.dtype).at[

@@ -1,0 +1,30 @@
+"""Import FIRST to force a standalone tool onto the host CPU backend.
+
+This image's sitecustomize force-registers the remote-TPU ("axon") PJRT
+plugin and sets JAX_PLATFORMS=axon, so merely exporting JAX_PLATFORMS=cpu
+does nothing — the same dance tests/conftest.py does for pytest is needed
+for ad-hoc tool runs (compile-time experiments, rehearsals) that must not
+dial the single-client TPU tunnel (a second client wedges it).
+
+    import _force_cpu  # noqa: F401  (before anything imports jax)
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+# pop only the tunnel plugin; removing "tpu" would unregister the platform
+# name itself (see tests/conftest.py)
+_xb._backend_factories.pop("axon", None)
+
+assert jax.devices()[0].platform == "cpu"

@@ -1,0 +1,53 @@
+"""Retry wrapper for transient axon-relay failures during measurement.
+
+The single-client TPU tunnel compiles through an HTTP endpoint that
+occasionally drops a response mid-body ("read body: response body closed
+before all bytes were read") without wedging the device — the very next
+dispatch succeeds. A measurement tool that dies on the first such flake
+forfeits its whole sweep entry (15-min timeout budget) for a 10-second
+hiccup, so the warm-up/compile step of every timing loop goes through
+``with_retries``. A true wedge (every retry failing) still fails fast enough
+to leave the sweep's per-entry timeout unspent.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+# substrings marking relay-transport flakes (retryable), as opposed to
+# genuine program errors (OOM, shape mismatch) which must propagate
+_TRANSIENT = (
+    "remote_compile",
+    "read body",
+    "response body closed",
+    "connection reset",
+    "connection refused",
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+)
+
+
+def with_retries(fn: Callable[[], T], attempts: int = 3,
+                 sleep_s: float = 15.0) -> T:
+    """Run ``fn`` (a compile/dispatch thunk), retrying transient relay
+    transport errors up to ``attempts`` times; non-transient errors and the
+    final failure propagate unchanged."""
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # jax.errors.JaxRuntimeError et al.
+            msg = str(e)
+            transient = any(t.lower() in msg.lower() for t in _TRANSIENT)
+            if not transient or i == attempts - 1:
+                raise
+            print(
+                f"relay flake (attempt {i + 1}/{attempts}), retrying in "
+                f"{sleep_s:.0f}s: {msg.splitlines()[0][:120]}",
+                file=sys.stderr,
+            )
+            time.sleep(sleep_s)
+    raise AssertionError("unreachable")

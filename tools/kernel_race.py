@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from _relay import with_retries
+
 
 def time_scanned(grad_fn, beta, X, y, w, iters: int, reps: int = 5) -> float:
     """Seconds per gradient application, measured INSIDE one dispatch.
@@ -40,7 +42,7 @@ def time_scanned(grad_fn, beta, X, y, w, iters: int, reps: int = 5) -> float:
         bN, _ = jax.lax.scan(body, b0, None, length=iters)
         return bN
 
-    jax.block_until_ready(many(beta))  # compile + warm
+    with_retries(lambda: jax.block_until_ready(many(beta)))  # compile + warm
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()

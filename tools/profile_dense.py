@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from _relay import with_retries
+
 
 def time_scanned(fn, beta0, iters=50, reps=5):
     @jax.jit
@@ -39,7 +41,7 @@ def time_scanned(fn, beta0, iters=50, reps=5):
         bN, _ = lax.scan(body, b0, None, length=iters)
         return bN
 
-    jax.block_until_ready(many(beta0))
+    with_retries(lambda: jax.block_until_ready(many(beta0)))
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -53,6 +55,12 @@ def main() -> None:
     ap.add_argument("--slots", type=int, default=90)
     ap.add_argument("--rows", type=int, default=4400)
     ap.add_argument("--cols", type=int, default=128)
+    ap.add_argument(
+        "--only", default="",
+        help="comma-separated substrings: measure only matching variants "
+             "(each costs a slow relay compile; the sweep runs this profile "
+             "as small tagged groups that fit a per-entry timeout)",
+    )
     args = ap.parse_args()
     M, R, F = args.slots, args.rows, args.cols
 
@@ -145,7 +153,10 @@ def main() -> None:
 
     cases["margin_default_prec"] = (margin_dot_bf16ops, X.nbytes)
 
+    only = [s for s in args.only.split(",") if s]
     for name, (fn, traffic) in cases.items():
+        if only and not any(s in name for s in only):
+            continue
         ms = time_scanned(fn, beta0) * 1e3
         gbps = traffic / (ms / 1e3) / 1e9
         results[f"{name}_ms"] = round(ms, 4)

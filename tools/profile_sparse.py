@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from _relay import with_retries
+
 
 def time_scanned(fn, args, iters=30, reps=3):
     """Seconds/iteration inside one jitted scan; fn(carry, *args)->carry."""
@@ -34,7 +36,7 @@ def time_scanned(fn, args, iters=30, reps=3):
         return cN
 
     c0 = jnp.zeros(F, jnp.float32)  # carry is always the beta vector
-    jax.block_until_ready(many(c0))
+    with_retries(lambda: jax.block_until_ready(many(c0)))
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -48,7 +50,19 @@ ap.add_argument("--slots", type=int, default=90)
 ap.add_argument("--rows", type=int, default=13203)
 ap.add_argument("--nnz", type=int, default=12)
 ap.add_argument("--cols", type=int, default=15509)
+ap.add_argument(
+    "--only", default="",
+    help="comma-separated substrings: measure only matching candidates "
+         "(each costs a slow relay compile, so the sweep runs this profile "
+         "as small tagged groups that fit a per-entry timeout)",
+)
 args = ap.parse_args()
+
+
+def want(name: str) -> bool:
+    return (not args.only) or any(
+        s and s in name for s in args.only.split(",")
+    )
 
 M, R, K, F = args.slots, args.rows, args.nnz, args.cols
 print(f"profile: {jax.devices()[0].platform} M={M} R={R} K={K} F={F}",
@@ -83,10 +97,11 @@ def margin(beta, idxs, vals, ys):
     return beta * 0.999 + jnp.sum(g) / F
 
 
-results["margin_gather_ms"] = round(
-    time_scanned(margin, (idx_j, val_j, y_j)) * 1e3, 3
-)
-print(f"profile: margin {results['margin_gather_ms']}ms", file=sys.stderr)
+if want("margin_gather"):
+    results["margin_gather_ms"] = round(
+        time_scanned(margin, (idx_j, val_j, y_j)) * 1e3, 3
+    )
+    print(f"profile: margin {results['margin_gather_ms']}ms", file=sys.stderr)
 
 
 # --- rmatvec: current unsorted scatter ------------------------------------
@@ -99,10 +114,11 @@ def scatter(beta, idxs, vals, ys):
     return dep(beta, g)
 
 
-results["scatter_ms"] = round(
-    time_scanned(scatter, (idx_j, val_j, y_j)) * 1e3, 3
-)
-print(f"profile: scatter {results['scatter_ms']}ms", file=sys.stderr)
+if want("scatter_ms"):
+    results["scatter_ms"] = round(
+        time_scanned(scatter, (idx_j, val_j, y_j)) * 1e3, 3
+    )
+    print(f"profile: scatter {results['scatter_ms']}ms", file=sys.stderr)
 
 
 # --- rmatvec: sort inside jit (hoistable: ids are loop-invariant) ---------
@@ -119,10 +135,12 @@ def sortjit(beta, idxs, vals, ys):
     return dep(beta, g)
 
 
-results["sort_in_jit_ms"] = round(
-    time_scanned(sortjit, (idx_j, val_j, y_j)) * 1e3, 3
-)
-print(f"profile: sort_in_jit {results['sort_in_jit_ms']}ms", file=sys.stderr)
+if want("sort_in_jit"):
+    results["sort_in_jit_ms"] = round(
+        time_scanned(sortjit, (idx_j, val_j, y_j)) * 1e3, 3
+    )
+    print(f"profile: sort_in_jit {results['sort_in_jit_ms']}ms",
+          file=sys.stderr)
 
 
 # --- rmatvec: host-presorted segment_sum ----------------------------------
@@ -137,11 +155,14 @@ def presorted(beta, idxs, vals, ys, orders, sids):
     return dep(beta, g)
 
 
-results["presorted_ms"] = round(
-    time_scanned(presorted, (idx_j, val_j, y_j, order_j, sorted_ids_j)) * 1e3,
-    3,
-)
-print(f"profile: presorted {results['presorted_ms']}ms", file=sys.stderr)
+if want("presorted"):
+    results["presorted_ms"] = round(
+        time_scanned(
+            presorted, (idx_j, val_j, y_j, order_j, sorted_ids_j)
+        ) * 1e3,
+        3,
+    )
+    print(f"profile: presorted {results['presorted_ms']}ms", file=sys.stderr)
 
 results["platform"] = jax.devices()[0].platform
 results["shape"] = [M, R, K, F]
@@ -167,6 +188,8 @@ def margin_rowgather_fn(L):
 
 
 for L in (8, 128):
+    if not want(f"margin_rowgather{L}"):
+        continue
     results[f"margin_rowgather{L}_ms"] = round(
         time_scanned(margin_rowgather_fn(L), (idx_j, val_j, y_j)) * 1e3, 3
     )
@@ -189,6 +212,8 @@ def scatter_rows_fn(L):
 
 
 for L in (8, 128):
+    if not want(f"scatter_rows{L}"):
+        continue
     results[f"scatter_rows{L}_ms"] = round(
         time_scanned(scatter_rows_fn(L), (idx_j, val_j, y_j)) * 1e3, 3
     )
@@ -243,16 +268,18 @@ def scatter_packed_fn(P):
 
 
 for P in (8, 128):
-    results[f"margin_packed{P}_ms"] = round(
-        time_scanned(margin_packed_fn(P), (idx_j, val_j, y_j)) * 1e3, 3
-    )
-    print(f"profile: margin_packed{P} "
-          f"{results[f'margin_packed{P}_ms']}ms", file=sys.stderr)
-    results[f"scatter_packed{P}_ms"] = round(
-        time_scanned(scatter_packed_fn(P), (idx_j, val_j, y_j)) * 1e3, 3
-    )
-    print(f"profile: scatter_packed{P} "
-          f"{results[f'scatter_packed{P}_ms']}ms", file=sys.stderr)
+    if want(f"margin_packed{P}"):
+        results[f"margin_packed{P}_ms"] = round(
+            time_scanned(margin_packed_fn(P), (idx_j, val_j, y_j)) * 1e3, 3
+        )
+        print(f"profile: margin_packed{P} "
+              f"{results[f'margin_packed{P}_ms']}ms", file=sys.stderr)
+    if want(f"scatter_packed{P}"):
+        results[f"scatter_packed{P}_ms"] = round(
+            time_scanned(scatter_packed_fn(P), (idx_j, val_j, y_j)) * 1e3, 3
+        )
+        print(f"profile: scatter_packed{P} "
+              f"{results[f'scatter_packed{P}_ms']}ms", file=sys.stderr)
 
 
 # --- pair-table variants (one-hot field structure): fold field pairs into
@@ -286,11 +313,12 @@ if K % 2 == 0 and B >= 2:
         # same reduction as every other margin variant (apples-to-apples)
         return beta * 0.999 + jnp.sum(p) / F
 
-    results["margin_pairs_ms"] = round(
-        time_scanned(margin_pairs, (pair_idx_j, y_j)) * 1e3, 3
-    )
-    print(f"profile: margin_pairs {results['margin_pairs_ms']}ms",
-          file=sys.stderr)
+    if want("margin_pairs"):
+        results["margin_pairs_ms"] = round(
+            time_scanned(margin_pairs, (pair_idx_j, y_j)) * 1e3, 3
+        )
+        print(f"profile: margin_pairs {results['margin_pairs_ms']}ms",
+              file=sys.stderr)
 
     def scatter_pairs(beta, pidx, ys):
         def one(ps):
@@ -306,10 +334,11 @@ if K % 2 == 0 and B >= 2:
         g = jax.lax.map(one, (pidx, ys)).sum(0)
         return dep(beta, jnp.pad(g, (0, F - K * B)))
 
-    results["scatter_pairs_ms"] = round(
-        time_scanned(scatter_pairs, (pair_idx_j, y_j)) * 1e3, 3
-    )
-    print(f"profile: scatter_pairs {results['scatter_pairs_ms']}ms",
-          file=sys.stderr)
+    if want("scatter_pairs"):
+        results["scatter_pairs_ms"] = round(
+            time_scanned(scatter_pairs, (pair_idx_j, y_j)) * 1e3, 3
+        )
+        print(f"profile: scatter_pairs {results['scatter_pairs_ms']}ms",
+              file=sys.stderr)
 
 print(json.dumps(results))

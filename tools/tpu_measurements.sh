@@ -6,9 +6,10 @@
 #   bash tools/tpu_measurements.sh [out.jsonl]
 #
 # Covers: canonical dense bench (f32 + bfloat16 data), the pallas kernel
-# race, the dense-lowering profile (precision/bf16/pass split), the sparse
-# canonical shapes (covtype + amazon) across faithful/deduped x
-# scalar/lanes lowerings, and the sparse rmatvec profile.
+# race, the dense-lowering profile (precision/bf16/pass split + margin
+# lowerings), the sparse canonical shapes (covtype + amazon) across
+# faithful/deduped x scalar/lanes/fields lowerings, and the sparse
+# gather/scatter candidate profile.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 OUT="${1:-tools/measurements.jsonl}"
@@ -47,45 +48,80 @@ sys.exit(1 if d.get("platform") in ("cpu", "none") else 0)' 2>/dev/null; then
   fi
 }
 
-# Ordered by value-per-wedge-risk: the round-2 window died at the covtype
-# faithful+lanes8 entry ("TPU device error" wedging every later process),
-# so the entries that decide round-3 items run FIRST and the known-risky
-# lane benches run LAST.
+# Ordered by value-per-wedge-risk, revised after the round-3 window-1
+# post-mortem: the 900 s per-entry budget is mostly COMPILE time over the
+# relay, so the multi-variant profiles are split into small tagged groups
+# (profile tools take --only) that each fit the budget; covtype (known-
+# compilable shapes) runs before amazon; and the amazon fields entries —
+# the window-1 run died mid-compile on sparse_amazon_faithful_fields —
+# run dead last.
 
-# dense_profile_v2: the margin-lowering variants (matmul2d / cols8 /
-# default-prec / raw-stream probes) added after the r2 dense_profile capture
-run dense_profile_v2 900 python tools/profile_dense.py
+# dense_profile_v2, split: (a) the margin-lowering variants that decide
+# VERDICT r3 item 2, (b) the raw-stream/bf16 attribution probes
+run dense_profile_margins 1200 python tools/profile_dense.py \
+    --only margin_matmul2d,margin_cols8,margin_default_prec,margin_only
+run dense_profile_streams 1200 python tools/profile_dense.py \
+    --only two_pass,bf16_data,raw_stream
 # one targeted fusion-favorable retry (VERDICT r2 #8): tall rows, F=64,
-# bf16-stored stack — the kernel streams half the bytes in one pass
-run kernel_race_bf16_tallR 900 python tools/kernel_race.py \
+# bf16-stored stack — the kernel streams half the bytes in one pass.
+# Window-1 measured the logistic half (pallas 3.48 vs XLA 1.87 ms, loses)
+# before timing out; 1800 s covers both halves' compiles.
+run kernel_race_bf16_tallR 1800 python tools/kernel_race.py \
     --slots 30 --rows 26400 --cols 64 --dtype bfloat16
-run sparse_profile 900  python tools/profile_sparse.py
+# sparse_profile, split (window-1 measured 8 of 14 candidates in 900 s
+# before the wedge — their numbers live only in the window-1 .log, so the
+# groups below re-capture ALL candidates into the resumable record):
+# pairs/packed first (the undecided ones), then base, then the measured-
+# loser re-captures last
+run sparse_profile_pairs  1200 python tools/profile_sparse.py \
+    --only margin_pairs,scatter_pairs
+run sparse_profile_packed 1200 python tools/profile_sparse.py \
+    --only margin_packed8,scatter_packed8
+run sparse_profile_base   1200 python tools/profile_sparse.py \
+    --only margin_gather,scatter_ms,margin_rowgather8,scatter_rows8
 # full production path under the margin_cols lowering — decides the
 # production default against the captured dense_f32 entry
 run dense_f32_margincols8 1800 env BENCH_MARGIN_COLS=8 python bench.py
 
-# the flagship sparse shapes: FieldOnehot pair tables (halves the lookup
-# count; amazon's 5.5k-category fields exceed the pair cap and fall back
-# to singles, which still drops the value payload), then the plain benches
-for shape in amazon covtype; do
-  run "sparse_${shape}_faithful_fields"  900 python tools/bench_sparse.py --shape "$shape" --format fields
-  run "sparse_${shape}_deduped_fields"   900 python tools/bench_sparse.py --shape "$shape" --mode deduped --format fields
-  run "sparse_${shape}_faithful"         900 python tools/bench_sparse.py --shape "$shape"
-  run "sparse_${shape}_deduped"          900 python tools/bench_sparse.py --shape "$shape" --mode deduped
-done
+# flagship sparse shapes, covtype (known-good compiles) before amazon;
+# fields = FieldOnehot pair tables (halves the lookup count where pairs
+# fit the cap — covtype; amazon falls back to singles). The plain covtype
+# entries are r2-captured and resume-skipped, but stay in the program so
+# RERUN_ALL=1 refreshes the full faithful/deduped x covtype/amazon grid.
+run sparse_covtype_faithful_fields  1200 python tools/bench_sparse.py --shape covtype --format fields
+run sparse_covtype_deduped_fields   1200 python tools/bench_sparse.py --shape covtype --mode deduped --format fields
+run sparse_covtype_faithful         1200 python tools/bench_sparse.py --shape covtype
+run sparse_covtype_deduped          1200 python tools/bench_sparse.py --shape covtype --mode deduped
+run sparse_amazon_faithful          1200 python tools/bench_sparse.py --shape amazon
+run sparse_amazon_deduped           1200 python tools/bench_sparse.py --shape amazon --mode deduped
 
 # bench.py manages wedge-probing internally — give it its full budget
 run dense_f32      1800 python bench.py
 run dense_bf16     1800 env BENCH_DTYPE=bfloat16 python bench.py
 run kernel_race    900  python tools/kernel_race.py
 
-# lane-replicated gather benches last: the [rows, nnz, L] gather temps are
+# lane-replicated gather benches: the [rows, nnz, L] gather temps are
 # the largest allocations in the program (the r2 wedge followed a lane-
-# temp OOM); a wedge here costs nothing already captured
-for shape in amazon covtype; do
+# temp OOM)
+for shape in covtype amazon; do
   run "sparse_${shape}_faithful_lanes8"  900 python tools/bench_sparse.py --shape "$shape" --lanes 8
   run "sparse_${shape}_deduped_lanes8"   900 python tools/bench_sparse.py --shape "$shape" --mode deduped --lanes 8
   run "sparse_${shape}_deduped_lanes128" 900 python tools/bench_sparse.py --shape "$shape" --mode deduped --lanes 128
 done
+
+# window-1 measured losers, re-captured into the resumable record (their
+# window-1 numbers exist only in a .log): the sort/presorted segment-sum
+# candidates, the 128-wide lane variants, and packed128
+run sparse_profile_rest 1200 python tools/profile_sparse.py \
+    --only sort_in_jit,presorted,margin_rowgather128,scatter_rows128
+run sparse_profile_packed128 1200 python tools/profile_sparse.py \
+    --only margin_packed128,scatter_packed128
+
+# amazon fields LAST: round-3 window 1 died mid-compile here (relay
+# terminal down at 01:52Z with this entry in flight; the compile itself
+# is proven cheap — 8 s on forced-CPU — so this is pure wedge paranoia).
+# K=44 singles fallback.
+run sparse_amazon_faithful_fields  1200 python tools/bench_sparse.py --shape amazon --format fields
+run sparse_amazon_deduped_fields   1200 python tools/bench_sparse.py --shape amazon --mode deduped --format fields
 
 echo "measurements appended to $OUT" >&2
