@@ -191,6 +191,31 @@ def test_sparse_lanes_matches_scalar_path():
         features.set_sparse_lanes(2048)
 
 
+def test_sparse_lanes_scope_to_matvec_only():
+    """Lanes rewrite the margin gather but NOT the scatter: the v5e profile
+    measured the lane gather at 2.6x the scalar margin and the lane scatter
+    as a net loss (tools/profile_sparse.py, BASELINE.md round-3 window 1),
+    so set_sparse_lanes must change matvec's lowering while rmatvec's stays
+    the scalar scatter-add. Pinned structurally via the traced jaxprs."""
+    from erasurehead_tpu.ops import features
+
+    rng = np.random.default_rng(7)
+    dense = sps.random(40, 30, density=0.2, random_state=4, format="csr")
+    P = PaddedRows.from_scipy(dense)
+    v = jnp.asarray(rng.standard_normal(30).astype(np.float32))
+    r = jnp.asarray(rng.standard_normal(40).astype(np.float32))
+    mv_scalar = str(jax.make_jaxpr(lambda u: matvec(P, u))(v))
+    rmv_scalar = str(jax.make_jaxpr(lambda u: rmatvec(P, u))(r))
+    try:
+        features.set_sparse_lanes(8)
+        mv_lanes = str(jax.make_jaxpr(lambda u: matvec(P, u))(v))
+        rmv_lanes = str(jax.make_jaxpr(lambda u: rmatvec(P, u))(r))
+    finally:
+        features.set_sparse_lanes(None)
+    assert mv_lanes != mv_scalar  # gather direction takes the lane table
+    assert rmv_lanes == rmv_scalar  # scatter direction ignores the knob
+
+
 def test_dense_margin_cols_matches_direct_path():
     """The margin_cols matvec lowering (features.set_dense_margin_cols —
     the candidate fix for the measured TPU cross-lane-reduction bound,

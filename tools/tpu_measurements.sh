@@ -98,6 +98,11 @@ run sparse_amazon_deduped           1200 python tools/bench_sparse.py --shape am
 # bench.py manages wedge-probing internally — give it its full budget
 run dense_f32      1800 python bench.py
 run dense_bf16     1800 env BENCH_DTYPE=bfloat16 python bench.py
+# deduped compute mode on the dense flagship: bit-compatible gradients at
+# 1/(s+1) the HBM traffic — the framework's structural win over the
+# faithful reference protocol, never yet TPU-measured for dense
+run dense_f32_deduped  1800 env BENCH_MODE=deduped python bench.py
+run dense_bf16_deduped 1800 env BENCH_MODE=deduped BENCH_DTYPE=bfloat16 python bench.py
 run kernel_race    900  python tools/kernel_race.py
 
 # lane-replicated gather benches: the [rows, nnz, L] gather temps are
