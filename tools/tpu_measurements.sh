@@ -28,7 +28,11 @@ run() { # run <tag> <timeout_s> <cmd...> — per-entry timeout so a relay
   fi
   echo "=== $tag ($tmo s): $*" >&2
   local line rc
-  line="$(timeout "$tmo" "$@" 2>"$OUT.$tag.log" | tail -1)"
+  # SIGINT (not the default SIGTERM) so python unwinds via
+  # KeyboardInterrupt and the PJRT client can close its relay session —
+  # both observed relay-terminal deaths (r2, r3 window 1) followed a
+  # process killed mid-RPC. --kill-after covers a child that ignores INT.
+  line="$(timeout -s INT -k 90 "$tmo" "$@" 2>"$OUT.$tag.log" | tail -1)"
   rc=$?
   # Record ONLY exit-0 runs whose last line is valid JSON from a real TPU:
   # garbage would corrupt the decision record, and — because the resume
