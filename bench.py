@@ -97,6 +97,13 @@ if _MARGIN_COLS_ENV:
 COMPUTE_MODE = os.environ.get("BENCH_MODE", "faithful")
 if COMPUTE_MODE == "deduped":
     METRIC_SUFFIX += "_deduped"
+# flat-stack lowering knob (parallel/step.make_flat_grad_fn): "on"/"off"
+# force the flat vs per-slot closed-form lowering; unset = cfg default
+# ("auto", resolves via step.FLAT_GRAD_DEFAULT). Tagged so sweep entries
+# with different lowerings never collide.
+DENSE_FLAT = os.environ.get("BENCH_FLAT", "")
+if DENSE_FLAT and DENSE_FLAT in ("on", "off"):
+    METRIC_SUFFIX += f"_flat{DENSE_FLAT}"
 
 
 def _failure_record(error: str) -> dict:
@@ -274,6 +281,9 @@ def child() -> None:
         dense_margin_cols=MARGIN_COLS,
         # BENCH_MODE=deduped: per-partition compute, 1/(s+1) the traffic
         compute_mode=COMPUTE_MODE,
+        # BENCH_FLAT: force the flat-stack closed-form lowering on/off
+        # (unset = "auto", step.FLAT_GRAD_DEFAULT decides)
+        dense_flat=DENSE_FLAT or "auto",
         seed=0,
     )
     print(
@@ -363,6 +373,15 @@ if __name__ == "__main__":
                 _failure_record(
                     f"BENCH_MODE must be faithful or deduped, "
                     f"got {COMPUTE_MODE!r}"
+                )
+            )
+        )
+        sys.exit(0 if "--child" not in sys.argv else 1)
+    if DENSE_FLAT not in ("", "on", "off"):
+        print(
+            json.dumps(
+                _failure_record(
+                    f"BENCH_FLAT must be on or off, got {DENSE_FLAT!r}"
                 )
             )
         )

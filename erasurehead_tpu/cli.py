@@ -151,6 +151,12 @@ def _flags_parser() -> argparse.ArgumentParser:
                    help="dense margin matvec lowering width [2,128]: "
                         "replicate beta behind a barrier so the margin "
                         "lowers as a tileable matmul (exact; column 0)")
+    p.add_argument("--dense-flat", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="flat-stack closed-form GLM gradient lowering "
+                        "(parallel/step.make_flat_grad_fn): margin as one "
+                        "2-D matmul, decode weights folded into the "
+                        "residual")
     p.add_argument("--seq-shards", type=int, default=1,
                    help="sequence-parallel shards for the attention model: "
                         ">1 builds a 2-D (workers, seq) mesh and spans the "
@@ -232,6 +238,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         arrival_mode=ns.arrival_mode,
         sparse_lanes=ns.sparse_lanes,
         dense_margin_cols=ns.dense_margin_cols,
+        dense_flat=ns.dense_flat,
         sparse_format=ns.sparse_format,
         seq_shards=ns.seq_shards,
         sp_form=ns.sp_form,

@@ -106,11 +106,18 @@ class LogisticModel(_GLMBase):
 
     name = "logistic"
 
+    def margin_residual(self, margins, y):
+        """r such that grad_sum = -X^T r. Elementwise in the row, which is
+        what lets the flat-stack grad lowering (parallel/step.
+        make_flat_grad_fn) fold per-slot decode weights into a per-row
+        scale of r."""
+        # written the reference's way: y / (exp(m*y) + 1)  (src/naive.py:137-139)
+        return y / (jnp.exp(margins * y) + 1.0)
+
     def grad_sum(self, params, X, y):
         margins = matvec(X, params)
         # d/dbeta sum_r log(1+exp(-y_r m_r)) = -X^T (y * sigmoid(-y*m))
-        # written the reference's way: y / (exp(m*y) + 1)   (src/naive.py:137-139)
-        r = y / (jnp.exp(margins * y) + 1.0)
+        r = self.margin_residual(margins, y)
         return -rmatvec(X, r)
 
     def loss_sum(self, params, X, y):
@@ -125,6 +132,11 @@ class LinearModel(_GLMBase):
     """Least-squares linear regression (kc_house_data task)."""
 
     name = "linear"
+
+    def margin_residual(self, margins, y):
+        """r such that grad_sum = -X^T r (see LogisticModel.margin_residual):
+        -2 X^T (y - X beta)  (src/naive.py:341-346)."""
+        return 2.0 * (y - margins)
 
     def grad_sum(self, params, X, y):
         resid = y - matvec(X, params)

@@ -367,6 +367,21 @@ def train(
         pw = layout.fold_slot_weights(slot_w)
         weights_seq, X, y = jnp.asarray(pw, dtype), data.Xp, data.yp
 
+    # flat-stack closed-form lowering for dense GLMs: one 2-D matmul pair
+    # instead of the batched per-slot contraction (step.make_flat_grad_fn)
+    if cfg.dense_flat == "on" or (
+        cfg.dense_flat == "auto"
+        and step_lib.FLAT_GRAD_DEFAULT
+        and step_lib.supports_flat_grad(model, X)
+    ):
+        if cfg.dense_flat == "on" and not step_lib.supports_flat_grad(model, X):
+            raise ValueError(
+                "dense_flat='on' needs a closed-form GLM on a dense stack; "
+                f"got model={getattr(model, 'name', type(model).__name__)!r}, "
+                f"X={type(X).__name__}"
+            )
+        grad_fn = step_lib.make_flat_grad_fn(model, mesh)
+
     # fused single-HBM-pass pallas kernel for dense GLM stacks
     from erasurehead_tpu.ops import kernels as kernels_lib
 
@@ -377,6 +392,13 @@ def train(
         cfg.use_pallas == "auto"
         and kernels_lib.supports_fused(X, kind, platform)
     ):
+        if cfg.use_pallas == "on" and cfg.dense_flat == "on":
+            # both knobs explicitly force a grad lowering; picking one
+            # silently would misattribute any measurement tagged by the other
+            raise ValueError(
+                "use_pallas='on' and dense_flat='on' are mutually exclusive "
+                "gradient lowerings; force at most one"
+            )
         if dense_glm:
             grad_fn = step_lib.make_fused_grad_fn(
                 kind, mesh, interpret=(platform != "tpu")

@@ -154,6 +154,12 @@ class RunConfig:
     # barrier so the margin lowers as a tileable matmul (the profile_dense
     # margin_cols candidate for the measured cross-lane-reduction bound)
     dense_margin_cols: Optional[int] = None
+    # flat-stack closed-form GLM gradient (parallel/step.make_flat_grad_fn):
+    # flattens the [slots, rows, F] stack so the margin lowers as one 2-D
+    # matmul and the decode weights fold into the residual. "on" forces it
+    # (errors off the closed-form dense path), "off" keeps the per-slot
+    # vmap, "auto" defers to step.FLAT_GRAD_DEFAULT (measurement-pinned).
+    dense_flat: str = "auto"
     # per-round collection deadline in simulated seconds (scheme="deadline")
     deadline: Optional[float] = None
     # sequence-parallel shards for the attention family: >1 builds a 2-D
@@ -205,6 +211,10 @@ class RunConfig:
         if self.use_pallas not in ("auto", "on", "off"):
             raise ValueError(
                 f"use_pallas must be auto/on/off, got {self.use_pallas!r}"
+            )
+        if self.dense_flat not in ("auto", "on", "off"):
+            raise ValueError(
+                f"dense_flat must be auto/on/off, got {self.dense_flat!r}"
             )
         if self.arrival_mode not in ("simulated", "measured"):
             raise ValueError(

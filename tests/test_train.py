@@ -295,6 +295,91 @@ def test_dense_margin_cols_trajectory_matches_direct(gmm):
     assert features.get_dense_margin_cols() is None  # restored after run
 
 
+class TestDenseFlatLowering:
+    """parallel/step.make_flat_grad_fn: the flat-stack closed-form GLM
+    lowering is the same math as the per-slot vmap (sum_s w_s(-X_s^T r_s)
+    == -Xf^T(w_row*r)) in a different reduction order — grads allclose,
+    trajectories allclose, and the knob is rejected off the closed-form
+    dense path."""
+
+    def _grad_pair(self, scheme="approx", mode="faithful", **extra):
+        from erasurehead_tpu.parallel import step as step_lib
+        from erasurehead_tpu.train.trainer import build_layout, build_model
+        from erasurehead_tpu.data.sharding import shard_run_data
+
+        cfg = _cfg(
+            scheme=scheme, n_stragglers=1, compute_mode=mode, **extra
+        )
+        data = generate_gmm(N_ROWS, N_COLS, n_partitions=W, seed=0)
+        layout = build_layout(cfg)
+        model = build_model(cfg)
+        mesh = worker_mesh(4)
+        sharded = shard_run_data(
+            data, layout, mesh, faithful=(mode == "faithful")
+        )
+        if mode == "faithful":
+            base = step_lib.make_faithful_grad_fn(model, mesh)
+            X, y = sharded.Xw, sharded.yw
+            w = np.random.default_rng(0).uniform(0.5, 1.5, X.shape[:2])
+        else:
+            base = step_lib.make_deduped_grad_fn(model, mesh)
+            X, y = sharded.Xp, sharded.yp
+            w = np.random.default_rng(0).uniform(0.5, 1.5, X.shape[:1])
+        flat = step_lib.make_flat_grad_fn(model, mesh)
+        params = model.init_params(jax.random.key(1), N_COLS)
+        import jax.numpy as jnp
+
+        wj = jnp.asarray(w, jnp.float32)
+        return np.asarray(base(params, X, y, wj)), np.asarray(
+            flat(params, X, y, wj)
+        )
+
+    @pytest.mark.parametrize("mode", ["faithful", "deduped"])
+    def test_flat_grad_matches_per_slot(self, mode):
+        g0, g1 = self._grad_pair(mode=mode)
+        np.testing.assert_allclose(g1, g0, rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("model", ["logistic", "linear"])
+    def test_trajectory_matches_per_slot(self, gmm, model):
+        data = gmm if model == "logistic" else generate_linear(
+            N_ROWS, N_COLS, n_partitions=W, seed=0
+        )
+        hists = {}
+        for flat in ("off", "on"):
+            cfg = _cfg(
+                scheme=Scheme.APPROX, model=model, n_stragglers=1,
+                num_collect=6, dense_flat=flat,
+                lr_schedule=0.2 if model == "linear" else 0.5,
+            )
+            res = trainer.train(cfg, data, mesh=worker_mesh(4))
+            hists[flat] = np.asarray(res.params_history, np.float32)
+        np.testing.assert_allclose(
+            hists["on"], hists["off"], rtol=2e-4, atol=2e-5
+        )
+
+    def test_flat_on_bf16_data_trains(self, gmm):
+        cfg = _cfg(
+            scheme=Scheme.APPROX, n_stragglers=1, num_collect=6,
+            dense_flat="on", dtype="bfloat16",
+        )
+        res = trainer.train(cfg, gmm, mesh=worker_mesh(4))
+        assert np.isfinite(np.asarray(res.params_history)).all()
+
+    def test_flat_on_rejects_mlp(self, gmm):
+        cfg = _cfg(model="mlp", dense_flat="on", lr_schedule=0.01)
+        with pytest.raises(ValueError, match="dense_flat"):
+            trainer.train(cfg, gmm, mesh=worker_mesh(4))
+
+    def test_config_validates_values(self):
+        with pytest.raises(ValueError, match="dense_flat"):
+            _cfg(dense_flat="yes")
+
+    def test_flat_on_conflicts_with_pallas_on(self, gmm):
+        cfg = _cfg(dense_flat="on", use_pallas="on")
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            trainer.train(cfg, gmm, mesh=worker_mesh(4))
+
+
 def test_adam_trains_mlp(gmm):
     """Adam (beyond-reference rule) on the MLP under AGC coding."""
     cfg = RunConfig(
