@@ -139,8 +139,8 @@ class LinearModel(_GLMBase):
         return 2.0 * (y - margins)
 
     def grad_sum(self, params, X, y):
-        resid = y - matvec(X, params)
-        return -2.0 * rmatvec(X, resid)
+        r = self.margin_residual(matvec(X, params), y)
+        return -rmatvec(X, r)
 
     def loss_sum(self, params, X, y):
         resid = y - matvec(X, params)

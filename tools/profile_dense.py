@@ -102,6 +102,33 @@ def main() -> None:
         "bf16_data": (grad(Xb, yb, DEF), 2 * Xb.nbytes),
     }
 
+    # the production flat-stack lowering (parallel/step.make_flat_grad_fn):
+    # slot axes flattened so the margin is one [M*R, F] matmul (measured at
+    # the raw-stream floor) and the weights fold into the residual
+    def grad_flat(Xa, ya, prec):
+        X2 = Xa.reshape(M * R, F)
+        y2 = ya.reshape(M * R)
+        w2 = jnp.broadcast_to(w[:, None], (M, R)).reshape(M * R)
+
+        def f(beta):
+            p = jnp.matmul(
+                X2, beta.astype(Xa.dtype),
+                precision=prec, preferred_element_type=jnp.float32,
+            )
+            yf = y2.astype(jnp.float32)
+            s = (-yf / (jnp.exp(p * yf) + 1.0)) * w2
+            return jnp.matmul(
+                X2.T, s.astype(Xa.dtype),
+                precision=prec, preferred_element_type=jnp.float32,
+            )
+
+        return f
+
+    # names deliberately avoid the substrings two_pass/bf16_data so the
+    # main sweep's --only filters (tpu_measurements.sh) never pick these up
+    cases["flatstack_full"] = (grad_flat(X, y, HI), 2 * X.nbytes)
+    cases["flatstack_bf16"] = (grad_flat(Xb, yb, DEF), 2 * Xb.nbytes)
+
     def margin_only(beta):
         p = jnp.einsum("mrf,f->mr", X, beta, precision=HI)
         # a nonlinear consumer: sum(X@b) alone is reassociable to

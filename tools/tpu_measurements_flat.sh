@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Follow-up measurement program for the flat-stack GLM lowering
+# (parallel/step.make_flat_grad_fn, landed mid-round after the margin
+# profile put the flat 2-D matmul at the raw-stream floor). Same resumable
+# tagged-append protocol as tpu_measurements.sh; run AFTER that sweep
+# drains — never concurrently (the relay serves one client).
+#
+#   bash tools/tpu_measurements_flat.sh [out.jsonl]
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+OUT="${1:-tools/measurements.jsonl}"
+export PYTHONPATH="${PYTHONPATH:-}:$(pwd)"
+
+run() { # see tpu_measurements.sh — identical capture discipline
+  local tag="$1" tmo="$2"; shift 2
+  if [ -z "${RERUN_ALL:-}" ] && [ -f "$OUT" ] \
+     && grep -q "\"tag\": \"$tag\"" "$OUT"; then
+    echo "=== $tag: already captured, skipping (RERUN_ALL=1 to redo)" >&2
+    return
+  fi
+  echo "=== $tag ($tmo s): $*" >&2
+  local line rc
+  line="$(timeout -s INT -k 90 "$tmo" "$@" 2>"$OUT.$tag.log" | tail -1)"
+  rc=$?
+  if [ "$rc" -eq 0 ] && [ -n "$line" ] \
+     && printf '%s' "$line" | python -c '
+import json, sys
+d = json.load(sys.stdin)
+sys.exit(1 if d.get("platform") in ("cpu", "none") else 0)' 2>/dev/null; then
+    printf '{"tag": "%s", "result": %s}\n' "$tag" "$line" >> "$OUT"
+    echo "$tag -> $line" >&2
+  else
+    echo "$tag -> FAILED rc=$rc (see $OUT.$tag.log)" >&2
+  fi
+}
+
+# the full production path under the flat lowering, racing the captured
+# dense_f32 / dense_bf16 / deduped entries for the production default
+run dense_f32_flat       1800 env BENCH_FLAT=on python bench.py
+run dense_bf16_flat      1800 env BENCH_FLAT=on BENCH_DTYPE=bfloat16 python bench.py
+run dense_f32_deduped_flat 1800 env BENCH_FLAT=on BENCH_MODE=deduped python bench.py
+# profile-level attribution: flat two-pass vs the per-slot two-pass
+run dense_profile_flat   1200 python tools/profile_dense.py \
+    --only flatstack_full,flatstack_bf16
+
+echo "flat measurements appended to $OUT" >&2
