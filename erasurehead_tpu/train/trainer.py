@@ -367,20 +367,7 @@ def train(
         pw = layout.fold_slot_weights(slot_w)
         weights_seq, X, y = jnp.asarray(pw, dtype), data.Xp, data.yp
 
-    # flat-stack closed-form lowering for dense GLMs: one 2-D matmul pair
-    # instead of the batched per-slot contraction (step.make_flat_grad_fn)
-    if cfg.dense_flat == "on" or (
-        cfg.dense_flat == "auto"
-        and step_lib.FLAT_GRAD_DEFAULT
-        and step_lib.supports_flat_grad(model, X)
-    ):
-        if cfg.dense_flat == "on" and not step_lib.supports_flat_grad(model, X):
-            raise ValueError(
-                "dense_flat='on' needs a closed-form GLM on a dense stack; "
-                f"got model={getattr(model, 'name', type(model).__name__)!r}, "
-                f"X={type(X).__name__}"
-            )
-        grad_fn = step_lib.make_flat_grad_fn(model, mesh)
+    grad_fn = _apply_dense_flat(cfg, model, mesh, X, grad_fn)
 
     # fused single-HBM-pass pallas kernel for dense GLM stacks
     from erasurehead_tpu.ops import kernels as kernels_lib
@@ -600,6 +587,13 @@ def train_measured(
             "arrival_mode='measured' has no fused-kernel path; "
             "use use_pallas='auto' or 'off'"
         )
+    if cfg.dense_flat == "on":
+        raise ValueError(
+            "arrival_mode='measured' times each worker's own message "
+            "separately; the flat-stack lowering fuses all slots into one "
+            "matmul and cannot be timed per worker — use dense_flat='auto' "
+            "or 'off'"
+        )
     setup = _setup_run(cfg, dataset, mesh, faithful=True, single_device=True)
     layout, model, data = setup.layout, setup.model, setup.data
     W = layout.n_workers
@@ -800,6 +794,26 @@ def train_measured(
     )
 
 
+def _apply_dense_flat(cfg, model, mesh, X, grad_fn):
+    """Swap in the flat-stack closed-form lowering (step.make_flat_grad_fn)
+    per cfg.dense_flat: one 2-D matmul pair instead of the batched per-slot
+    contraction. "on" forces (raising off the closed-form dense path),
+    "auto" defers to step.FLAT_GRAD_DEFAULT."""
+    if cfg.dense_flat == "on" and not step_lib.supports_flat_grad(model, X):
+        raise ValueError(
+            "dense_flat='on' needs a closed-form GLM on a dense stack; "
+            f"got model={getattr(model, 'name', type(model).__name__)!r}, "
+            f"X={type(X).__name__}"
+        )
+    if cfg.dense_flat == "on" or (
+        cfg.dense_flat == "auto"
+        and step_lib.FLAT_GRAD_DEFAULT
+        and step_lib.supports_flat_grad(model, X)
+    ):
+        return step_lib.make_flat_grad_fn(model, mesh)
+    return grad_fn
+
+
 @_with_run_sparse_lanes
 def train_dynamic(cfg: RunConfig, dataset: Dataset, mesh=None) -> TrainResult:
     """Fully on-device run: arrivals, collection masks, and decode are
@@ -819,7 +833,10 @@ def train_dynamic(cfg: RunConfig, dataset: Dataset, mesh=None) -> TrainResult:
         cfg.scheme, layout, cfg.num_collect, cfg.delay_mean, cfg.add_delay,
         deadline=cfg.deadline,
     )
-    grad_fn = step_lib.make_faithful_grad_fn(model, mesh)
+    grad_fn = _apply_dense_flat(
+        cfg, model, mesh, data.Xw,
+        step_lib.make_faithful_grad_fn(model, mesh),
+    )
     update_fn = setup.update_fn
     dtype = jnp.float32  # param/update dtype (cfg.dtype is the data dtype)
     coeffs = jnp.asarray(layout.coeffs, dtype)

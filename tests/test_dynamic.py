@@ -193,6 +193,27 @@ class TestDecodeTableW30:
         assert last < first * 0.8, (first, last)
 
 
+def test_train_dynamic_flat_lowering_matches_per_slot():
+    """cfg.dense_flat='on' routes train_dynamic through
+    step.make_flat_grad_fn (per-round traced weights fold into the
+    residual) — trajectory allclose to the per-slot lowering."""
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+    from erasurehead_tpu.train import trainer
+
+    data = generate_gmm(16 * W, 12, n_partitions=W, seed=0)
+    hists = {}
+    for flat in ("off", "on"):
+        cfg = RunConfig(
+            scheme="approx", n_workers=W, n_stragglers=2, num_collect=8,
+            rounds=8, n_rows=16 * W, n_cols=12, lr_schedule=0.5,
+            update_rule="AGD", add_delay=True, seed=0, dense_flat=flat,
+        )
+        res = trainer.train_dynamic(cfg, data, mesh=worker_mesh(4))
+        hists[flat] = np.asarray(res.params_history, np.float32)
+    np.testing.assert_allclose(hists["on"], hists["off"], rtol=2e-4, atol=2e-5)
+
+
 def test_ranks_tie_break_matches_order():
     t = jnp.asarray([0.0, 0.0, 1.0, 0.0])
     ranks = np.asarray(dynamic._ranks(t))

@@ -156,3 +156,5 @@ def test_measured_mode_rejects_unsupported_knobs(data):
         trainer.train_measured(_cfg(compute_mode="deduped"), data)
     with pytest.raises(ValueError, match="fused-kernel"):
         trainer.train_measured(_cfg(use_pallas="on"), data)
+    with pytest.raises(ValueError, match="flat-stack"):
+        trainer.train_measured(_cfg(dense_flat="on"), data)
