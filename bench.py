@@ -101,9 +101,9 @@ if COMPUTE_MODE == "deduped":
 # force the flat vs per-slot closed-form lowering; unset = cfg default
 # ("auto", resolves via step.FLAT_GRAD_DEFAULT). Tagged so sweep entries
 # with different lowerings never collide.
-DENSE_FLAT = os.environ.get("BENCH_FLAT", "")
-if DENSE_FLAT and DENSE_FLAT in ("on", "off"):
-    METRIC_SUFFIX += f"_flat{DENSE_FLAT}"
+FLAT_GRAD = os.environ.get("BENCH_FLAT", "")
+if FLAT_GRAD and FLAT_GRAD in ("on", "off"):
+    METRIC_SUFFIX += f"_flat{FLAT_GRAD}"
 
 
 def _failure_record(error: str) -> dict:
@@ -283,7 +283,7 @@ def child() -> None:
         compute_mode=COMPUTE_MODE,
         # BENCH_FLAT: force the flat-stack closed-form lowering on/off
         # (unset = "auto", step.FLAT_GRAD_DEFAULT decides)
-        dense_flat=DENSE_FLAT or "auto",
+        flat_grad=FLAT_GRAD or "auto",
         seed=0,
     )
     print(
@@ -377,11 +377,11 @@ if __name__ == "__main__":
             )
         )
         sys.exit(0 if "--child" not in sys.argv else 1)
-    if DENSE_FLAT not in ("", "on", "off"):
+    if FLAT_GRAD not in ("", "on", "off"):
         print(
             json.dumps(
                 _failure_record(
-                    f"BENCH_FLAT must be on or off, got {DENSE_FLAT!r}"
+                    f"BENCH_FLAT must be on or off, got {FLAT_GRAD!r}"
                 )
             )
         )

@@ -151,7 +151,7 @@ def _flags_parser() -> argparse.ArgumentParser:
                    help="dense margin matvec lowering width [2,128]: "
                         "replicate beta behind a barrier so the margin "
                         "lowers as a tileable matmul (exact; column 0)")
-    p.add_argument("--dense-flat", default="auto",
+    p.add_argument("--flat-grad", default="auto",
                    choices=["auto", "on", "off"],
                    help="flat-stack closed-form GLM gradient lowering "
                         "(parallel/step.make_flat_grad_fn): margin as one "
@@ -238,7 +238,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         arrival_mode=ns.arrival_mode,
         sparse_lanes=ns.sparse_lanes,
         dense_margin_cols=ns.dense_margin_cols,
-        dense_flat=ns.dense_flat,
+        flat_grad=ns.flat_grad,
         sparse_format=ns.sparse_format,
         seq_shards=ns.seq_shards,
         sp_form=ns.sp_form,

@@ -397,6 +397,30 @@ def _fields_rmatvec(X: "FieldOnehot", r: jnp.ndarray) -> jnp.ndarray:
     return out
 
 
+def flatten_rows(X: Features) -> Features:
+    """Collapse every leading (slot) axis of a stacked Features into the
+    row axis: dense [..., R, F] -> [M*R, F], PaddedRows leaves
+    [..., R, nnz] -> [M*R, nnz], FieldOnehot local [..., R, K] -> [M*R, K].
+
+    The flat-stack gradient lowering (parallel/step.make_flat_grad_fn)
+    uses this so the whole local stack is ONE matvec/rmatvec call: for
+    dense the margin becomes a single 2-D matmul (measured at the
+    raw-stream floor); for the sparse formats the gradient scatter targets
+    ONE accumulator instead of a vmapped per-slot batch of them — the
+    [n_slots, table] transient the PAIR_TABLE_CAP comment budgets simply
+    never exists.
+    """
+    if isinstance(X, FieldOnehot):
+        K = X.local.shape[-1]
+        return FieldOnehot(X.local.reshape(-1, K), X.field_sizes, X.n_cols)
+    if isinstance(X, PaddedRows):
+        nnz = X.indices.shape[-1]
+        return PaddedRows(
+            X.indices.reshape(-1, nnz), X.values.reshape(-1, nnz), X.n_cols
+        )
+    return X.reshape(-1, X.shape[-1])
+
+
 def matvec(X: Features, v: jnp.ndarray, precision=None) -> jnp.ndarray:
     """X @ v for dense [n, F], PaddedRows, or FieldOnehot; v may also be a
     matrix [F, H]."""

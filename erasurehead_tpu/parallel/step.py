@@ -142,7 +142,7 @@ def make_deduped_grad_fn(model, mesh: Mesh) -> GradFn:
     )
 
 
-# Whether dense_flat="auto" resolves to the flat lowering. False until the
+# Whether flat_grad="auto" resolves to the flat lowering. False until the
 # end-to-end TPU measurement lands (the margin-pass profile alone showed
 # margin_matmul2d 1.587 ms vs the batched per-slot contraction's 1.843 ms,
 # tools/profile_dense.py, v5e round 3); flipped by that measurement, pinned
@@ -151,45 +151,54 @@ FLAT_GRAD_DEFAULT = False
 
 
 def supports_flat_grad(model, X) -> bool:
-    """make_flat_grad_fn needs a closed-form GLM (margin_residual) on a
-    dense stack; autodiff families take ONE jax.grad per device instead
-    (see _grads_via_loss) and sparse stacks gain nothing from flattening
-    (their bound is the gather/scatter, not the batched contraction)."""
-    return (
-        hasattr(model, "margin_residual")
-        and not _grads_via_loss(model)
-        and isinstance(X, jax.Array)
+    """make_flat_grad_fn needs a closed-form GLM (margin_residual) on any
+    Features stack (dense, PaddedRows, FieldOnehot); autodiff families
+    take ONE jax.grad per device instead (see _grads_via_loss)."""
+    from erasurehead_tpu.ops import features as features_lib
+
+    return hasattr(model, "margin_residual") and not _grads_via_loss(
+        model
+    ) and isinstance(
+        X, (jax.Array, features_lib.PaddedRows, features_lib.FieldOnehot)
     )
 
 
 def make_flat_grad_fn(model, mesh: Mesh) -> GradFn:
     """Closed-form GLM decoded gradient with the slot axes flattened away.
 
-    Drop-in for make_faithful_grad_fn (worker-major [Wl, S, rows, F]) and
-    make_deduped_grad_fn (partition-major [Pl, rows, F]) on dense stacks:
-    instead of vmapping grad_sum per slot — which XLA lowers as a batched
-    per-tile contraction — the whole local stack becomes ONE [rows_l, F]
-    operand, so the margin is a single flat 2-D matmul (measured faster on
-    v5e: profile_dense margin_matmul2d 1.587 ms vs 1.843 ms batched at the
-    canonical [90, 4400, 128]) and the per-slot decode weights fold into a
-    per-row scale of the residual before the single transpose matvec:
+    Drop-in for make_faithful_grad_fn (worker-major [Wl, S, rows, ...])
+    and make_deduped_grad_fn (partition-major [Pl, rows, ...]): instead of
+    vmapping grad_sum per slot, the whole local stack becomes ONE flat
+    Features operand (features.flatten_rows) and the per-slot decode
+    weights fold into a per-row scale of the residual before the single
+    transpose matvec:
 
         sum_s w_s * (-X_s^T r_s)  ==  -Xf^T (w_row * r)     (exact)
+
+    Why it's faster than the per-slot vmap on TPU (measured, round 3):
+      - dense: the margin is a single flat 2-D matmul — 1.587 ms vs the
+        batched per-tile contraction's 1.843 ms at the canonical
+        [90, 4400, 128], AT the raw-stream floor (profile_dense);
+      - sparse: the gradient scatter-add targets ONE accumulator (per
+        pair table / per column space) instead of materializing a
+        [n_slots, table]-shaped batch of per-slot accumulators — the
+        transient that made the vmapped FieldOnehot path ~10x slower
+        end-to-end than its own profiled candidates.
 
     Same math and FLOPs as the per-slot form; only the reduction order
     differs (tests pin the two to allclose, not bitwise).
     """
 
     def local(params, Xs, ys, ws):
-        R, F = Xs.shape[-2], Xs.shape[-1]
-        M = int(np.prod(Xs.shape[:-2]))
-        Xf = Xs.reshape(M * R, F)
+        from erasurehead_tpu.ops import features as features_lib
+
+        M = int(np.prod(ys.shape[:-1]))
+        R = ys.shape[-1]
+        Xf = features_lib.flatten_rows(Xs)
         yf = ys.reshape(M * R)
         # [M] slot weights -> [M*R] row weights
         wf = jnp.broadcast_to(ws.reshape(M)[:, None], (M, R)).reshape(M * R)
-        from erasurehead_tpu.ops import features as features_lib
-
-        p = features_lib.matvec(Xf, params)  # bf16-data + margin_cols aware
+        p = features_lib.matvec(Xf, params)  # bf16-data + lanes/cols aware
         r = model.margin_residual(p, yf)
         g = -features_lib.rmatvec(Xf, wf.astype(r.dtype) * r)
         return lax.psum(g, WORKER_AXIS)

@@ -367,7 +367,7 @@ def train(
         pw = layout.fold_slot_weights(slot_w)
         weights_seq, X, y = jnp.asarray(pw, dtype), data.Xp, data.yp
 
-    grad_fn = _apply_dense_flat(cfg, model, mesh, X, grad_fn)
+    grad_fn = _apply_flat_grad(cfg, model, mesh, X, grad_fn)
 
     # fused single-HBM-pass pallas kernel for dense GLM stacks
     from erasurehead_tpu.ops import kernels as kernels_lib
@@ -379,11 +379,11 @@ def train(
         cfg.use_pallas == "auto"
         and kernels_lib.supports_fused(X, kind, platform)
     ):
-        if cfg.use_pallas == "on" and cfg.dense_flat == "on":
+        if cfg.use_pallas == "on" and cfg.flat_grad == "on":
             # both knobs explicitly force a grad lowering; picking one
             # silently would misattribute any measurement tagged by the other
             raise ValueError(
-                "use_pallas='on' and dense_flat='on' are mutually exclusive "
+                "use_pallas='on' and flat_grad='on' are mutually exclusive "
                 "gradient lowerings; force at most one"
             )
         if dense_glm:
@@ -587,11 +587,11 @@ def train_measured(
             "arrival_mode='measured' has no fused-kernel path; "
             "use use_pallas='auto' or 'off'"
         )
-    if cfg.dense_flat == "on":
+    if cfg.flat_grad == "on":
         raise ValueError(
             "arrival_mode='measured' times each worker's own message "
             "separately; the flat-stack lowering fuses all slots into one "
-            "matmul and cannot be timed per worker — use dense_flat='auto' "
+            "matmul and cannot be timed per worker — use flat_grad='auto' "
             "or 'off'"
         )
     setup = _setup_run(cfg, dataset, mesh, faithful=True, single_device=True)
@@ -794,19 +794,20 @@ def train_measured(
     )
 
 
-def _apply_dense_flat(cfg, model, mesh, X, grad_fn):
+def _apply_flat_grad(cfg, model, mesh, X, grad_fn):
     """Swap in the flat-stack closed-form lowering (step.make_flat_grad_fn)
-    per cfg.dense_flat: one 2-D matmul pair instead of the batched per-slot
+    per cfg.flat_grad: one 2-D matmul pair instead of the batched per-slot
     contraction. "on" forces (raising off the closed-form dense path),
     "auto" defers to step.FLAT_GRAD_DEFAULT."""
-    if cfg.dense_flat == "on" and not step_lib.supports_flat_grad(model, X):
+    if cfg.flat_grad == "on" and not step_lib.supports_flat_grad(model, X):
         raise ValueError(
-            "dense_flat='on' needs a closed-form GLM on a dense stack; "
+            "flat_grad='on' needs a closed-form GLM (logistic/linear) on a "
+            "dense, PaddedRows, or FieldOnehot stack; "
             f"got model={getattr(model, 'name', type(model).__name__)!r}, "
             f"X={type(X).__name__}"
         )
-    if cfg.dense_flat == "on" or (
-        cfg.dense_flat == "auto"
+    if cfg.flat_grad == "on" or (
+        cfg.flat_grad == "auto"
         and step_lib.FLAT_GRAD_DEFAULT
         and step_lib.supports_flat_grad(model, X)
     ):
@@ -833,7 +834,7 @@ def train_dynamic(cfg: RunConfig, dataset: Dataset, mesh=None) -> TrainResult:
         cfg.scheme, layout, cfg.num_collect, cfg.delay_mean, cfg.add_delay,
         deadline=cfg.deadline,
     )
-    grad_fn = _apply_dense_flat(
+    grad_fn = _apply_flat_grad(
         cfg, model, mesh, data.Xw,
         step_lib.make_faithful_grad_fn(model, mesh),
     )

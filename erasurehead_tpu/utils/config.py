@@ -159,7 +159,7 @@ class RunConfig:
     # matmul and the decode weights fold into the residual. "on" forces it
     # (errors off the closed-form dense path), "off" keeps the per-slot
     # vmap, "auto" defers to step.FLAT_GRAD_DEFAULT (measurement-pinned).
-    dense_flat: str = "auto"
+    flat_grad: str = "auto"
     # per-round collection deadline in simulated seconds (scheme="deadline")
     deadline: Optional[float] = None
     # sequence-parallel shards for the attention family: >1 builds a 2-D
@@ -212,9 +212,9 @@ class RunConfig:
             raise ValueError(
                 f"use_pallas must be auto/on/off, got {self.use_pallas!r}"
             )
-        if self.dense_flat not in ("auto", "on", "off"):
+        if self.flat_grad not in ("auto", "on", "off"):
             raise ValueError(
-                f"dense_flat must be auto/on/off, got {self.dense_flat!r}"
+                f"flat_grad must be auto/on/off, got {self.flat_grad!r}"
             )
         if self.arrival_mode not in ("simulated", "measured"):
             raise ValueError(

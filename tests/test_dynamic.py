@@ -194,7 +194,7 @@ class TestDecodeTableW30:
 
 
 def test_train_dynamic_flat_lowering_matches_per_slot():
-    """cfg.dense_flat='on' routes train_dynamic through
+    """cfg.flat_grad='on' routes train_dynamic through
     step.make_flat_grad_fn (per-round traced weights fold into the
     residual) — trajectory allclose to the per-slot lowering."""
     from erasurehead_tpu.data.synthetic import generate_gmm
@@ -207,7 +207,7 @@ def test_train_dynamic_flat_lowering_matches_per_slot():
         cfg = RunConfig(
             scheme="approx", n_workers=W, n_stragglers=2, num_collect=8,
             rounds=8, n_rows=16 * W, n_cols=12, lr_schedule=0.5,
-            update_rule="AGD", add_delay=True, seed=0, dense_flat=flat,
+            update_rule="AGD", add_delay=True, seed=0, flat_grad=flat,
         )
         res = trainer.train_dynamic(cfg, data, mesh=worker_mesh(4))
         hists[flat] = np.asarray(res.params_history, np.float32)

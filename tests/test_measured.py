@@ -157,4 +157,4 @@ def test_measured_mode_rejects_unsupported_knobs(data):
     with pytest.raises(ValueError, match="fused-kernel"):
         trainer.train_measured(_cfg(use_pallas="on"), data)
     with pytest.raises(ValueError, match="flat-stack"):
-        trainer.train_measured(_cfg(dense_flat="on"), data)
+        trainer.train_measured(_cfg(flat_grad="on"), data)

@@ -302,7 +302,9 @@ class TestDenseFlatLowering:
     trajectories allclose, and the knob is rejected off the closed-form
     dense path."""
 
-    def _grad_pair(self, scheme="approx", mode="faithful", **extra):
+    def _grad_pair(
+        self, scheme="approx", mode="faithful", sparse_format=None, **extra
+    ):
         from erasurehead_tpu.parallel import step as step_lib
         from erasurehead_tpu.train.trainer import build_layout, build_model
         from erasurehead_tpu.data.sharding import shard_run_data
@@ -310,23 +312,32 @@ class TestDenseFlatLowering:
         cfg = _cfg(
             scheme=scheme, n_stragglers=1, compute_mode=mode, **extra
         )
-        data = generate_gmm(N_ROWS, N_COLS, n_partitions=W, seed=0)
+        if sparse_format is None:
+            data = generate_gmm(N_ROWS, N_COLS, n_partitions=W, seed=0)
+        else:
+            from erasurehead_tpu.data.synthetic import generate_onehot
+
+            data = generate_onehot(
+                N_ROWS, 60, n_partitions=W, n_fields=6, seed=0
+            )
         layout = build_layout(cfg)
         model = build_model(cfg)
         mesh = worker_mesh(4)
         sharded = shard_run_data(
-            data, layout, mesh, faithful=(mode == "faithful")
+            data, layout, mesh, faithful=(mode == "faithful"),
+            sparse_format=sparse_format or "padded",
         )
         if mode == "faithful":
             base = step_lib.make_faithful_grad_fn(model, mesh)
             X, y = sharded.Xw, sharded.yw
-            w = np.random.default_rng(0).uniform(0.5, 1.5, X.shape[:2])
+            w = np.random.default_rng(0).uniform(0.5, 1.5, y.shape[:2])
         else:
             base = step_lib.make_deduped_grad_fn(model, mesh)
             X, y = sharded.Xp, sharded.yp
-            w = np.random.default_rng(0).uniform(0.5, 1.5, X.shape[:1])
+            w = np.random.default_rng(0).uniform(0.5, 1.5, y.shape[:1])
         flat = step_lib.make_flat_grad_fn(model, mesh)
-        params = model.init_params(jax.random.key(1), N_COLS)
+        n_features = data.X_train.shape[1]
+        params = model.init_params(jax.random.key(1), n_features)
         import jax.numpy as jnp
 
         wj = jnp.asarray(w, jnp.float32)
@@ -339,6 +350,14 @@ class TestDenseFlatLowering:
         g0, g1 = self._grad_pair(mode=mode)
         np.testing.assert_allclose(g1, g0, rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.parametrize("fmt", ["padded", "fields"])
+    @pytest.mark.parametrize("mode", ["faithful", "deduped"])
+    def test_flat_grad_matches_per_slot_sparse(self, mode, fmt):
+        """The flat lowering on sparse stacks: one scatter accumulator
+        instead of a vmapped per-slot batch of them — same gradient."""
+        g0, g1 = self._grad_pair(mode=mode, sparse_format=fmt)
+        np.testing.assert_allclose(g1, g0, rtol=1e-5, atol=1e-5)
+
     @pytest.mark.parametrize("model", ["logistic", "linear"])
     def test_trajectory_matches_per_slot(self, gmm, model):
         data = gmm if model == "logistic" else generate_linear(
@@ -348,7 +367,7 @@ class TestDenseFlatLowering:
         for flat in ("off", "on"):
             cfg = _cfg(
                 scheme=Scheme.APPROX, model=model, n_stragglers=1,
-                num_collect=6, dense_flat=flat,
+                num_collect=6, flat_grad=flat,
                 lr_schedule=0.2 if model == "linear" else 0.5,
             )
             res = trainer.train(cfg, data, mesh=worker_mesh(4))
@@ -360,22 +379,22 @@ class TestDenseFlatLowering:
     def test_flat_on_bf16_data_trains(self, gmm):
         cfg = _cfg(
             scheme=Scheme.APPROX, n_stragglers=1, num_collect=6,
-            dense_flat="on", dtype="bfloat16",
+            flat_grad="on", dtype="bfloat16",
         )
         res = trainer.train(cfg, gmm, mesh=worker_mesh(4))
         assert np.isfinite(np.asarray(res.params_history)).all()
 
     def test_flat_on_rejects_mlp(self, gmm):
-        cfg = _cfg(model="mlp", dense_flat="on", lr_schedule=0.01)
-        with pytest.raises(ValueError, match="dense_flat"):
+        cfg = _cfg(model="mlp", flat_grad="on", lr_schedule=0.01)
+        with pytest.raises(ValueError, match="flat_grad"):
             trainer.train(cfg, gmm, mesh=worker_mesh(4))
 
     def test_config_validates_values(self):
-        with pytest.raises(ValueError, match="dense_flat"):
-            _cfg(dense_flat="yes")
+        with pytest.raises(ValueError, match="flat_grad"):
+            _cfg(flat_grad="yes")
 
     def test_flat_on_conflicts_with_pallas_on(self, gmm):
-        cfg = _cfg(dense_flat="on", use_pallas="on")
+        cfg = _cfg(flat_grad="on", use_pallas="on")
         with pytest.raises(ValueError, match="mutually exclusive"):
             trainer.train(cfg, gmm, mesh=worker_mesh(4))
 

@@ -55,6 +55,12 @@ def main() -> None:
         help="fields = FieldOnehot fused pair-table lowering (halves the "
              "lookup count on one-hot field-structured data)",
     )
+    ap.add_argument(
+        "--flat", dest="flat_grad", default="auto",
+        choices=["auto", "on", "off"],
+        help="flat-stack closed-form lowering (step.make_flat_grad_fn): "
+             "one scatter accumulator instead of a vmapped per-slot batch",
+    )
     args = ap.parse_args()
     presets = {
         "covtype": (396112 // W * W, 15509, 12),
@@ -129,6 +135,7 @@ def main() -> None:
         compute_mode=args.mode,
         sparse_lanes=args.lanes,
         sparse_format=args.sparse_format,
+        flat_grad=args.flat_grad,
         seed=0,
     )
     t0 = time.perf_counter()
@@ -177,6 +184,7 @@ def main() -> None:
                 "mode": args.mode,
                 "lanes": args.lanes,
                 "format": args.sparse_format,
+                "flat": args.flat_grad,
                 "n_rows": args.rows,
                 "n_cols": args.cols,
                 "nnz_per_row": args.nnz,

@@ -43,4 +43,19 @@ run dense_f32_deduped_flat 1800 env BENCH_FLAT=on BENCH_MODE=deduped python benc
 run dense_profile_flat   1200 python tools/profile_dense.py \
     --only flatstack_full,flatstack_bf16
 
+# sparse flat: ONE scatter accumulator instead of the vmapped per-slot
+# batch — the prime suspect for the fields end-to-end path running ~10x
+# slower than its own profiled pair-table candidates (sweep entry
+# sparse_covtype_faithful_fields: 0.896 steps/s vs ~8.8 predicted)
+run sparse_covtype_faithful_fields_flat 1200 python tools/bench_sparse.py \
+    --shape covtype --format fields --flat on
+run sparse_covtype_faithful_flat        1200 python tools/bench_sparse.py \
+    --shape covtype --flat on
+run sparse_covtype_deduped_fields_flat  1200 python tools/bench_sparse.py \
+    --shape covtype --mode deduped --format fields --flat on
+run sparse_amazon_faithful_fields_flat  1200 python tools/bench_sparse.py \
+    --shape amazon --format fields --flat on
+run sparse_amazon_faithful_flat         1200 python tools/bench_sparse.py \
+    --shape amazon --flat on
+
 echo "flat measurements appended to $OUT" >&2
