@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # Watch the axon relay for a healthy window; when one appears, run the
-# resumable measurement sweep (tools/tpu_measurements.sh). Probe is a
-# SUBPROCESS jax.devices() with a hard timeout — a wedged relay hangs the
-# probe child, never this script. Logs to tools/relay_watch.log.
+# resumable measurement programs (tpu_measurements_flat.sh first — its
+# entries decide production defaults — then tpu_measurements.sh). Probe
+# is a SUBPROCESS jax.devices() with a hard timeout — a wedged relay
+# hangs the probe child, never this script. Logs to tools/relay_watch.log.
 #
 #   bash tools/relay_watch.sh [max_hours]
 set -u
@@ -25,7 +26,18 @@ EOF
 
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if probe; then
-    echo "$(date -Is) relay HEALTHY — running sweep" >> "$LOG"
+    echo "$(date -Is) relay HEALTHY — running sweeps" >> "$LOG"
+    # flat-lowering program first: its entries decide the production
+    # defaults (dense flat race, the sparse fields fix validation)
+    bash tools/tpu_measurements_flat.sh >> "$LOG" 2>&1
+    # re-probe between programs — a mid-sweep wedge otherwise burns the
+    # second program's per-entry timeouts against a dead relay (and would
+    # fall through to a doomed bench.py below: skip to the next poll)
+    if ! probe; then
+      echo "$(date -Is) relay wedged mid-window — re-polling" >> "$LOG"
+      sleep 240
+      continue
+    fi
     bash tools/tpu_measurements.sh >> "$LOG" 2>&1
     missing=$(python tools/sweep_status.py 2>/dev/null || echo "?")
     echo "$(date -Is) sweep pass done; missing entries: $missing" >> "$LOG"

@@ -1,19 +1,20 @@
 #!/usr/bin/env python
 """Print the number of measurement-sweep tags not yet captured in
-tools/measurements.jsonl (0 means the sweep is complete). Tag list is
-parsed from tools/tpu_measurements.sh so the two never drift."""
+tools/measurements.jsonl (0 means the full program is complete). Tag
+lists are parsed from every tpu_measurements*.sh program so the scripts
+and this count never drift."""
 import json
 import pathlib
 import re
 import sys
 
 root = pathlib.Path(__file__).resolve().parent
-sh = (root / "tpu_measurements.sh").read_text()
 tags = []
-for line in sh.splitlines():
-    m = re.match(r'\s*run\s+"?([A-Za-z0-9_${}]+)"?\s+\d+', line)
-    if m:
-        tags.append(m.group(1))
+for script in sorted(root.glob("tpu_measurements*.sh")):
+    for line in script.read_text().splitlines():
+        m = re.match(r'\s*run\s+"?([A-Za-z0-9_${}]+)"?\s+\d+', line)
+        if m:
+            tags.append(m.group(1))
 expanded = []
 for t in tags:
     if "${shape}" in t:

@@ -2,8 +2,9 @@
 # Follow-up measurement program for the flat-stack GLM lowering
 # (parallel/step.make_flat_grad_fn, landed mid-round after the margin
 # profile put the flat 2-D matmul at the raw-stream floor). Same resumable
-# tagged-append protocol as tpu_measurements.sh; run AFTER that sweep
-# drains — never concurrently (the relay serves one client).
+# tagged-append protocol as tpu_measurements.sh; the watcher runs this
+# program FIRST (its entries decide production defaults). Never run two
+# programs concurrently — the relay serves one client.
 #
 #   bash tools/tpu_measurements_flat.sh [out.jsonl]
 set -u -o pipefail
