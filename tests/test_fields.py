@@ -308,6 +308,35 @@ class TestTrainingIntegration:
         # covtype-class fields stay fused
         assert _greedy_pairing((1292, 1292))[0][0] == "pair"
 
+    def test_flat_grad_singles_fallback_matches_per_slot(self):
+        """The flat lowering (step.make_flat_grad_fn) on an amazon-class
+        FieldOnehot whose pair table exceeds the cap — the singles-plan
+        branch must agree with the per-slot vmap too."""
+        import jax
+
+        from erasurehead_tpu.models.glm import LogisticModel
+        from erasurehead_tpu.parallel import step as step_lib
+        from erasurehead_tpu.parallel.mesh import worker_mesh
+
+        sizes = (2048, 1200)
+        assert _greedy_pairing(sizes) == (("single", 0), ("single", 1))
+        rng = np.random.default_rng(0)
+        Wl, S, R = 4, 2, 16
+        local = rng.integers(0, sizes, size=(Wl, S, R, 2)).astype(np.int32)
+        X = FieldOnehot(jnp.asarray(local), sizes, int(sum(sizes)))
+        y = jnp.asarray(
+            np.sign(rng.standard_normal((Wl, S, R))), jnp.float32
+        )
+        w = jnp.asarray(rng.uniform(0.5, 1.5, (Wl, S)), jnp.float32)
+        mesh = worker_mesh(4)
+        model = LogisticModel()
+        params = model.init_params(jax.random.key(1), int(sum(sizes)))
+        base = step_lib.make_faithful_grad_fn(model, mesh)(params, X, y, w)
+        flat = step_lib.make_flat_grad_fn(model, mesh)(params, X, y, w)
+        np.testing.assert_allclose(
+            np.asarray(flat), np.asarray(base), rtol=1e-5, atol=1e-5
+        )
+
     def test_from_scipy_returns_host_arrays(self):
         csr = _onehot_csr(16, (4, 4))
         fo = FieldOnehot.from_scipy(csr)
