@@ -45,7 +45,10 @@ def main() -> None:
         for tag, r in benches:
             extras = ", ".join(
                 f"{k}={r[k]}"
-                for k in ("mode", "lanes", "format", "dtype", "pct_roofline")
+                for k in (
+                    "mode", "lanes", "format", "flat", "dtype",
+                    "pct_roofline",
+                )
                 if r.get(k) is not None
             )
             print(
@@ -60,9 +63,13 @@ def main() -> None:
         for kind in ("logistic", "linear"):
             if kind in r:
                 k = r[kind]
+                flag = (
+                    f"  INVALID: {k['invalid']}" if k.get("invalid") else ""
+                )
                 print(
                     f"- {kind}: pallas {k.get('pallas_ms')}ms vs "
                     f"XLA {k.get('xla_ms')}ms (speedup {k.get('speedup')})"
+                    f"{flag}"
                 )
         print()
 
