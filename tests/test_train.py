@@ -376,6 +376,20 @@ class TestDenseFlatLowering:
             hists["on"], hists["off"], rtol=2e-4, atol=2e-5
         )
 
+    def test_trajectory_matches_per_slot_mds(self, gmm):
+        """MDS decode weights (per-message lstsq solutions, not 0/1 masks)
+        fold through the flat lowering's per-row scale identically."""
+        hists = {}
+        for flat in ("off", "on"):
+            cfg = _cfg(
+                scheme=Scheme.CYCLIC_MDS, n_stragglers=2, flat_grad=flat,
+            )
+            res = trainer.train(cfg, gmm, mesh=worker_mesh(4))
+            hists[flat] = np.asarray(res.params_history, np.float32)
+        np.testing.assert_allclose(
+            hists["on"], hists["off"], rtol=2e-4, atol=2e-5
+        )
+
     def test_flat_on_bf16_data_trains(self, gmm):
         cfg = _cfg(
             scheme=Scheme.APPROX, n_stragglers=1, num_collect=6,
