@@ -341,4 +341,52 @@ if K % 2 == 0 and B >= 2:
         print(f"profile: scatter_pairs {results['scatter_pairs_ms']}ms",
               file=sys.stderr)
 
+    # --- the PRODUCTION flat lowering's exact shapes (features.
+    # flatten_rows + step.make_flat_grad_fn): one [M*R, K/2] code array,
+    # and ONE [B*B] accumulator per pair over ALL rows — no per-slot
+    # batch, no lax.map. The fields regression taught that candidates
+    # must match the production lowering to predict it. Names dodge the
+    # margin_pairs/scatter_pairs substrings so the main sweep's --only
+    # groups never pick these up. ----------------------------------------
+    def flatpairs_margin(beta, pidx, ys):
+        blocks = beta[: K * B].reshape(K, B)
+        pf = pidx.reshape(M * R, K // 2)
+        p = jnp.zeros(M * R, jnp.float32)
+        for pr in range(K // 2):
+            table = (
+                blocks[2 * pr][:, None] + blocks[2 * pr + 1][None, :]
+            ).reshape(B * B)
+            p = p + jnp.take(table, pf[:, pr], axis=0)
+        return beta * 0.999 + jnp.sum(p) / F
+
+    if want("flatpairs_margin"):
+        results["flatpairs_margin_ms"] = round(
+            time_scanned(flatpairs_margin, (pair_idx_j, y_j)) * 1e3, 3
+        )
+        print(
+            f"profile: flatpairs_margin "
+            f"{results['flatpairs_margin_ms']}ms", file=sys.stderr,
+        )
+
+    def flatpairs_scatter(beta, pidx, ys):
+        pf = pidx.reshape(M * R, K // 2)
+        s = ys.reshape(M * R)
+        gs = []
+        for pr in range(K // 2):
+            acc = jnp.zeros(B * B, jnp.float32).at[pf[:, pr]].add(s)
+            t = acc.reshape(B, B)
+            gs.append(t.sum(axis=1))
+            gs.append(t.sum(axis=0))
+        g = jnp.concatenate(gs)
+        return dep(beta, jnp.pad(g, (0, F - K * B)))
+
+    if want("flatpairs_scatter"):
+        results["flatpairs_scatter_ms"] = round(
+            time_scanned(flatpairs_scatter, (pair_idx_j, y_j)) * 1e3, 3
+        )
+        print(
+            f"profile: flatpairs_scatter "
+            f"{results['flatpairs_scatter_ms']}ms", file=sys.stderr,
+        )
+
 print(json.dumps(results))

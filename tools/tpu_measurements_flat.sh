@@ -58,5 +58,9 @@ run sparse_amazon_faithful_fields_flat  1200 python tools/bench_sparse.py \
     --shape amazon --format fields --flat on
 run sparse_amazon_faithful_flat         1200 python tools/bench_sparse.py \
     --shape amazon --flat on
+# attribution at the production flat shapes (one flat gather / ONE
+# accumulator per pair): predicts the end-to-end fields+flat entries
+run sparse_profile_flatpairs 1200 python tools/profile_sparse.py \
+    --only flatpairs_margin,flatpairs_scatter
 
 echo "flat measurements appended to $OUT" >&2
