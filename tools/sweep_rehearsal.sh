@@ -55,5 +55,19 @@ for shape in amazon covtype; do
   run "sparse_${shape}_deduped_lanes128" 600 python tools/bench_sparse.py --shape "$shape" --mode deduped --lanes 128 --light
 done
 
+# flat-lowering program (tpu_measurements_flat.sh) entries, light form
+run dense_f32_flat 600 env BENCH_FLAT=on python bench.py
+run dense_profile_flat 600 python tools/profile_dense.py \
+    --slots 4 --rows 256 --cols 64 --only flatstack_full,flatstack_bf16
+run sparse_covtype_faithful_fields_flat 600 python tools/bench_sparse.py \
+    --shape covtype --format fields --flat on --light
+run sparse_covtype_faithful_flat 600 python tools/bench_sparse.py \
+    --shape covtype --flat on --light
+run sparse_amazon_faithful_fields_flat 600 python tools/bench_sparse.py \
+    --shape amazon --format fields --flat on --light
+run sparse_profile_flatpairs 600 python tools/profile_sparse.py \
+    --slots 4 --rows 256 --nnz 4 --cols 512 \
+    --only flatpairs_margin,flatpairs_scatter
+
 n_ok=$(wc -l < "$OUT")
 echo "rehearsal: $n_ok entries captured in $OUT" >&2
