@@ -99,7 +99,7 @@ if COMPUTE_MODE == "deduped":
     METRIC_SUFFIX += "_deduped"
 # flat-stack lowering knob (parallel/step.make_flat_grad_fn): "on"/"off"
 # force the flat vs per-slot closed-form lowering; unset = cfg default
-# ("auto", resolves via step.FLAT_GRAD_DEFAULT). Tagged so sweep entries
+# ("auto", step.resolve_flat_grad's per-stack-kind rules). Tagged so sweep entries
 # with different lowerings never collide.
 FLAT_GRAD = os.environ.get("BENCH_FLAT", "")
 if FLAT_GRAD and FLAT_GRAD in ("on", "off"):
@@ -282,7 +282,7 @@ def child() -> None:
         # BENCH_MODE=deduped: per-partition compute, 1/(s+1) the traffic
         compute_mode=COMPUTE_MODE,
         # BENCH_FLAT: force the flat-stack closed-form lowering on/off
-        # (unset = "auto", step.FLAT_GRAD_DEFAULT decides)
+        # (unset = "auto", step.resolve_flat_grad decides per stack kind)
         flat_grad=FLAT_GRAD or "auto",
         seed=0,
     )

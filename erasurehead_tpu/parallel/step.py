@@ -142,11 +142,12 @@ def make_deduped_grad_fn(model, mesh: Mesh) -> GradFn:
     )
 
 
-# Whether flat_grad="auto" resolves to the flat lowering. False until the
-# end-to-end TPU measurement lands (the margin-pass profile alone showed
-# margin_matmul2d 1.587 ms vs the batched per-slot contraction's 1.843 ms,
-# tools/profile_dense.py, v5e round 3); flipped by that measurement, pinned
-# by tests either way.
+# Whether flat_grad="auto" resolves to the flat lowering for DENSE and
+# PaddedRows stacks. False until their end-to-end TPU races land (the
+# margin-pass profile alone showed margin_matmul2d 1.587 ms vs the batched
+# per-slot contraction's 1.843 ms, tools/profile_dense.py, v5e round 3);
+# flipped by that measurement, pinned by tests either way. FieldOnehot is
+# decided separately (see resolve_flat_grad).
 FLAT_GRAD_DEFAULT = False
 
 
@@ -161,6 +162,34 @@ def supports_flat_grad(model, X) -> bool:
     ) and isinstance(
         X, (jax.Array, features_lib.PaddedRows, features_lib.FieldOnehot)
     )
+
+
+def resolve_flat_grad(flat_grad: str, model, X) -> bool:
+    """Should this run use make_flat_grad_fn? ("on" validity is the
+    caller's concern — this resolves the choice, it does not raise.)
+
+    "auto" resolution is measurement-pinned per stack kind:
+      - FieldOnehot: FLAT. The per-slot vmap materializes a
+        [n_slots, pair-table] batch of scatter accumulators and measured
+        catastrophically slow end-to-end on v5e (0.896 steps/s faithful
+        covtype, deduped timed out its sweep entry outright) while the
+        one-accumulator candidates profile ~10x faster
+        (tools/measurements.jsonl round 3); the flat lowering IS the
+        one-accumulator form.
+      - dense / PaddedRows: per-slot until FLAT_GRAD_DEFAULT is flipped
+        by their queued end-to-end races (tpu_measurements_flat.sh).
+    """
+    if not supports_flat_grad(model, X):
+        return False
+    if flat_grad == "on":
+        return True
+    if flat_grad == "off":
+        return False
+    from erasurehead_tpu.ops import features as features_lib
+
+    if isinstance(X, features_lib.FieldOnehot):
+        return True
+    return FLAT_GRAD_DEFAULT
 
 
 def make_flat_grad_fn(model, mesh: Mesh) -> GradFn:

@@ -796,9 +796,9 @@ def train_measured(
 
 def _apply_flat_grad(cfg, model, mesh, X, grad_fn):
     """Swap in the flat-stack closed-form lowering (step.make_flat_grad_fn)
-    per cfg.flat_grad: one 2-D matmul pair instead of the batched per-slot
-    contraction. "on" forces (raising off the closed-form dense path),
-    "auto" defers to step.FLAT_GRAD_DEFAULT."""
+    per cfg.flat_grad: one matvec/rmatvec pair instead of the batched
+    per-slot contraction. "on" forces (raising off the closed-form path),
+    "auto" defers to step.resolve_flat_grad's measurement-pinned rules."""
     if cfg.flat_grad == "on" and not step_lib.supports_flat_grad(model, X):
         raise ValueError(
             "flat_grad='on' needs a closed-form GLM (logistic/linear) on a "
@@ -806,11 +806,7 @@ def _apply_flat_grad(cfg, model, mesh, X, grad_fn):
             f"got model={getattr(model, 'name', type(model).__name__)!r}, "
             f"X={type(X).__name__}"
         )
-    if cfg.flat_grad == "on" or (
-        cfg.flat_grad == "auto"
-        and step_lib.FLAT_GRAD_DEFAULT
-        and step_lib.supports_flat_grad(model, X)
-    ):
+    if step_lib.resolve_flat_grad(cfg.flat_grad, model, X):
         return step_lib.make_flat_grad_fn(model, mesh)
     return grad_fn
 

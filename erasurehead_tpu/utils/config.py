@@ -158,7 +158,9 @@ class RunConfig:
     # flattens the [slots, rows, F] stack so the margin lowers as one 2-D
     # matmul and the decode weights fold into the residual. "on" forces it
     # (errors off the closed-form dense path), "off" keeps the per-slot
-    # vmap, "auto" defers to step.FLAT_GRAD_DEFAULT (measurement-pinned).
+    # vmap, "auto" resolves per stack kind (step.resolve_flat_grad):
+    # flat for FieldOnehot (per-slot measured catastrophic on v5e), else
+    # step.FLAT_GRAD_DEFAULT pending the dense/PaddedRows races.
     flat_grad: str = "auto"
     # per-round collection deadline in simulated seconds (scheme="deadline")
     deadline: Optional[float] = None

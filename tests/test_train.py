@@ -407,6 +407,36 @@ class TestDenseFlatLowering:
         with pytest.raises(ValueError, match="flat_grad"):
             _cfg(flat_grad="yes")
 
+    def test_auto_resolution_is_measurement_pinned(self):
+        """auto -> flat for FieldOnehot (per-slot measured catastrophic on
+        v5e); dense/PaddedRows follow FLAT_GRAD_DEFAULT until their races
+        land; autodiff families never resolve flat."""
+        import jax.numpy as jnp
+
+        from erasurehead_tpu.models.glm import LogisticModel
+        from erasurehead_tpu.models.mlp import MLPModel
+        from erasurehead_tpu.ops.features import FieldOnehot, PaddedRows
+        from erasurehead_tpu.parallel import step as step_lib
+
+        glm = LogisticModel()
+        dense = jnp.zeros((2, 4, 8))
+        padded = PaddedRows(
+            jnp.zeros((2, 4, 3), jnp.int32), jnp.ones((2, 4, 3)), 8
+        )
+        fields = FieldOnehot(jnp.zeros((2, 4, 2), jnp.int32), (4, 4), 8)
+        assert step_lib.resolve_flat_grad("auto", glm, fields)
+        assert (
+            step_lib.resolve_flat_grad("auto", glm, dense)
+            == step_lib.FLAT_GRAD_DEFAULT
+        )
+        assert (
+            step_lib.resolve_flat_grad("auto", glm, padded)
+            == step_lib.FLAT_GRAD_DEFAULT
+        )
+        assert not step_lib.resolve_flat_grad("off", glm, fields)
+        assert step_lib.resolve_flat_grad("on", glm, dense)
+        assert not step_lib.resolve_flat_grad("auto", MLPModel(), dense)
+
     def test_flat_on_conflicts_with_pallas_on(self, gmm):
         cfg = _cfg(flat_grad="on", use_pallas="on")
         with pytest.raises(ValueError, match="mutually exclusive"):

@@ -46,8 +46,8 @@ run sparse_profile 600 python tools/profile_sparse.py \
 run dense_f32_margincols8 600 env BENCH_MARGIN_COLS=8 python bench.py
 
 for shape in amazon covtype; do
-  run "sparse_${shape}_faithful_fields"  600 python tools/bench_sparse.py --shape "$shape" --format fields --light
-  run "sparse_${shape}_deduped_fields"   600 python tools/bench_sparse.py --shape "$shape" --mode deduped --format fields --light
+  run "sparse_${shape}_faithful_fields"  600 python tools/bench_sparse.py --shape "$shape" --format fields --flat off --light
+  run "sparse_${shape}_deduped_fields"   600 python tools/bench_sparse.py --shape "$shape" --mode deduped --format fields --flat off --light
   run "sparse_${shape}_faithful"         600 python tools/bench_sparse.py --shape "$shape" --light
   run "sparse_${shape}_deduped"          600 python tools/bench_sparse.py --shape "$shape" --mode deduped --light
   run "sparse_${shape}_faithful_lanes8"  600 python tools/bench_sparse.py --shape "$shape" --lanes 8 --light

@@ -89,11 +89,16 @@ run dense_f32_margincols8 1800 env BENCH_MARGIN_COLS=8 python bench.py
 
 # flagship sparse shapes, covtype (known-good compiles) before amazon;
 # fields = FieldOnehot pair tables (halves the lookup count where pairs
-# fit the cap — covtype; amazon falls back to singles). The plain covtype
+# fit the cap — covtype; amazon falls back to singles). Fields entries pin
+# --flat off: flat_grad="auto" now resolves FieldOnehot to the flat
+# lowering (step.resolve_flat_grad), so these stay the PER-SLOT baselines
+# — the flat races live in tpu_measurements_flat.sh. The plain covtype
 # entries are r2-captured and resume-skipped, but stay in the program so
 # RERUN_ALL=1 refreshes the full faithful/deduped x covtype/amazon grid.
-run sparse_covtype_faithful_fields  1200 python tools/bench_sparse.py --shape covtype --format fields
-run sparse_covtype_deduped_fields   1200 python tools/bench_sparse.py --shape covtype --mode deduped --format fields
+run sparse_covtype_faithful_fields  1200 python tools/bench_sparse.py --shape covtype --format fields --flat off
+# (timed out its 1200 s budget in r3 window 2 — the per-slot pair
+# accumulators; worth one bounded retry as the baseline, not more)
+run sparse_covtype_deduped_fields   600 python tools/bench_sparse.py --shape covtype --mode deduped --format fields --flat off
 run sparse_covtype_faithful         1200 python tools/bench_sparse.py --shape covtype
 run sparse_covtype_deduped          1200 python tools/bench_sparse.py --shape covtype --mode deduped
 run sparse_amazon_faithful          1200 python tools/bench_sparse.py --shape amazon
@@ -130,7 +135,7 @@ run sparse_profile_packed128 1200 python tools/profile_sparse.py \
 # terminal down at 01:52Z with this entry in flight; the compile itself
 # is proven cheap — 8 s on forced-CPU — so this is pure wedge paranoia).
 # K=44 singles fallback.
-run sparse_amazon_faithful_fields  1200 python tools/bench_sparse.py --shape amazon --format fields
-run sparse_amazon_deduped_fields   1200 python tools/bench_sparse.py --shape amazon --mode deduped --format fields
+run sparse_amazon_faithful_fields  1200 python tools/bench_sparse.py --shape amazon --format fields --flat off
+run sparse_amazon_deduped_fields   1200 python tools/bench_sparse.py --shape amazon --mode deduped --format fields --flat off
 
 echo "measurements appended to $OUT" >&2
