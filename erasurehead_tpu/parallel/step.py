@@ -172,10 +172,9 @@ def resolve_flat_grad(flat_grad: str, model, X) -> bool:
       - FieldOnehot: FLAT. The per-slot vmap materializes a
         [n_slots, pair-table] batch of scatter accumulators and measured
         catastrophically slow end-to-end on v5e (0.896 steps/s faithful
-        covtype, deduped timed out its sweep entry outright) while the
-        one-accumulator candidates profile ~10x faster
-        (tools/measurements.jsonl round 3); the flat lowering IS the
-        one-accumulator form.
+        covtype — ~10x under what its own one-accumulator profile
+        candidates predict, tools/measurements.jsonl round 3); the flat
+        lowering IS the one-accumulator form.
       - dense / PaddedRows: per-slot until FLAT_GRAD_DEFAULT is flipped
         by their queued end-to-end races (tpu_measurements_flat.sh).
     """

@@ -96,8 +96,8 @@ run dense_f32_margincols8 1800 env BENCH_MARGIN_COLS=8 python bench.py
 # entries are r2-captured and resume-skipped, but stay in the program so
 # RERUN_ALL=1 refreshes the full faithful/deduped x covtype/amazon grid.
 run sparse_covtype_faithful_fields  1200 python tools/bench_sparse.py --shape covtype --format fields --flat off
-# (timed out its 1200 s budget in r3 window 2 — the per-slot pair
-# accumulators; worth one bounded retry as the baseline, not more)
+# (timed out its 1200 s budget in r3 window 2, but the relay wedge began
+# mid-entry so that run proves nothing; one bounded retry as baseline)
 run sparse_covtype_deduped_fields   600 python tools/bench_sparse.py --shape covtype --mode deduped --format fields --flat off
 run sparse_covtype_faithful         1200 python tools/bench_sparse.py --shape covtype
 run sparse_covtype_deduped          1200 python tools/bench_sparse.py --shape covtype --mode deduped
