@@ -90,6 +90,7 @@ bench:
 
 sweep:            ## the full on-TPU measurement program (resumable, tagged)
 	bash tools/tpu_measurements.sh
+	bash tools/tpu_measurements_flat.sh
 
 rehearse:         ## CPU rehearsal of every queued sweep entry (light form)
 	bash tools/sweep_rehearsal.sh
