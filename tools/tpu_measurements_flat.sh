@@ -58,6 +58,8 @@ run sparse_amazon_faithful_fields_flat  1200 python tools/bench_sparse.py \
     --shape amazon --format fields --flat on
 run sparse_amazon_faithful_flat         1200 python tools/bench_sparse.py \
     --shape amazon --flat on
+run sparse_amazon_deduped_fields_flat   1200 python tools/bench_sparse.py \
+    --shape amazon --mode deduped --format fields --flat on
 # attribution at the production flat shapes (one flat gather / ONE
 # accumulator per pair): predicts the end-to-end fields+flat entries
 run sparse_profile_flatpairs 1200 python tools/profile_sparse.py \
