@@ -35,34 +35,28 @@ sys.exit(1 if d.get("platform") in ("cpu", "none") else 0)' 2>/dev/null; then
   fi
 }
 
-# the full production path under the flat lowering, racing the captured
-# dense_f32 / dense_bf16 / deduped entries for the production default
-run dense_f32_flat       1800 env BENCH_FLAT=on python bench.py
-run dense_bf16_flat      1800 env BENCH_FLAT=on BENCH_DTYPE=bfloat16 python bench.py
-run dense_f32_deduped_flat 1800 env BENCH_FLAT=on BENCH_MODE=deduped python bench.py
-# profile-level attribution: flat two-pass vs the per-slot two-pass
-run dense_profile_flat   1200 python tools/profile_dense.py \
-    --only flatstack_full,flatstack_bf16
-
-# sparse flat: ONE scatter accumulator instead of the vmapped per-slot
-# batch — the prime suspect for the fields end-to-end path running ~10x
-# slower than its own profiled pair-table candidates (sweep entry
-# sparse_covtype_faithful_fields: 0.896 steps/s vs ~8.8 predicted)
+# Ordered by decision value for a short window:
+# 1-2: validate the fields fix (auto->flat flipped on the r3 evidence) at
+#      both canonical shapes; 3: decide FLAT_GRAD_DEFAULT for dense;
+#      then attribution and the rest of the grid.
 run sparse_covtype_faithful_fields_flat 1200 python tools/bench_sparse.py \
     --shape covtype --format fields --flat on
+run sparse_amazon_faithful_fields_flat  1200 python tools/bench_sparse.py \
+    --shape amazon --format fields --flat on
+run dense_f32_flat       1800 env BENCH_FLAT=on python bench.py
+run dense_profile_flat   1200 python tools/profile_dense.py \
+    --only flatstack_full,flatstack_bf16
+run sparse_profile_flatpairs 1200 python tools/profile_sparse.py \
+    --only flatpairs_margin,flatpairs_scatter
 run sparse_covtype_faithful_flat        1200 python tools/bench_sparse.py \
     --shape covtype --flat on
 run sparse_covtype_deduped_fields_flat  1200 python tools/bench_sparse.py \
     --shape covtype --mode deduped --format fields --flat on
-run sparse_amazon_faithful_fields_flat  1200 python tools/bench_sparse.py \
-    --shape amazon --format fields --flat on
 run sparse_amazon_faithful_flat         1200 python tools/bench_sparse.py \
     --shape amazon --flat on
 run sparse_amazon_deduped_fields_flat   1200 python tools/bench_sparse.py \
     --shape amazon --mode deduped --format fields --flat on
-# attribution at the production flat shapes (one flat gather / ONE
-# accumulator per pair): predicts the end-to-end fields+flat entries
-run sparse_profile_flatpairs 1200 python tools/profile_sparse.py \
-    --only flatpairs_margin,flatpairs_scatter
+run dense_bf16_flat      1800 env BENCH_FLAT=on BENCH_DTYPE=bfloat16 python bench.py
+run dense_f32_deduped_flat 1800 env BENCH_FLAT=on BENCH_MODE=deduped python bench.py
 
 echo "flat measurements appended to $OUT" >&2
