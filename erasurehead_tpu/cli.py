@@ -141,8 +141,10 @@ def _flags_parser() -> argparse.ArgumentParser:
                         "gradient compute and collect on those arrivals "
                         "(trainer.train_measured)")
     p.add_argument("--sparse-lanes", type=int, default=None,
-                   help="PaddedRows gather/scatter lane width (power of "
-                        "two; TPU scalar-gather workaround)")
+                   help="sparse margin-gather lane width (power of two; "
+                        "TPU scalar-gather workaround). Applies to "
+                        "PaddedRows value gathers and FieldOnehot "
+                        "pair-table gathers; the scatter stays scalar")
     p.add_argument("--sparse-format", default="padded",
                    choices=["padded", "fields", "auto"],
                    help="sparse stack representation: fields = FieldOnehot "

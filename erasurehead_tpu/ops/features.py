@@ -21,6 +21,7 @@ default to ``HIGHEST`` precision; perf-oriented callers can opt down with
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional, Union
 
 import jax
@@ -144,6 +145,30 @@ class PaddedRows:
 # per-field singles (same lookup count as PaddedRows, no value payload):
 # amazon-class ~5.5k-category fields (30M-entry tables) always do.
 PAIR_TABLE_CAP = 1 << 21
+
+# Budget for one lane-replicated margin table ([entries, L] f32 behind an
+# optimization barrier — XLA cannot fold it away). Separate from
+# PAIR_TABLE_CAP, which budgets the scatter side's per-slot accumulators:
+# the gather table is a single transient, so it tolerates a much larger
+# byte budget, but lane width multiplies it — at L=1024 an uncapped
+# covtype pair table would be 1.67M x 1024 x 4B ~= 6.8 GB. Oversized
+# pairs fall back to lane-replicated singles (same fallback rule as the
+# scalar path, narrower tables).
+LANE_TABLE_BYTES_CAP = 1 << 28  # 256 MB
+
+
+def fields_margin_plan(field_sizes, lanes=None):
+    """The pairing plan the margin matvec will use at a given lane width.
+
+    Lane replication shrinks the effective pair-table cap so one
+    [entries, L] f32 table stays within LANE_TABLE_BYTES_CAP. Exposed so
+    traffic models (tools/bench_sparse.py) can count the true number of
+    margin lookups per row instead of assuming all-pairs.
+    """
+    cap = PAIR_TABLE_CAP
+    if lanes is not None:
+        cap = min(cap, LANE_TABLE_BYTES_CAP // (4 * lanes))
+    return _greedy_pairing(tuple(field_sizes), cap=cap)
 
 
 def _greedy_pairing(field_sizes, cap=PAIR_TABLE_CAP):
@@ -315,9 +340,10 @@ def validate_lanes(L: Optional[int]) -> Optional[int]:
 
 
 def set_sparse_lanes(L: Optional[int]) -> None:
-    """Set the PaddedRows margin-gather lane width (None = scalar path).
+    """Set the sparse margin-gather lane width (None = scalar path).
 
-    Applies to the matvec (margin) direction only: the v5e profile
+    Applies to the matvec (margin) direction only — for both PaddedRows
+    value gathers and FieldOnehot pair-table gathers: the v5e profile
     (tools/profile_sparse.py) measured the lane gather at 2.6x the scalar
     gather but the lane scatter as a net loss, so rmatvec always uses the
     scalar scatter-add.
@@ -338,6 +364,26 @@ def get_sparse_lanes() -> Optional[int]:
     return _SPARSE_LANES
 
 
+def _plan_tables(plan, sizes, local, v):
+    """Yield one (table, code) per plan entry: the fused sum table over a
+    pair's (or single's) categories and each row's index into it. The single
+    home for the fused-code layout — the scalar and lane margin lowerings
+    must gather from identical tables."""
+    offs = np.concatenate([[0], np.cumsum(sizes)]).astype(int)
+    for entry in plan:
+        if entry[0] == "pair":
+            _, i, j = entry
+            bi = v[offs[i] : offs[i + 1]]
+            bj = v[offs[j] : offs[j + 1]]
+            table = (bi[:, None] + bj[None, :]).reshape(-1)
+            code = local[:, i] * sizes[j] + local[:, j]
+        else:
+            _, i = entry
+            table = v[offs[i] : offs[i + 1]]
+            code = local[:, i]
+        yield table, code
+
+
 def _fields_matvec(X: "FieldOnehot", v: jnp.ndarray) -> jnp.ndarray:
     """sum_k v[off_k + local[:, k]] via fused pair tables (see FieldOnehot)."""
     offs = X.offsets
@@ -352,21 +398,54 @@ def _fields_matvec(X: "FieldOnehot", v: jnp.ndarray) -> jnp.ndarray:
                 v[offs[k] : offs[k + 1]], X.local[:, k], axis=0
             )
         return out
+    L = _SPARSE_LANES
+    if L is not None:
+        return _lanes_fields_matvec(sizes, X.n_cols, L, X.local, v)
     out = 0.0
-    for entry in _greedy_pairing(sizes):
-        if entry[0] == "pair":
-            _, i, j = entry
-            bi = v[offs[i] : offs[i + 1]]
-            bj = v[offs[j] : offs[j + 1]]
-            table = (bi[:, None] + bj[None, :]).reshape(-1)
-            code = X.local[:, i] * sizes[j] + X.local[:, j]
-            out = out + jnp.take(table, code, axis=0)
-        else:
-            _, i = entry
-            out = out + jnp.take(
-                v[offs[i] : offs[i + 1]], X.local[:, i], axis=0
-            )
+    for table, code in _plan_tables(_greedy_pairing(sizes), sizes, X.local, v):
+        out = out + jnp.take(table, code, axis=0)
     return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _lanes_fields_matvec(sizes, n_cols, L, local, v):
+    """Composed margin lowering: pair tables (half the lookup count) x lane
+    replication (vectorized addressing, measured 2.6x on the scalar gather
+    — see set_sparse_lanes). Each table replicates to [entries, L] behind a
+    barrier; gathers return [n, L] rows whose lanes are identical, so the
+    per-lane accumulator reduces exactly (power-of-two L) at the end. The
+    pairing plan is lane-aware: pairs whose replicated table would exceed
+    LANE_TABLE_BYTES_CAP fall back to singles.
+
+    custom_vjp: the forward lane gather's automatic transpose would be a
+    lane-wide scatter into the [entries, L] table — exactly the op the v5e
+    profile measured as a net loss, and far outside the 8 MB/table scatter
+    budget PAIR_TABLE_CAP enforces. The op is linear in v with transpose
+    X^T r, so the backward pass is pinned to the scalar-scatter rmatvec:
+    autodiff through the lane path costs the same as through the scalar
+    path, and every differentiation path stays inside the scatter budget.
+    """
+    acc = 0.0
+    for table, code in _plan_tables(
+        fields_margin_plan(sizes, L), sizes, local, v
+    ):
+        wide = jax.lax.optimization_barrier(
+            jnp.broadcast_to(table[:, None], (table.shape[0], L))
+        )
+        acc = acc + jnp.take(wide, code, axis=0)  # [n, L]
+    return acc.sum(axis=1) * (1.0 / L)
+
+
+def _lanes_fields_matvec_fwd(sizes, n_cols, L, local, v):
+    return _lanes_fields_matvec(sizes, n_cols, L, local, v), local
+
+
+def _lanes_fields_matvec_bwd(sizes, n_cols, L, local, g):
+    grad_v = _fields_rmatvec(FieldOnehot(local, sizes, n_cols), g)
+    return np.zeros(local.shape, jax.dtypes.float0), grad_v
+
+
+_lanes_fields_matvec.defvjp(_lanes_fields_matvec_fwd, _lanes_fields_matvec_bwd)
 
 
 def _fields_rmatvec(X: "FieldOnehot", r: jnp.ndarray) -> jnp.ndarray:

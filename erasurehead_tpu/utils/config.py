@@ -145,9 +145,10 @@ class RunConfig:
     # feed those arrivals to the collection rule (trainer.train_measured —
     # worker_timeset becomes a measurement, like src/naive.py:106).
     arrival_mode: str = "simulated"
-    # PaddedRows gather/scatter lane width (ops/features.set_sparse_lanes):
-    # None = scalar lowering; a power of two widens every sparse lookup to
-    # an L-lane row, the TPU workaround for ~7ns/element scalar gathers.
+    # Sparse margin-gather lane width (ops/features.set_sparse_lanes):
+    # None = scalar lowering; a power of two widens each margin lookup
+    # (PaddedRows value gather, or FieldOnehot pair-table gather) to an
+    # L-lane row — the TPU workaround for ~7ns/element scalar gathers.
     sparse_lanes: Optional[int] = None
     # dense margin-matvec lowering width (ops/features.set_dense_margin_cols):
     # None = direct matvec; C in [2,128] replicates beta to [F, C] behind a
@@ -304,15 +305,11 @@ class RunConfig:
                 f"{self.sparse_format!r}"
             )
         if self.sparse_format == "auto" and self.sparse_lanes is not None:
-            # an explicit lane request pins the PaddedRows lowering: "auto"
-            # resolving to FieldOnehot would silently ignore the lanes and
-            # misattribute any lane-width measurement
+            # an explicit lane request pins the PaddedRows lowering so the
+            # historical lane measurements stay attributed to it; the
+            # composed fields x lanes lowering must be asked for explicitly
+            # (sparse_format="fields") until its race flips this default
             self.sparse_format = "padded"
-        if self.sparse_format == "fields" and self.sparse_lanes is not None:
-            raise ValueError(
-                "sparse_lanes applies to the PaddedRows lowering only; "
-                "sparse_format='fields' uses pair tables instead"
-            )
         if self.num_collect is None:
             self.num_collect = self.n_workers
         if self.dataset not in DATASET_PRESETS:

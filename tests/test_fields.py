@@ -353,9 +353,11 @@ class TestTrainingIntegration:
         FieldOnehot.from_scipy(csr, field_sizes=(2, 2))
         assert csr.nnz == nnz_before
 
-    def test_lanes_and_fields_conflict(self):
-        with pytest.raises(ValueError, match="sparse_lanes"):
-            self._cfg(sparse_format="fields", sparse_lanes=8)
+    def test_lanes_compose_with_fields(self):
+        # fields + lanes is the composed lowering (lane-replicated pair
+        # tables, ops/features._fields_matvec), not a conflict
+        cfg = self._cfg(sparse_format="fields", sparse_lanes=8)
+        assert cfg.sparse_format == "fields" and cfg.sparse_lanes == 8
 
     def test_auto_with_lanes_resolves_to_padded(self):
         # lanes pin the PaddedRows lowering — auto must not silently
@@ -419,3 +421,124 @@ class TestInferenceProperty:
         csr = self._build(sizes, n, seed)
         csr.data[knock % csr.nnz] = 0.5
         assert infer_field_sizes(csr) is None
+
+
+def test_fields_lanes_matches_scalar_and_scopes_to_matvec():
+    """The composed fields x lanes margin lowering (pair tables halve the
+    lookup count, lane replication vectorizes each lookup's addressing —
+    the two independently-measured v5e wins, tools/profile_sparse.py) must
+    agree with the scalar fields path to f32 tolerance, and — like the
+    PaddedRows lanes — rewrite only the matvec direction: the scatter
+    jaxpr must be identical with the knob on."""
+    import jax
+
+    sizes = (7, 3, 5, 1, 8, 2, 11)  # odd count: pairs + a single
+    n = 52
+    csr = _onehot_csr(n, sizes, seed=9)
+    fo = FieldOnehot.from_scipy(csr)
+    rng = np.random.default_rng(10)
+    v = jnp.asarray(rng.standard_normal(csr.shape[1]).astype(np.float32))
+    r = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    base_mv = np.asarray(matvec(fo, v))
+    base_rmv = np.asarray(rmatvec(fo, r))
+    mv_scalar = str(jax.make_jaxpr(lambda u: matvec(fo, u))(v))
+    rmv_scalar = str(jax.make_jaxpr(lambda u: rmatvec(fo, u))(r))
+    try:
+        for L in (1, 8, 128):
+            features.set_sparse_lanes(L)
+            np.testing.assert_allclose(
+                np.asarray(matvec(fo, v)), base_mv, rtol=1e-5, atol=1e-5
+            )
+            np.testing.assert_allclose(
+                np.asarray(rmatvec(fo, r)), base_rmv, rtol=1e-5, atol=1e-5
+            )
+        features.set_sparse_lanes(8)
+        mv_lanes = str(jax.make_jaxpr(lambda u: matvec(fo, u))(v))
+        rmv_lanes = str(jax.make_jaxpr(lambda u: rmatvec(fo, u))(r))
+        assert mv_lanes != mv_scalar  # margin takes the lane tables
+        assert rmv_lanes == rmv_scalar  # scatter ignores the knob
+        # matrix RHS (MLP first layer) keeps the per-field row-gather path
+        V = jnp.asarray(rng.standard_normal((csr.shape[1], 4)).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(matvec(fo, V)),
+            csr.toarray() @ np.asarray(V),
+            rtol=1e-4, atol=1e-4,
+        )
+    finally:
+        features.set_sparse_lanes(None)
+
+
+def test_runconfig_accepts_fields_with_lanes():
+    """fields + sparse_lanes is the composed lowering, not an error; auto +
+    lanes still pins padded (historical measurement attribution)."""
+    from erasurehead_tpu.utils.config import RunConfig
+
+    cfg = RunConfig(
+        scheme="approx", n_workers=6, n_stragglers=1, num_collect=4,
+        n_rows=60, n_cols=30, sparse_format="fields", sparse_lanes=8,
+    )
+    assert cfg.sparse_format == "fields" and cfg.sparse_lanes == 8
+    cfg2 = RunConfig(
+        scheme="approx", n_workers=6, n_stragglers=1, num_collect=4,
+        n_rows=60, n_cols=30, sparse_format="auto", sparse_lanes=8,
+    )
+    assert cfg2.sparse_format == "padded"
+
+
+def test_lane_aware_pairing_plan_respects_byte_budget():
+    """fields_margin_plan shrinks the pair cap by lane width: a pair whose
+    [entries, L] replicated table would exceed LANE_TABLE_BYTES_CAP falls
+    back to singles, so wide lanes cannot blow the memory budget."""
+    from erasurehead_tpu.ops.features import (
+        LANE_TABLE_BYTES_CAP, fields_margin_plan,
+    )
+
+    sizes = (1292, 1292)  # covtype-like: 1.67M-entry pair table
+    assert fields_margin_plan(sizes, None) == (("pair", 0, 1),)
+    assert fields_margin_plan(sizes, 8) == (("pair", 0, 1),)  # 53 MB: fits
+    # 1.67M x 1024 x 4B ~= 6.8 GB: must fall back to singles
+    assert fields_margin_plan(sizes, 1024) == (("single", 0), ("single", 1))
+    for L in (1, 8, 128, 1024):
+        for e in fields_margin_plan(sizes, L):
+            if e[0] == "pair":
+                table = sizes[e[1]] * sizes[e[2]]
+                assert table * L * 4 <= LANE_TABLE_BYTES_CAP
+
+
+def test_autodiff_through_lane_path_matches_closed_form():
+    """jax.grad through the lane matvec must equal the hand-written
+    gradient: the custom_vjp pins the backward pass to the scalar-scatter
+    rmatvec (the lane gather's automatic transpose would be a lane-wide
+    table scatter — the op the v5e profile measured as a net loss and the
+    PAIR_TABLE_CAP budget excludes)."""
+    import jax
+
+    from erasurehead_tpu.models.glm import LogisticModel
+
+    sizes = (7, 3, 5, 4, 9)
+    n = 44
+    csr = _onehot_csr(n, sizes, seed=21)
+    fo = FieldOnehot.from_scipy(csr)
+    rng = np.random.default_rng(22)
+    beta = jnp.asarray(rng.standard_normal(csr.shape[1]).astype(np.float32))
+    y = jnp.asarray(np.sign(rng.standard_normal(n)).astype(np.float32))
+    m = LogisticModel()
+    closed = np.asarray(m.grad_sum(beta, fo, y))
+    try:
+        features.set_sparse_lanes(8)
+        auto = np.asarray(m.grad_sum_auto(beta, fo, y))
+        # and the backward jaxpr contains no lane-wide scatter: its only
+        # scatter shapes match the scalar path's
+        jaxpr_lanes = str(
+            jax.make_jaxpr(lambda b: m.grad_sum_auto(b, fo, y))(beta)
+        )
+    finally:
+        features.set_sparse_lanes(None)
+    np.testing.assert_allclose(auto, closed, rtol=1e-4, atol=1e-4)
+    # structural pin: the backward contains no lane-wide scatter — every
+    # scatter in the traced program produces a scalar-path shape (the
+    # forward's [entries, 8] arrays come from the barrier table, which is
+    # gather-only)
+    for line in jaxpr_lanes.splitlines():
+        if "scatter" in line:
+            assert ",8]" not in line.replace(" ", ""), line

@@ -47,7 +47,8 @@ def main() -> None:
     )
     ap.add_argument(
         "--lanes", type=int, default=None,
-        help="PaddedRows gather/scatter lane width (power of two)",
+        help="sparse margin-gather lane width (power of two); applies to "
+             "PaddedRows value gathers and FieldOnehot pair-table gathers",
     )
     ap.add_argument(
         "--format", dest="sparse_format", default="padded",
@@ -156,12 +157,44 @@ def main() -> None:
     if args.sparse_format == "fields":
         # FieldOnehot stores only the [rows, K] int32 locals (no value
         # payload); pair tables are rebuilt per step but are tiny vs the
-        # row traffic and are excluded from this stack-traffic model
+        # row traffic and are excluded from this stack-traffic model.
         stack_bytes = n_stacks * slot_rows * args.nnz * 4
+        bytes_per_step = 2 * stack_bytes  # margin gather + scatter passes
     else:
-        payload = 4 * (args.lanes or 1)
-        stack_bytes = n_stacks * slot_rows * args.nnz * (4 + payload)
-    bytes_per_step = 2 * stack_bytes
+        # Two passes with asymmetric payloads: lanes apply to the margin
+        # gather only (the scatter stays scalar — rmatvec ignores the
+        # knob, ops/features.py), so the margin pass moves 4-byte index +
+        # 4L-byte lane row per nnz while the scatter pass moves 4 + 4.
+        margin_payload = 4 * (args.lanes or 1)
+        bytes_per_step = n_stacks * slot_rows * args.nnz * (
+            (4 + margin_payload) + (4 + 4)
+        )
+    if args.sparse_format == "fields" and args.lanes:
+        # Lane terms, margin pass only (the scatter stays scalar): one
+        # L-lane table read per plan entry per row, plus the per-step
+        # [entries, L] replicated-table build (written once behind the
+        # barrier; beta changes every step so it cannot be hoisted — at
+        # lane widths this is no longer "tiny vs the row traffic"). The
+        # plan is lane-aware — fields whose replicated pair table would
+        # blow the lane budget fall back to singles (e.g. every amazon
+        # field) — so both terms come from the actual plan, not an
+        # all-pairs assumption.
+        from erasurehead_tpu.ops.features import (
+            fields_margin_plan, infer_field_sizes,
+        )
+
+        sizes = infer_field_sizes(data.X_train)
+        if sizes is None:  # unreachable: fields mode validated the data
+            sizes = (args.cols // args.nnz,) * args.nnz
+        plan = fields_margin_plan(sizes, args.lanes)
+        table_entries = sum(
+            sizes[e[1]] * sizes[e[2]] if e[0] == "pair" else sizes[e[1]]
+            for e in plan
+        )
+        bytes_per_step += (
+            n_stacks * slot_rows * len(plan) * 4 * args.lanes
+            + table_entries * 4 * args.lanes
+        )
     achieved_gbps = bytes_per_step * steps_per_sec / 1e9
 
     print(
