@@ -143,11 +143,15 @@ def make_deduped_grad_fn(model, mesh: Mesh) -> GradFn:
 
 
 # Whether flat_grad="auto" resolves to the flat lowering for DENSE and
-# PaddedRows stacks. False until their end-to-end TPU races land (the
-# margin-pass profile alone showed margin_matmul2d 1.587 ms vs the batched
-# per-slot contraction's 1.843 ms, tools/profile_dense.py, v5e round 3);
-# flipped by that measurement, pinned by tests either way. FieldOnehot is
-# decided separately (see resolve_flat_grad).
+# PaddedRows stacks. DECIDED False by the end-to-end race (v5e, round 3,
+# tools/measurements.jsonl dense_f32_flat): the flat dense step measured
+# 229 steps/s vs the per-slot step's 462-530 — despite the margin-pass
+# profile favoring the flat 2-D matmul in isolation (margin_matmul2d
+# 1.587 ms vs 1.843, tools/profile_dense.py), flattening the whole
+# gradient loses the batched per-slot tiling of the transpose pass and
+# doubles the step time. Per-slot stays the dense default; the flat form
+# remains forceable (flat_grad="on") and is the FieldOnehot default,
+# where it is the measured 10x fix (see resolve_flat_grad).
 FLAT_GRAD_DEFAULT = False
 
 
@@ -175,8 +179,9 @@ def resolve_flat_grad(flat_grad: str, model, X) -> bool:
         covtype — ~10x under what its own one-accumulator profile
         candidates predict, tools/measurements.jsonl round 3); the flat
         lowering IS the one-accumulator form.
-      - dense / PaddedRows: per-slot until FLAT_GRAD_DEFAULT is flipped
-        by their queued end-to-end races (tpu_measurements_flat.sh).
+      - dense / PaddedRows: PER-SLOT. The dense end-to-end race measured
+        the flat step at half the per-slot rate (229 vs 462-530 steps/s,
+        dense_f32_flat, v5e round 3) — see FLAT_GRAD_DEFAULT.
     """
     if not supports_flat_grad(model, X):
         return False

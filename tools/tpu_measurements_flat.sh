@@ -43,6 +43,14 @@ run sparse_covtype_faithful_fields_flat 1200 python tools/bench_sparse.py \
     --shape covtype --format fields --flat on
 run sparse_amazon_faithful_fields_flat  1200 python tools/bench_sparse.py \
     --shape amazon --format fields --flat on
+# composed lowering (landed mid-round-3): lane-replicated pair-table
+# margin gathers — the two measured wins stacked. Candidate to push
+# faithful covtype past 3x the reference rate (fields_flat measured
+# 2.994x; profiled margin drop 54.5 -> ~21 ms predicts ~3.5x).
+run sparse_covtype_faithful_fields_lanes8_flat 1200 python tools/bench_sparse.py \
+    --shape covtype --format fields --lanes 8 --flat on
+run sparse_amazon_faithful_fields_lanes8_flat  1200 python tools/bench_sparse.py \
+    --shape amazon --format fields --lanes 8 --flat on
 run dense_f32_flat       1800 env BENCH_FLAT=on python bench.py
 run dense_profile_flat   1200 python tools/profile_dense.py \
     --only flatstack_full,flatstack_bf16
@@ -56,6 +64,10 @@ run sparse_amazon_faithful_flat         1200 python tools/bench_sparse.py \
     --shape amazon --flat on
 run sparse_amazon_deduped_fields_flat   1200 python tools/bench_sparse.py \
     --shape amazon --mode deduped --format fields --flat on
+run sparse_covtype_deduped_fields_lanes8_flat 1200 python tools/bench_sparse.py \
+    --shape covtype --mode deduped --format fields --lanes 8 --flat on
+run sparse_amazon_deduped_fields_lanes8_flat  1200 python tools/bench_sparse.py \
+    --shape amazon --mode deduped --format fields --lanes 8 --flat on
 run dense_bf16_flat      1800 env BENCH_FLAT=on BENCH_DTYPE=bfloat16 python bench.py
 run dense_f32_deduped_flat 1800 env BENCH_FLAT=on BENCH_MODE=deduped python bench.py
 
