@@ -149,6 +149,11 @@ def _flags_parser() -> argparse.ArgumentParser:
                    choices=["padded", "fields", "auto"],
                    help="sparse stack representation: fields = FieldOnehot "
                         "fused pair-table lowering (one-hot data only)")
+    p.add_argument("--fields-scatter", default="pairs",
+                   choices=["pairs", "onehot"],
+                   help="FieldOnehot gradient-scatter lowering: onehot = "
+                        "per-field one-hot MXU matmuls instead of "
+                        "pair-accumulator scatter-adds")
     p.add_argument("--dense-margin-cols", type=int, default=None,
                    help="dense margin matvec lowering width [2,128]: "
                         "replicate beta behind a barrier so the margin "
@@ -242,6 +247,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         dense_margin_cols=ns.dense_margin_cols,
         flat_grad=ns.flat_grad,
         sparse_format=ns.sparse_format,
+        fields_scatter=ns.fields_scatter,
         seq_shards=ns.seq_shards,
         sp_form=ns.sp_form,
         tp_shards=ns.tp_shards,

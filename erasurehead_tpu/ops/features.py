@@ -364,6 +364,41 @@ def get_sparse_lanes() -> Optional[int]:
     return _SPARSE_LANES
 
 
+# FieldOnehot gradient-scatter lowering:
+#   "pairs"  — scatter-add into fused pair accumulators, then marginalize
+#              (halves the serialized lookup count vs per-field; measured
+#              58.0 vs 102.0 ms at the covtype stack, v5e round 3);
+#   "onehot" — segment-sum as one-hot MATMUL: per field, g[b] =
+#              sum_n [local_n == b] * r_n is a [C] x [C, B] product over
+#              row chunks — the compare builds an exact 0/1 one-hot, the
+#              MXU does the reduction, and a chunk scan bounds the live
+#              one-hot. Attacks the scatter-add's read-modify-write
+#              serialization (~7 ns/element) structurally; exact (f32
+#              one-hot, HIGHEST precision, f32 accumulation) up to sum
+#              reassociation.
+_FIELDS_SCATTER = "pairs"
+
+# one-hot chunk byte budget: the chunk row count C is sized so one
+# [C, B_max] f32 chunk stays within this, rounded down to a multiple of
+# 512 for tile alignment (covtype B~1292 -> C=6144; amazon B~5.5k ->
+# C=1024; floor 512)
+_ONEHOT_CHUNK_BYTES = 1 << 25  # 32 MB
+
+
+def set_fields_scatter(mode: str) -> None:
+    """Select the FieldOnehot rmatvec lowering ("pairs" / "onehot")."""
+    global _FIELDS_SCATTER
+    if mode not in ("pairs", "onehot"):
+        raise ValueError(
+            f"fields scatter mode must be pairs/onehot, got {mode!r}"
+        )
+    _FIELDS_SCATTER = mode
+
+
+def get_fields_scatter() -> str:
+    return _FIELDS_SCATTER
+
+
 def _plan_tables(plan, sizes, local, v):
     """Yield one (table, code) per plan entry: the fused sum table over a
     pair's (or single's) categories and each row's index into it. The single
@@ -421,9 +456,11 @@ def _lanes_fields_matvec(sizes, n_cols, L, local, v):
     lane-wide scatter into the [entries, L] table — exactly the op the v5e
     profile measured as a net loss, and far outside the 8 MB/table scatter
     budget PAIR_TABLE_CAP enforces. The op is linear in v with transpose
-    X^T r, so the backward pass is pinned to the scalar-scatter rmatvec:
-    autodiff through the lane path costs the same as through the scalar
-    path, and every differentiation path stays inside the scatter budget.
+    X^T r, so the backward pass is pinned to _fields_rmatvec — the
+    pair-accumulator scalar scatter, or the one-hot matmul when
+    set_fields_scatter("onehot") is active: autodiff through the lane
+    path costs the same as through the scalar path, and never emits a
+    lane-wide table scatter.
     """
     acc = 0.0
     for table, code in _plan_tables(
@@ -448,10 +485,57 @@ def _lanes_fields_matvec_bwd(sizes, n_cols, L, local, g):
 _lanes_fields_matvec.defvjp(_lanes_fields_matvec_fwd, _lanes_fields_matvec_bwd)
 
 
-def _fields_rmatvec(X: "FieldOnehot", r: jnp.ndarray) -> jnp.ndarray:
-    """X.T @ r: scatter into per-pair accumulators, then marginalize."""
+def _onehot_fields_rmatvec(X: "FieldOnehot", r: jnp.ndarray) -> jnp.ndarray:
+    """X.T @ r via per-field one-hot matmuls (see set_fields_scatter).
+
+    Exact 0/1 one-hots from an integer compare; f32 HIGHEST-precision
+    matmul so the reduction is true f32 accumulation (the one-hot factor
+    is exact in any dtype; only the reduction order differs from the
+    scatter path). Rows are chunk-scanned so the live one-hot stays within
+    _ONEHOT_CHUNK_BYTES; padded rows carry r=0 and land on code 0 with
+    zero weight.
+    """
     offs = X.offsets
     sizes = X.field_sizes
+    n = X.local.shape[0]
+    C = max(512, _ONEHOT_CHUNK_BYTES // (4 * max(sizes)) // 512 * 512)
+    n_chunks = -(-n // C)
+    Np = n_chunks * C
+    lf = jnp.pad(X.local, ((0, Np - n), (0, 0))).reshape(n_chunks, C, -1)
+    rc = jnp.pad(r, (0, Np - n)).reshape(n_chunks, C)
+
+    def chunk(xs):
+        l, rv = xs  # [C, K], [C]
+        outs = []
+        for k, B in enumerate(sizes):
+            iota = jnp.arange(B, dtype=X.local.dtype)
+            oh = (l[:, k][:, None] == iota[None, :]).astype(r.dtype)
+            outs.append(
+                jnp.matmul(
+                    rv, oh,
+                    precision=lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32,
+                )
+            )
+        # lax.map (scan with an empty carry) rather than a scan carry:
+        # under shard_map a zeros-initialized carry is axis-unvarying
+        # while the body output varies, and the types must match
+        return tuple(outs)
+
+    g = lax.map(chunk, (lf, rc))  # tuple of [n_chunks, B_k]
+    out = jnp.zeros(X.n_cols, r.dtype)
+    for k in range(len(sizes)):
+        out = out.at[offs[k] : offs[k + 1]].add(g[k].sum(axis=0).astype(r.dtype))
+    return out
+
+
+def _fields_rmatvec(X: "FieldOnehot", r: jnp.ndarray) -> jnp.ndarray:
+    """X.T @ r: scatter into per-pair accumulators, then marginalize —
+    or per-field one-hot matmuls when set_fields_scatter("onehot")."""
+    offs = X.offsets
+    sizes = X.field_sizes
+    if r.ndim == 1 and _FIELDS_SCATTER == "onehot":
+        return _onehot_fields_rmatvec(X, r)
     if r.ndim > 1:
         out = jnp.zeros((X.n_cols, r.shape[1]), r.dtype)
         for k in range(len(sizes)):

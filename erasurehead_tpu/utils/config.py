@@ -192,6 +192,11 @@ class RunConfig:
     #              exactly-one-hot-per-field data; errors otherwise);
     #   "auto"   — FieldOnehot when the data's structure allows, else padded.
     sparse_format: str = "padded"
+    # FieldOnehot gradient-scatter lowering (ops/features.set_fields_scatter):
+    #   "pairs"  — scatter-add into fused pair accumulators (default);
+    #   "onehot" — segment-sum as per-field one-hot MXU matmuls, the
+    #              candidate attacking the serialized scatter-add bound.
+    fields_scatter: str = "pairs"
 
     @classmethod
     def for_dataset(cls, dataset: str, **overrides) -> "RunConfig":
@@ -303,6 +308,11 @@ class RunConfig:
             raise ValueError(
                 f"sparse_format must be padded/fields/auto, got "
                 f"{self.sparse_format!r}"
+            )
+        if self.fields_scatter not in ("pairs", "onehot"):
+            raise ValueError(
+                f"fields_scatter must be pairs/onehot, got "
+                f"{self.fields_scatter!r}"
             )
         if self.sparse_format == "auto" and self.sparse_lanes is not None:
             # an explicit lane request pins the PaddedRows lowering so the

@@ -542,3 +542,67 @@ def test_autodiff_through_lane_path_matches_closed_form():
     for line in jaxpr_lanes.splitlines():
         if "scatter" in line:
             assert ",8]" not in line.replace(" ", ""), line
+
+
+def test_onehot_scatter_matches_pairs_and_dense():
+    """set_fields_scatter("onehot") — segment-sum as per-field one-hot MXU
+    matmuls — must agree with the pairs scatter and the dense transpose to
+    f32 reduction tolerance, cover the chunk-padding edge (n not a
+    multiple of the chunk), and leave matrix operands and the margin
+    untouched."""
+    sizes = (7, 3, 5, 1, 8, 2, 11)
+    n = 531  # prime-ish: exercises chunk padding
+    csr = _onehot_csr(n, sizes, seed=31)
+    fo = FieldOnehot.from_scipy(csr)
+    dense = jnp.asarray(csr.toarray())
+    rng = np.random.default_rng(32)
+    r = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal(csr.shape[1]).astype(np.float32))
+    base = np.asarray(rmatvec(fo, r))
+    try:
+        features.set_fields_scatter("onehot")
+        oh = np.asarray(rmatvec(fo, r))
+        mv = np.asarray(matvec(fo, v))
+        R2 = jnp.asarray(rng.standard_normal((n, 3)).astype(np.float32))
+        mat = np.asarray(rmatvec(fo, R2))
+    finally:
+        features.set_fields_scatter("pairs")
+    np.testing.assert_allclose(oh, base, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        oh, np.asarray(rmatvec(dense, r)), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        mv, np.asarray(matvec(dense, v)), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        mat, np.asarray(rmatvec(dense, R2)), rtol=1e-4, atol=1e-4
+    )
+    with pytest.raises(ValueError):
+        features.set_fields_scatter("bogus")
+
+
+def test_onehot_scatter_trainer_trajectory_matches_pairs():
+    """End-to-end: the onehot-scatter run's trajectory must match the
+    pairs-scatter run at the canonical W=30 AGC config (flat lowering,
+    the production fields path)."""
+    from erasurehead_tpu.data.synthetic import generate_onehot
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    W = 30
+    data = generate_onehot(2640, 166, n_partitions=W, n_fields=6, seed=3)
+
+    def run(mode):
+        cfg = RunConfig(
+            scheme="approx", n_workers=W, n_stragglers=2, num_collect=15,
+            rounds=8, n_rows=2640, n_cols=166, update_rule="AGD",
+            dataset="covtype", add_delay=True, sparse_format="fields",
+            fields_scatter=mode, flat_grad="on", seed=0,
+        )
+        return trainer.train(cfg, data)
+
+    a = run("pairs")
+    b = run("onehot")
+    pa = np.asarray(a.final_params)
+    pb = np.asarray(b.final_params)
+    np.testing.assert_allclose(pb, pa, rtol=1e-4, atol=1e-5)

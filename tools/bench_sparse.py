@@ -62,6 +62,11 @@ def main() -> None:
         help="flat-stack closed-form lowering (step.make_flat_grad_fn): "
              "one scatter accumulator instead of a vmapped per-slot batch",
     )
+    ap.add_argument(
+        "--fields-scatter", default="pairs", choices=["pairs", "onehot"],
+        help="FieldOnehot gradient-scatter lowering: onehot = per-field "
+             "one-hot MXU matmuls instead of pair-accumulator scatter-adds",
+    )
     args = ap.parse_args()
     presets = {
         "covtype": (396112 // W * W, 15509, 12),
@@ -137,6 +142,7 @@ def main() -> None:
         sparse_lanes=args.lanes,
         sparse_format=args.sparse_format,
         flat_grad=args.flat_grad,
+        fields_scatter=args.fields_scatter,
         seed=0,
     )
     t0 = time.perf_counter()
@@ -218,6 +224,7 @@ def main() -> None:
                 "lanes": args.lanes,
                 "format": args.sparse_format,
                 "flat": args.flat_grad,
+                "fields_scatter": args.fields_scatter,
                 "n_rows": args.rows,
                 "n_cols": args.cols,
                 "nnz_per_row": args.nnz,

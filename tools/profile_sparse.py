@@ -389,4 +389,94 @@ if K % 2 == 0 and B >= 2:
             f"{results['flatpairs_scatter_ms']}ms", file=sys.stderr,
         )
 
+    # --- composed flat x lanes margin (the production fields+lanes
+    # lowering, ops/features._lanes_fields_matvec): lane-replicated pair
+    # tables behind a barrier, flat [M*R] rows. Predicts the
+    # *_fields_lanes8_flat bench entries. ---------------------------------
+    def flatlanes_margin_fn(L):
+        def f(beta, pidx, ys):
+            blocks = beta[: K * B].reshape(K, B)
+            pf = pidx.reshape(M * R, K // 2)
+            acc = jnp.zeros((M * R, L), jnp.float32)
+            for pr in range(K // 2):
+                table = (
+                    blocks[2 * pr][:, None] + blocks[2 * pr + 1][None, :]
+                ).reshape(B * B)
+                wide = jax.lax.optimization_barrier(
+                    jnp.broadcast_to(table[:, None], (B * B, L))
+                )
+                acc = acc + jnp.take(wide, pf[:, pr], axis=0)
+            p = acc.sum(axis=1) * (1.0 / L)
+            return beta * 0.999 + jnp.sum(p) / F
+        return f
+
+    for L in (8,):
+        if want(f"flatlanes_margin{L}"):
+            results[f"flatlanes_margin{L}_ms"] = round(
+                time_scanned(
+                    flatlanes_margin_fn(L), (pair_idx_j, y_j)
+                ) * 1e3, 3,
+            )
+            print(
+                f"profile: flatlanes_margin{L} "
+                f"{results[f'flatlanes_margin{L}_ms']}ms", file=sys.stderr,
+            )
+
+    # --- scatter as one-hot MATMUL (segment-sum on the MXU): the scalar
+    # scatter-add serializes ~7ns per read-modify-write; instead, per
+    # field, g_k[b] = sum_n [local_n == b] * s_n is a [C]x[C,B] matmul
+    # over row chunks — the compare+select builds an exact 0/1 one-hot
+    # (any dtype), the MXU does the reduction, and the chunk scan keeps
+    # the live one-hot at [C, B]. Two dtype variants: f32/HIGHEST (exact
+    # accumulation) and bf16 operands (s rounded to bf16 — the speed
+    # ceiling; one-hot entries are exact either way). ---------------------
+    loc_j = jnp.asarray(loc.astype(np.int32))
+
+    def scatter_onehot_fn(C, dtype, precision):
+        MR = M * R
+        Np = -(-MR // C) * C
+
+        def f(beta, locs, ys):
+            lf = jnp.pad(
+                locs.reshape(MR, K), ((0, Np - MR), (0, 0))
+            ).reshape(Np // C, C, K)
+            # padded rows carry s=0: they hit code 0 with zero weight
+            sc = jnp.pad(ys.reshape(MR), (0, Np - MR)).reshape(Np // C, C)
+            iota = jnp.arange(B, dtype=jnp.int32)
+
+            def chunk(g, xs):
+                l, sv = xs  # [C, K], [C]
+                svd = sv.astype(dtype)
+                outs = []
+                for k in range(K):
+                    oh = (l[:, k][:, None] == iota[None, :]).astype(dtype)
+                    outs.append(
+                        jnp.matmul(
+                            svd, oh,
+                            precision=precision,
+                            preferred_element_type=jnp.float32,
+                        )
+                    )
+                return g + jnp.stack(outs), None
+
+            g0 = jnp.zeros((K, B), jnp.float32)
+            g, _ = jax.lax.scan(chunk, g0, (lf, sc))
+            return dep(beta, jnp.pad(g.reshape(-1), (0, F - K * B)))
+
+        return f
+
+    for nm, dt, prec in (
+        ("scatter_onehot_f32", jnp.float32, jax.lax.Precision.HIGHEST),
+        ("scatter_onehot_bf16", jnp.bfloat16, None),
+    ):
+        if want(nm):
+            results[f"{nm}_ms"] = round(
+                time_scanned(
+                    scatter_onehot_fn(4096, dt, prec), (loc_j, y_j)
+                ) * 1e3, 3,
+            )
+            print(
+                f"profile: {nm} {results[f'{nm}_ms']}ms", file=sys.stderr
+            )
+
 print(json.dumps(results))

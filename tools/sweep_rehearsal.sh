@@ -68,6 +68,17 @@ run sparse_amazon_faithful_fields_flat 600 python tools/bench_sparse.py \
 run sparse_profile_flatpairs 600 python tools/profile_sparse.py \
     --slots 4 --rows 256 --nnz 4 --cols 512 \
     --only flatpairs_margin,flatpairs_scatter
+run sparse_profile_flatlanes 600 python tools/profile_sparse.py \
+    --slots 4 --rows 256 --nnz 4 --cols 512 \
+    --only flatlanes_margin8,scatter_onehot
+run sparse_covtype_faithful_fields_lanes8_flat 600 python tools/bench_sparse.py \
+    --shape covtype --format fields --lanes 8 --flat on --light
+run sparse_amazon_faithful_fields_lanes8_flat 600 python tools/bench_sparse.py \
+    --shape amazon --format fields --lanes 8 --flat on --light
+run sparse_covtype_faithful_fields_lanes8_onehot_flat 600 python tools/bench_sparse.py \
+    --shape covtype --format fields --lanes 8 --fields-scatter onehot --flat on --light
+run sparse_amazon_faithful_fields_lanes8_onehot_flat 600 python tools/bench_sparse.py \
+    --shape amazon --format fields --lanes 8 --fields-scatter onehot --flat on --light
 
 n_ok=$(wc -l < "$OUT")
 echo "rehearsal: $n_ok entries captured in $OUT" >&2

@@ -51,11 +51,23 @@ run sparse_covtype_faithful_fields_lanes8_flat 1200 python tools/bench_sparse.py
     --shape covtype --format fields --lanes 8 --flat on
 run sparse_amazon_faithful_fields_lanes8_flat  1200 python tools/bench_sparse.py \
     --shape amazon --format fields --lanes 8 --flat on
+# one-hot MXU scatter stacked on the lane margin: the first candidate
+# that attacks the serialized scatter-add bound structurally (per-field
+# segment-sum as compare + matmul, ops/features._onehot_fields_rmatvec)
+run sparse_covtype_faithful_fields_lanes8_onehot_flat 1200 python tools/bench_sparse.py \
+    --shape covtype --format fields --lanes 8 --fields-scatter onehot --flat on
+run sparse_amazon_faithful_fields_lanes8_onehot_flat  1200 python tools/bench_sparse.py \
+    --shape amazon --format fields --lanes 8 --fields-scatter onehot --flat on
 run dense_f32_flat       1800 env BENCH_FLAT=on python bench.py
 run dense_profile_flat   1200 python tools/profile_dense.py \
     --only flatstack_full,flatstack_bf16
 run sparse_profile_flatpairs 1200 python tools/profile_sparse.py \
     --only flatpairs_margin,flatpairs_scatter
+# composed flat x lanes margin at production shapes, plus the one-hot
+# MXU scatter (segment-sum as compare + matmul — the first candidate
+# that attacks the serialized scatter-add bound structurally)
+run sparse_profile_flatlanes 1200 python tools/profile_sparse.py \
+    --only flatlanes_margin8,scatter_onehot
 run sparse_covtype_faithful_flat        1200 python tools/bench_sparse.py \
     --shape covtype --flat on
 run sparse_covtype_deduped_fields_flat  1200 python tools/bench_sparse.py \
