@@ -479,4 +479,50 @@ if K % 2 == 0 and B >= 2:
                 f"profile: {nm} {results[f'{nm}_ms']}ms", file=sys.stderr
             )
 
+    # --- margin as one-hot MATMUL: the mirror trick — per field,
+    # p_n += sum_b [local_n == b] * beta_k[b] is onehot [C, B] @ beta_k,
+    # the same compare cost as the one-hot scatter with the MXU replacing
+    # every gather. If both directions go MXU the sparse step does no
+    # serialized lookups at all. ------------------------------------------
+    def margin_onehot_fn(C, dtype, prec):
+        MR = M * R
+        Np = -(-MR // C) * C
+
+        def f(beta, locs, ys):
+            blocks = beta[: K * B].reshape(K, B)
+            lf = jnp.pad(
+                locs.reshape(MR, K), ((0, Np - MR), (0, 0))
+            ).reshape(Np // C, C, K)
+
+            def chunk(l):
+                p = jnp.zeros(C, jnp.float32)
+                for k in range(K):
+                    iota = jnp.arange(B, dtype=jnp.int32)
+                    oh = (l[:, k][:, None] == iota[None, :]).astype(dtype)
+                    p = p + jnp.matmul(
+                        oh, blocks[k].astype(dtype),
+                        precision=prec,
+                        preferred_element_type=jnp.float32,
+                    )
+                return p
+
+            p = jax.lax.map(chunk, lf)  # [Np//C, C]
+            return beta * 0.999 + jnp.sum(p) / F
+
+        return f
+
+    for nm, dt, prec in (
+        ("margin_onehot_f32", jnp.float32, jax.lax.Precision.HIGHEST),
+        ("margin_onehot_bf16", jnp.bfloat16, None),
+    ):
+        if want(nm):
+            results[f"{nm}_ms"] = round(
+                time_scanned(
+                    margin_onehot_fn(4096, dt, prec), (loc_j, y_j)
+                ) * 1e3, 3,
+            )
+            print(
+                f"profile: {nm} {results[f'{nm}_ms']}ms", file=sys.stderr
+            )
+
 print(json.dumps(results))

@@ -71,6 +71,9 @@ run sparse_profile_flatpairs 600 python tools/profile_sparse.py \
 run sparse_profile_flatlanes 600 python tools/profile_sparse.py \
     --slots 4 --rows 256 --nnz 4 --cols 512 \
     --only flatlanes_margin8,scatter_onehot
+run sparse_profile_marginonehot 600 python tools/profile_sparse.py \
+    --slots 4 --rows 256 --nnz 4 --cols 512 \
+    --only margin_onehot
 run sparse_covtype_faithful_fields_lanes8_flat 600 python tools/bench_sparse.py \
     --shape covtype --format fields --lanes 8 --flat on --light
 run sparse_amazon_faithful_fields_lanes8_flat 600 python tools/bench_sparse.py \

@@ -68,6 +68,8 @@ run sparse_profile_flatpairs 1200 python tools/profile_sparse.py \
 # that attacks the serialized scatter-add bound structurally)
 run sparse_profile_flatlanes 1200 python tools/profile_sparse.py \
     --only flatlanes_margin8,scatter_onehot
+run sparse_profile_marginonehot 1200 python tools/profile_sparse.py \
+    --only margin_onehot
 run sparse_covtype_faithful_flat        1200 python tools/bench_sparse.py \
     --shape covtype --flat on
 run sparse_covtype_deduped_fields_flat  1200 python tools/bench_sparse.py \
