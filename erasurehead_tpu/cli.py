@@ -154,6 +154,10 @@ def _flags_parser() -> argparse.ArgumentParser:
                    help="FieldOnehot gradient-scatter lowering: onehot = "
                         "per-field one-hot MXU matmuls instead of "
                         "pair-accumulator scatter-adds")
+    p.add_argument("--fields-margin", default="tables",
+                   choices=["tables", "onehot"],
+                   help="FieldOnehot margin lowering: onehot = per-field "
+                        "one-hot MXU matmuls instead of pair-table gathers")
     p.add_argument("--dense-margin-cols", type=int, default=None,
                    help="dense margin matvec lowering width [2,128]: "
                         "replicate beta behind a barrier so the margin "
@@ -248,6 +252,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         flat_grad=ns.flat_grad,
         sparse_format=ns.sparse_format,
         fields_scatter=ns.fields_scatter,
+        fields_margin=ns.fields_margin,
         seq_shards=ns.seq_shards,
         sp_form=ns.sp_form,
         tp_shards=ns.tp_shards,

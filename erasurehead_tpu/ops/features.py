@@ -399,6 +399,30 @@ def get_fields_scatter() -> str:
     return _FIELDS_SCATTER
 
 
+# FieldOnehot margin (matvec) lowering:
+#   "tables" — fused pair-table gathers (default; composes with
+#              set_sparse_lanes lane replication);
+#   "onehot" — the mirror of the one-hot scatter: per field,
+#              p += onehot [C, B] @ beta_k on the MXU — same compare
+#              cost, zero serialized gathers. sparse_lanes is ignored in
+#              this mode (there is no gather to widen).
+_FIELDS_MARGIN = "tables"
+
+
+def set_fields_margin(mode: str) -> None:
+    """Select the FieldOnehot matvec lowering ("tables" / "onehot")."""
+    global _FIELDS_MARGIN
+    if mode not in ("tables", "onehot"):
+        raise ValueError(
+            f"fields margin mode must be tables/onehot, got {mode!r}"
+        )
+    _FIELDS_MARGIN = mode
+
+
+def get_fields_margin() -> str:
+    return _FIELDS_MARGIN
+
+
 def _plan_tables(plan, sizes, local, v):
     """Yield one (table, code) per plan entry: the fused sum table over a
     pair's (or single's) categories and each row's index into it. The single
@@ -433,6 +457,8 @@ def _fields_matvec(X: "FieldOnehot", v: jnp.ndarray) -> jnp.ndarray:
                 v[offs[k] : offs[k + 1]], X.local[:, k], axis=0
             )
         return out
+    if _FIELDS_MARGIN == "onehot":
+        return _onehot_fields_matvec(X, v)
     L = _SPARSE_LANES
     if L is not None:
         return _lanes_fields_matvec(sizes, X.n_cols, L, X.local, v)
@@ -440,6 +466,33 @@ def _fields_matvec(X: "FieldOnehot", v: jnp.ndarray) -> jnp.ndarray:
     for table, code in _plan_tables(_greedy_pairing(sizes), sizes, X.local, v):
         out = out + jnp.take(table, code, axis=0)
     return out
+
+
+def _onehot_fields_matvec(X: "FieldOnehot", v: jnp.ndarray) -> jnp.ndarray:
+    """X @ v via per-field one-hot matmuls (see set_fields_margin).
+
+    Per chunk, p += onehot [C, B_k] @ v_k for each field — the compare
+    builds an exact 0/1 one-hot and the MXU does the contraction; no
+    serialized gathers. Autodiff needs no custom rule: the matmul's own
+    transpose is onehot.T @ g, the one-hot scatter form, with the same
+    [C, B] chunk bound.
+    """
+    offs = X.offsets
+    sizes = X.field_sizes
+    lf, C, n = _onehot_chunks(X)
+
+    def chunk(l):
+        p = jnp.zeros(C, jnp.float32)
+        for k, B in enumerate(sizes):
+            oh = _field_onehot(l[:, k], B, v.dtype, X.local.dtype)
+            p = p + jnp.matmul(
+                oh, v[offs[k] : offs[k + 1]],
+                precision=lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32,
+            )
+        return p
+
+    return lax.map(chunk, lf).reshape(-1)[:n].astype(v.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
@@ -485,6 +538,24 @@ def _lanes_fields_matvec_bwd(sizes, n_cols, L, local, g):
 _lanes_fields_matvec.defvjp(_lanes_fields_matvec_fwd, _lanes_fields_matvec_bwd)
 
 
+def _onehot_chunks(X: "FieldOnehot"):
+    """Shared chunking scaffold for the one-hot matmul lowerings: rows
+    padded to a multiple of the chunk size C (sized so one [C, B_max] f32
+    one-hot stays within _ONEHOT_CHUNK_BYTES, 512-aligned) and reshaped to
+    [n_chunks, C, K]. Returns (chunked_local, C, n)."""
+    n = X.local.shape[0]
+    C = max(512, _ONEHOT_CHUNK_BYTES // (4 * max(X.field_sizes)) // 512 * 512)
+    Np = -(-n // C) * C
+    lf = jnp.pad(X.local, ((0, Np - n), (0, 0))).reshape(-1, C, X.local.shape[1])
+    return lf, C, n
+
+
+def _field_onehot(l_col, B, dtype, index_dtype):
+    """Exact 0/1 one-hot [C, B] from an integer compare."""
+    iota = jnp.arange(B, dtype=index_dtype)
+    return (l_col[:, None] == iota[None, :]).astype(dtype)
+
+
 def _onehot_fields_rmatvec(X: "FieldOnehot", r: jnp.ndarray) -> jnp.ndarray:
     """X.T @ r via per-field one-hot matmuls (see set_fields_scatter).
 
@@ -497,19 +568,14 @@ def _onehot_fields_rmatvec(X: "FieldOnehot", r: jnp.ndarray) -> jnp.ndarray:
     """
     offs = X.offsets
     sizes = X.field_sizes
-    n = X.local.shape[0]
-    C = max(512, _ONEHOT_CHUNK_BYTES // (4 * max(sizes)) // 512 * 512)
-    n_chunks = -(-n // C)
-    Np = n_chunks * C
-    lf = jnp.pad(X.local, ((0, Np - n), (0, 0))).reshape(n_chunks, C, -1)
-    rc = jnp.pad(r, (0, Np - n)).reshape(n_chunks, C)
+    lf, C, n = _onehot_chunks(X)
+    rc = jnp.pad(r, (0, lf.shape[0] * C - n)).reshape(-1, C)
 
     def chunk(xs):
         l, rv = xs  # [C, K], [C]
         outs = []
         for k, B in enumerate(sizes):
-            iota = jnp.arange(B, dtype=X.local.dtype)
-            oh = (l[:, k][:, None] == iota[None, :]).astype(r.dtype)
+            oh = _field_onehot(l[:, k], B, r.dtype, X.local.dtype)
             outs.append(
                 jnp.matmul(
                     rv, oh,

@@ -183,15 +183,18 @@ def _with_run_sparse_lanes(fn):
         prev = features_lib.get_sparse_lanes()
         prev_cols = features_lib.get_dense_margin_cols()
         prev_scatter = features_lib.get_fields_scatter()
+        prev_margin = features_lib.get_fields_margin()
         features_lib.set_sparse_lanes(cfg.sparse_lanes)
         features_lib.set_dense_margin_cols(cfg.dense_margin_cols)
         features_lib.set_fields_scatter(cfg.fields_scatter)
+        features_lib.set_fields_margin(cfg.fields_margin)
         try:
             return fn(cfg, dataset, *args, **kwargs)
         finally:
             features_lib.set_sparse_lanes(prev)
             features_lib.set_dense_margin_cols(prev_cols)
             features_lib.set_fields_scatter(prev_scatter)
+            features_lib.set_fields_margin(prev_margin)
 
     return wrapper
 

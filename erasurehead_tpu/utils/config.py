@@ -197,6 +197,12 @@ class RunConfig:
     #   "onehot" — segment-sum as per-field one-hot MXU matmuls, the
     #              candidate attacking the serialized scatter-add bound.
     fields_scatter: str = "pairs"
+    # FieldOnehot margin lowering (ops/features.set_fields_margin):
+    #   "tables" — fused pair-table gathers (default; composes with
+    #              sparse_lanes);
+    #   "onehot" — per-field one-hot MXU matmuls (no gathers at all;
+    #              sparse_lanes is ignored in this mode).
+    fields_margin: str = "tables"
 
     @classmethod
     def for_dataset(cls, dataset: str, **overrides) -> "RunConfig":
@@ -313,6 +319,23 @@ class RunConfig:
             raise ValueError(
                 f"fields_scatter must be pairs/onehot, got "
                 f"{self.fields_scatter!r}"
+            )
+        if self.fields_margin not in ("tables", "onehot"):
+            raise ValueError(
+                f"fields_margin must be tables/onehot, got "
+                f"{self.fields_margin!r}"
+            )
+        if (
+            self.sparse_format == "fields"
+            and self.fields_margin == "onehot"
+            and self.sparse_lanes is not None
+        ):
+            # the onehot margin has no gather to widen — accepting lanes
+            # here would silently ignore them and misattribute any
+            # lane-width measurement (same rule as auto-format pinning)
+            raise ValueError(
+                "sparse_lanes has no effect under fields_margin='onehot' "
+                "(no gathers to lane-replicate); drop one of the two"
             )
         if self.sparse_format == "auto" and self.sparse_lanes is not None:
             # an explicit lane request pins the PaddedRows lowering so the

@@ -606,3 +606,81 @@ def test_onehot_scatter_trainer_trajectory_matches_pairs():
     pa = np.asarray(a.final_params)
     pb = np.asarray(b.final_params)
     np.testing.assert_allclose(pb, pa, rtol=1e-4, atol=1e-5)
+
+
+def test_onehot_margin_matches_tables_and_dense():
+    """set_fields_margin("onehot") — per-field one-hot MXU matmuls — must
+    agree with the pair-table margin and the dense product, and autodiff
+    through it (whose transpose is the one-hot scatter form) must match
+    the closed-form gradient."""
+    import jax
+
+    from erasurehead_tpu.models.glm import LogisticModel
+
+    sizes = (7, 3, 5, 1, 8, 2, 11)
+    n = 531
+    csr = _onehot_csr(n, sizes, seed=41)
+    fo = FieldOnehot.from_scipy(csr)
+    dense = jnp.asarray(csr.toarray())
+    rng = np.random.default_rng(42)
+    v = jnp.asarray(rng.standard_normal(csr.shape[1]).astype(np.float32))
+    y = jnp.asarray(np.sign(rng.standard_normal(n)).astype(np.float32))
+    base = np.asarray(matvec(fo, v))
+    m = LogisticModel()
+    closed = np.asarray(m.grad_sum(v, fo, y))
+    try:
+        features.set_fields_margin("onehot")
+        oh = np.asarray(matvec(fo, v))
+        auto = np.asarray(m.grad_sum_auto(v, fo, y))
+    finally:
+        features.set_fields_margin("tables")
+    np.testing.assert_allclose(oh, base, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        oh, np.asarray(matvec(dense, v)), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(auto, closed, rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError):
+        features.set_fields_margin("bogus")
+
+
+def test_full_mxu_fields_trainer_trajectory_matches_baseline():
+    """End-to-end: onehot margin + onehot scatter (the no-serialized-
+    lookups sparse step) must match the tables+pairs baseline trajectory
+    at the canonical W=30 AGC config under the flat lowering."""
+    from erasurehead_tpu.data.synthetic import generate_onehot
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    W = 30
+    data = generate_onehot(2640, 166, n_partitions=W, n_fields=6, seed=5)
+
+    def run(margin, scatter):
+        cfg = RunConfig(
+            scheme="approx", n_workers=W, n_stragglers=2, num_collect=15,
+            rounds=8, n_rows=2640, n_cols=166, update_rule="AGD",
+            dataset="covtype", add_delay=True, sparse_format="fields",
+            fields_margin=margin, fields_scatter=scatter, flat_grad="on",
+            seed=0,
+        )
+        return trainer.train(cfg, data)
+
+    a = run("tables", "pairs")
+    b = run("onehot", "onehot")
+    np.testing.assert_allclose(
+        np.asarray(b.final_params), np.asarray(a.final_params),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_lanes_with_onehot_margin_rejected():
+    """sparse_lanes has no effect under fields_margin='onehot' (no gathers
+    to widen) — the config must reject the combination rather than record
+    a lane width that never ran (measurement attribution)."""
+    from erasurehead_tpu.utils.config import RunConfig
+
+    with pytest.raises(ValueError, match="sparse_lanes has no effect"):
+        RunConfig(
+            scheme="approx", n_workers=6, n_stragglers=1, num_collect=4,
+            n_rows=60, n_cols=30, sparse_format="fields",
+            fields_margin="onehot", sparse_lanes=8,
+        )

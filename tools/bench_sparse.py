@@ -67,6 +67,11 @@ def main() -> None:
         help="FieldOnehot gradient-scatter lowering: onehot = per-field "
              "one-hot MXU matmuls instead of pair-accumulator scatter-adds",
     )
+    ap.add_argument(
+        "--fields-margin", default="tables", choices=["tables", "onehot"],
+        help="FieldOnehot margin lowering: onehot = per-field one-hot MXU "
+             "matmuls instead of pair-table gathers (lanes ignored)",
+    )
     args = ap.parse_args()
     presets = {
         "covtype": (396112 // W * W, 15509, 12),
@@ -143,6 +148,7 @@ def main() -> None:
         sparse_format=args.sparse_format,
         flat_grad=args.flat_grad,
         fields_scatter=args.fields_scatter,
+        fields_margin=args.fields_margin,
         seed=0,
     )
     t0 = time.perf_counter()
@@ -225,6 +231,7 @@ def main() -> None:
                 "format": args.sparse_format,
                 "flat": args.flat_grad,
                 "fields_scatter": args.fields_scatter,
+                "fields_margin": args.fields_margin,
                 "n_rows": args.rows,
                 "n_cols": args.cols,
                 "nnz_per_row": args.nnz,

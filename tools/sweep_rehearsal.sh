@@ -82,6 +82,10 @@ run sparse_covtype_faithful_fields_lanes8_onehot_flat 600 python tools/bench_spa
     --shape covtype --format fields --lanes 8 --fields-scatter onehot --flat on --light
 run sparse_amazon_faithful_fields_lanes8_onehot_flat 600 python tools/bench_sparse.py \
     --shape amazon --format fields --lanes 8 --fields-scatter onehot --flat on --light
+run sparse_covtype_faithful_fields_mxu_flat 600 python tools/bench_sparse.py \
+    --shape covtype --format fields --fields-margin onehot --fields-scatter onehot --flat on --light
+run sparse_amazon_faithful_fields_mxu_flat 600 python tools/bench_sparse.py \
+    --shape amazon --format fields --fields-margin onehot --fields-scatter onehot --flat on --light
 
 n_ok=$(wc -l < "$OUT")
 echo "rehearsal: $n_ok entries captured in $OUT" >&2

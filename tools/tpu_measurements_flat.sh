@@ -58,6 +58,12 @@ run sparse_covtype_faithful_fields_lanes8_onehot_flat 1200 python tools/bench_sp
     --shape covtype --format fields --lanes 8 --fields-scatter onehot --flat on
 run sparse_amazon_faithful_fields_lanes8_onehot_flat  1200 python tools/bench_sparse.py \
     --shape amazon --format fields --lanes 8 --fields-scatter onehot --flat on
+# full-MXU sparse step: one-hot matmuls in BOTH directions — zero
+# serialized lookups (ops/features._onehot_fields_matvec/_rmatvec)
+run sparse_covtype_faithful_fields_mxu_flat 1200 python tools/bench_sparse.py \
+    --shape covtype --format fields --fields-margin onehot --fields-scatter onehot --flat on
+run sparse_amazon_faithful_fields_mxu_flat  1200 python tools/bench_sparse.py \
+    --shape amazon --format fields --fields-margin onehot --fields-scatter onehot --flat on
 run dense_f32_flat       1800 env BENCH_FLAT=on python bench.py
 run dense_profile_flat   1200 python tools/profile_dense.py \
     --only flatstack_full,flatstack_bf16
