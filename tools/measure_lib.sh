@@ -1,0 +1,65 @@
+# Shared capture discipline for the resumable TPU measurement programs
+# (tpu_measurements.sh, tpu_measurements_flat.sh). Source after setting
+# OUT. Provides run <tag> <timeout_s> <cmd...>:
+#
+#   - already-captured tags are skipped (resume protocol; RERUN_ALL=1
+#     overrides), so a wedge costs only the remaining entries;
+#   - SIGINT first (python unwinds via KeyboardInterrupt so the PJRT
+#     client can close its relay session — both observed relay-terminal
+#     deaths followed a process killed mid-RPC); --kill-after covers a
+#     child that ignores INT;
+#   - ONLY exit-0 runs whose last line is valid JSON from a real TPU are
+#     recorded: bench.py exits 0 with a platform:"cpu" fallback line when
+#     the relay wedges mid-run, and that must stay un-captured so the
+#     next healthy window retries it;
+#   - wedge abort: an entry timeout (rc 124/137) OR a cpu-fallback line
+#     (rc 0, platform cpu/none — the same wedge's other signature) counts
+#     as wedge evidence; two consecutive pieces of evidence abort the
+#     program with EX_TEMPFAIL so the watcher re-polls instead of burning
+#     every remaining entry's budget against a dead relay. Any captured
+#     entry, or a failure that is NOT wedge-shaped (a tool bug), resets
+#     the counter.
+
+CONSEC_WEDGE_EVIDENCE=0
+
+run() {
+  local tag="$1" tmo="$2"; shift 2
+  if [ -z "${RERUN_ALL:-}" ] && [ -f "$OUT" ] \
+     && grep -q "\"tag\": \"$tag\"" "$OUT"; then
+    echo "=== $tag: already captured, skipping (RERUN_ALL=1 to redo)" >&2
+    return
+  fi
+  echo "=== $tag ($tmo s): $*" >&2
+  local line rc verdict
+  line="$(timeout -s INT -k 90 "$tmo" "$@" 2>"$OUT.$tag.log" | tail -1)"
+  rc=$?
+  # verdict: ok | cpu (exit-0 but platform cpu/none) | bad (anything else)
+  verdict=bad
+  if [ "$rc" -eq 0 ] && [ -n "$line" ]; then
+    verdict="$(printf '%s' "$line" | python -c '
+import json, sys
+try:
+    d = json.load(sys.stdin)
+except Exception:
+    print("bad"); raise SystemExit
+print("cpu" if d.get("platform") in ("cpu", "none") else "ok")' 2>/dev/null)"
+    [ -n "$verdict" ] || verdict=bad
+  fi
+  if [ "$verdict" = "ok" ]; then
+    printf '{"tag": "%s", "result": %s}\n' "$tag" "$line" >> "$OUT"
+    echo "$tag -> $line" >&2
+    CONSEC_WEDGE_EVIDENCE=0
+    return
+  fi
+  echo "$tag -> FAILED rc=$rc verdict=$verdict (see $OUT.$tag.log)" >&2
+  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ] || [ "$verdict" = "cpu" ]; then
+    CONSEC_WEDGE_EVIDENCE=$((CONSEC_WEDGE_EVIDENCE + 1))
+    if [ "$CONSEC_WEDGE_EVIDENCE" -ge 2 ]; then
+      echo "two consecutive wedge signatures — relay presumed dead," \
+           "aborting program (resumable; nothing captured is lost)" >&2
+      exit 75  # EX_TEMPFAIL
+    fi
+  else
+    CONSEC_WEDGE_EVIDENCE=0
+  fi
+}

@@ -15,42 +15,7 @@ cd "$(dirname "$0")/.."
 OUT="${1:-tools/measurements.jsonl}"
 export PYTHONPATH="${PYTHONPATH:-}:$(pwd)"
 
-run() { # run <tag> <timeout_s> <cmd...> — per-entry timeout so a relay
-        # wedge mid-program costs one entry, not the rest of the sweep;
-        # stderr goes to a per-tag log so failures keep their diagnostics.
-        # Already-captured tags are skipped, so a rerun after a mid-sweep
-        # wedge resumes at the first missing entry (RERUN_ALL=1 overrides).
-  local tag="$1" tmo="$2"; shift 2
-  if [ -z "${RERUN_ALL:-}" ] && [ -f "$OUT" ] \
-     && grep -q "\"tag\": \"$tag\"" "$OUT"; then
-    echo "=== $tag: already captured, skipping (RERUN_ALL=1 to redo)" >&2
-    return
-  fi
-  echo "=== $tag ($tmo s): $*" >&2
-  local line rc
-  # SIGINT (not the default SIGTERM) so python unwinds via
-  # KeyboardInterrupt and the PJRT client can close its relay session —
-  # both observed relay-terminal deaths (r2, r3 window 1) followed a
-  # process killed mid-RPC. --kill-after covers a child that ignores INT.
-  line="$(timeout -s INT -k 90 "$tmo" "$@" 2>"$OUT.$tag.log" | tail -1)"
-  rc=$?
-  # Record ONLY exit-0 runs whose last line is valid JSON from a real TPU:
-  # garbage would corrupt the decision record, and — because the resume
-  # check greps for the tag — any recorded line marks the entry captured
-  # forever. In particular bench.py exits 0 with a platform:"cpu" fallback
-  # line when the relay wedges mid-sweep; that must stay un-captured so
-  # the next healthy window retries it. A failure appends nothing.
-  if [ "$rc" -eq 0 ] && [ -n "$line" ] \
-     && printf '%s' "$line" | python -c '
-import json, sys
-d = json.load(sys.stdin)
-sys.exit(1 if d.get("platform") in ("cpu", "none") else 0)' 2>/dev/null; then
-    printf '{"tag": "%s", "result": %s}\n' "$tag" "$line" >> "$OUT"
-    echo "$tag -> $line" >&2
-  else
-    echo "$tag -> FAILED rc=$rc (see $OUT.$tag.log)" >&2
-  fi
-}
+. "$(dirname "$0")/measure_lib.sh"
 
 # Ordered by value-per-wedge-risk, revised after the round-3 window-1
 # post-mortem: the 900 s per-entry budget is mostly COMPILE time over the

@@ -12,28 +12,7 @@ cd "$(dirname "$0")/.."
 OUT="${1:-tools/measurements.jsonl}"
 export PYTHONPATH="${PYTHONPATH:-}:$(pwd)"
 
-run() { # see tpu_measurements.sh — identical capture discipline
-  local tag="$1" tmo="$2"; shift 2
-  if [ -z "${RERUN_ALL:-}" ] && [ -f "$OUT" ] \
-     && grep -q "\"tag\": \"$tag\"" "$OUT"; then
-    echo "=== $tag: already captured, skipping (RERUN_ALL=1 to redo)" >&2
-    return
-  fi
-  echo "=== $tag ($tmo s): $*" >&2
-  local line rc
-  line="$(timeout -s INT -k 90 "$tmo" "$@" 2>"$OUT.$tag.log" | tail -1)"
-  rc=$?
-  if [ "$rc" -eq 0 ] && [ -n "$line" ] \
-     && printf '%s' "$line" | python -c '
-import json, sys
-d = json.load(sys.stdin)
-sys.exit(1 if d.get("platform") in ("cpu", "none") else 0)' 2>/dev/null; then
-    printf '{"tag": "%s", "result": %s}\n' "$tag" "$line" >> "$OUT"
-    echo "$tag -> $line" >&2
-  else
-    echo "$tag -> FAILED rc=$rc (see $OUT.$tag.log)" >&2
-  fi
-}
+. "$(dirname "$0")/measure_lib.sh"
 
 # Ordered by decision value for a short window:
 # 1-2: validate the fields fix (auto->flat flipped on the r3 evidence) at
