@@ -69,5 +69,11 @@ run sparse_amazon_deduped_fields_lanes8_flat  1200 python tools/bench_sparse.py 
     --shape amazon --mode deduped --format fields --lanes 8 --flat on
 run dense_bf16_flat      1800 env BENCH_FLAT=on BENCH_DTYPE=bfloat16 python bench.py
 run dense_f32_deduped_flat 1800 env BENCH_FLAT=on BENCH_MODE=deduped python bench.py
+# deduped x full-MXU: if the MXU lowerings win faithful, these decide
+# the fastest-honest-mode production default
+run sparse_covtype_deduped_fields_mxu_flat 1200 python tools/bench_sparse.py \
+    --shape covtype --mode deduped --format fields --fields-margin onehot --fields-scatter onehot --flat on
+run sparse_amazon_deduped_fields_mxu_flat  1200 python tools/bench_sparse.py \
+    --shape amazon --mode deduped --format fields --fields-margin onehot --fields-scatter onehot --flat on
 
 echo "flat measurements appended to $OUT" >&2
