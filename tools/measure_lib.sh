@@ -20,6 +20,15 @@
 #     entry, or a failure that is NOT wedge-shaped (a tool bug), resets
 #     the counter.
 
+# Persistent XLA compilation cache: every observed relay wedge (r1-r3)
+# began during a fresh compile over the relay, and the per-entry budgets
+# are mostly compile time. Caching compiled programs across entries and
+# windows cuts both the wedge surface and the harvest time. Harmless if
+# the backend declines it (JAX warns and compiles as usual).
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$(pwd)/tools/.jax_cache}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-5}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
 CONSEC_WEDGE_EVIDENCE=0
 
 run() {

@@ -12,6 +12,11 @@ LOG=tools/relay_watch.log
 MAX_HOURS="${1:-11}"
 DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
 export PYTHONPATH="${PYTHONPATH:-}:$(pwd)"
+# persistent compile cache (see measure_lib.sh) — also covers the fresh
+# bench.py below
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$(pwd)/tools/.jax_cache}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-5}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
 probe() {
   timeout 90 python - <<'EOF' >/dev/null 2>&1
