@@ -105,6 +105,12 @@ FLAT_GRAD = os.environ.get("BENCH_FLAT", "")
 if FLAT_GRAD and FLAT_GRAD in ("on", "off"):
     METRIC_SUFFIX += f"_flat{FLAT_GRAD}"
 
+# hybrid dense margin lowering (parallel/step._hybrid_margin_flat_grad):
+# flat 2-D margin matmul + batched per-slot transpose
+MARGIN_FLAT = os.environ.get("BENCH_MARGIN_FLAT", "")
+if MARGIN_FLAT and MARGIN_FLAT in ("on", "off"):
+    METRIC_SUFFIX += f"_marginflat{MARGIN_FLAT}"
+
 
 def _failure_record(error: str) -> dict:
     """A valid one-line JSON payload for any can't-measure outcome — the
@@ -204,12 +210,16 @@ def _record_or_annotate(payload: dict) -> dict:
     doesn't erase the evidence that a TPU number exists."""
     on_tpu = payload.get("platform") in ("tpu", "axon")
     # canonical = the unmodified flagship config: variant knobs (bf16 data,
-    # margin-cols lowering) are real TPU numbers but must not replace the
-    # canonical last-known-TPU artifact
+    # margin-cols / flat / margin-flat lowerings, deduped mode) are real
+    # TPU numbers but must not replace the canonical last-known-TPU
+    # artifact (a BENCH_FLAT=on run overwrote it in round 3 — restored
+    # from git, and the check now covers every variant knob)
     canonical = (
         payload.get("dtype", "float32") == "float32"
         and not _MARGIN_COLS_ENV
         and COMPUTE_MODE == "faithful"
+        and not FLAT_GRAD
+        and not MARGIN_FLAT
     )
     try:
         if on_tpu and canonical:
@@ -284,6 +294,7 @@ def child() -> None:
         # BENCH_FLAT: force the flat-stack closed-form lowering on/off
         # (unset = "auto", step.resolve_flat_grad decides per stack kind)
         flat_grad=FLAT_GRAD or "auto",
+        margin_flat=MARGIN_FLAT or "auto",
         seed=0,
     )
     print(
@@ -382,6 +393,15 @@ if __name__ == "__main__":
             json.dumps(
                 _failure_record(
                     f"BENCH_FLAT must be on or off, got {FLAT_GRAD!r}"
+                )
+            )
+        )
+        sys.exit(0 if "--child" not in sys.argv else 1)
+    if MARGIN_FLAT not in ("", "on", "off"):
+        print(
+            json.dumps(
+                _failure_record(
+                    f"BENCH_MARGIN_FLAT must be on or off, got {MARGIN_FLAT!r}"
                 )
             )
         )

@@ -81,6 +81,86 @@ def _weighted_loss_grad(model, params, Xs, ys, ws, contract: str):
     return jax.grad(L)(params)
 
 
+# Whether margin_flat="auto" resolves to the hybrid lowering for dense
+# closed-form stacks. False pending its end-to-end race
+# (dense_f32_marginflat, tools/tpu_measurements_flat.sh); the profile
+# evidence behind the hybrid: the flat 2-D margin matmul measured 1.587 ms
+# vs the batched per-slot contraction's 1.843 at [90, 4400, 128], while
+# the batched transpose is near-free (two_pass 1.909 vs margin_only
+# 1.843) and the FLAT transpose is catastrophic (the flat-everything step
+# halved end-to-end throughput, dense_f32_flat).
+MARGIN_FLAT_DEFAULT = False
+
+
+def supports_margin_flat(model, X) -> bool:
+    """The hybrid needs a closed-form GLM on a DENSE stack: the margin
+    lowers as one flat 2-D matmul while the transpose stays the batched
+    per-slot contraction (sparse stacks have their own margin paths)."""
+    return (
+        hasattr(model, "margin_residual")
+        and not _grads_via_loss(model)
+        and isinstance(X, jax.Array)
+    )
+
+
+def resolve_margin_flat(margin_flat: str, model, X) -> bool:
+    if not supports_margin_flat(model, X):
+        return False
+    if margin_flat == "on":
+        return True
+    if margin_flat == "off":
+        return False
+    return MARGIN_FLAT_DEFAULT
+
+
+def _hybrid_margin_flat_grad(model, params, Xs, ys, ws):
+    """Flat 2-D margin matmul + batched per-slot weighted transpose — the
+    two measured winners combined (see MARGIN_FLAT_DEFAULT). Works for
+    both the worker-major [Wl, S, rows, F] and partition-major
+    [Pl, rows, F] stacks: leading axes flatten into one slot axis M.
+    Same math as the per-slot vmap; only reduction order differs."""
+    from erasurehead_tpu.ops import features as features_lib
+
+    R = ys.shape[-1]
+    F = Xs.shape[-1]
+    M = int(np.prod(ys.shape[:-1]))
+    X3 = Xs.reshape(M, R, F)
+    p = features_lib.matvec(Xs.reshape(M * R, F), params)
+    r = model.margin_residual(p, ys.reshape(M * R))
+    wr = ws.reshape(M)[:, None] * r.reshape(M, R)
+    if X3.dtype == jnp.bfloat16 and wr.dtype != X3.dtype:
+        # bf16 DATA mode: stream X as stored, cast the small operand down,
+        # accumulate f32 on the MXU (same rule as features.rmatvec)
+        return -jnp.einsum(
+            "mrf,mr->f", X3, wr.astype(X3.dtype),
+            preferred_element_type=jnp.float32,
+        )
+    return -jnp.einsum(
+        "mrf,mr->f", X3, wr,
+        precision=features_lib.get_default_precision(),
+    )
+
+
+def make_margin_flat_grad_fn(model, mesh: Mesh) -> GradFn:
+    """The hybrid lowering as a whole-grad_fn swap (the _apply_flat_grad
+    pattern): drop-in for make_faithful_grad_fn (worker-major
+    [Wl, S, rows, F]) and make_deduped_grad_fn (partition-major
+    [Pl, rows, F]) on dense closed-form stacks — leading axes flatten
+    into one slot axis either way. Caller gates on supports_margin_flat.
+    """
+
+    def local(params, Xs, ys, ws):
+        g = _hybrid_margin_flat_grad(model, params, Xs, ys, ws)
+        return lax.psum(g, WORKER_AXIS)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
+        out_specs=P(),
+    )
+
+
 def make_faithful_grad_fn(model, mesh: Mesh) -> GradFn:
     """Every logical worker computes all of its (redundant) slot gradients.
 

@@ -373,6 +373,7 @@ def train(
         pw = layout.fold_slot_weights(slot_w)
         weights_seq, X, y = jnp.asarray(pw, dtype), data.Xp, data.yp
 
+    grad_fn = _apply_margin_flat(cfg, model, mesh, X, grad_fn)
     grad_fn = _apply_flat_grad(cfg, model, mesh, X, grad_fn)
 
     # fused single-HBM-pass pallas kernel for dense GLM stacks
@@ -798,6 +799,23 @@ def train_measured(
         config=cfg,
         layout=layout,
     )
+
+
+def _apply_margin_flat(cfg, model, mesh, X, grad_fn):
+    """Swap in the hybrid dense lowering (step.make_margin_flat_grad_fn)
+    per cfg.margin_flat: flat 2-D margin matmul + batched per-slot
+    transpose. "on" forces (raising off the dense closed-form path);
+    "auto" defers to step.resolve_margin_flat (MARGIN_FLAT_DEFAULT,
+    pending the dense_f32_marginflat race)."""
+    if cfg.margin_flat == "on" and not step_lib.supports_margin_flat(model, X):
+        raise ValueError(
+            "margin_flat='on' needs a closed-form GLM on a dense stack; "
+            f"got model={getattr(model, 'name', type(model).__name__)!r}, "
+            f"X={type(X).__name__}"
+        )
+    if step_lib.resolve_margin_flat(cfg.margin_flat, model, X):
+        return step_lib.make_margin_flat_grad_fn(model, mesh)
+    return grad_fn
 
 
 def _apply_flat_grad(cfg, model, mesh, X, grad_fn):

@@ -163,6 +163,12 @@ class RunConfig:
     # flat for FieldOnehot (per-slot measured catastrophic on v5e), else
     # step.FLAT_GRAD_DEFAULT pending the dense/PaddedRows races.
     flat_grad: str = "auto"
+    # hybrid dense margin lowering (parallel/step._hybrid_margin_flat_grad):
+    # the margin as one flat 2-D matmul (the measured margin winner) while
+    # the transpose stays the batched per-slot contraction (the measured
+    # transpose winner). "auto" resolves to step.MARGIN_FLAT_DEFAULT
+    # pending the dense_f32_marginflat race; closed-form dense GLMs only.
+    margin_flat: str = "auto"
     # per-round collection deadline in simulated seconds (scheme="deadline")
     deadline: Optional[float] = None
     # sequence-parallel shards for the attention family: >1 builds a 2-D
@@ -319,6 +325,20 @@ class RunConfig:
             raise ValueError(
                 f"fields_scatter must be pairs/onehot, got "
                 f"{self.fields_scatter!r}"
+            )
+        if self.margin_flat not in ("auto", "on", "off"):
+            raise ValueError(
+                f"margin_flat must be auto/on/off, got {self.margin_flat!r}"
+            )
+        if self.margin_flat == "on" and self.flat_grad == "on":
+            raise ValueError(
+                "margin_flat='on' and flat_grad='on' both force a margin "
+                "lowering; force at most one"
+            )
+        if self.margin_flat == "on" and self.use_pallas == "on":
+            raise ValueError(
+                "margin_flat='on' and use_pallas='on' both force a grad "
+                "lowering; force at most one"
             )
         if self.fields_margin not in ("tables", "onehot"):
             raise ValueError(

@@ -999,3 +999,67 @@ def test_parallelism_matrix_trajectory_fuzz(scheme, extra, model, axis_kw):
             np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
             err_msg=f"{scheme}/{model}/{axis_kw}",
         )
+
+
+class TestMarginFlat:
+    """The hybrid dense margin lowering (cfg.margin_flat,
+    step._hybrid_margin_flat_grad): flat 2-D margin matmul + batched
+    per-slot transpose — trajectory-equal to the per-slot path."""
+
+    def test_resolution_rules(self):
+        import jax.numpy as jnp
+
+        from erasurehead_tpu.models.glm import LogisticModel
+        from erasurehead_tpu.models.mlp import MLPModel
+        from erasurehead_tpu.ops.features import PaddedRows
+        from erasurehead_tpu.parallel import step as step_lib
+
+        glm = LogisticModel()
+        dense = jnp.zeros((2, 4, 8))
+        padded = PaddedRows(
+            jnp.zeros((2, 4, 3), jnp.int32), jnp.ones((2, 4, 3)), 8
+        )
+        assert (
+            step_lib.resolve_margin_flat("auto", glm, dense)
+            == step_lib.MARGIN_FLAT_DEFAULT
+        )
+        assert step_lib.resolve_margin_flat("on", glm, dense)
+        assert not step_lib.resolve_margin_flat("off", glm, dense)
+        # sparse stacks and autodiff models are unsupported -> always False
+        assert not step_lib.resolve_margin_flat("on", glm, padded)
+        assert not step_lib.resolve_margin_flat("on", MLPModel(), dense)
+
+    @pytest.mark.parametrize("mode", ["faithful", "deduped"])
+    def test_trajectory_matches_per_slot(self, gmm, mode):
+        base = trainer.train(
+            _cfg(scheme="approx", num_collect=3, compute_mode=mode,
+                 margin_flat="off"),
+            gmm, mesh=worker_mesh(4),
+        )
+        hyb = trainer.train(
+            _cfg(scheme="approx", num_collect=3, compute_mode=mode,
+                 margin_flat="on"),
+            gmm, mesh=worker_mesh(4),
+        )
+        np.testing.assert_allclose(
+            np.asarray(hyb.final_params), np.asarray(base.final_params),
+            rtol=1e-4, atol=1e-6,
+        )
+
+    def test_on_rejects_unsupported(self, gmm):
+        from erasurehead_tpu.data.synthetic import generate_onehot
+
+        data = generate_onehot(N_ROWS, 40, n_partitions=W, n_fields=4, seed=7)
+        cfg = _cfg(scheme="approx", num_collect=3, margin_flat="on",
+                   sparse_format="fields")
+        with pytest.raises(ValueError, match="margin_flat"):
+            trainer.train(cfg, data, mesh=worker_mesh(4))
+
+    def test_on_conflicts_with_flat_on(self):
+        with pytest.raises(ValueError, match="at most one"):
+            _cfg(margin_flat="on", flat_grad="on")
+
+
+def test_margin_flat_on_conflicts_with_pallas_on():
+    with pytest.raises(ValueError, match="at most one"):
+        _cfg(margin_flat="on", use_pallas="on")

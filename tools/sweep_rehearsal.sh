@@ -57,6 +57,7 @@ done
 
 # flat-lowering program (tpu_measurements_flat.sh) entries, light form
 run dense_f32_flat 600 env BENCH_FLAT=on python bench.py
+run dense_f32_marginflat 600 env BENCH_MARGIN_FLAT=on python bench.py
 run dense_profile_flat 600 python tools/profile_dense.py \
     --slots 4 --rows 256 --cols 64 --only flatstack_full,flatstack_bf16
 run sparse_covtype_faithful_fields_flat 600 python tools/bench_sparse.py \

@@ -44,6 +44,10 @@ run sparse_covtype_faithful_fields_mxu_flat 1200 python tools/bench_sparse.py \
 run sparse_amazon_faithful_fields_mxu_flat  1200 python tools/bench_sparse.py \
     --shape amazon --format fields --fields-margin onehot --fields-scatter onehot --flat on
 run dense_f32_flat       1800 env BENCH_FLAT=on python bench.py
+# hybrid: flat 2-D margin matmul + batched per-slot transpose — the two
+# profiled winners combined (margin_matmul2d 1.587 ms; transpose near-
+# free per two_pass-vs-margin_only). Races the captured dense_f32.
+run dense_f32_marginflat 1800 env BENCH_MARGIN_FLAT=on python bench.py
 run dense_profile_flat   1200 python tools/profile_dense.py \
     --only flatstack_full,flatstack_bf16
 run sparse_profile_flatpairs 1200 python tools/profile_sparse.py \
