@@ -503,7 +503,9 @@ def _lanes_fields_matvec(sizes, n_cols, L, local, v):
     barrier; gathers return [n, L] rows whose lanes are identical, so the
     per-lane accumulator reduces exactly (power-of-two L) at the end. The
     pairing plan is lane-aware: pairs whose replicated table would exceed
-    LANE_TABLE_BYTES_CAP fall back to singles.
+    LANE_TABLE_BYTES_CAP fall back to singles, and a single whose own
+    replicated [B, L] table would still exceed it (a >65536-category field
+    at L=1024) falls back to the scalar gather for that field only.
 
     custom_vjp: the forward lane gather's automatic transpose would be a
     lane-wide scatter into the [entries, L] table — exactly the op the v5e
@@ -516,14 +518,22 @@ def _lanes_fields_matvec(sizes, n_cols, L, local, v):
     lane-wide table scatter.
     """
     acc = 0.0
+    scalar_acc = 0.0
     for table, code in _plan_tables(
         fields_margin_plan(sizes, L), sizes, local, v
     ):
+        if table.shape[0] * L * 4 > LANE_TABLE_BYTES_CAP:
+            # a single field too large even unreplicated to fit the lane
+            # budget (pairs are already excluded by the lane-aware plan):
+            # scalar-gather it rather than build an over-cap [B, L] table
+            scalar_acc = scalar_acc + jnp.take(table, code)  # [n]
+            continue
         wide = jax.lax.optimization_barrier(
             jnp.broadcast_to(table[:, None], (table.shape[0], L))
         )
         acc = acc + jnp.take(wide, code, axis=0)  # [n, L]
-    return acc.sum(axis=1) * (1.0 / L)
+    lane_sum = acc.sum(axis=1) * (1.0 / L) if not isinstance(acc, float) else 0.0
+    return lane_sum + scalar_acc
 
 
 def _lanes_fields_matvec_fwd(sizes, n_cols, L, local, v):

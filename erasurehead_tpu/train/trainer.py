@@ -601,6 +601,13 @@ def train_measured(
             "matmul and cannot be timed per worker — use flat_grad='auto' "
             "or 'off'"
         )
+    if cfg.margin_flat == "on":
+        raise ValueError(
+            "arrival_mode='measured' times each worker's own message "
+            "separately; the flat-margin lowering fuses all slots' margins "
+            "into one matmul and cannot be timed per worker — use "
+            "margin_flat='auto' or 'off'"
+        )
     setup = _setup_run(cfg, dataset, mesh, faithful=True, single_device=True)
     layout, model, data = setup.layout, setup.model, setup.data
     W = layout.n_workers
@@ -856,7 +863,10 @@ def train_dynamic(cfg: RunConfig, dataset: Dataset, mesh=None) -> TrainResult:
     )
     grad_fn = _apply_flat_grad(
         cfg, model, mesh, data.Xw,
-        step_lib.make_faithful_grad_fn(model, mesh),
+        _apply_margin_flat(
+            cfg, model, mesh, data.Xw,
+            step_lib.make_faithful_grad_fn(model, mesh),
+        ),
     )
     update_fn = setup.update_fn
     dtype = jnp.float32  # param/update dtype (cfg.dtype is the data dtype)

@@ -214,6 +214,28 @@ def test_train_dynamic_flat_lowering_matches_per_slot():
     np.testing.assert_allclose(hists["on"], hists["off"], rtol=2e-4, atol=2e-5)
 
 
+def test_train_dynamic_margin_flat_matches_per_slot():
+    """cfg.margin_flat='on' routes train_dynamic through the hybrid dense
+    margin lowering (step.make_margin_flat_grad_fn) — trajectory allclose
+    to the per-slot lowering. Before round 4 the knob was silently ignored
+    here (ADVICE r3)."""
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+    from erasurehead_tpu.train import trainer
+
+    data = generate_gmm(16 * W, 12, n_partitions=W, seed=0)
+    hists = {}
+    for margin in ("off", "on"):
+        cfg = RunConfig(
+            scheme="approx", n_workers=W, n_stragglers=2, num_collect=8,
+            rounds=8, n_rows=16 * W, n_cols=12, lr_schedule=0.5,
+            update_rule="AGD", add_delay=True, seed=0, margin_flat=margin,
+        )
+        res = trainer.train_dynamic(cfg, data, mesh=worker_mesh(4))
+        hists[margin] = np.asarray(res.params_history, np.float32)
+    np.testing.assert_allclose(hists["on"], hists["off"], rtol=2e-4, atol=2e-5)
+
+
 def test_ranks_tie_break_matches_order():
     t = jnp.asarray([0.0, 0.0, 1.0, 0.0])
     ranks = np.asarray(dynamic._ranks(t))

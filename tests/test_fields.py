@@ -468,6 +468,37 @@ def test_fields_lanes_matches_scalar_and_scopes_to_matvec():
         features.set_sparse_lanes(None)
 
 
+def test_fields_lanes_oversized_single_falls_back_to_scalar(monkeypatch):
+    """A single field whose lane-replicated [B, L] table would exceed
+    LANE_TABLE_BYTES_CAP must be scalar-gathered, not replicated (ADVICE
+    r3: singles used to bypass the byte budget entirely — a 200k-category
+    field at L=1024 would build an ~800 MB transient). Exercised by
+    shrinking the cap so a small field trips it; numerics must still match
+    the scalar path exactly-enough."""
+    import jax
+
+    sizes = (9, 13)
+    n = 40
+    csr = _onehot_csr(n, sizes, seed=3)
+    fo = FieldOnehot.from_scipy(csr)
+    rng = np.random.default_rng(4)
+    v = jnp.asarray(rng.standard_normal(csr.shape[1]).astype(np.float32))
+    base_mv = np.asarray(matvec(fo, v))
+    L = 8
+    # cap below 9*L*4 bytes: the plan degenerates to singles AND both
+    # singles' replicated tables are over-budget -> pure scalar gathers
+    monkeypatch.setattr(features, "LANE_TABLE_BYTES_CAP", 9 * L * 4 - 1)
+    try:
+        features.set_sparse_lanes(L)
+        np.testing.assert_allclose(
+            np.asarray(matvec(fo, v)), base_mv, rtol=1e-5, atol=1e-5
+        )
+        jaxpr = str(jax.make_jaxpr(lambda u: matvec(fo, u))(v))
+        assert "optimization_barrier" not in jaxpr  # no replicated tables
+    finally:
+        features.set_sparse_lanes(None)
+
+
 def test_runconfig_accepts_fields_with_lanes():
     """fields + sparse_lanes is the composed lowering, not an error; auto +
     lanes still pins padded (historical measurement attribution)."""

@@ -158,3 +158,5 @@ def test_measured_mode_rejects_unsupported_knobs(data):
         trainer.train_measured(_cfg(use_pallas="on"), data)
     with pytest.raises(ValueError, match="flat-stack"):
         trainer.train_measured(_cfg(flat_grad="on"), data)
+    with pytest.raises(ValueError, match="flat-margin"):
+        trainer.train_measured(_cfg(margin_flat="on"), data)

@@ -203,6 +203,12 @@ def main() -> None:
             sizes[e[1]] * sizes[e[2]] if e[0] == "pair" else sizes[e[1]]
             for e in plan
         )
+        # the table build is once per step in BOTH lowerings: under the
+        # per-slot vmap ("off") the tables are built from the unbatched
+        # params, so vmap's batching rules leave them slot-invariant —
+        # verified from the jaxpr (one [entries, L] broadcast+barrier
+        # OUTSIDE the batched inner jaxpr; the round-3 vmap catastrophe
+        # was the batched BACKWARD scatter accumulators, not these)
         bytes_per_step += (
             n_stacks * slot_rows * len(plan) * 4 * args.lanes
             + table_entries * 4 * args.lanes
