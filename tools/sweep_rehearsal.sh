@@ -88,5 +88,8 @@ run sparse_covtype_faithful_fields_mxu_flat 600 python tools/bench_sparse.py \
 run sparse_amazon_faithful_fields_mxu_flat 600 python tools/bench_sparse.py \
     --shape amazon --format fields --fields-margin onehot --fields-scatter onehot --flat on --light
 
+run measured_arrival_agc 600 python tools/bench_measured.py --light
+run dense_hbm_crosscheck 600 python tools/profile_hbm.py --light
+
 n_ok=$(wc -l < "$OUT")
 echo "rehearsal: $n_ok entries captured in $OUT" >&2

@@ -96,6 +96,16 @@ run sparse_profile_rest 1200 python tools/profile_sparse.py \
 run sparse_profile_packed128 1200 python tools/profile_sparse.py \
     --only margin_packed128,scatter_packed128
 
+# round-4 additions (VERDICT r3 #3 and #5), cheap compiles:
+# measured-arrival AGC on real silicon — worker_timeset as a device
+# measurement, plus the AGC/naive protocol-rate ratio under real
+# (induced) heterogeneity; writes artifacts/measured_arrival_tpu.json
+run measured_arrival_agc 900 python tools/bench_measured.py
+# independent bandwidth-ceiling cross-check: out-of-scan stream probes +
+# an xplane device trace of the production-shaped two-pass step —
+# hardens (or reopens) the 126 GB/s in-scan floor claim (BASELINE.md)
+run dense_hbm_crosscheck 900 python tools/profile_hbm.py
+
 # amazon fields LAST: round-3 window 1 died mid-compile here (relay
 # terminal down at 01:52Z with this entry in flight; the compile itself
 # is proven cheap — 8 s on forced-CPU — so this is pure wedge paranoia).
