@@ -114,3 +114,87 @@ def test_cli_status_dry_run(capsys):
     lines = capsys.readouterr().out.splitlines()
     json_text = "\n".join(l for l in lines if not l.startswith("[dry-run]"))
     assert json.loads(json_text) == {"state": None, "hosts": []}
+
+
+# ---------------------------------------------------------------------------
+# k8s JobSet manifest: offline structural validation (the fleet lifecycle's
+# k8s path, VERDICT r3 #6)
+# ---------------------------------------------------------------------------
+
+import copy
+
+import pytest
+import yaml
+
+JOBSET = os.path.join(
+    os.path.dirname(__file__), "..", "tools", "k8s", "jobset-v4-32.yaml"
+)
+
+
+def _load():
+    with open(JOBSET) as f:
+        return yaml.safe_load(f)
+
+
+def _write(tmp_path, doc):
+    p = tmp_path / "jobset.yaml"
+    p.write_text(yaml.safe_dump(doc))
+    return str(p)
+
+
+def test_committed_jobset_validates():
+    summary = tpu_fleet.validate_jobset(JOBSET)
+    assert summary["name"] == "erasurehead-agc"
+    assert summary["jobs"] == [
+        {"name": "workers", "parallelism": 4, "topology": "2x2x4"}
+    ]
+
+
+def test_jobset_cli_subcommand(capsys):
+    rc = tpu_fleet.main(["validate_jobset"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["name"] == "erasurehead-agc"
+
+
+def _pod(doc):
+    return doc["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]["spec"]
+
+
+def test_jobset_topology_mismatch_rejected(tmp_path):
+    doc = _load()
+    _pod(doc)["nodeSelector"]["cloud.google.com/gke-tpu-topology"] = "2x2x2"
+    with pytest.raises(ValueError, match="topology"):
+        tpu_fleet.validate_jobset(_write(tmp_path, doc))
+
+
+def test_jobset_completions_mismatch_rejected(tmp_path):
+    doc = _load()
+    doc["spec"]["replicatedJobs"][0]["template"]["spec"]["completions"] = 3
+    with pytest.raises(ValueError, match="completions"):
+        tpu_fleet.validate_jobset(_write(tmp_path, doc))
+
+
+def test_jobset_dangling_volume_mount_rejected(tmp_path):
+    doc = _load()
+    _pod(doc)["volumes"] = []
+    with pytest.raises(ValueError, match="volumeMount"):
+        tpu_fleet.validate_jobset(_write(tmp_path, doc))
+
+
+def test_jobset_tpu_requests_limits_mismatch_rejected(tmp_path):
+    doc = _load()
+    _pod(doc)["containers"][0]["resources"]["limits"]["google.com/tpu"] = 8
+    with pytest.raises(ValueError, match="requests must equal limits"):
+        tpu_fleet.validate_jobset(_write(tmp_path, doc))
+
+
+def test_jobset_embedded_cli_drift_rejected(tmp_path):
+    """The manifest's training command is parsed against the REAL CLI
+    surface: renaming a flag in cli.py (or typoing one in the yaml) fails
+    validation instead of failing at pod runtime."""
+    doc = _load()
+    c = _pod(doc)["containers"][0]
+    c["command"] = ["bash", "-c",
+                    "python -m erasurehead_tpu.cli --no-such-flag 1"]
+    with pytest.raises(ValueError, match="unknown flags|does not parse"):
+        tpu_fleet.validate_jobset(_write(tmp_path, doc))

@@ -38,6 +38,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import re
 import shlex
 import subprocess
 import sys
@@ -174,6 +176,107 @@ class Fleet:
         self.run_command(command)
 
 
+def _validate_cli_fragment(joined: str) -> None:
+    """Parse the flag tail of an embedded ``python -m erasurehead_tpu.cli``
+    command against the REAL CLI parser, so a manifest can't drift from the
+    actual flag surface. Raises ValueError on any unknown/invalid flag."""
+    args: list[str] = []
+    for tok in shlex.split(joined.split("erasurehead_tpu.cli", 1)[1]):
+        if tok in ("&&", "||", ";", "|"):
+            break
+        args.append(tok)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from erasurehead_tpu import cli
+
+    try:
+        _, extra = cli._flags_parser().parse_known_args(args)
+    except SystemExit as e:  # argparse errors exit(2)
+        raise ValueError(f"embedded CLI command does not parse: {args}") from e
+    if extra:
+        raise ValueError(f"embedded CLI command has unknown flags: {extra}")
+
+
+def validate_jobset(path: str) -> dict:
+    """Offline structural validation of a JobSet manifest (the k8s path of
+    the fleet lifecycle — no cluster, no CRD install needed). Checks the
+    fields the JobSet controller and GKE TPU scheduling actually require,
+    plus the repo-specific invariants:
+
+      - apiVersion/kind/DNS-1123 metadata.name;
+      - every replicatedJob: parallelism == completions (every host runs),
+        restartPolicy, non-empty containers with name+image+command;
+      - google.com/tpu requests == limits (extended resources must match);
+      - gke-tpu-topology chip count == parallelism x chips-per-host;
+      - every volumeMount resolves to a declared volume;
+      - any embedded erasurehead_tpu.cli command parses against the real
+        CLI surface (_validate_cli_fragment).
+
+    Returns a summary dict; raises ValueError on the first violation."""
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+
+    def need(cond, msg):
+        if not cond:
+            raise ValueError(f"{path}: {msg}")
+
+    need(isinstance(doc, dict), "not a YAML mapping")
+    need(
+        doc.get("apiVersion") == "jobset.x-k8s.io/v1alpha2",
+        f"apiVersion must be jobset.x-k8s.io/v1alpha2, got {doc.get('apiVersion')!r}",
+    )
+    need(doc.get("kind") == "JobSet", f"kind must be JobSet, got {doc.get('kind')!r}")
+    name = (doc.get("metadata") or {}).get("name", "")
+    need(
+        re.fullmatch(r"[a-z0-9]([-a-z0-9]{0,61}[a-z0-9])?", name or ""),
+        f"metadata.name {name!r} is not a DNS-1123 label",
+    )
+    rjs = (doc.get("spec") or {}).get("replicatedJobs")
+    need(isinstance(rjs, list) and rjs, "spec.replicatedJobs must be a non-empty list")
+    summary = {"name": name, "jobs": []}
+    for rj in rjs:
+        need(rj.get("name"), "replicatedJob needs a name")
+        jspec = (rj.get("template") or {}).get("spec") or {}
+        par, comp = jspec.get("parallelism"), jspec.get("completions")
+        need(isinstance(par, int) and par >= 1, f"job {rj.get('name')}: parallelism must be int >= 1")
+        need(comp == par, f"job {rj.get('name')}: completions ({comp}) must equal parallelism ({par}) — every host runs the SPMD program")
+        pod = (jspec.get("template") or {}).get("spec") or {}
+        need(pod.get("restartPolicy") in ("Never", "OnFailure"),
+             f"job {rj.get('name')}: restartPolicy must be Never/OnFailure")
+        containers = pod.get("containers")
+        need(isinstance(containers, list) and containers,
+             f"job {rj.get('name')}: needs at least one container")
+        volumes = {v.get("name") for v in pod.get("volumes") or []}
+        topo = (pod.get("nodeSelector") or {}).get("cloud.google.com/gke-tpu-topology")
+        for c in containers:
+            need(c.get("name") and c.get("image"),
+                 f"job {rj.get('name')}: container needs name and image")
+            res = c.get("resources") or {}
+            chips = (res.get("requests") or {}).get("google.com/tpu")
+            need(chips == (res.get("limits") or {}).get("google.com/tpu"),
+                 f"container {c.get('name')}: google.com/tpu requests must equal limits")
+            for vm in c.get("volumeMounts") or []:
+                need(vm.get("name") in volumes,
+                     f"container {c.get('name')}: volumeMount {vm.get('name')!r} has no declared volume")
+            if topo and chips:
+                total = 1
+                for d in str(topo).split("x"):
+                    total *= int(d)
+                need(total == par * int(chips),
+                     f"topology {topo} has {total} chips but parallelism {par} x {chips} chips/host = {par * int(chips)}")
+            cmd = c.get("command")
+            need(cmd, f"container {c.get('name')}: needs a command")
+            joined = " ".join(cmd) if isinstance(cmd, list) else str(cmd)
+            if "erasurehead_tpu.cli" in joined:
+                _validate_cli_fragment(joined)
+        summary["jobs"].append({"name": rj["name"], "parallelism": par,
+                                "topology": topo})
+    return summary
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="tpu_fleet",
@@ -203,7 +306,18 @@ def main(argv: list[str] | None = None) -> int:
     lr = sub.add_parser("launch_run")
     lr.add_argument("command")
     sub.add_parser("shutdown")
+    vj = sub.add_parser("validate_jobset")
+    vj.add_argument(
+        "manifest",
+        nargs="?",
+        default=os.path.join(os.path.dirname(__file__), "k8s",
+                             "jobset-v4-32.yaml"),
+    )
     ns = p.parse_args(argv)
+
+    if ns.cmd == "validate_jobset":
+        print(json.dumps(validate_jobset(ns.manifest), indent=2))
+        return 0
 
     fleet = Fleet(
         name=ns.name, zone=ns.zone, project=ns.project,
