@@ -261,6 +261,7 @@ def train_elastic(
     mesh=None,
     survivor_overrides: Optional[dict] = None,
     measure: bool = True,
+    dynamic: bool = False,
 ):
     """True elastic recovery: re-shard onto the survivors and keep training.
 
@@ -285,6 +286,11 @@ def train_elastic(
     divisibility requirement). Returns (TrainResult, ElasticReport); the
     merged artifacts keep the ORIGINAL worker numbering — dead workers'
     columns carry the reference's -1 sentinel after the restart.
+
+    ``dynamic=True`` runs both phases through trainer.train_dynamic — the
+    fully on-device control plane (deadline scheme included): the shape an
+    online pod scheduler needs when a worker dies mid-run while collection
+    decisions live inside the jitted scan.
     """
     import jax
 
@@ -317,13 +323,15 @@ def train_elastic(
     # prefix) so per-round lr arrays and presets alike stay continuous
     # through the restart
     lr_full = cfg.resolve_lr_schedule()
-    phase1 = trainer.train(
+    train_fn = trainer.train_dynamic if dynamic else trainer.train
+    phase_kw = {} if dynamic else {"measure": measure}
+    phase1 = train_fn(
         dataclasses.replace(
             cfg, rounds=death_round, lr_schedule=lr_full[:death_round]
         ),
         dataset,
         mesh=mesh,
-        measure=measure,
+        **phase_kw,
     )
 
     overrides = dict(
@@ -335,12 +343,12 @@ def train_elastic(
     )
     overrides.update(survivor_overrides or {})
     cfg2 = dataclasses.replace(cfg, **overrides)
-    phase2 = trainer.train(
+    phase2 = train_fn(
         cfg2,
         dataset,
         initial_state=phase1.final_state,
         initial_round=death_round,
-        measure=measure,
+        **phase_kw,
     )
 
     # the phases ran on different meshes (W vs W' divisor device counts):

@@ -843,7 +843,13 @@ def _apply_flat_grad(cfg, model, mesh, X, grad_fn):
 
 
 @_with_run_sparse_lanes
-def train_dynamic(cfg: RunConfig, dataset: Dataset, mesh=None) -> TrainResult:
+def train_dynamic(
+    cfg: RunConfig,
+    dataset: Dataset,
+    mesh=None,
+    initial_state: Optional[Any] = None,
+    initial_round: int = 0,
+) -> TrainResult:
     """Fully on-device run: arrivals, collection masks, and decode are
     traced values inside ONE jitted scan (parallel/dynamic.py) — no host
     control plane between rounds.
@@ -852,6 +858,12 @@ def train_dynamic(cfg: RunConfig, dataset: Dataset, mesh=None) -> TrainResult:
     MT19937 delay streams, float64 decode); this one trades numeric parity
     for a closed-loop on-device program — the shape an online scheduler
     fed by *measured* arrivals takes. Faithful compute mode only.
+
+    ``initial_state``/``initial_round`` mirror :func:`train`'s mid-schedule
+    restart contract (the elastic hook, failures.train_elastic): the scan
+    covers rounds [initial_round, rounds); telemetry rows before that
+    carry zero time / -1 clocks / nothing-collected, and params_history
+    has ``rounds - initial_round`` entries.
     """
     from erasurehead_tpu.parallel import dynamic as dynamic_lib
 
@@ -878,6 +890,18 @@ def train_dynamic(cfg: RunConfig, dataset: Dataset, mesh=None) -> TrainResult:
     X, y = data.Xw, data.yw
 
     state0 = setup.state0
+    start = 0
+    if initial_state is not None:
+        if not 0 <= initial_round < cfg.rounds:
+            raise ValueError(
+                f"initial_round={initial_round} outside [0, {cfg.rounds})"
+            )
+        # strand off the donor phase's placement: an elastic restart carries
+        # state across meshes with different worker counts
+        state0 = jax.tree.map(
+            lambda l: jnp.asarray(np.asarray(l)), initial_state
+        )
+        start = initial_round
     key = jax.random.key(cfg.seed + 1)
 
     def body(Xa, ya, state, xs):
@@ -896,26 +920,35 @@ def train_dynamic(cfg: RunConfig, dataset: Dataset, mesh=None) -> TrainResult:
     def run(state, Xa, ya, lr_c, it_c):
         return jax.lax.scan(partial(body, Xa, ya), state, (lr_c, it_c))
 
-    iters = jnp.arange(cfg.rounds)
+    iters = jnp.arange(start, cfg.rounds)
     t0 = time.perf_counter()
     final_state, (hist, sim, wtimes, collected) = run(
-        state0, X, y, lr_seq, iters
+        state0, X, y, lr_seq[start:], iters
     )
     _hard_sync(final_state)
     wall = time.perf_counter() - t0
 
-    sim = np.asarray(sim, np.float64)
+    # telemetry padded to the full horizon (train()'s restart contract):
+    # rows before ``start`` belong to the donor phase
+    R, W = cfg.rounds, layout.n_workers
+    timeset = np.zeros(R)
+    timeset[start:] = np.asarray(sim, np.float64)
+    wt = -np.ones((R, W))
+    wt[start:] = np.asarray(wtimes, np.float64)
+    col = np.zeros((R, W), dtype=bool)
+    col[start:] = np.asarray(collected)
     return TrainResult(
         params_history=hist,
         final_params=final_state.params,
         final_state=final_state,
-        timeset=sim,
-        worker_times=np.asarray(wtimes, np.float64),
-        collected=np.asarray(collected),
-        sim_total_time=float(sim.sum()),
+        timeset=timeset,
+        worker_times=wt,
+        collected=col,
+        sim_total_time=float(timeset.sum()),
         wall_time=wall,
-        steps_per_sec=cfg.rounds / wall if wall > 0 else 0.0,
+        steps_per_sec=(cfg.rounds - start) / wall if wall > 0 else 0.0,
         n_train=n_train,
+        start_round=start,
         config=cfg,
         layout=layout,
     )

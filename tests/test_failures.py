@@ -316,3 +316,48 @@ def test_elastic_restart_mlp():
     l_end = float(model.loss_mean(
         jax.tree.map(lambda l: l[-1], hist), Xt, yt))
     assert l_end < l_at_death, (l_at_death, l_end)
+
+
+def test_elastic_dynamic_deadline_death_midrun():
+    """VERDICT r3 #7: elastic recovery under the fully on-device control
+    plane (trainer.train_dynamic) with the DEADLINE scheme — a worker dies
+    mid-run while collection decisions live inside the jitted scan, the
+    combination an online pod scheduler actually needs. Loss continuous
+    through the death; dead column carries the -1 sentinel afterwards."""
+    import jax.numpy as jnp
+
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.models.glm import LogisticModel
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+
+    W, R, DEATH = 8, 12, 5
+    ds = generate_gmm(32 * W, 24, n_partitions=W, seed=0)
+    cfg = RunConfig(
+        scheme="deadline", deadline=0.8, n_workers=W, n_stragglers=1,
+        rounds=R, n_rows=32 * W, n_cols=24, lr_schedule=1.0,
+        update_rule="AGD", add_delay=True, seed=0,
+    )
+    res, rep = failures.train_elastic(
+        cfg, ds, {3: DEATH}, mesh=worker_mesh(4), dynamic=True
+    )
+    assert rep.death_round == DEATH
+    assert rep.n_workers_before == W and rep.n_workers_after == W - 1
+    hist = np.asarray(res.params_history)
+    assert hist.shape[0] == R and np.isfinite(hist).all()
+    # dead worker's column: -1 / never collected after the restart,
+    # real clocks before it
+    assert (res.worker_times[DEATH:, 3] == -1.0).all()
+    assert not res.collected[DEATH:, 3].any()
+    assert (res.worker_times[:DEATH, 3] > -1.0).any()
+    # deadline telemetry: every round's protocol time is bounded by the
+    # deadline (the rule costs the full deadline when someone misses it)
+    assert (res.timeset <= cfg.deadline + 1e-6).all()  # f32 sim clock
+    # loss continuity + progress through the restart
+    model = LogisticModel()
+    Xt, yt = jnp.asarray(ds.X_train), jnp.asarray(ds.y_train)
+    losses = [
+        float(model.loss_mean(jnp.asarray(hist[r]), Xt, yt))
+        for r in (0, DEATH - 1, DEATH, R - 1)
+    ]
+    assert losses[3] < losses[1] < losses[0]       # still converging
+    assert losses[2] < losses[1] * 1.25            # no blow-up at restart
