@@ -1,0 +1,129 @@
+"""Evaluate the open lowering decisions against tools/measurements.jsonl.
+
+Each production default flips ONLY on a measured end-to-end win (the
+repo's measurement discipline; profile wins do not transfer — the dense
+flat margin won its profile and lost the step race). This tool encodes the
+round-4 decision table (VERDICT r3 items 1-2) so a healthy relay window is
+followed by mechanical default flips:
+
+  dense  — MARGIN_FLAT_DEFAULT (parallel/step.py): dense_f32_marginflat
+           races the captured dense_f32 per-slot baseline (and the
+           margincols8 candidate, which also remains un-defaulted).
+  fields — the FieldOnehot production constellation (sparse_lanes /
+           fields_margin / fields_scatter under the flat lowering):
+           best of {flat, lanes8_flat, lanes8_onehot_flat, mxu_flat}
+           per shape; a default flips only if the same candidate wins
+           BOTH canonical shapes, else the winners are reported per
+           shape for a shape-conditional default.
+  deduped — whether deduped mode routes FieldOnehot through the same
+           constellation (deduped_fields_* vs the padded per-slot
+           deduped baselines).
+
+Usage: python tools/harvest_decisions.py [tools/measurements.jsonl]
+Prints a markdown digest; exits 0 always (missing entries are reported,
+not fatal — the sweep is resumable).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def load(path: str) -> dict[str, dict]:
+    out: dict[str, dict] = {}
+    try:
+        for ln in open(path):
+            if not ln.strip():
+                continue
+            e = json.loads(ln)
+            out[e["tag"]] = e.get("result", {})
+    except FileNotFoundError:
+        pass
+    return out
+
+
+def val(entries, tag):
+    r = entries.get(tag)
+    return None if r is None else r.get("value")
+
+
+def best(entries, tags):
+    have = [(t, val(entries, t)) for t in tags if val(entries, t) is not None]
+    missing = [t for t in tags if val(entries, t) is None]
+    have.sort(key=lambda tv: -tv[1])
+    return have, missing
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else "tools/measurements.jsonl"
+    e = load(path)
+
+    print("# Harvest decision digest\n")
+
+    # --- dense margin ------------------------------------------------------
+    dense_tags = ["dense_f32", "dense_f32_margincols8", "dense_f32_marginflat"]
+    have, missing = best(e, dense_tags)
+    print("## dense margin lowering (MARGIN_FLAT_DEFAULT, step.py)\n")
+    for t, v in have:
+        print(f"- {t}: {v} steps/s")
+    for t in missing:
+        print(f"- {t}: MISSING")
+    if have and not missing:
+        winner = have[0][0]
+        base = val(e, "dense_f32")
+        if winner == "dense_f32_marginflat" and have[0][1] > base:
+            print(f"\n=> FLIP MARGIN_FLAT_DEFAULT=True ({have[0][1]} > {base})")
+        else:
+            print(f"\n=> keep per-slot defaults; winner is {winner}")
+    else:
+        print("\n=> UNDECIDED (entries missing)")
+
+    # --- fields constellation, faithful ------------------------------------
+    for shape, baseline in (("covtype", "sparse_covtype_faithful_fields_flat"),
+                            ("amazon", "sparse_amazon_faithful_fields_flat")):
+        tags = [
+            f"sparse_{shape}_faithful_fields_flat",
+            f"sparse_{shape}_faithful_fields_lanes8_flat",
+            f"sparse_{shape}_faithful_fields_lanes8_onehot_flat",
+            f"sparse_{shape}_faithful_fields_mxu_flat",
+        ]
+        have, missing = best(e, tags)
+        print(f"\n## faithful {shape} fields constellation\n")
+        for t, v in have:
+            vb = e.get(t, {}).get("vs_baseline")
+            print(f"- {t}: {v} steps/s (vs_baseline {vb})")
+        for t in missing:
+            print(f"- {t}: MISSING")
+        if have:
+            print(f"\n=> current winner: {have[0][0]} at {have[0][1]} steps/s"
+                  + (" (entries still missing)" if missing else ""))
+
+    # --- deduped fields ----------------------------------------------------
+    for shape in ("covtype", "amazon"):
+        tags = [
+            f"sparse_{shape}_deduped",
+            f"sparse_{shape}_deduped_fields",
+            f"sparse_{shape}_deduped_fields_flat",
+            f"sparse_{shape}_deduped_fields_lanes8_flat",
+            f"sparse_{shape}_deduped_fields_mxu_flat",
+        ]
+        have, missing = best(e, tags)
+        print(f"\n## deduped {shape}\n")
+        for t, v in have:
+            print(f"- {t}: {v} steps/s")
+        for t in missing:
+            print(f"- {t}: MISSING")
+        if have:
+            print(f"\n=> current winner: {have[0][0]} at {have[0][1]} steps/s"
+                  + (" (entries still missing)" if missing else ""))
+
+    # --- round-4 evidence entries ------------------------------------------
+    print("\n## round-4 evidence entries\n")
+    for tag in ("measured_arrival_agc", "dense_hbm_crosscheck"):
+        r = e.get(tag)
+        print(f"- {tag}: " + ("MISSING" if r is None else json.dumps(r)[:300]))
+
+
+if __name__ == "__main__":
+    main()
