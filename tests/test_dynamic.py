@@ -236,6 +236,49 @@ def test_train_dynamic_margin_flat_matches_per_slot():
     np.testing.assert_allclose(hists["on"], hists["off"], rtol=2e-4, atol=2e-5)
 
 
+def test_train_dynamic_split_restart_matches_unsplit():
+    """The restart contract (initial_state/initial_round): splitting a
+    dynamic run at any round and resuming from the carried state must
+    reproduce the unsplit trajectory EXACTLY — per-round randomness is
+    fold_in(key, absolute_round) and lr is absolutely indexed, so the
+    resumed scan replays the identical per-round programs."""
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+    from erasurehead_tpu.train import trainer
+
+    R, SPLIT = 10, 4
+    data = generate_gmm(16 * W, 12, n_partitions=W, seed=0)
+
+    def cfg(rounds, lr):
+        return RunConfig(
+            scheme="approx", n_workers=W, n_stragglers=2, num_collect=8,
+            rounds=rounds, n_rows=16 * W, n_cols=12, lr_schedule=lr,
+            update_rule="AGD", add_delay=True, seed=0,
+        )
+
+    mesh = worker_mesh(4)
+    full = trainer.train_dynamic(cfg(R, 0.5), data, mesh=mesh)
+    lr_full = cfg(R, 0.5).resolve_lr_schedule()
+    p1 = trainer.train_dynamic(
+        cfg(SPLIT, lr_full[:SPLIT]), data, mesh=mesh
+    )
+    p2 = trainer.train_dynamic(
+        cfg(R, lr_full), data, mesh=mesh,
+        initial_state=p1.final_state, initial_round=SPLIT,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(p2.params_history),
+        np.asarray(full.params_history)[SPLIT:],
+    )
+    # padded telemetry: donor rows carry the sentinels, live rows match
+    assert (p2.worker_times[:SPLIT] == -1.0).all()
+    assert (p2.timeset[:SPLIT] == 0.0).all()
+    np.testing.assert_allclose(
+        p2.timeset[SPLIT:], full.timeset[SPLIT:], rtol=1e-6
+    )
+    assert p2.start_round == SPLIT
+
+
 def test_ranks_tie_break_matches_order():
     t = jnp.asarray([0.0, 0.0, 1.0, 0.0])
     ranks = np.asarray(dynamic._ranks(t))
