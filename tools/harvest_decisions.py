@@ -79,6 +79,18 @@ def main() -> None:
     else:
         print("\n=> UNDECIDED (entries missing)")
 
+    # --- dense bf16 frontier -----------------------------------------------
+    bf_tags = ["dense_bf16", "dense_bf16_flat", "dense_bf16_marginflat"]
+    have, missing = best(e, bf_tags)
+    print("\n## dense bf16 frontier\n")
+    for t, v in have:
+        print(f"- {t}: {v} steps/s")
+    for t in missing:
+        print(f"- {t}: MISSING")
+    if have:
+        print(f"\n=> current winner: {have[0][0]} at {have[0][1]} steps/s"
+              + (" (entries still missing)" if missing else ""))
+
     # --- fields constellation, faithful ------------------------------------
     for shape, baseline in (("covtype", "sparse_covtype_faithful_fields_flat"),
                             ("amazon", "sparse_amazon_faithful_fields_flat")):

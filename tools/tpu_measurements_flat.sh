@@ -48,6 +48,9 @@ run dense_f32_flat       1800 env BENCH_FLAT=on python bench.py
 # profiled winners combined (margin_matmul2d 1.587 ms; transpose near-
 # free per two_pass-vs-margin_only). Races the captured dense_f32.
 run dense_f32_marginflat 1800 env BENCH_MARGIN_FLAT=on python bench.py
+# bf16 data (the measured 581-vs-462 win) x the hybrid margin candidate:
+# if marginflat wins f32, this is the composed production frontier
+run dense_bf16_marginflat 1800 env BENCH_MARGIN_FLAT=on BENCH_DTYPE=bfloat16 python bench.py
 run dense_profile_flat   1200 python tools/profile_dense.py \
     --only flatstack_full,flatstack_bf16
 run sparse_profile_flatpairs 1200 python tools/profile_sparse.py \
