@@ -495,6 +495,14 @@ def test_fields_lanes_oversized_single_falls_back_to_scalar(monkeypatch):
         )
         jaxpr = str(jax.make_jaxpr(lambda u: matvec(fo, u))(v))
         assert "optimization_barrier" not in jaxpr  # no replicated tables
+        # mixed case: cap exactly fits the 9-field's [9, L] table but not
+        # the 13-field's -> one lane table + one scalar gather, still exact
+        monkeypatch.setattr(features, "LANE_TABLE_BYTES_CAP", 9 * L * 4)
+        np.testing.assert_allclose(
+            np.asarray(matvec(fo, v)), base_mv, rtol=1e-5, atol=1e-5
+        )
+        jaxpr = str(jax.make_jaxpr(lambda u: matvec(fo, u))(v))
+        assert jaxpr.count("optimization_barrier") == 1
     finally:
         features.set_sparse_lanes(None)
 
