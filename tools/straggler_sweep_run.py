@@ -1,0 +1,84 @@
+"""Produce the north-star curve (time-to-target-loss vs n_stragglers for
+AGC/EGC/FRC/avoidstragg/uncoded — BASELINE.json's stated metric) at a
+chosen worker count, as a committed artifact pair
+``artifacts/straggler_sweep_w{W}.{json,png}``.
+
+The W=12 artifact came from an earlier ad-hoc run; this script is its
+reproducible home, defaulting to the CANONICAL reference scale (W=30,
+the flagship 13200x100 shape, 100 AGD rounds, the reference's seeded
+delay schedule — run_approx_coding.sh:2-9's frame with W=30 folded onto
+whatever devices exist). Simulated-clock science: platform-independent.
+
+Usage: python tools/straggler_sweep_run.py [--workers 30] [--rounds 100]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=30)
+    ap.add_argument("--rounds", type=int, default=100)
+    ap.add_argument("--rows", type=int, default=13200)
+    ap.add_argument("--cols", type=int, default=100)
+    ap.add_argument("--num-collect", type=int, default=None,
+                    help="AGC collection target (default W/2)")
+    ns = ap.parse_args()
+    W = ns.workers
+    collect = ns.num_collect or W // 2
+
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.train import experiments, plots
+    from erasurehead_tpu.utils.config import RunConfig
+
+    rows = W * max(1, round(ns.rows / W))
+    base = RunConfig(
+        scheme="naive", n_workers=W, n_stragglers=0, num_collect=collect,
+        rounds=ns.rounds, n_rows=rows, n_cols=ns.cols, lr_schedule=1.0,
+        update_rule="AGD", add_delay=True, seed=0,
+    )
+    data = generate_gmm(rows, ns.cols, n_partitions=W, seed=0)
+
+    # FRC-family schemes need (s+1) | W; MDS/avoidstragg take any s < W
+    frc_s = [s for s in range(1, 6) if W % (s + 1) == 0]
+    sweep = {
+        "naive": [0],
+        "cyccoded": list(range(1, 6)),
+        "avoidstragg": list(range(1, 6)),
+        "repcoded": frc_s,
+        "approx": frc_s,
+    }
+    t0 = time.time()
+    summaries = experiments.straggler_sweep(base, data, sweep)
+    print(f"sweep: {len(summaries)} runs in {time.time() - t0:.0f}s",
+          file=sys.stderr)
+
+    out_dir = os.path.join(os.path.dirname(__file__), "..", "artifacts")
+    jpath = os.path.join(out_dir, f"straggler_sweep_w{W}.json")
+    with open(jpath, "w") as f:
+        json.dump([s.row() for s in summaries], f, indent=1)
+    by_scheme: dict[str, list] = {}
+    for s in summaries:
+        by_scheme.setdefault(s.config.scheme.value, []).append(s)
+    ppath = plots.save_sweep_figure(
+        by_scheme,
+        os.path.join(out_dir, f"straggler_sweep_w{W}.png"),
+        title=f"time to target loss vs stragglers (W={W}, AGD)",
+    )
+    for s in summaries:
+        print(f"  {s.label}: time_to_target="
+              f"{s.time_to_target if s.time_to_target is not None else 'never'}"
+              f" sim_rate={s.sim_steps_per_sec:.3f} it/s",
+          file=sys.stderr)
+    print(json.dumps({"json": jpath, "png": ppath, "runs": len(summaries)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
