@@ -38,6 +38,22 @@ run() {
     echo "=== $tag: already captured, skipping (RERUN_ALL=1 to redo)" >&2
     return
   fi
+  # Absolute harvest deadline (HARVEST_DEADLINE_UNIX, set by the watcher):
+  # the single-client tunnel must be FREE before the round-end driver
+  # bench, so no entry may start that cannot finish in the remaining time
+  # — clamp its timeout, and stop the program when <5 min remain.
+  if [ -n "${HARVEST_DEADLINE_UNIX:-}" ]; then
+    local rem=$(( HARVEST_DEADLINE_UNIX - $(date +%s) ))
+    if [ "$rem" -lt 300 ]; then
+      echo "harvest deadline reached ($rem s left) — stopping program" \
+           "(resumable; nothing captured is lost)" >&2
+      exit 75  # EX_TEMPFAIL
+    fi
+    if [ "$tmo" -gt $(( rem - 120 )) ]; then
+      tmo=$(( rem - 120 ))
+      echo "=== $tag: timeout clamped to $tmo s (harvest deadline)" >&2
+    fi
+  fi
   echo "=== $tag ($tmo s): $*" >&2
   local line rc verdict
   line="$(timeout -s INT -k 90 "$tmo" "$@" 2>"$OUT.$tag.log" | tail -1)"

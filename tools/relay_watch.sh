@@ -11,6 +11,13 @@ cd "$(dirname "$0")/.."
 LOG=tools/relay_watch.log
 MAX_HOURS="${1:-11}"
 DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+# Absolute cutoff (epoch seconds) after which the tunnel must be free —
+# the round-end driver bench is the next single client. Clamp the poll
+# deadline to it and export so measure_lib clamps per-entry timeouts.
+if [ -n "${HARVEST_DEADLINE_UNIX:-}" ]; then
+  [ "$DEADLINE" -gt "$HARVEST_DEADLINE_UNIX" ] && DEADLINE="$HARVEST_DEADLINE_UNIX"
+  export HARVEST_DEADLINE_UNIX
+fi
 export PYTHONPATH="${PYTHONPATH:-}:$(pwd)"
 # persistent compile cache (see measure_lib.sh) — also covers the fresh
 # bench.py below
@@ -53,6 +60,13 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
       # then. 2700s > bench.py's worst-case internal attempt budget
       # (~120+900 + 120+420 + 120+900), so its one-JSON-line contract
       # cannot be killed mid-fallback.
+      if [ -n "${HARVEST_DEADLINE_UNIX:-}" ] \
+         && [ $(( HARVEST_DEADLINE_UNIX - $(date +%s) )) -lt 2760 ]; then
+        echo "$(date -Is) sweep done but <46 min to harvest deadline —" \
+             "skipping fresh bench (driver's round-end bench covers it);" \
+             "watcher exiting" >> "$LOG"
+        exit 0
+      fi
       echo "$(date -Is) running fresh bench.py for BENCH_TPU_LAST" >> "$LOG"
       timeout 2700 python bench.py >> "$LOG" 2>&1
       echo "$(date -Is) fresh bench exit=$? — watcher exiting" >> "$LOG"
