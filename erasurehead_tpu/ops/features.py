@@ -532,8 +532,9 @@ def _lanes_fields_matvec(sizes, n_cols, L, local, v):
             jnp.broadcast_to(table[:, None], (table.shape[0], L))
         )
         acc = acc + jnp.take(wide, code, axis=0)  # [n, L]
-    lane_sum = acc.sum(axis=1) * (1.0 / L) if not isinstance(acc, float) else 0.0
-    return lane_sum + scalar_acc
+    if not isinstance(acc, float):  # at least one lane table was built
+        scalar_acc = scalar_acc + acc.sum(axis=1) * (1.0 / L)
+    return scalar_acc
 
 
 def _lanes_fields_matvec_fwd(sizes, n_cols, L, local, v):

@@ -55,6 +55,24 @@ def best(entries, tags):
     return have, missing
 
 
+def section(entries, title, tags, extra=None):
+    """Print one decision section: each tag's value or MISSING, then the
+    current winner. Returns (have, missing) for any follow-up rule."""
+    have, missing = best(entries, tags)
+    print(f"\n## {title}\n")
+    for t, v in have:
+        line = f"- {t}: {v} steps/s"
+        if extra:
+            line += f" (vs_baseline {entries.get(t, {}).get('vs_baseline')})"
+        print(line)
+    for t in missing:
+        print(f"- {t}: MISSING")
+    if have:
+        print(f"\n=> current winner: {have[0][0]} at {have[0][1]} steps/s"
+              + (" (entries still missing)" if missing else ""))
+    return have, missing
+
+
 def main() -> None:
     path = sys.argv[1] if len(sys.argv) > 1 else "tools/measurements.jsonl"
     e = load(path)
@@ -62,73 +80,45 @@ def main() -> None:
     print("# Harvest decision digest\n")
 
     # --- dense margin ------------------------------------------------------
-    dense_tags = ["dense_f32", "dense_f32_margincols8", "dense_f32_marginflat"]
-    have, missing = best(e, dense_tags)
-    print("## dense margin lowering (MARGIN_FLAT_DEFAULT, step.py)\n")
-    for t, v in have:
-        print(f"- {t}: {v} steps/s")
-    for t in missing:
-        print(f"- {t}: MISSING")
+    have, missing = section(
+        e, "dense margin lowering (MARGIN_FLAT_DEFAULT, step.py)",
+        ["dense_f32", "dense_f32_margincols8", "dense_f32_marginflat"],
+    )
     if have and not missing:
-        winner = have[0][0]
-        base = val(e, "dense_f32")
+        winner, base = have[0][0], val(e, "dense_f32")
         if winner == "dense_f32_marginflat" and have[0][1] > base:
-            print(f"\n=> FLIP MARGIN_FLAT_DEFAULT=True ({have[0][1]} > {base})")
+            print(f"=> FLIP MARGIN_FLAT_DEFAULT=True ({have[0][1]} > {base})")
         else:
-            print(f"\n=> keep per-slot defaults; winner is {winner}")
+            print(f"=> keep per-slot defaults; winner is {winner}")
     else:
-        print("\n=> UNDECIDED (entries missing)")
+        print("=> UNDECIDED (entries missing)")
 
-    # --- dense bf16 frontier -----------------------------------------------
-    bf_tags = ["dense_bf16", "dense_bf16_flat", "dense_bf16_marginflat"]
-    have, missing = best(e, bf_tags)
-    print("\n## dense bf16 frontier\n")
-    for t, v in have:
-        print(f"- {t}: {v} steps/s")
-    for t in missing:
-        print(f"- {t}: MISSING")
-    if have:
-        print(f"\n=> current winner: {have[0][0]} at {have[0][1]} steps/s"
-              + (" (entries still missing)" if missing else ""))
+    section(e, "dense bf16 frontier",
+            ["dense_bf16", "dense_bf16_flat", "dense_bf16_marginflat"])
 
-    # --- fields constellation, faithful ------------------------------------
-    for shape, baseline in (("covtype", "sparse_covtype_faithful_fields_flat"),
-                            ("amazon", "sparse_amazon_faithful_fields_flat")):
-        tags = [
-            f"sparse_{shape}_faithful_fields_flat",
-            f"sparse_{shape}_faithful_fields_lanes8_flat",
-            f"sparse_{shape}_faithful_fields_lanes8_onehot_flat",
-            f"sparse_{shape}_faithful_fields_mxu_flat",
-        ]
-        have, missing = best(e, tags)
-        print(f"\n## faithful {shape} fields constellation\n")
-        for t, v in have:
-            vb = e.get(t, {}).get("vs_baseline")
-            print(f"- {t}: {v} steps/s (vs_baseline {vb})")
-        for t in missing:
-            print(f"- {t}: MISSING")
-        if have:
-            print(f"\n=> current winner: {have[0][0]} at {have[0][1]} steps/s"
-                  + (" (entries still missing)" if missing else ""))
-
-    # --- deduped fields ----------------------------------------------------
     for shape in ("covtype", "amazon"):
-        tags = [
-            f"sparse_{shape}_deduped",
-            f"sparse_{shape}_deduped_fields",
-            f"sparse_{shape}_deduped_fields_flat",
-            f"sparse_{shape}_deduped_fields_lanes8_flat",
-            f"sparse_{shape}_deduped_fields_mxu_flat",
-        ]
-        have, missing = best(e, tags)
-        print(f"\n## deduped {shape}\n")
-        for t, v in have:
-            print(f"- {t}: {v} steps/s")
-        for t in missing:
-            print(f"- {t}: MISSING")
-        if have:
-            print(f"\n=> current winner: {have[0][0]} at {have[0][1]} steps/s"
-                  + (" (entries still missing)" if missing else ""))
+        section(
+            e, f"faithful {shape} fields constellation",
+            [
+                f"sparse_{shape}_faithful_fields_flat",
+                f"sparse_{shape}_faithful_fields_lanes8_flat",
+                f"sparse_{shape}_faithful_fields_lanes8_onehot_flat",
+                f"sparse_{shape}_faithful_fields_mxu_flat",
+            ],
+            extra=True,
+        )
+
+    for shape in ("covtype", "amazon"):
+        section(
+            e, f"deduped {shape}",
+            [
+                f"sparse_{shape}_deduped",
+                f"sparse_{shape}_deduped_fields",
+                f"sparse_{shape}_deduped_fields_flat",
+                f"sparse_{shape}_deduped_fields_lanes8_flat",
+                f"sparse_{shape}_deduped_fields_mxu_flat",
+            ],
+        )
 
     # --- round-4 evidence entries ------------------------------------------
     print("\n## round-4 evidence entries\n")
