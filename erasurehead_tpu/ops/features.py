@@ -157,17 +157,20 @@ PAIR_TABLE_CAP = 1 << 21
 LANE_TABLE_BYTES_CAP = 1 << 28  # 256 MB
 
 
-def fields_margin_plan(field_sizes, lanes=None):
+def fields_margin_plan(field_sizes, lanes=None, itemsize=4):
     """The pairing plan the margin matvec will use at a given lane width.
 
     Lane replication shrinks the effective pair-table cap so one
-    [entries, L] f32 table stays within LANE_TABLE_BYTES_CAP. Exposed so
-    traffic models (tools/bench_sparse.py) can count the true number of
-    margin lookups per row instead of assuming all-pairs.
+    [entries, L] table stays within LANE_TABLE_BYTES_CAP. ``itemsize`` is
+    the table element width in bytes (tables inherit the param dtype; 4 =
+    the f32 default) — the same width the runtime over-cap guard in
+    _lanes_fields_matvec charges, so plan and guard agree for any dtype.
+    Exposed so traffic models (tools/bench_sparse.py) can count the true
+    number of margin lookups per row instead of assuming all-pairs.
     """
     cap = PAIR_TABLE_CAP
     if lanes is not None:
-        cap = min(cap, LANE_TABLE_BYTES_CAP // (4 * lanes))
+        cap = min(cap, LANE_TABLE_BYTES_CAP // (itemsize * lanes))
     return _greedy_pairing(tuple(field_sizes), cap=cap)
 
 
@@ -520,9 +523,10 @@ def _lanes_fields_matvec(sizes, n_cols, L, local, v):
     acc = 0.0
     scalar_acc = 0.0
     for table, code in _plan_tables(
-        fields_margin_plan(sizes, L), sizes, local, v
+        fields_margin_plan(sizes, L, itemsize=jnp.dtype(v.dtype).itemsize),
+        sizes, local, v,
     ):
-        if table.shape[0] * L * 4 > LANE_TABLE_BYTES_CAP:
+        if table.shape[0] * L * table.dtype.itemsize > LANE_TABLE_BYTES_CAP:
             # a single field too large even unreplicated to fit the lane
             # budget (pairs are already excluded by the lane-aware plan):
             # scalar-gather it rather than build an over-cap [B, L] table

@@ -335,6 +335,20 @@ def train(
     onward runs with THIS config's layout/mesh while the optimizer state
     carries over (its leaves are worker-count independent).
     """
+    # argument validation up front, before any device setup (ADVICE r4):
+    # a bare initial_round would otherwise silently run the full horizon
+    # from round 0 with telemetry misrepresenting the request. resume=True
+    # derives its start round from the checkpoint, never from initial_round.
+    if initial_round != 0 and initial_state is None:
+        raise ValueError(
+            f"initial_round={initial_round} requires initial_state: a "
+            "mid-schedule restart resumes from donor state (resume=True "
+            "takes its start round from the checkpoint instead)"
+        )
+    if checkpoint_every is not None and checkpoint_every < 1:
+        raise ValueError(
+            f"checkpoint_every must be >= 1, got {checkpoint_every}"
+        )
     faithful = cfg.compute_mode == ComputeMode.FAITHFUL
     setup = _setup_run(cfg, dataset, mesh, faithful=faithful)
     layout, model, mesh, data = setup.layout, setup.model, setup.mesh, setup.data
@@ -433,10 +447,6 @@ def train(
             partial(body, Xa, ya), state, (lr_c, w_c, it_c)
         )
 
-    if checkpoint_every is not None and checkpoint_every < 1:
-        raise ValueError(
-            f"checkpoint_every must be >= 1, got {checkpoint_every}"
-        )
     start_round = 0
     if initial_state is not None:
         if resume:
@@ -867,6 +877,12 @@ def train_dynamic(
     """
     from erasurehead_tpu.parallel import dynamic as dynamic_lib
 
+    # mirror train()'s restart guard, before any device setup (ADVICE r4)
+    if initial_round != 0 and initial_state is None:
+        raise ValueError(
+            f"initial_round={initial_round} requires initial_state: a "
+            "mid-schedule restart resumes from donor state"
+        )
     setup = _setup_run(cfg, dataset, mesh, faithful=True)
     layout, model, mesh, data = setup.layout, setup.model, setup.mesh, setup.data
     sched_fn = dynamic_lib.make_round_schedule_fn(

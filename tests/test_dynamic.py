@@ -279,6 +279,25 @@ def test_train_dynamic_split_restart_matches_unsplit():
     assert p2.start_round == SPLIT
 
 
+def test_train_dynamic_initial_round_without_state_rejected():
+    """A bare initial_round (no donor state) must fail loudly instead of
+    silently running the full horizon from round 0 (ADVICE r4)."""
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+    from erasurehead_tpu.train import trainer
+
+    data = generate_gmm(16 * W, 12, n_partitions=W, seed=0)
+    cfg = RunConfig(
+        scheme="approx", n_workers=W, n_stragglers=2, num_collect=8,
+        rounds=6, n_rows=16 * W, n_cols=12, lr_schedule=0.5, seed=0,
+    )
+    mesh = worker_mesh(4)
+    with pytest.raises(ValueError, match="requires initial_state"):
+        trainer.train_dynamic(cfg, data, mesh=mesh, initial_round=3)
+    with pytest.raises(ValueError, match="requires initial_state"):
+        trainer.train(cfg, data, mesh=mesh, initial_round=3)
+
+
 def test_ranks_tie_break_matches_order():
     t = jnp.asarray([0.0, 0.0, 1.0, 0.0])
     ranks = np.asarray(dynamic._ranks(t))

@@ -188,6 +188,57 @@ def test_jobset_tpu_requests_limits_mismatch_rejected(tmp_path):
         tpu_fleet.validate_jobset(_write(tmp_path, doc))
 
 
+def test_jobset_tpu_quantity_string_accepted(tmp_path):
+    """k8s quantities are YAML scalars: "4" and 4 are the same quantity
+    and must not spuriously fail the requests==limits check (ADVICE r4)."""
+    doc = _load()
+    _pod(doc)["containers"][0]["resources"]["limits"]["google.com/tpu"] = "4"
+    summary = tpu_fleet.validate_jobset(_write(tmp_path, doc))
+    assert summary["jobs"][0]["topology"] == "2x2x4"
+
+
+def test_jobset_topology_without_tpu_resource_rejected(tmp_path):
+    """A pod selecting a TPU topology but declaring no google.com/tpu
+    resources would never schedule onto TPU — reject it (ADVICE r4)."""
+    doc = _load()
+    del _pod(doc)["containers"][0]["resources"]["requests"]["google.com/tpu"]
+    del _pod(doc)["containers"][0]["resources"]["limits"]["google.com/tpu"]
+    with pytest.raises(ValueError, match="no container declares"):
+        tpu_fleet.validate_jobset(_write(tmp_path, doc))
+
+
+def test_jobset_non_integer_tpu_quantity_rejected(tmp_path):
+    doc = _load()
+    _pod(doc)["containers"][0]["resources"]["requests"]["google.com/tpu"] = "four"
+    with pytest.raises(ValueError, match="not an integer chip count"):
+        tpu_fleet.validate_jobset(_write(tmp_path, doc))
+
+
+def test_jobset_tpu_limits_only_accepted(tmp_path):
+    """k8s defaults extended-resource requests to limits — the documented
+    GKE TPU pattern declares google.com/tpu under limits only."""
+    doc = _load()
+    del _pod(doc)["containers"][0]["resources"]["requests"]["google.com/tpu"]
+    summary = tpu_fleet.validate_jobset(_write(tmp_path, doc))
+    assert summary["jobs"][0]["topology"] == "2x2x4"
+
+
+def test_jobset_tpu_requests_only_rejected(tmp_path):
+    doc = _load()
+    del _pod(doc)["containers"][0]["resources"]["limits"]["google.com/tpu"]
+    with pytest.raises(ValueError, match="requests only"):
+        tpu_fleet.validate_jobset(_write(tmp_path, doc))
+
+
+def test_jobset_nonpositive_tpu_quantity_rejected(tmp_path):
+    doc = _load()
+    res = _pod(doc)["containers"][0]["resources"]
+    res["requests"]["google.com/tpu"] = 0
+    res["limits"]["google.com/tpu"] = 0
+    with pytest.raises(ValueError, match="must be >= 1"):
+        tpu_fleet.validate_jobset(_write(tmp_path, doc))
+
+
 def test_jobset_embedded_cli_drift_rejected(tmp_path):
     """The manifest's training command is parsed against the REAL CLI
     surface: renaming a flag in cli.py (or typoing one in the yaml) fails

@@ -85,11 +85,16 @@ def _parse_xplane(logdir):
     )
     if not paths:
         return {"error": "no xplane.pb produced"}
-    xspace = xplane_pb2.XSpace()
-    with open(paths[0], "rb") as f:
-        xspace.ParseFromString(f.read())
-    summary = {"planes": []}
-    for plane in xspace.planes:
+    # multi-host / per-device dumps emit one xplane.pb each — parse them
+    # all and record the count so partial coverage is visible (ADVICE r4)
+    summary = {"planes": [], "xplane_files": len(paths)}
+    planes = []
+    for p in sorted(paths):
+        xspace = xplane_pb2.XSpace()
+        with open(p, "rb") as f:
+            xspace.ParseFromString(f.read())
+        planes.extend(xspace.planes)
+    for plane in planes:
         ev_names = {m.id: m.name for m in plane.event_metadata.values()}
         st_names = {m.id: m.name for m in plane.stat_metadata.values()}
         op_ps: dict[str, int] = {}

@@ -251,27 +251,64 @@ def validate_jobset(path: str) -> dict:
              f"job {rj.get('name')}: needs at least one container")
         volumes = {v.get("name") for v in pod.get("volumes") or []}
         topo = (pod.get("nodeSelector") or {}).get("cloud.google.com/gke-tpu-topology")
+
+        def chip_count(res_block, where, cname):
+            # k8s quantities arrive as YAML scalars (4 or "4"); normalize
+            # to int so equivalent quantities compare equal
+            q = (res_block or {}).get("google.com/tpu")
+            if q is None:
+                return None
+            try:
+                n = int(str(q))
+            except ValueError:
+                raise ValueError(
+                    f"{path}: container {cname}: google.com/tpu {where} "
+                    f"{q!r} is not an integer chip count"
+                )
+            if n < 1:
+                raise ValueError(
+                    f"{path}: container {cname}: google.com/tpu {where} "
+                    f"must be >= 1, got {n}"
+                )
+            return n
+
+        pod_chips = None
         for c in containers:
             need(c.get("name") and c.get("image"),
                  f"job {rj.get('name')}: container needs name and image")
             res = c.get("resources") or {}
-            chips = (res.get("requests") or {}).get("google.com/tpu")
-            need(chips == (res.get("limits") or {}).get("google.com/tpu"),
-                 f"container {c.get('name')}: google.com/tpu requests must equal limits")
+            req = chip_count(res.get("requests"), "requests", c.get("name"))
+            lim = chip_count(res.get("limits"), "limits", c.get("name"))
+            # k8s defaults extended-resource requests to limits when only
+            # limits is declared (the documented GKE TPU pattern), but an
+            # extended resource declared only under requests is invalid
+            need(lim is not None or req is None,
+                 f"container {c.get('name')}: google.com/tpu declared under requests only — extended resources need limits")
+            need(req is None or req == lim,
+                 f"container {c.get('name')}: google.com/tpu requests must equal limits (got {req} vs {lim})")
+            chips = lim
             for vm in c.get("volumeMounts") or []:
                 need(vm.get("name") in volumes,
                      f"container {c.get('name')}: volumeMount {vm.get('name')!r} has no declared volume")
-            if topo and chips:
-                total = 1
-                for d in str(topo).split("x"):
-                    total *= int(d)
-                need(total == par * int(chips),
-                     f"topology {topo} has {total} chips but parallelism {par} x {chips} chips/host = {par * int(chips)}")
+            if chips is not None:
+                pod_chips = chips
+                if topo:
+                    total = 1
+                    for d in str(topo).split("x"):
+                        total *= int(d)
+                    need(total == par * chips,
+                         f"topology {topo} has {total} chips but parallelism {par} x {chips} chips/host = {par * chips}")
             cmd = c.get("command")
             need(cmd, f"container {c.get('name')}: needs a command")
             joined = " ".join(cmd) if isinstance(cmd, list) else str(cmd)
             if "erasurehead_tpu.cli" in joined:
                 _validate_cli_fragment(joined)
+        if topo:
+            # a pod that selects a TPU topology but declares no google.com/tpu
+            # resources would never be scheduled onto TPU by GKE (ADVICE r4)
+            need(pod_chips is not None,
+                 f"job {rj.get('name')}: nodeSelector requests TPU topology "
+                 f"{topo} but no container declares google.com/tpu resources")
         summary["jobs"].append({"name": rj["name"], "parallelism": par,
                                 "topology": topo})
     return summary

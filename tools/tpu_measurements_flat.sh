@@ -14,10 +14,14 @@ export PYTHONPATH="${PYTHONPATH:-}:$(pwd)"
 
 . "$(dirname "$0")/measure_lib.sh"
 
-# Ordered by decision value for a short window:
+# Ordered by decision value for a short window (VERDICT r4 ordering):
+# 0: the structure-independent bandwidth crosscheck FIRST — it decides
+#    whether the dense ">650 steps/s unreachable" ceiling claim stands
+#    or every margin variant gets re-raced (VERDICT r4 #2);
 # 1-2: validate the fields fix (auto->flat flipped on the r3 evidence) at
-#      both canonical shapes; 3: decide FLAT_GRAD_DEFAULT for dense;
-#      then attribution and the rest of the grid.
+#      both canonical shapes; then the fields x lanes constellation;
+#      then marginflat for MARGIN_FLAT_DEFAULT; then the rest.
+run dense_hbm_crosscheck 900 python tools/profile_hbm.py
 run sparse_covtype_faithful_fields_flat 1200 python tools/bench_sparse.py \
     --shape covtype --format fields --flat on
 run sparse_amazon_faithful_fields_flat  1200 python tools/bench_sparse.py \
@@ -51,6 +55,17 @@ run dense_f32_marginflat 1800 env BENCH_MARGIN_FLAT=on python bench.py
 # bf16 data (the measured 581-vs-462 win) x the hybrid margin candidate:
 # if marginflat wins f32, this is the composed production frontier
 run dense_bf16_marginflat 1800 env BENCH_MARGIN_FLAT=on BENCH_DTYPE=bfloat16 python bench.py
+# measured-arrival AGC (VERDICT r4 #4): worker_timeset as a device
+# measurement; writes artifacts/measured_arrival_tpu.json. Also listed in
+# tpu_measurements.sh — the tag-skip protocol makes that a no-op.
+run measured_arrival_agc 900 python tools/bench_measured.py
+# repeat captures of the round-3 single-window headline wins (VERDICT r4
+# #8): same commands, fresh tags, so each headline sparse number carries
+# window variance like the dense ones do (462-530 across windows).
+run sparse_covtype_faithful_fields_flat_rep 1200 python tools/bench_sparse.py \
+    --shape covtype --format fields --flat on
+run sparse_amazon_faithful_fields_flat_rep  1200 python tools/bench_sparse.py \
+    --shape amazon --format fields --flat on
 run dense_profile_flat   1200 python tools/profile_dense.py \
     --only flatstack_full,flatstack_bf16
 run sparse_profile_flatpairs 1200 python tools/profile_sparse.py \
