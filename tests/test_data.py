@@ -279,6 +279,100 @@ class TestGenuineSchemas:
                      "worker_timeset"):
             assert any(part in n for n in names), (part, names)
 
+    def test_covtype_fixture_end_to_end(self, tmp_path):
+        """Genuine UCI covtype.data layout (10 quantitative + 4 wilderness
+        + 40 soil columns + Cover_Type, the file fetch_covtype parses ≙
+        arrange_real_data.py:147-178) -> raw-file preparer path -> class
+        {1,2} binarization -> reference layout -> AGC training."""
+        import shutil
+
+        src = tmp_path / "raw"
+        src.mkdir()
+        shutil.copy(
+            os.path.join(FIXTURES, "covtype_head.data"),
+            src / "covtype.data",
+        )
+        ds = real.prepare("covtype", str(src))
+        # only classes {1,2} survive, mapped onto ±1
+        assert set(np.unique(ds.y_train)) <= {-1.0, 1.0}
+        assert ds.n_samples == 79  # 80% of the 99 class-1/2 fixture rows
+        out = str(tmp_path / "prepared")
+        prepare.main(
+            ["real", "--dataset", "covtype", "--source", str(src),
+             "--workers", "4", "--out", out]
+        )
+        back = data_io.read_reference_layout(
+            os.path.join(out, "covtype/4"), 4, sparse=True
+        )
+        # one-hot per original column: 54 features + bias = 55 nnz/row
+        assert (np.diff(back.X_train.tocsr().indptr) == 55).all()
+        cfg = RunConfig(
+            scheme="approx", n_workers=4, n_stragglers=1, num_collect=3,
+            rounds=6, n_rows=back.n_samples, n_cols=back.n_features,
+            dataset="covtype", lr_schedule=1.0, add_delay=True, seed=0,
+        )
+        res = trainer.train(cfg, back)
+        assert np.isfinite(np.asarray(res.params_history)).all()
+
+    def test_covtype_wrong_column_count_rejected(self, tmp_path):
+        (tmp_path / "covtype.data").write_text(
+            "\n".join(",".join("1" for _ in range(54)) for _ in range(3))
+        )
+        with pytest.raises(ValueError, match="expected 55 columns"):
+            real.prepare("covtype", str(tmp_path))
+
+    def test_kc_house_loc_slice_against_real_header(self):
+        df = pd.read_csv(os.path.join(FIXTURES, "kc_house_head.csv"))
+        # the genuine Kaggle kc_house_data.csv column order: the
+        # positional 'bedrooms':-onward slice (arrange_real_data.py:213)
+        # must select the 18 feature columns and exclude id/date/price
+        assert list(df.columns[:4]) == ["id", "date", "price", "bedrooms"]
+        feats = df.loc[:, "bedrooms":]
+        assert feats.shape[1] == 18
+        assert {"id", "date", "price"}.isdisjoint(feats.columns)
+        assert list(feats.columns[-2:]) == ["sqft_living15", "sqft_lot15"]
+
+    def test_kc_house_fixture_end_to_end(self, tmp_path):
+        """Genuine-header kc_house_data.csv -> preparer ('bedrooms':
+        slice, price/1e6 regression target) -> layout -> linear-model
+        training (arrange_real_data.py:207-253)."""
+        import shutil
+
+        src = tmp_path / "raw"
+        src.mkdir()
+        shutil.copy(
+            os.path.join(FIXTURES, "kc_house_head.csv"),
+            src / "kc_house_data.csv",
+        )
+        ds = real.prepare("kc_house_data", str(src))
+        assert ds.X_train.shape[0] == 96 and ds.X_test.shape[0] == 24
+        # regression target at O(1) scale, not ±1 labels
+        assert 0.0 < ds.y_train.mean() < 3.0
+        # 18 features + bias, one-hot per column = 19 nnz/row
+        assert (np.diff(ds.X_train.tocsr().indptr) == 19).all()
+        out = str(tmp_path / "prepared")
+        prepare.main(
+            ["real", "--dataset", "kc_house_data", "--source", str(src),
+             "--workers", "4", "--out", out]
+        )
+        back = data_io.read_reference_layout(
+            os.path.join(out, "kc_house_data/4"), 4, sparse=True
+        )
+        cfg = RunConfig(
+            scheme="approx", model="linear", n_workers=4, n_stragglers=1,
+            num_collect=3, rounds=6, n_rows=back.n_samples,
+            n_cols=back.n_features, dataset="kc_house_data",
+            lr_schedule=0.1, add_delay=True, seed=0,
+        )
+        res = trainer.train(cfg, back)
+        ev = evaluate.replay(
+            trainer.build_model(cfg), cfg.model, res.params_history,
+            back.X_train[: res.n_train], back.y_train[: res.n_train],
+            back.X_test, back.y_test,
+        )
+        assert np.isfinite(ev.training_loss).all()
+        assert ev.training_loss[-1] < ev.training_loss[0]
+
     def test_dna_fixture_end_to_end(self, tmp_path):
         """TU-Berlin-shaped features.csv (1 label + 200 feature columns)
         -> preparer -> layout -> training; proves the genfromtxt parse and
