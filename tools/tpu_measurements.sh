@@ -106,6 +106,11 @@ run measured_arrival_agc 900 python tools/bench_measured.py
 # hardens (or reopens) the 126 GB/s in-scan floor claim (BASELINE.md)
 run dense_hbm_crosscheck 900 python tools/profile_hbm.py
 
+# the fully on-device control plane at canonical scale (VERDICT r4 #9):
+# 10k rounds of W=30 cyclic-MDS with table decode in ONE jitted scan —
+# the reference's 10k per-iteration host lstsq loop as a single dispatch
+run dynamic_mds_w30_10k 1500 python tools/bench_dynamic.py
+
 # amazon fields LAST: round-3 window 1 died mid-compile here (relay
 # terminal down at 01:52Z with this entry in flight; the compile itself
 # is proven cheap — 8 s on forced-CPU — so this is pure wedge paranoia).
