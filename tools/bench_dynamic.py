@@ -39,7 +39,7 @@ def main() -> None:
                     help="rehearsal shape (CPU: seconds, not minutes)")
     args = ap.parse_args()
     if args.light:
-        args.rows, args.cols, args.rounds = 30 * 16, 16, 50
+        args.rows, args.cols, args.rounds = args.workers * 16, 16, 50
 
     # the warm-run protocol below relies on the persistent compile cache:
     # each train_dynamic call jits a fresh closure, so without this the
@@ -51,7 +51,10 @@ def main() -> None:
         "JAX_COMPILATION_CACHE_DIR",
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
     )
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
+    # threshold 0, forced even under measure_lib's exported 5 s default:
+    # the scan may compile in under 5 s, and an un-persisted cold compile
+    # makes the warm call silently recompile (fresh closure per call)
+    os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
 
     import jax
     import jax.numpy as jnp

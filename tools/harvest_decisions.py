@@ -120,11 +120,25 @@ def main() -> None:
             ],
         )
 
-    # --- round-4 evidence entries ------------------------------------------
-    print("\n## round-4 evidence entries\n")
-    for tag in ("measured_arrival_agc", "dense_hbm_crosscheck"):
+    # --- evidence entries (round-4/5; no default gates on these) ----------
+    print("\n## evidence entries\n")
+    for tag in ("measured_arrival_agc", "dense_hbm_crosscheck",
+                "dynamic_mds_w30_10k"):
         r = e.get(tag)
         print(f"- {tag}: " + ("MISSING" if r is None else json.dumps(r)[:300]))
+
+    # --- repeat captures (VERDICT r4 #8: window variance for the single-
+    # capture round-3 headline numbers) --------------------------------------
+    print("\n## headline repeats (window variance)\n")
+    for base_tag in ("sparse_covtype_faithful_fields_flat",
+                     "sparse_amazon_faithful_fields_flat"):
+        v0, v1 = val(e, base_tag), val(e, base_tag + "_rep")
+        pair = [x for x in (v0, v1) if x is not None]
+        spread = (
+            f" spread {min(pair)}-{max(pair)} steps/s" if len(pair) == 2 else ""
+        )
+        print(f"- {base_tag}: {v0 if v0 is not None else 'MISSING'}"
+              f" / repeat {v1 if v1 is not None else 'MISSING'}{spread}")
 
 
 if __name__ == "__main__":
