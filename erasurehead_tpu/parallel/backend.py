@@ -41,6 +41,25 @@ def initialize_distributed(
         or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
     )
     if in_cluster and not _initialized:
+        # Manual-coordinator path only: this jax build does not read
+        # JAX_NUM_PROCESSES/JAX_PROCESS_ID itself, and a k8s indexed Job
+        # (the JobSet deployment, tools/k8s/) hands each pod its rank as
+        # JOB_COMPLETION_INDEX. On TPU-metadata deployments (MEGASCALE_*),
+        # jax's own cluster detection computes the GLOBAL rank
+        # (slice_id x hosts_per_slice + worker_id); JOB_COMPLETION_INDEX
+        # restarts at 0 per slice there and must not preempt it.
+        manual = coordinator_address is not None or os.environ.get(
+            "JAX_COORDINATOR_ADDRESS"
+        )
+        if manual and not os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+            if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+                num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+            if process_id is None:
+                rank = os.environ.get(
+                    "JAX_PROCESS_ID", os.environ.get("JOB_COMPLETION_INDEX")
+                )
+                if rank is not None:
+                    process_id = int(rank)
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

@@ -39,3 +39,26 @@ def devices():
     assert devs[0].platform == "cpu"
     assert len(devs) == 8, f"expected 8 forced CPU devices, got {len(devs)}"
     return devs
+
+
+def free_port() -> int:
+    """A free localhost TCP port (multi-process cluster tests)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def cpu_cluster_env(local_devices: int = 2, **extra) -> dict:
+    """Subprocess env for a virtual-CPU jax.distributed child: pins the
+    CPU platform with N local devices and scrubs the axon TPU tunnel (a
+    child dialing the relay can wedge a concurrent TPU client)."""
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": f"--xla_force_host_platform_device_count={local_devices}",
+        **extra,
+    }
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env

@@ -9,9 +9,10 @@ them; the AGC trajectory must equal the single-process run bit-for-bit.
 """
 
 import os
-import socket
 import subprocess
 import sys
+
+from conftest import cpu_cluster_env, free_port
 import textwrap
 
 import numpy as np
@@ -89,12 +90,6 @@ _CHILD = textwrap.dedent(
 )
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 # 4-process cluster, 2 devices each, COMPOSED 2-D mesh (VERDICT r2 item 7):
 # the 4x2 (workers, model) grid puts coded-DP across the process boundary
 # (the DCN axis on a real pod) with tensor parallelism inside each process
@@ -140,16 +135,12 @@ def test_four_process_composed_tp_dp_mesh_matches_single_process(tmp_path):
     processes while the MLP's hidden dim shards inside each — the
     trajectory must match the 8-device single-process run bit-for-bit
     (same mesh shape, same shardings, only the process topology differs)."""
-    port = _free_port()
     out = str(tmp_path / "hist_4p.npz")
-    env = {
-        **os.environ,
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-        "EH_COORD": f"127.0.0.1:{port}",
-        "EH_OUT": out,
-    }
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env = cpu_cluster_env(
+        local_devices=2,
+        EH_COORD=f"127.0.0.1:{free_port()}",
+        EH_OUT=out,
+    )
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _CHILD_4P],
@@ -191,24 +182,18 @@ def test_four_process_composed_tp_dp_mesh_matches_single_process(tmp_path):
 
 
 def test_two_process_cpu_cluster_matches_single_process(tmp_path):
-    port = _free_port()
     out = str(tmp_path / "hist.npy")
     out_sparse = str(tmp_path / "hist_sparse.npy")
     out_fields = str(tmp_path / "hist_fields.npy")
     out_attn = str(tmp_path / "hist_attn.npz")
-    env = {
-        **os.environ,
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-        "EH_COORD": f"127.0.0.1:{port}",
-        "EH_OUT": out,
-        "EH_OUT_SPARSE": out_sparse,
-        "EH_OUT_FIELDS": out_fields,
-        "EH_OUT_ATTN": out_attn,
-    }
-    # children must not dial the axon TPU tunnel (sitecustomize registers it
-    # whenever PALLAS_AXON_POOL_IPS is set, before any user code runs)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env = cpu_cluster_env(
+        local_devices=2,
+        EH_COORD=f"127.0.0.1:{free_port()}",
+        EH_OUT=out,
+        EH_OUT_SPARSE=out_sparse,
+        EH_OUT_FIELDS=out_fields,
+        EH_OUT_ATTN=out_attn,
+    )
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _CHILD],
