@@ -39,6 +39,10 @@ def initialize_distributed(
         coordinator_address is not None
         or os.environ.get("JAX_COORDINATOR_ADDRESS")
         or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+        # GKE's TPU webhook injects the worker hostnames into every pod of
+        # a TPU podslice; jax's own cluster detection derives coordinator
+        # and ranks from it when no manual env is set
+        or os.environ.get("TPU_WORKER_HOSTNAMES")
     )
     if in_cluster and not _initialized:
         # Manual-coordinator path only: this jax build does not read

@@ -14,17 +14,21 @@ from __future__ import annotations
 import os
 from typing import Optional, Tuple
 
-import jax.numpy as jnp
+import numpy as np
 import orbax.checkpoint as ocp
 
 from erasurehead_tpu.train.optimizer import OptState
 
 
 def _pack(state: OptState, next_round: int) -> dict:
+    # next_round stays a host numpy scalar: a jnp.asarray here would be a
+    # host-LOCAL jax array (SingleDeviceSharding), which orbax refuses to
+    # serialize in a multi-process cluster — the state leaves are globally
+    # replicated by the trainer, and this must not be the odd one out
     return {
         "params": state.params,
         "momentum": state.momentum,
-        "next_round": jnp.asarray(next_round, jnp.int32),
+        "next_round": np.asarray(next_round, np.int32),
     }
 
 

@@ -230,6 +230,24 @@ def test_jobset_tpu_requests_only_rejected(tmp_path):
         tpu_fleet.validate_jobset(_write(tmp_path, doc))
 
 
+def test_jobset_missing_cluster_env_rejected(tmp_path):
+    """A training container without JAX_COORDINATOR_ADDRESS would run four
+    independent single-process programs instead of one SPMD cluster."""
+    doc = _load()
+    _pod(doc)["containers"][0]["env"] = []
+    with pytest.raises(ValueError, match="JAX_COORDINATOR_ADDRESS"):
+        tpu_fleet.validate_jobset(_write(tmp_path, doc))
+
+
+def test_jobset_num_processes_parallelism_mismatch_rejected(tmp_path):
+    doc = _load()
+    for ev in _pod(doc)["containers"][0]["env"]:
+        if ev["name"] == "JAX_NUM_PROCESSES":
+            ev["value"] = "8"
+    with pytest.raises(ValueError, match="must equal parallelism"):
+        tpu_fleet.validate_jobset(_write(tmp_path, doc))
+
+
 def test_jobset_nonpositive_tpu_quantity_rejected(tmp_path):
     doc = _load()
     res = _pod(doc)["containers"][0]["resources"]
@@ -285,6 +303,16 @@ def test_jobset_command_executes_in_local_pod_emulation(tmp_path):
     write_reference_layout(data, str(layout0), W)
     shutil.copytree(tmp_path / "pod0", tmp_path / "pod1")
 
+    # cluster-formation env comes FROM the manifest (not invented here):
+    # the JobSet service DNS becomes loopback, the host count becomes the
+    # emulation's process count — both presence-asserted so a manifest
+    # that drops them fails this test the way it would fail on GKE
+    manifest_env = {
+        ev["name"]: ev["value"]
+        for ev in _pod(doc)["containers"][0].get("env") or []
+    }
+    assert "JAX_COORDINATOR_ADDRESS" in manifest_env, manifest_env
+    assert manifest_env.get("JAX_NUM_PROCESSES") == "4", manifest_env
     env = cpu_cluster_env(
         local_devices=2,
         JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{free_port()}",

@@ -303,6 +303,24 @@ def validate_jobset(path: str) -> dict:
             joined = " ".join(cmd) if isinstance(cmd, list) else str(cmd)
             if "erasurehead_tpu.cli" in joined:
                 _validate_cli_fragment(joined)
+                # cluster formation: the SPMD program needs the manual
+                # coordinator env (or TPU/MEGASCALE metadata, which only
+                # exists on the real nodes — the manifest cannot rely on
+                # what it doesn't declare); JAX_NUM_PROCESSES must match
+                # the job's parallelism or initialize() hangs at the
+                # coordinator barrier
+                env_vars = {
+                    ev.get("name"): ev.get("value")
+                    for ev in c.get("env") or []
+                }
+                need("JAX_COORDINATOR_ADDRESS" in env_vars,
+                     f"container {c.get('name')}: training container needs "
+                     "JAX_COORDINATOR_ADDRESS env for cluster formation")
+                nproc = env_vars.get("JAX_NUM_PROCESSES")
+                need(nproc is not None and str(nproc).isdigit()
+                     and int(nproc) == par,
+                     f"container {c.get('name')}: JAX_NUM_PROCESSES "
+                     f"({nproc}) must equal parallelism ({par})")
         if topo:
             # a pod that selects a TPU topology but declares no google.com/tpu
             # resources would never be scheduled onto TPU by GKE (ADVICE r4)
