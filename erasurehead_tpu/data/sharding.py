@@ -115,6 +115,60 @@ def worker_stack(layout: CodingLayout, Xp, yp):
     return take(Xp), yp[layout.assignment]
 
 
+def np_global(x, dtype=None):
+    """np.asarray that also works in a multi-controller cluster — the
+    fetch-side counterpart of :func:`put_global`.
+
+    Cluster cases a plain np.asarray cannot handle, each needing a
+    DIFFERENT collective. Every process must take the same branch, so the
+    branch keys on the sharding (identical everywhere), never on this
+    process's own addressability:
+
+    - the array spans all processes but is partitioned (XLA may leave jit
+      outputs sharded): process_allgather reassembles the global value;
+    - the array lives on a SUBMESH that excludes some processes (an
+      elastic survivor phase folded onto fewer devices): the excluded
+      processes hold nothing to gather — one owning process broadcasts.
+      Decidable sub-cases: a single-process submesh (its owner reads the
+      whole value) or a replicated multi-process submesh (any member
+      holds a full local replica); a submesh both multi-process AND
+      partitioned has no single reader and is refused consistently on
+      every process.
+    """
+    if isinstance(x, jax.Array) and jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        procs = {d.process_index for d in x.sharding.device_set}
+        me = jax.process_index()
+        if len(procs) < jax.process_count():
+            if len(procs) == 1:
+                owner = next(iter(procs))
+                val = (
+                    np.asarray(x)
+                    if me == owner
+                    else np.zeros(x.shape, x.dtype)
+                )
+            elif x.is_fully_replicated:
+                owner = min(procs)
+                val = (
+                    np.asarray(x.addressable_shards[0].data)
+                    if me == owner
+                    else np.zeros(x.shape, x.dtype)
+                )
+            else:
+                # consistent refusal on EVERY process — a one-sided raise
+                # would strand the others inside the broadcast collective
+                raise NotImplementedError(
+                    "array partitioned across a strict subset of processes"
+                )
+            x = multihost_utils.broadcast_one_to_all(
+                val, is_source=me == owner
+            )
+        elif not x.is_fully_addressable:
+            x = multihost_utils.process_allgather(x, tiled=True)
+    return np.asarray(x, dtype) if dtype is not None else np.asarray(x)
+
+
 def put_global(leaf: np.ndarray, sharding) -> jax.Array:
     """Materialize a host array as a (possibly multi-host) sharded Array.
 
@@ -127,8 +181,11 @@ def put_global(leaf: np.ndarray, sharding) -> jax.Array:
     """
     if jax.process_count() == 1:
         return jax.device_put(leaf, sharding)
+    # dtype must be explicit: a process can own ZERO shards of this array
+    # (e.g. an elastic survivor phase folded onto a 1-device mesh) and then
+    # has no shard to infer it from
     return jax.make_array_from_callback(
-        leaf.shape, sharding, lambda idx: leaf[idx]
+        leaf.shape, sharding, lambda idx: leaf[idx], dtype=leaf.dtype
     )
 
 

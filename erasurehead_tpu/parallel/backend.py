@@ -41,8 +41,10 @@ def initialize_distributed(
         or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
         # GKE's TPU webhook injects the worker hostnames into every pod of
         # a TPU podslice; jax's own cluster detection derives coordinator
-        # and ranks from it when no manual env is set
-        or os.environ.get("TPU_WORKER_HOSTNAMES")
+        # and ranks from it when no manual env is set. Only a MULTI-host
+        # list means there is a cluster to form — single-host runtimes
+        # (incl. this sandbox's relay plugin) set a lone hostname
+        or "," in os.environ.get("TPU_WORKER_HOSTNAMES", "")
     )
     if in_cluster and not _initialized:
         # Manual-coordinator path only: this jax build does not read

@@ -41,6 +41,8 @@ from typing import Mapping, Optional
 
 import numpy as np
 
+from erasurehead_tpu.data import sharding as sharding_lib
+
 from erasurehead_tpu.ops import codes
 from erasurehead_tpu.ops.codes import CodingLayout
 from erasurehead_tpu.parallel import collect
@@ -354,9 +356,13 @@ def train_elastic(
     # the phases ran on different meshes (W vs W' divisor device counts):
     # concatenate on host and KEEP the numpy tree — the history's consumers
     # (eval replay, artifacts) pull it to host anyway, so re-uploading
-    # [R, ...] x every param leaf to HBM would be pure waste
+    # [R, ...] x every param leaf to HBM would be pure waste. The fetch is
+    # multihost-safe: in a cluster the survivor mesh can exclude some
+    # processes' devices entirely (sharding.np_global gathers globally).
     history = jax.tree.map(
-        lambda a, b: np.concatenate([np.asarray(a), np.asarray(b)]),
+        lambda a, b: np.concatenate(
+            [sharding_lib.np_global(a), sharding_lib.np_global(b)]
+        ),
         phase1.params_history,
         phase2.params_history,
     )

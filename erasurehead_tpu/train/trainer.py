@@ -31,6 +31,7 @@ import numpy as np
 
 from erasurehead_tpu.data.sharding import (
     ShardedData,
+    np_global,
     put_global,
     shard_run_data,
 )
@@ -278,7 +279,11 @@ def _hard_sync(x) -> None:
     leaves = jax.tree.leaves(x)
     if leaves:
         jax.block_until_ready(leaves[0])
-        np.asarray(leaves[0])
+        if not isinstance(leaves[0], jax.Array) or leaves[0].is_fully_addressable:
+            # the fetch stays LOCAL: in a cluster a collective gather here
+            # would ship the leaf over DCN inside timed regions, and a
+            # ready buffer is already an unambiguous completion signal
+            np.asarray(leaves[0])
 
 
 @dataclasses.dataclass
@@ -948,11 +953,11 @@ def train_dynamic(
     # rows before ``start`` belong to the donor phase
     R, W = cfg.rounds, layout.n_workers
     timeset = np.zeros(R)
-    timeset[start:] = np.asarray(sim, np.float64)
+    timeset[start:] = np_global(sim, np.float64)
     wt = -np.ones((R, W))
-    wt[start:] = np.asarray(wtimes, np.float64)
+    wt[start:] = np_global(wtimes, np.float64)
     col = np.zeros((R, W), dtype=bool)
-    col[start:] = np.asarray(collected)
+    col[start:] = np_global(collected)
     return TrainResult(
         params_history=hist,
         final_params=final_state.params,

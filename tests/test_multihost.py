@@ -381,3 +381,125 @@ def test_pod_cluster_preemption_resume_matches_uninterrupted(tmp_path):
     # when it degenerated to a completed first run (still a valid resume)
     if not preempted:
         print("note: first run completed before the kill landed")
+
+
+# The fully on-device control plane and elastic recovery across a REAL
+# process boundary: train_dynamic's jitted-scan collection and
+# train_elastic's mid-run re-shard both run in a 2-process cluster and
+# must match the same-mesh single-process trajectories exactly.
+_CHILD_DYNAMIC = textwrap.dedent(
+    """
+    import os, sys
+    import numpy as np
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=os.environ["EH_COORD"],
+        num_processes=2,
+        process_id=int(os.environ["EH_PID"]),
+    )
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.parallel import failures
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    # on-device MDS-table collection in one scan, workers axis spanning
+    # both processes
+    dcfg = RunConfig(
+        scheme="cyccoded", n_workers=4, n_stragglers=1, rounds=6,
+        n_rows=16 * 4, n_cols=16, lr_schedule=1.0, update_rule="AGD",
+        add_delay=True, seed=0,
+    )
+    ddata = generate_gmm(dcfg.n_rows, dcfg.n_cols, n_partitions=4, seed=0)
+    dres = trainer.train_dynamic(dcfg, ddata, mesh=worker_mesh(4))
+
+    # elastic death mid-run under the on-device deadline control plane:
+    # the survivor re-shard moves shards across the process boundary
+    W = 8
+    ecfg = RunConfig(
+        scheme="deadline", deadline=0.8, n_workers=W, n_stragglers=1,
+        rounds=12, n_rows=32 * W, n_cols=24, lr_schedule=1.0,
+        update_rule="AGD", add_delay=True, seed=0,
+    )
+    edata = generate_gmm(ecfg.n_rows, ecfg.n_cols, n_partitions=W, seed=0)
+    eres, erep = failures.train_elastic(
+        ecfg, edata, {3: 5}, mesh=worker_mesh(4), dynamic=True,
+        measure=False,
+    )
+    assert erep.n_workers_after == W - 1, erep
+
+    # np_global: params_history comes straight from the jitted scan and
+    # XLA may leave it partitioned across the processes
+    from erasurehead_tpu.data.sharding import np_global
+
+    if jax.process_index() == 0:
+        np.save(os.environ["EH_OUT_DYN"], np_global(dres.params_history))
+        np.save(os.environ["EH_OUT_ELA"], np.asarray(eres.params_history))
+    else:
+        np_global(dres.params_history)  # collective: all processes join
+    """
+)
+
+
+def test_dynamic_and_elastic_cluster_match_single_process(tmp_path):
+    out_dyn = str(tmp_path / "dyn.npy")
+    out_ela = str(tmp_path / "ela.npy")
+    env = cpu_cluster_env(
+        local_devices=2,
+        EH_COORD=f"127.0.0.1:{free_port()}",
+        EH_OUT_DYN=out_dyn,
+        EH_OUT_ELA=out_ela,
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CHILD_DYNAMIC],
+            env={**env, "EH_PID": str(pid)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        logs = [p.communicate(timeout=420)[0].decode() for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"child failed:\n{log[-3000:]}"
+
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.parallel import failures
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    dcfg = RunConfig(
+        scheme="cyccoded", n_workers=4, n_stragglers=1, rounds=6,
+        n_rows=16 * 4, n_cols=16, lr_schedule=1.0, update_rule="AGD",
+        add_delay=True, seed=0,
+    )
+    ddata = generate_gmm(dcfg.n_rows, dcfg.n_cols, n_partitions=4, seed=0)
+    dres = trainer.train_dynamic(dcfg, ddata, mesh=worker_mesh(4))
+    np.testing.assert_allclose(
+        np.load(out_dyn), np.asarray(dres.params_history),
+        rtol=1e-6, atol=1e-7,
+    )
+
+    W = 8
+    ecfg = RunConfig(
+        scheme="deadline", deadline=0.8, n_workers=W, n_stragglers=1,
+        rounds=12, n_rows=32 * W, n_cols=24, lr_schedule=1.0,
+        update_rule="AGD", add_delay=True, seed=0,
+    )
+    edata = generate_gmm(ecfg.n_rows, ecfg.n_cols, n_partitions=W, seed=0)
+    eres, _ = failures.train_elastic(
+        ecfg, edata, {3: 5}, mesh=worker_mesh(4), dynamic=True,
+        measure=False,
+    )
+    np.testing.assert_allclose(
+        np.load(out_ela), np.asarray(eres.params_history),
+        rtol=1e-6, atol=1e-7,
+    )
