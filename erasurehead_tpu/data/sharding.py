@@ -137,6 +137,16 @@ def np_global(x, dtype=None):
     """
     if isinstance(x, jax.Array) and jax.process_count() > 1:
         from jax.experimental import multihost_utils
+        from jax.sharding import SingleDeviceSharding
+
+        if isinstance(x.sharding, SingleDeviceSharding):
+            # HOST-LOCAL array (plain device_put / fresh init): every
+            # process holds its own complete copy, and the sharding is
+            # NOT globally consistent (each names its own local device) —
+            # keying a collective on it would make every process the
+            # "owner" and a broadcast would SUM the copies. Plain local
+            # read is the complete, correct value.
+            return np.asarray(x, dtype)
 
         procs = {d.process_index for d in x.sharding.device_set}
         me = jax.process_index()
@@ -166,7 +176,7 @@ def np_global(x, dtype=None):
             )
         elif not x.is_fully_addressable:
             x = multihost_utils.process_allgather(x, tiled=True)
-    return np.asarray(x, dtype) if dtype is not None else np.asarray(x)
+    return np.asarray(x, dtype)
 
 
 def put_global(leaf: np.ndarray, sharding) -> jax.Array:

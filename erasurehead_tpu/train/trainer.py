@@ -425,8 +425,10 @@ def train(
     update_fn = setup.update_fn
 
     def replicate(state):
+        # np_global: a donor initial_state may live on a DIFFERENT mesh
+        # (an elastic restart), including a submesh of the cluster
         return jax.tree.map(
-            lambda l: put_global(np.asarray(l), replicated(mesh)), state
+            lambda l: put_global(np_global(l), replicated(mesh)), state
         )
 
     # host-side until the initial_state/resume resolution below picks the
@@ -918,9 +920,10 @@ def train_dynamic(
                 f"initial_round={initial_round} outside [0, {cfg.rounds})"
             )
         # strand off the donor phase's placement: an elastic restart carries
-        # state across meshes with different worker counts
+        # state across meshes with different worker counts (np_global: the
+        # donor mesh may be a submesh of the cluster)
         state0 = jax.tree.map(
-            lambda l: jnp.asarray(np.asarray(l)), initial_state
+            lambda l: jnp.asarray(np_global(l)), initial_state
         )
         start = initial_round
     key = jax.random.key(cfg.seed + 1)

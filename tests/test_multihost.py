@@ -429,6 +429,16 @@ _CHILD_DYNAMIC = textwrap.dedent(
     )
     assert erep.n_workers_after == W - 1, erep
 
+    # chained restart with a SUBMESH donor: the survivor phase ran on a
+    # 1-device mesh (7 workers, 4 devices); continuing from its final
+    # state on the FULL mesh forces replicate() to broadcast the donor
+    import dataclasses
+    ccfg = dataclasses.replace(ecfg, n_workers=4, rounds=13)
+    cres = trainer.train(
+        ccfg, edata, mesh=worker_mesh(4),
+        initial_state=eres.final_state, initial_round=12, measure=False,
+    )
+
     # np_global: params_history comes straight from the jitted scan and
     # XLA may leave it partitioned across the processes
     from erasurehead_tpu.data.sharding import np_global
@@ -436,8 +446,11 @@ _CHILD_DYNAMIC = textwrap.dedent(
     if jax.process_index() == 0:
         np.save(os.environ["EH_OUT_DYN"], np_global(dres.params_history))
         np.save(os.environ["EH_OUT_ELA"], np.asarray(eres.params_history))
+        np.save(os.environ["EH_OUT_CHAIN"], np_global(cres.params_history))
     else:
-        np_global(dres.params_history)  # collective: all processes join
+        # collectives: all processes join the fetches pid 0 performs
+        np_global(dres.params_history)
+        np_global(cres.params_history)
     """
 )
 
@@ -445,11 +458,13 @@ _CHILD_DYNAMIC = textwrap.dedent(
 def test_dynamic_and_elastic_cluster_match_single_process(tmp_path):
     out_dyn = str(tmp_path / "dyn.npy")
     out_ela = str(tmp_path / "ela.npy")
+    out_chain = str(tmp_path / "chain.npy")
     env = cpu_cluster_env(
         local_devices=2,
         EH_COORD=f"127.0.0.1:{free_port()}",
         EH_OUT_DYN=out_dyn,
         EH_OUT_ELA=out_ela,
+        EH_OUT_CHAIN=out_chain,
     )
     procs = [
         subprocess.Popen(
@@ -501,5 +516,16 @@ def test_dynamic_and_elastic_cluster_match_single_process(tmp_path):
     )
     np.testing.assert_allclose(
         np.load(out_ela), np.asarray(eres.params_history),
+        rtol=1e-6, atol=1e-7,
+    )
+
+    import dataclasses
+    ccfg = dataclasses.replace(ecfg, n_workers=4, rounds=13)
+    cres = trainer.train(
+        ccfg, edata, mesh=worker_mesh(4),
+        initial_state=eres.final_state, initial_round=12, measure=False,
+    )
+    np.testing.assert_allclose(
+        np.load(out_chain), np.asarray(cres.params_history),
         rtol=1e-6, atol=1e-7,
     )
