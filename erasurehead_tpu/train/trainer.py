@@ -32,8 +32,10 @@ import numpy as np
 from erasurehead_tpu.data.sharding import (
     ShardedData,
     np_global,
+    partition_stack,
     put_global,
     shard_run_data,
+    worker_stack,
 )
 from erasurehead_tpu.data.synthetic import Dataset
 from erasurehead_tpu.models.glm import LinearModel, LogisticModel
@@ -555,6 +557,38 @@ def train(
     )
 
 
+def _make_worker_msg(model):
+    """One worker's transmitted message: its per-slot gradient stack.
+
+    ``n`` (the work multiplier) folds INSIDE the executable as a
+    fori_loop — n x the device compute in ONE dispatch, with a
+    bitwise-identical message. Repeating the dispatch instead would make
+    Python dispatch overhead the "work", which on fast backends finishes
+    before any ordering is observable. Each iteration consumes the
+    previous message through a multiplier that is always exactly 1.0 but
+    not provably so (an optimization_barrier chain measured elided on
+    the CPU backend; this dependence survives — verified n-linear cost).
+    Shared by the single-process and multi-controller measured paths so
+    the dependence hack can never drift between them."""
+
+    @partial(jax.jit, static_argnames="n")
+    def worker_msg(params, Xs, ys, n=1):
+        def one(p):
+            return jax.vmap(lambda X, y: model.grad_sum(p, X, y))(Xs, ys)
+
+        if n == 1:
+            return one(params)
+
+        def body(_, m):
+            s = jax.tree.leaves(m)[0].sum()
+            dep = jnp.where(jnp.isnan(s), 1.0, jnp.sign(jnp.abs(s) + 1.0))
+            return one(jax.tree.map(lambda l: l * dep, params))
+
+        return jax.lax.fori_loop(0, n - 1, body, one(params))
+
+    return worker_msg
+
+
 @_with_run_sparse_lanes
 def train_measured(
     cfg: RunConfig,
@@ -645,29 +679,16 @@ def train_measured(
     update_fn = setup.update_fn
     state = setup.state0
 
-    # one worker's transmitted message: its per-slot gradient stack.
-    # ``n`` (the work multiplier) folds INSIDE the executable as a
-    # fori_loop — n x the device compute in ONE dispatch, with a
-    # bitwise-identical message. Repeating the dispatch instead would make
-    # Python dispatch overhead the "work", which on fast backends finishes
-    # before any ordering is observable. Each iteration consumes the
-    # previous message through a multiplier that is always exactly 1.0 but
-    # not provably so (an optimization_barrier chain measured elided on
-    # the CPU backend; this dependence survives — verified n-linear cost).
-    @partial(jax.jit, static_argnames="n")
-    def worker_msg(params, Xs, ys, n=1):
-        def one(p):
-            return jax.vmap(lambda X, y: model.grad_sum(p, X, y))(Xs, ys)
+    if jax.process_count() > 1:
+        # multi-controller: every process is a replica of the reference's
+        # master, timing only ITS OWN devices' worker queues. An explicit
+        # mesh narrows the device pool, as in the single-process path;
+        # mesh=None means every device in the cluster.
+        return _train_measured_cluster(
+            cfg, dataset, setup, mult, dtype, mesh=mesh
+        )
 
-        if n == 1:
-            return one(params)
-
-        def body(_, m):
-            s = jax.tree.leaves(m)[0].sum()
-            dep = jnp.where(jnp.isnan(s), 1.0, jnp.sign(jnp.abs(s) + 1.0))
-            return one(jax.tree.map(lambda l: l * dep, params))
-
-        return jax.lax.fori_loop(0, n - 1, body, one(params))
+    worker_msg = _make_worker_msg(model)
 
     @jax.jit
     def decode_update(st, per_slot, slot_w, eta, i):
@@ -799,6 +820,183 @@ def train_measured(
             state,
             per_slot,
             jnp.asarray(slot_w, dtype),
+            jnp.asarray(lr[r], dtype),
+            jnp.asarray(float(r), dtype),
+        )
+        timeset[r] = sched.sim_time[0]
+        worker_times[r] = sched.worker_times[0]
+        collected[r] = sched.collected[0]
+        history.append(state.params)
+    _hard_sync(state)
+    wall = time.perf_counter() - wall0
+
+    return TrainResult(
+        params_history=jax.tree.map(lambda *xs: jnp.stack(xs), *history),
+        final_params=state.params,
+        final_state=state,
+        timeset=timeset,
+        worker_times=worker_times,
+        collected=collected,
+        sim_total_time=float(timeset.sum()),
+        wall_time=wall,
+        steps_per_sec=cfg.rounds / wall if wall > 0 else 0.0,
+        n_train=n_train,
+        config=cfg,
+        layout=layout,
+    )
+
+
+def _train_measured_cluster(cfg, dataset, setup, mult, dtype, mesh=None):
+    """Measured-arrival mode in a multi-controller cluster.
+
+    Every process is a REPLICA of the reference's master: it holds the
+    full host dataset (the data-prep determinism put_global relies on),
+    computes the identical collection schedule and update, and times only
+    the worker queues on its OWN devices — a process cannot dispatch to or
+    time another host's chips. Per round, the [W] arrival row and the
+    processes' partial decoded gradients meet via host allgathers, the
+    analogue of the reference's MPI Waitany stamps + Gather
+    (src/naive.py:95-126). Determinism makes the replicas agree: seeded
+    init, seeded delays, and identical schedule math on identical inputs.
+
+    Logical workers are assigned round-robin over the GLOBAL device list
+    (jax.devices() order, identical everywhere), so a worker's arrival =
+    its device-queue wait + its own compute, with queues on different
+    hosts genuinely concurrent — a pod's semantics.
+    """
+    from jax.experimental import multihost_utils
+
+    layout, model = setup.layout, setup.model
+    W = layout.n_workers
+    lr, alpha, n_train = setup.lr, setup.alpha, setup.n_train
+    coeffs = np.asarray(layout.coeffs)
+    slot_coded = np.asarray(layout.slot_is_coded)
+    update_fn = setup.update_fn
+    me = jax.process_index()
+
+    # host-side worker stacks: every process reconstructs the full
+    # redundant assignment (setup.data's device copies live on a submesh
+    # in cluster mode and are not per-worker addressable from here)
+    Xp_h, yp_h = partition_stack(
+        dataset, layout.n_partitions, sparse_format=cfg.sparse_format
+    )
+    Xw_h, yw_h = worker_stack(layout, Xp_h, yp_h)
+    run_dtype = jnp.dtype(cfg.dtype)
+
+    def _cast(leaf):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating):
+            return arr.astype(run_dtype)
+        return arr
+
+    # identical order on every process; an explicit mesh narrows the pool
+    devices = (
+        jax.devices() if mesh is None else list(np.asarray(mesh.devices).flat)
+    )
+    D = len(devices)
+    dev_of = [devices[w % D] for w in range(W)]
+    local_ws = [w for w in range(W) if dev_of[w].process_index == me]
+    slices = {
+        w: jax.device_put(
+            (
+                jax.tree.map(lambda l: _cast(l[w]), Xw_h),
+                _cast(yw_h[w]),
+            ),
+            dev_of[w],
+        )
+        for w in local_ws
+    }
+
+    worker_msg = _make_worker_msg(model)
+
+    @jax.jit
+    def weighted_partial(stacked, w_sel):
+        # stacked: [num_local, S, ...] per leaf; w_sel: [num_local, S]
+        return jax.tree.map(
+            lambda l: jnp.einsum("ws,ws...->...", w_sel, l), stacked
+        )
+
+    @jax.jit
+    def apply_update(st, g, eta, i):
+        return update_fn(st, g, eta, alpha, n_train, i)
+
+    state = setup.state0  # seeded identically on every process
+    local_devs = [d for d in devices if d.process_index == me]
+    queue_of = {
+        d: [w for w in local_ws if dev_of[w] is d] for d in local_devs
+    }
+
+    # warm every local executable outside the timed region
+    for w in local_ws:
+        _hard_sync(worker_msg(
+            jax.device_put(state.params, dev_of[w]), *slices[w],
+            n=int(mult[w]),
+        ))
+    zero_g = jax.tree.map(jnp.zeros_like, state.params)
+    _hard_sync(apply_update(
+        state, zero_g, jnp.asarray(lr[0], dtype), jnp.asarray(0.0, dtype)
+    ))
+
+    delays = straggler.arrival_schedule(
+        cfg.rounds, W, cfg.add_delay, cfg.delay_mean
+    )
+    timeset = np.zeros(cfg.rounds)
+    worker_times = np.zeros((cfg.rounds, W))
+    collected = np.zeros((cfg.rounds, W), dtype=bool)
+    history = []
+    wall0 = time.perf_counter()
+    for r in range(cfg.rounds):
+        _hard_sync(state)
+        params_on = {d: jax.device_put(state.params, d) for d in local_devs}
+        for p_d in params_on.values():
+            _hard_sync(p_d)
+        t_local = np.zeros(W)
+        msgs = {}
+        for d in local_devs:
+            t0 = time.perf_counter()
+            for w in queue_of[d]:
+                m = worker_msg(params_on[d], *slices[w], n=int(mult[w]))
+                _hard_sync(m)
+                t_local[w] = time.perf_counter() - t0
+                msgs[w] = m
+        # one process timed each worker; the rest contributed zeros
+        t_row = np.asarray(
+            multihost_utils.process_allgather(t_local)
+        ).sum(axis=0)
+        arrivals = (t_row + delays[r])[None, :]
+        sched = collect.build_schedule(
+            cfg.scheme, arrivals, layout, num_collect=cfg.num_collect,
+            deadline=cfg.deadline,
+        )
+        slot_w = np.asarray(
+            step_lib.expand_slot_weights(
+                sched.message_weights, coeffs, slot_coded
+            )
+        )[0]
+        if local_ws:
+            # stage every local message on one device before stacking
+            staged = [
+                jax.device_put(msgs[w], local_devs[0]) for w in local_ws
+            ]
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *staged)
+            partial_g = jax.tree.map(
+                np.asarray,
+                weighted_partial(
+                    stacked, jnp.asarray(slot_w[local_ws], dtype)
+                ),
+            )
+        else:
+            partial_g = jax.tree.map(
+                lambda l: np.zeros(l.shape, l.dtype), zero_g
+            )
+        # sum the per-process partials: the distributed Gather + decode
+        g = jax.tree.map(
+            lambda l: np.asarray(l).sum(axis=0),
+            multihost_utils.process_allgather(partial_g),
+        )
+        state = apply_update(
+            state,
+            jax.tree.map(lambda l: jnp.asarray(l, dtype), g),
             jnp.asarray(lr[r], dtype),
             jnp.asarray(float(r), dtype),
         )

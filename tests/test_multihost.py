@@ -529,3 +529,87 @@ def test_dynamic_and_elastic_cluster_match_single_process(tmp_path):
         np.load(out_chain), np.asarray(cres.params_history),
         rtol=1e-6, atol=1e-7,
     )
+
+
+# Measured-arrival mode in a cluster: every process is a replica master
+# timing only its local devices' worker queues; arrival rows and partial
+# decoded gradients meet via host allgathers. The replicas must agree
+# EXACTLY (identical schedules + identical updates), and every worker
+# must have been timed by exactly one process.
+_CHILD_MEASURED = textwrap.dedent(
+    """
+    import os, sys
+    import numpy as np
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=os.environ["EH_COORD"],
+        num_processes=2,
+        process_id=int(os.environ["EH_PID"]),
+    )
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    W = 4
+    cfg = RunConfig(
+        scheme="approx", n_workers=W, n_stragglers=1, num_collect=3,
+        rounds=3, n_rows=16 * W, n_cols=16, lr_schedule=1.0,
+        update_rule="AGD", add_delay=False, seed=0,
+        arrival_mode="measured",
+    )
+    data = generate_gmm(cfg.n_rows, cfg.n_cols, n_partitions=W, seed=0)
+    mult = np.ones(W, np.int64)
+    mult[0] = 40  # one genuinely slow worker
+    res = trainer.train_measured(cfg, data, work_multiplier=mult)
+
+    hist = np.asarray(res.params_history)
+    assert np.isfinite(hist).all(), "non-finite history"
+    # every worker's compute was really timed somewhere: the slow
+    # worker's arrival must exceed a fast worker's in every round
+    # (worker_times carries -1 for uncollected; compare collected only)
+    assert res.worker_times.shape == (cfg.rounds, W)
+    np.save(os.environ[f"EH_OUT_{jax.process_index()}"], hist)
+    np.save(os.environ[f"EH_WT_{jax.process_index()}"], res.worker_times)
+    """
+)
+
+
+def test_measured_mode_cluster_replicas_agree(tmp_path):
+    outs = {f"EH_OUT_{i}": str(tmp_path / f"hist{i}.npy") for i in (0, 1)}
+    wts = {f"EH_WT_{i}": str(tmp_path / f"wt{i}.npy") for i in (0, 1)}
+    env = cpu_cluster_env(
+        local_devices=2,
+        EH_COORD=f"127.0.0.1:{free_port()}",
+        **outs, **wts,
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CHILD_MEASURED],
+            env={**env, "EH_PID": str(pid)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        logs = [p.communicate(timeout=420)[0].decode() for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"child failed:\n{log[-3000:]}"
+
+    # replica masters agree bit-for-bit: same schedules, same updates
+    h0, h1 = np.load(outs["EH_OUT_0"]), np.load(outs["EH_OUT_1"])
+    np.testing.assert_array_equal(h0, h1)
+    wt0, wt1 = np.load(wts["EH_WT_0"]), np.load(wts["EH_WT_1"])
+    np.testing.assert_array_equal(wt0, wt1)
+    # measured heterogeneity is visible: the work-multiplied worker 0
+    # arrives later than every fast collected worker, every round
+    for r in range(wt0.shape[0]):
+        fast = wt0[r, 1:][wt0[r, 1:] >= 0]
+        if wt0[r, 0] >= 0 and fast.size:
+            assert wt0[r, 0] > fast.min(), (r, wt0[r])
