@@ -111,6 +111,19 @@ MARGIN_FLAT = os.environ.get("BENCH_MARGIN_FLAT", "")
 if MARGIN_FLAT and MARGIN_FLAT in ("on", "off"):
     METRIC_SUFFIX += f"_marginflat{MARGIN_FLAT}"
 
+# lax.scan unroll factor: >1 lets XLA fuse/overlap consecutive rounds —
+# the candidate fix for the in-scan bandwidth gap (126 GB/s in-scan vs
+# 819 peak, BASELINE.md round-3 window 2). Identical math at any value.
+_UNROLL_ENV = os.environ.get("BENCH_UNROLL", "")
+SCAN_UNROLL = 1
+if _UNROLL_ENV:
+    try:
+        SCAN_UNROLL = int(_UNROLL_ENV)
+    except ValueError:
+        SCAN_UNROLL = -1  # flagged invalid; validated in __main__
+if SCAN_UNROLL > 1:
+    METRIC_SUFFIX += f"_unroll{SCAN_UNROLL}"
+
 
 def _failure_record(error: str) -> dict:
     """A valid one-line JSON payload for any can't-measure outcome — the
@@ -295,6 +308,7 @@ def child() -> None:
         # (unset = "auto", step.resolve_flat_grad decides per stack kind)
         flat_grad=FLAT_GRAD or "auto",
         margin_flat=MARGIN_FLAT or "auto",
+        scan_unroll=SCAN_UNROLL,
         seed=0,
     )
     print(
@@ -384,6 +398,16 @@ if __name__ == "__main__":
                 _failure_record(
                     f"BENCH_MODE must be faithful or deduped, "
                     f"got {COMPUTE_MODE!r}"
+                )
+            )
+        )
+        sys.exit(0 if "--child" not in sys.argv else 1)
+    if _UNROLL_ENV and SCAN_UNROLL < 1:
+        print(
+            json.dumps(
+                _failure_record(
+                    f"BENCH_UNROLL must be an int >= 1, "
+                    f"got {_UNROLL_ENV!r}"
                 )
             )
         )

@@ -162,6 +162,10 @@ def _flags_parser() -> argparse.ArgumentParser:
                    help="dense margin matvec lowering width [2,128]: "
                         "replicate beta behind a barrier so the margin "
                         "lowers as a tileable matmul (exact; column 0)")
+    p.add_argument("--scan-unroll", type=int, default=1,
+                   help="lax.scan unroll factor for the training scan: "
+                        ">1 lets XLA fuse/overlap consecutive rounds "
+                        "(identical math; a lowering knob)")
     p.add_argument("--flat-grad", default="auto",
                    choices=["auto", "on", "off"],
                    help="flat-stack closed-form GLM gradient lowering "
@@ -250,6 +254,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         sparse_lanes=ns.sparse_lanes,
         dense_margin_cols=ns.dense_margin_cols,
         flat_grad=ns.flat_grad,
+        scan_unroll=ns.scan_unroll,
         sparse_format=ns.sparse_format,
         fields_scatter=ns.fields_scatter,
         fields_margin=ns.fields_margin,

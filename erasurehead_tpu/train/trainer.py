@@ -453,7 +453,8 @@ def train(
     @jax.jit
     def run(state, Xa, ya, lr_c, w_c, it_c):
         return jax.lax.scan(
-            partial(body, Xa, ya), state, (lr_c, w_c, it_c)
+            partial(body, Xa, ya), state, (lr_c, w_c, it_c),
+            unroll=cfg.scan_unroll,
         )
 
     start_round = 0
@@ -1140,7 +1141,10 @@ def train_dynamic(
 
     @jax.jit
     def run(state, Xa, ya, lr_c, it_c):
-        return jax.lax.scan(partial(body, Xa, ya), state, (lr_c, it_c))
+        return jax.lax.scan(
+            partial(body, Xa, ya), state, (lr_c, it_c),
+            unroll=cfg.scan_unroll,
+        )
 
     iters = jnp.arange(start, cfg.rounds)
     t0 = time.perf_counter()

@@ -171,6 +171,13 @@ class RunConfig:
     margin_flat: str = "auto"
     # per-round collection deadline in simulated seconds (scheme="deadline")
     deadline: Optional[float] = None
+    # lax.scan unroll factor for the training scans (train/train_dynamic):
+    # >1 lets XLA fuse and overlap consecutive rounds, amortizing the
+    # per-iteration scan overhead the in-scan bandwidth probes showed
+    # (BASELINE.md round-3 window 2: 126 GB/s in-scan vs 819 peak).
+    # Identical math at any value (scan semantics); a lowering knob like
+    # dtype/flat_grad — raced on silicon before becoming a default.
+    scan_unroll: int = 1
     # sequence-parallel shards for the attention family: >1 builds a 2-D
     # (workers, seq) mesh; each row's token axis splits over seq and
     # attention spans it (parallel/ring.py, models/attention._predict_seq)
@@ -235,6 +242,10 @@ class RunConfig:
         if self.flat_grad not in ("auto", "on", "off"):
             raise ValueError(
                 f"flat_grad must be auto/on/off, got {self.flat_grad!r}"
+            )
+        if self.scan_unroll < 1:
+            raise ValueError(
+                f"scan_unroll must be >= 1, got {self.scan_unroll}"
             )
         if self.arrival_mode not in ("simulated", "measured"):
             raise ValueError(

@@ -1063,3 +1063,43 @@ class TestMarginFlat:
 def test_margin_flat_on_conflicts_with_pallas_on():
     with pytest.raises(ValueError, match="at most one"):
         _cfg(margin_flat="on", use_pallas="on")
+
+
+def test_scan_unroll_matches_unrolled_one():
+    """cfg.scan_unroll is a pure lowering knob: lax.scan semantics are
+    identical at any unroll factor; XLA's cross-iteration fusion may
+    reassociate f32, so trajectories agree to float tolerance (like the
+    other lowering knobs). Queued as the dense_f32_unroll* sweep
+    entries."""
+    import dataclasses
+
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    W = 8
+    cfg = RunConfig(
+        scheme="approx", n_workers=W, n_stragglers=1, num_collect=6,
+        rounds=7, n_rows=16 * W, n_cols=24, lr_schedule=1.0,
+        update_rule="AGD", add_delay=True, seed=0,
+    )
+    data = generate_gmm(cfg.n_rows, cfg.n_cols, n_partitions=W, seed=0)
+    base = trainer.train(cfg, data, measure=False)
+    for unroll in (3, 4):  # non-divisor AND divisor of rounds
+        u = dataclasses.replace(cfg, scan_unroll=unroll)
+        res = trainer.train(u, data, measure=False)
+        np.testing.assert_allclose(
+            np.asarray(res.params_history),
+            np.asarray(base.params_history), rtol=3e-5, atol=1e-6,
+        )
+    dbase = trainer.train_dynamic(cfg, data)
+    dres = trainer.train_dynamic(
+        dataclasses.replace(cfg, scan_unroll=4), data
+    )
+    np.testing.assert_allclose(
+        np.asarray(dres.params_history),
+        np.asarray(dbase.params_history), rtol=3e-5, atol=1e-6,
+    )
+    with pytest.raises(ValueError, match="scan_unroll"):
+        RunConfig(scheme="naive", n_workers=4, n_rows=32, n_cols=8,
+                  scan_unroll=0)

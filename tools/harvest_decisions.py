@@ -96,6 +96,12 @@ def main() -> None:
     section(e, "dense bf16 frontier",
             ["dense_bf16", "dense_bf16_flat", "dense_bf16_marginflat"])
 
+    # scan unroll: the in-scan bandwidth-gap candidate (r5). A winner
+    # here composes with whatever margin lowering wins above — decide
+    # the unroll default, then re-race the composed form if both win.
+    section(e, "dense scan unroll (cfg.scan_unroll)",
+            ["dense_f32", "dense_f32_unroll4", "dense_f32_unroll8"])
+
     for shape in ("covtype", "amazon"):
         section(
             e, f"faithful {shape} fields constellation",

@@ -91,6 +91,8 @@ run sparse_amazon_faithful_fields_mxu_flat 600 python tools/bench_sparse.py \
 run measured_arrival_agc 600 python tools/bench_measured.py --light
 run dense_hbm_crosscheck 600 python tools/profile_hbm.py --light
 run dynamic_mds_w30_10k 600 python tools/bench_dynamic.py --light
+run dense_f32_unroll4 900 env BENCH_UNROLL=4 python bench.py
+run dense_f32_unroll8 900 env BENCH_UNROLL=8 python bench.py
 
 n_ok=$(wc -l < "$OUT")
 echo "rehearsal: $n_ok entries captured in $OUT" >&2

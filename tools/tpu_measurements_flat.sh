@@ -59,6 +59,11 @@ run dense_bf16_marginflat 1800 env BENCH_MARGIN_FLAT=on BENCH_DTYPE=bfloat16 pyt
 # measurement; writes artifacts/measured_arrival_tpu.json. Also listed in
 # tpu_measurements.sh — the tag-skip protocol makes that a no-op.
 run measured_arrival_agc 900 python tools/bench_measured.py
+# scan-unroll race: the candidate fix for the in-scan bandwidth gap
+# (126 GB/s in-scan vs 819 peak) — XLA fuses/overlaps consecutive
+# rounds. Races the captured dense_f32 per-slot baseline directly.
+run dense_f32_unroll4 1800 env BENCH_UNROLL=4 python bench.py
+run dense_f32_unroll8 1800 env BENCH_UNROLL=8 python bench.py
 # repeat captures of the round-3 single-window headline wins (VERDICT r4
 # #8): same commands, fresh tags, so each headline sparse number carries
 # window variance like the dense ones do (462-530 across windows).
