@@ -660,6 +660,12 @@ def train_measured(
             "into one matmul and cannot be timed per worker — use "
             "margin_flat='auto' or 'off'"
         )
+    if cfg.scan_unroll != 1:
+        raise ValueError(
+            "arrival_mode='measured' drives rounds from the host (no "
+            "lax.scan to unroll); scan_unroll has no measured-mode "
+            "implementation — leave it at 1"
+        )
     setup = _setup_run(cfg, dataset, mesh, faithful=True, single_device=True)
     layout, model, data = setup.layout, setup.model, setup.data
     W = layout.n_workers

@@ -160,3 +160,5 @@ def test_measured_mode_rejects_unsupported_knobs(data):
         trainer.train_measured(_cfg(flat_grad="on"), data)
     with pytest.raises(ValueError, match="flat-margin"):
         trainer.train_measured(_cfg(margin_flat="on"), data)
+    with pytest.raises(ValueError, match="scan_unroll"):
+        trainer.train_measured(_cfg(scan_unroll=4), data)

@@ -613,3 +613,94 @@ def test_measured_mode_cluster_replicas_agree(tmp_path):
         fast = wt0[r, 1:][wt0[r, 1:] >= 0]
         if wt0[r, 0] >= 0 and fast.size:
             assert wt0[r, 0] > fast.min(), (r, wt0[r])
+
+
+# The canonical W=30 shape on a REAL uneven topology: 30 logical workers
+# fold onto 6 of the cluster's 8 devices (auto mesh), leaving process 3
+# with NO devices in the run's mesh — the strongest submesh case: data
+# upload (put_global zero-shard), compute (a jit whose mesh excludes a
+# process), and history fetch must all hold together, and the trajectory
+# must equal the same-mesh single-process run.
+_CHILD_W30 = textwrap.dedent(
+    """
+    import os, sys
+    import numpy as np
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=os.environ["EH_COORD"],
+        num_processes=4,
+        process_id=int(os.environ["EH_PID"]),
+    )
+    from erasurehead_tpu.data.sharding import np_global
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    W = 30
+    cfg = RunConfig(
+        scheme="approx", n_workers=W, n_stragglers=2, num_collect=15,
+        rounds=3, n_rows=16 * W, n_cols=24, lr_schedule=1.0,
+        update_rule="AGD", add_delay=True, seed=0,
+    )
+    data = generate_gmm(cfg.n_rows, cfg.n_cols, n_partitions=W, seed=0)
+    # pin the premise: the auto mesh must be the 6-device uneven fold
+    # that EXCLUDES process 3 — the coverage this test exists for
+    mesh = trainer._auto_mesh(W)
+    assert mesh.devices.size == 6, mesh
+    mine = [d for d in mesh.devices.flat
+            if d.process_index == jax.process_index()]
+    if jax.process_index() == 3:
+        assert not mine, mine
+
+    res = trainer.train(cfg, data, measure=False)  # auto mesh: 6 devices
+    assert res.layout.n_workers == W
+    hist = np_global(res.params_history)
+    if jax.process_index() == 0:
+        np.save(os.environ["EH_OUT"], hist)
+    """
+)
+
+
+def test_canonical_w30_uneven_fold_cluster_matches_single_process(tmp_path):
+    out = str(tmp_path / "w30.npy")
+    env = cpu_cluster_env(
+        local_devices=2,
+        EH_COORD=f"127.0.0.1:{free_port()}",
+        EH_OUT=out,
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CHILD_W30],
+            env={**env, "EH_PID": str(pid)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for pid in range(4)
+    ]
+    try:
+        logs = [p.communicate(timeout=420)[0].decode() for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"child failed:\n{log[-3000:]}"
+
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    W = 30
+    cfg = RunConfig(
+        scheme="approx", n_workers=W, n_stragglers=2, num_collect=15,
+        rounds=3, n_rows=16 * W, n_cols=24, lr_schedule=1.0,
+        update_rule="AGD", add_delay=True, seed=0,
+    )
+    data = generate_gmm(cfg.n_rows, cfg.n_cols, n_partitions=W, seed=0)
+    res = trainer.train(cfg, data, mesh=worker_mesh(6), measure=False)
+    np.testing.assert_allclose(
+        np.load(out), np.asarray(res.params_history), rtol=1e-6, atol=1e-7
+    )
