@@ -9,6 +9,8 @@
 #   python -m erasurehead_tpu.cli $(N_PROCS) $(N_ROWS) $(N_COLS) $(DATA_DIR) \
 #       0 artificial 1 $(N_STRAGGLERS) 0 3 $(N_COLLECT) 1 AGD
 
+# bash: the tier1 recipe needs pipefail, which POSIX sh lacks
+SHELL         := /bin/bash
 PY            ?= python
 # canonical run shape (run_approx_coding.sh:2-9): 31 procs = 30 workers + master.
 # The reference's own s=3 there violates its FRC guard (s+1) | W for the
@@ -39,7 +41,7 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 .PHONY: naive cyccoded repcoded avoidstragg approxcoded \
 	partialrepcoded partialcyccoded randreg deadline \
 	generate_random_data arrange_real_data \
-	test bench sweep rehearse watch compare real_data dryrun clean
+	test tier1 bench sweep rehearse watch compare real_data dryrun clean
 
 naive:            ## uncoded wait-for-all baseline (src/naive.py)
 	$(RUN) --scheme naive
@@ -84,6 +86,15 @@ real_data:        ## canonical comparison on genuinely real (UCI) data
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+tier1:            ## the ROADMAP tier-1 verify line (what CI gates on)
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+		-m 'not slow' --continue-on-collection-errors \
+		-p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+		| tee /tmp/_t1.log; rc=$$?; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
+	exit $$rc
 
 bench:
 	$(PY) bench.py

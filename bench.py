@@ -322,6 +322,23 @@ def child() -> None:
     result = trainer.train(cfg, data)  # compiles, then times the scan
     total = time.perf_counter() - t0
 
+    # ---- sweep-engine extra: wall-clock of a CACHED rerun -----------------
+    # The sweep engine (train/cache.py) makes the Nth run of this
+    # signature skip trace+compile+upload; a second identical train() call
+    # measures exactly what a 7-scheme compare() pays per additional run.
+    # Never let the extra break the one-JSON-line contract.
+    sweep_extra = {}
+    try:
+        t1 = time.perf_counter()
+        rerun = trainer.train(cfg, data)
+        sweep_extra = {
+            "sweep_cached_run_s": round(time.perf_counter() - t1, 4),
+            "sweep_first_run_s": round(total, 4),
+            "sweep_cache": rerun.cache_info,
+        }
+    except Exception as e:  # noqa: BLE001 — extras must never kill the bench
+        print(f"bench: sweep-engine extra failed: {e}", file=sys.stderr)
+
     steps_per_sec = result.steps_per_sec
     # reference-protocol effective rate on the identical straggler schedule
     ref_steps_per_sec = ROUNDS / result.sim_total_time
@@ -366,6 +383,7 @@ def child() -> None:
                 "bytes_per_step": bytes_per_step,
                 "achieved_gbps": round(float(achieved_gbps), 2),
                 "pct_roofline": pct_roofline,
+                **sweep_extra,
             }
         )
     )

@@ -191,6 +191,12 @@ def _flags_parser() -> argparse.ArgumentParser:
                    help="expert-parallel shards for the moe model: >1 "
                         "builds a 2-D (workers, expert) mesh and splits "
                         "the experts over it")
+    p.add_argument("--sweep-cache", default="on", choices=["on", "off"],
+                   help="sweep-engine executable/data caches "
+                        "(train/cache.py): off forces every run to "
+                        "recompile and re-upload (debugging; memory "
+                        "pressure). ERASUREHEAD_SWEEP_CACHE=0 in the env "
+                        "does the same")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--checkpoint-dir", default=None,
                    help="save optimizer state here every --checkpoint-every "
@@ -477,6 +483,10 @@ def main(argv: list[str] | None = None) -> int:
     parser = _flags_parser()
     ns = parser.parse_args(argv)
     _validate_checkpoint_flags(parser, ns)
+    if ns.sweep_cache == "off":
+        from erasurehead_tpu.train import cache as cache_lib
+
+        cache_lib.set_enabled(False)
     cfg = _flags_to_config(ns)
     run(
         cfg,

@@ -145,7 +145,18 @@ def np_global(x, dtype=None):
             # NOT globally consistent (each names its own local device) —
             # keying a collective on it would make every process the
             # "owner" and a broadcast would SUM the copies. Plain local
-            # read is the complete, correct value.
+            # read is the complete, correct value — but ONLY for a
+            # host-local array: an array explicitly device_put onto one
+            # specific remote device also carries SingleDeviceSharding,
+            # and a non-owning process has nothing to read (ADVICE r5 #2)
+            if not x.is_fully_addressable:
+                raise ValueError(
+                    "np_global: array has SingleDeviceSharding on a device "
+                    "this process does not own — a global single-device "
+                    "placement is not host-local-replicated; fetch it on "
+                    "the owning process or re-shard onto a mesh sharding "
+                    "before the cross-process read"
+                )
             return np.asarray(x, dtype)
 
         procs = {d.process_index for d in x.sharding.device_set}
@@ -191,10 +202,12 @@ def put_global(leaf: np.ndarray, sharding) -> jax.Array:
     """
     if jax.process_count() == 1:
         return jax.device_put(leaf, sharding)
-    # dtype must be explicit: a process can own ZERO shards of this array
-    # (e.g. an elastic survivor phase folded onto a 1-device mesh) and then
-    # has no shard to infer it from
-    return jax.make_array_from_callback(
+    # dtype must be explicit (where the jax version allows): a process can
+    # own ZERO shards of this array (e.g. an elastic survivor phase folded
+    # onto a 1-device mesh) and then has no shard to infer it from
+    from erasurehead_tpu.utils import compat
+
+    return compat.make_array_from_callback(
         leaf.shape, sharding, lambda idx: leaf[idx], dtype=leaf.dtype
     )
 

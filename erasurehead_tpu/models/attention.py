@@ -29,6 +29,7 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+from erasurehead_tpu.utils import compat
 import jax.numpy as jnp
 from jax import lax
 
@@ -137,7 +138,7 @@ class AttentionModel(MarginClassifierBase):
         context; the pooled activations psum over the axis (identical
         margins on every member)."""
         ax = self.seq_axis
-        s = lax.axis_size(ax)
+        s = compat.axis_size(ax)
         if T % s:
             raise ValueError(
                 f"T={T} tokens must divide over {s} sequence shards"
@@ -183,7 +184,7 @@ class AttentionModel(MarginClassifierBase):
         if self.seq_axis is None:
             return jax.grad(self.loss_sum)(params, X, y)
         ax = self.seq_axis
-        scaled = lambda p: self.loss_sum(p, X, y) / lax.axis_size(ax)
+        scaled = lambda p: self.loss_sum(p, X, y) / compat.axis_size(ax)
         return lax.psum(jax.grad(scaled)(params), ax)
 
     grad_sum_auto = grad_sum

@@ -26,6 +26,7 @@ sharding is out of scope for this framework's model sizes.
 from __future__ import annotations
 
 import jax
+from erasurehead_tpu.utils import compat
 import jax.numpy as jnp
 from jax import lax
 
@@ -107,7 +108,7 @@ class DeepMLPModel(MarginClassifierBase):
         sparse feature containers out of the microbatch indexing); the
         pipeline streams its dense [mb, H] activations."""
         ax = self.pp_axis
-        p = lax.axis_size(ax)
+        p = compat.axis_size(ax)
         i = lax.axis_index(ax)
         L = self.n_layers
         if L % p:
@@ -155,7 +156,7 @@ class DeepMLPModel(MarginClassifierBase):
         # under the trainer) AND the pipe axis (explicit pcast: every
         # later carry depends on axis_index), keeping the scan carry type
         # stable under vma checking
-        act0 = lax.pcast(Hmb[0] * 0.0, ax, to="varying")
+        act0 = compat.pcast(Hmb[0] * 0.0, ax, to="varying")
         out0 = jnp.zeros((M, mb)) + act0[:, 0] * 0.0
         (_, out), _ = lax.scan(
             step, (act0, out0), jnp.arange(M + p - 1)

@@ -24,6 +24,7 @@ the attention family's seq mode uses.
 from __future__ import annotations
 
 import jax
+from erasurehead_tpu.utils import compat
 import jax.numpy as jnp
 from jax import lax
 
@@ -70,7 +71,7 @@ class MLPModel(MarginClassifierBase):
         """Tensor-parallel forward: this member computes its hidden slice
         only; partial margins psum over the model axis."""
         ax = self.tp_axis
-        p = lax.axis_size(ax)
+        p = compat.axis_size(ax)
         H = params["b1"].shape[0]
         if H % p:
             raise ValueError(f"hidden={H} must divide over {p} tp shards")

@@ -22,6 +22,7 @@ trajectory-equal in tests, like every other composed axis.
 from __future__ import annotations
 
 import jax
+from erasurehead_tpu.utils import compat
 import jax.numpy as jnp
 from jax import lax
 
@@ -98,7 +99,7 @@ class MoEModel(MarginClassifierBase):
         """Expert-parallel forward: this member evaluates only its block
         of experts; gate-weighted partial margins psum over the axis."""
         ax = self.ep_axis
-        p = lax.axis_size(ax)
+        p = compat.axis_size(ax)
         E = self.n_experts
         if E % p:
             raise ValueError(f"n_experts={E} must divide over {p} ep shards")

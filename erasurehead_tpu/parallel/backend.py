@@ -66,6 +66,18 @@ def initialize_distributed(
                 )
                 if rank is not None:
                     process_id = int(rank)
+            if process_id is not None and num_processes is None:
+                # forwarding the partial pair would fail deep inside
+                # jax.distributed with an opaque library error; name the
+                # missing knob instead (ADVICE r5 #3 — validate_jobset
+                # only protects the committed manifest, not ad-hoc runs)
+                raise ValueError(
+                    "distributed init resolved a process rank "
+                    f"(process_id={process_id} via JAX_PROCESS_ID/"
+                    "JOB_COMPLETION_INDEX) but no process count; set "
+                    "JAX_NUM_PROCESSES (or pass num_processes) so "
+                    "jax.distributed.initialize receives the full pair"
+                )
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

@@ -28,10 +28,12 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+from erasurehead_tpu.utils import compat
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from erasurehead_tpu.utils.compat import shard_map
 
 SEQ_AXIS = "seq"
 _NEG_INF = -1e30  # additive mask value; finite so exp() never NaNs
@@ -67,7 +69,7 @@ def ring_attention_shard(
     holds the shard originally owned by device (idx - s) mod N; ppermute
     passes buffers to the next ring position each step.
     """
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     Tq, d = q.shape
     Tk = k.shape[0]
@@ -146,7 +148,7 @@ def ulysses_attention_shard(
     the axis size; the ring wins when T is long and H is small. Both
     produce exact attention; tests pin them to each other and the oracle.
     """
-    n = lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     H = q.shape[1]
     if H % n:
         raise ValueError(f"heads={H} must be divisible by axis size {n}")

@@ -32,9 +32,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
 from erasurehead_tpu.parallel.mesh import WORKER_AXIS
+from erasurehead_tpu.utils import compat
+from erasurehead_tpu.utils.compat import shard_map
 
 GradFn = Callable[..., Any]  # (params, X, y, weights) -> gradient pytree
 
@@ -52,6 +53,17 @@ def _weighted_tree_sum(weights: jnp.ndarray, grads: Any, contract: str) -> Any:
     )
 
 
+def _vma_check(model):
+    """shard_map replication-check setting for a grad body: on jax 0.4.x
+    the checker cannot trace replication through the grads-via-loss
+    models' AD (the explicit recipe in _weighted_loss_grad makes the
+    output replicated in fact) — disable it there; None keeps the
+    version default everywhere else."""
+    if _grads_via_loss(model) and not compat.IMPLICIT_REPLICATED_GRAD_PSUM:
+        return False
+    return None
+
+
 def _grads_via_loss(model) -> bool:
     """Autodiff models (MLP/attention — MarginClassifierBase) must NOT have
     per-slot jax.grad calls under the shard_map: differentiating w.r.t. the
@@ -66,10 +78,17 @@ def _grads_via_loss(model) -> bool:
     return getattr(model, "grads_via_loss", False)
 
 
-def _weighted_loss_grad(model, params, Xs, ys, ws, contract: str):
+def _weighted_loss_grad(model, params, Xs, ys, ws, contract: str, mesh=None):
     """grad of sum_slots w_slot * loss(params, X_slot, y_slot) over THIS
-    device's slots; the implicit replicated-param psum makes the result the
-    mesh-global decoded gradient, replicated."""
+    device's slots; under the vma system (jax >= 0.6) the implicit
+    replicated-param psum makes the result the mesh-global decoded
+    gradient, replicated. On jax 0.4.x there is no implicit psum: the
+    standalone recipe from the model families' ``grad_sum`` docstrings is
+    applied explicitly — scale the loss by 1/(model-internal axis sizes),
+    then psum over EVERY mesh axis (replicated-path leaves arrive
+    full-per-member and the psum undoes the scaling; partitioned-path
+    leaves arrive as member slices and the psum assembles them; the
+    worker axis carries disjoint data shards that the psum sums)."""
     nvmap = len(contract)  # "ws" = [Wl, S, ...] stacks, "p" = [Pl, ...]
 
     def L(p):
@@ -78,7 +97,17 @@ def _weighted_loss_grad(model, params, Xs, ys, ws, contract: str):
             per = jax.vmap(per, in_axes=(None, 0, 0))
         return jnp.sum(ws.astype(jnp.float32) * per(p, Xs, ys))
 
-    return jax.grad(L)(params)
+    g = jax.grad(L)(params)
+    if not compat.IMPLICIT_REPLICATED_GRAD_PSUM:
+        axes = tuple(mesh.axis_names) if mesh is not None else (WORKER_AXIS,)
+        denom = 1
+        for a in axes:
+            if a != WORKER_AXIS:
+                denom *= mesh.shape[a]
+        g = jax.tree.map(
+            lambda l: lax.psum(l / denom if denom > 1 else l, axes), g
+        )
+    return g
 
 
 # Whether margin_flat="auto" resolves to the hybrid lowering for dense
@@ -178,7 +207,9 @@ def make_faithful_grad_fn(model, mesh: Mesh) -> GradFn:
 
     def local(params, Xw, yw, slot_weights):
         if _grads_via_loss(model):
-            return _weighted_loss_grad(model, params, Xw, yw, slot_weights, "ws")
+            return _weighted_loss_grad(
+                model, params, Xw, yw, slot_weights, "ws", mesh
+            )
         per_slot = jax.vmap(
             jax.vmap(lambda X, y: model.grad_sum(params, X, y))
         )(Xw, yw)  # leaves [Wl, S, ...]
@@ -190,6 +221,7 @@ def make_faithful_grad_fn(model, mesh: Mesh) -> GradFn:
         mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
         out_specs=P(),
+        check_vma=_vma_check(model),
     )
 
 
@@ -209,7 +241,9 @@ def make_deduped_grad_fn(model, mesh: Mesh) -> GradFn:
 
     def local(params, Xp, yp, part_weights):
         if _grads_via_loss(model):
-            return _weighted_loss_grad(model, params, Xp, yp, part_weights, "p")
+            return _weighted_loss_grad(
+                model, params, Xp, yp, part_weights, "p", mesh
+            )
         per_part = jax.vmap(lambda X, y: model.grad_sum(params, X, y))(Xp, yp)
         g = _weighted_tree_sum(part_weights, per_part, "p")
         return lax.psum(g, WORKER_AXIS)
@@ -219,6 +253,7 @@ def make_deduped_grad_fn(model, mesh: Mesh) -> GradFn:
         mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
         out_specs=P(),
+        check_vma=_vma_check(model),
     )
 
 
@@ -356,6 +391,22 @@ def make_fused_grad_fn(kind: str, mesh: Mesh, *, interpret: bool = False) -> Gra
         # pallas_call's out_shape carries no varying-across-mesh info, so
         # jax 0.9's vma checker cannot validate this body
         check_vma=False,
+    )
+
+
+def lowering_signature(cfg, model, X) -> tuple:
+    """The RESOLVED gradient-lowering choice for (cfg, model, stack) — the
+    part of the sweep-engine executable cache key (train/cache.py) that
+    cfg alone cannot determine: resolve_flat_grad / resolve_margin_flat
+    depend on the model class and the materialized stack kind, and their
+    defaults (FLAT_GRAD_DEFAULT / MARGIN_FLAT_DEFAULT) are
+    measurement-pinned module state that future races may flip. Keying on
+    the resolution rather than the knob strings keeps a cached executable
+    from surviving a default flip."""
+    return (
+        bool(resolve_flat_grad(cfg.flat_grad, model, X)),
+        bool(resolve_margin_flat(cfg.margin_flat, model, X)),
+        type(X).__name__,
     )
 
 

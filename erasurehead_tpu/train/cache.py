@@ -1,0 +1,211 @@
+"""Sweep engine caches: run-to-run executable and device-data reuse.
+
+The experiment harness (train/experiments.compare / straggler_sweep /
+baseline_suite) races many configs over the SAME dataset, mesh, shapes and
+lowering — only the per-round weight tables differ, and those are ordinary
+traced *arguments* of the training scan. Historically every `train()` call
+still recompiled the full scan (its jit lived in a closure, so jit's own
+cache could never hit) and re-stacked + re-uploaded the worker stacks. At
+paper-scale shapes compile time dominates sweep wall-clock.
+
+Two module-level caches fix that:
+
+  - the **executable cache** maps a hashable static signature — everything
+    that changes the lowering: model kind, resolved gradient lowering
+    (parallel/step's resolve_flat_grad / resolve_margin_flat / pallas
+    gates), mesh axes + devices, stack shapes/dtypes, optimizer family,
+    scan_unroll, scan length — to the AOT-compiled scan. The Nth run of a
+    signature skips tracing, compilation, and the warm-up execution.
+  - the **data cache** maps (dataset identity, layout stacking signature,
+    mesh, data dtype, sparse format, compute mode) to the device-resident
+    ShardedData, so repeated runs reuse the uploaded worker/partition
+    stacks instead of re-stacking and re-transferring.
+
+Correctness: a cached executable was compiled from an identical lowering,
+so cached and fresh runs are **bitwise identical** (pinned in
+tests/test_sweep_cache.py). Anything that changes the compiled program must
+be part of the key — when adding a lowering knob, add it to
+RunConfig.static_signature() or the trainer-side resolved tuple.
+
+Disable with ``ERASUREHEAD_SWEEP_CACHE=0`` in the env, ``--sweep-cache
+off`` on the CLI, or :func:`set_enabled`. Telemetry (hits/misses, compile
+seconds saved, bytes not re-uploaded) lands in ``TrainResult.cache_info``
+and the experiment rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+from collections import OrderedDict
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+#: LRU bounds — sweeps cycle over a handful of signatures; the caps only
+#: guard against unbounded growth in long-lived servers.
+EXEC_CACHE_MAX = 32
+DATA_CACHE_MAX = 8
+
+
+@dataclasses.dataclass
+class CacheStats:
+    """Cumulative cache telemetry (process lifetime; reset via clear())."""
+
+    exec_hits: int = 0
+    exec_misses: int = 0
+    data_hits: int = 0
+    data_misses: int = 0
+    #: compile+warmup seconds NOT spent thanks to executable hits (each hit
+    #: credits the measured cost of the miss that populated its entry)
+    compile_seconds_saved: float = 0.0
+    #: device bytes NOT re-uploaded thanks to data hits
+    bytes_reused: int = 0
+
+    def snapshot(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+_stats = CacheStats()
+#: key -> (executable, compile_seconds)
+_exec_cache: "OrderedDict[Any, tuple[Any, float]]" = OrderedDict()
+#: key -> (ShardedData, device_bytes)
+_data_cache: "OrderedDict[Any, tuple[Any, int]]" = OrderedDict()
+
+_enabled = os.environ.get("ERASUREHEAD_SWEEP_CACHE", "1").lower() not in (
+    "0", "off", "false",
+)
+
+_token_counter = itertools.count()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+def clear() -> None:
+    """Drop both caches and reset the counters (tests; memory pressure)."""
+    global _stats
+    _exec_cache.clear()
+    _data_cache.clear()
+    _stats = CacheStats()
+
+
+def stats() -> CacheStats:
+    return _stats
+
+
+# ---------------------------------------------------------------------------
+# key builders
+
+
+def dataset_token(dataset) -> Any:
+    """Stable identity token for a dataset object.
+
+    Content-hashing paper-scale arrays would cost more than the upload the
+    cache avoids; instead the first sighting brands the OBJECT with a
+    process-unique token (plain ``id()`` is unsafe — ids get reused after
+    GC). An object that refuses attributes (slots/frozen) is uncacheable:
+    returns a fresh token every call, turning the cache into a no-op for
+    it rather than a correctness hazard."""
+    tok = getattr(dataset, "_sweep_cache_token", None)
+    if tok is None:
+        tok = next(_token_counter)
+        try:
+            dataset._sweep_cache_token = tok
+        except (AttributeError, TypeError):
+            return next(_token_counter)
+    return tok
+
+
+def mesh_signature(mesh) -> tuple:
+    """Axes, sizes, and the exact device assignment (executables bind
+    input shardings to concrete devices)."""
+    return (
+        tuple(mesh.axis_names),
+        tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+        tuple(int(d.id) for d in np.asarray(mesh.devices).flat),
+    )
+
+
+def tree_signature(tree) -> tuple:
+    """Treedef + per-leaf (shape, dtype) — the aval part of a jit key."""
+    leaves, treedef = jax.tree.flatten(tree)
+    return (
+        str(treedef),
+        tuple(
+            (tuple(getattr(l, "shape", ())), str(getattr(l, "dtype", type(l))))
+            for l in leaves
+        ),
+    )
+
+
+def _device_nbytes(obj) -> int:
+    """Total device bytes of the jax Arrays inside ``obj`` — which may be
+    a plain (unregistered) dataclass like ShardedData, so unpack its
+    fields before the pytree walk."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        parts = [getattr(obj, f.name) for f in dataclasses.fields(obj)]
+    else:
+        parts = [obj]
+    return sum(
+        int(l.nbytes)
+        for part in parts
+        for l in jax.tree.leaves(part)
+        if isinstance(l, jax.Array)
+    )
+
+
+# ---------------------------------------------------------------------------
+# lookups
+
+
+def get_or_build_data(key, build: Callable[[], Any]):
+    """ShardedData for ``key``, building (stack + upload) on miss.
+
+    Returns ``(data, hit)``. jax Arrays are immutable, so sharing one
+    ShardedData across runs is safe."""
+    if not _enabled or key is None:
+        return build(), False
+    if key in _data_cache:
+        data, nbytes = _data_cache[key]
+        _data_cache.move_to_end(key)
+        _stats.data_hits += 1
+        _stats.bytes_reused += nbytes
+        return data, True
+    data = build()
+    _stats.data_misses += 1
+    _data_cache[key] = (data, _device_nbytes(data))
+    while len(_data_cache) > DATA_CACHE_MAX:
+        _data_cache.popitem(last=False)
+    return data, False
+
+
+def get_or_compile(key, compile_fn: Callable[[], tuple[Any, float]]):
+    """Compiled scan executable for ``key``.
+
+    ``compile_fn`` runs on miss and returns ``(executable,
+    compile_seconds)`` — the measured trace+compile+warmup cost, credited
+    to ``compile_seconds_saved`` on every later hit. Returns
+    ``(executable, hit)``."""
+    if not _enabled:
+        return compile_fn()[0], False
+    if key in _exec_cache:
+        ex, secs = _exec_cache[key]
+        _exec_cache.move_to_end(key)
+        _stats.exec_hits += 1
+        _stats.compile_seconds_saved += secs
+        return ex, True
+    ex, secs = compile_fn()
+    _stats.exec_misses += 1
+    _exec_cache[key] = (ex, secs)
+    while len(_exec_cache) > EXEC_CACHE_MAX:
+        _exec_cache.popitem(last=False)
+    return ex, False

@@ -49,6 +49,10 @@ class RunSummary:
     #: carried as its own artifact field so the flattened rows stay
     #: attributable without overloading the display label
     suite: Optional[str] = None
+    #: sweep-engine cache telemetry for this run (train/cache.py via
+    #: TrainResult.cache_info): data/exec hit-miss, compile seconds saved,
+    #: bytes not re-uploaded — how much of the sweep the caches absorbed
+    cache: Optional[dict] = None
 
     def row(self) -> dict:
         out = {
@@ -72,6 +76,8 @@ class RunSummary:
             out["suite"] = self.suite
         if self.note:
             out["note"] = self.note
+        if self.cache is not None:
+            out["cache"] = self.cache
         return out
 
 
@@ -151,6 +157,7 @@ def compare(
                 ),
                 training_loss=ev.training_loss,
                 timeset=res.timeset,
+                cache=res.cache_info,
             )
         )
     return out

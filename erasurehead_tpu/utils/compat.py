@@ -1,0 +1,75 @@
+"""jax version compatibility shims.
+
+The codebase targets the modern jax surface (``jax.shard_map`` with the
+``check_vma`` knob, jax >= 0.6); this image ships jax 0.4.x where the same
+primitive lives at ``jax.experimental.shard_map.shard_map`` and the
+replication checker is spelled ``check_rep``. One wrapper here keeps every
+call site on the modern spelling.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax < 0.6
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+#: Does this jax implicitly psum the cotangent of a replicated operand when
+#: jax.grad runs INSIDE a shard_map body? True under the >= 0.6 vma system
+#: (an unvarying primal's cotangent is the mesh-wide sum); False on 0.4.x,
+#: where jax.grad in the body yields the LOCAL partial gradient and the
+#: caller must psum explicitly (parallel/step._weighted_loss_grad).
+IMPLICIT_REPLICATED_GRAD_PSUM = _CHECK_KW == "check_vma"
+
+
+def axis_size(axis_name):
+    """``lax.axis_size`` across jax versions (0.4.x lacks it; the psum of
+    a non-tracer 1 is the documented size idiom there — constant-folded,
+    no collective)."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def make_array_from_callback(shape, sharding, data_callback, dtype=None):
+    """``jax.make_array_from_callback`` across versions: 0.4.x has no
+    ``dtype`` kwarg (the callback's outputs carry it; the explicit kwarg
+    only matters to newer jax when a process owns zero shards)."""
+    import inspect
+
+    import jax
+
+    fn = jax.make_array_from_callback
+    if "dtype" in inspect.signature(fn).parameters:
+        return fn(shape, sharding, data_callback, dtype=dtype)
+    return fn(shape, sharding, data_callback)
+
+
+def pcast(x, axis_name, *, to="varying"):
+    """``lax.pcast`` across jax versions: a vma-type cast under the >= 0.6
+    varying-manual-axes system, and (correctly) a no-op on 0.4.x, which
+    has no vma tracking to satisfy."""
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to=to)
+    return x
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    """``jax.shard_map`` across jax versions.
+
+    ``check_vma`` maps onto the installed version's checker kwarg
+    (``check_rep`` on jax < 0.6); None leaves the version default.
+    """
+    if check_vma is not None:
+        kwargs[_CHECK_KW] = check_vma
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

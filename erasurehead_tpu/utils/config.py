@@ -392,6 +392,36 @@ class RunConfig:
                     f"(got {self.deadline!r})"
                 )
 
+    def static_signature(self) -> tuple:
+        """The config-derived half of the sweep-engine executable cache key
+        (train/cache.py): every knob that changes the compiled scan's
+        lowering but is NOT already captured by argument shapes/dtypes or
+        by the trainer's resolved-lowering tuple. Per-round weight tables,
+        the arrival schedule, and lr values are traced ARGUMENTS and
+        deliberately absent — sharing the executable across them is the
+        whole point. When adding a lowering knob to RunConfig, add it
+        here."""
+        return (
+            self.model.value,
+            self.compute_mode.value,
+            self.update_rule.value,
+            self.dtype,
+            self.scan_unroll,
+            # features-module lowering knobs (scoped per run by
+            # trainer._with_run_sparse_lanes; they retrace every jit)
+            self.sparse_lanes,
+            self.dense_margin_cols,
+            self.sparse_format,
+            self.fields_scatter,
+            self.fields_margin,
+            # model-family internal axes (change for_mesh's model variant)
+            self.sp_form,
+            self.seq_shards,
+            self.tp_shards,
+            self.pp_shards,
+            self.ep_shards,
+        )
+
     @property
     def effective_alpha(self) -> float:
         return self.alpha if self.alpha is not None else 1.0 / self.n_rows

@@ -41,6 +41,14 @@ def devices():
     return devs
 
 
+#: Can this jaxlib run a multi-process jax.distributed cluster on the CPU
+#: backend? 0.4.x cannot — XLA rejects every cross-process computation with
+#: INVALID_ARGUMENT "Multiprocess computations aren't implemented on the
+#: CPU backend" — so the virtual-cluster tests (test_multihost, the fleet
+#: pod emulation) are structurally unrunnable there, not failing.
+CPU_CLUSTER_SUPPORTED = jax.__version_info__ >= (0, 5)
+
+
 def free_port() -> int:
     """A free localhost TCP port (multi-process cluster tests)."""
     import socket

@@ -374,12 +374,36 @@ class TestTrainingIntegration:
             self._cfg(sparse_format="pairs")
 
 
+# hypothesis is optional in this image: gate the fuzz class so the rest
+# of the module still collects without it
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+
+    _HAVE_HYPOTHESIS = True
+except ImportError:  # pragma: no cover
+    _HAVE_HYPOTHESIS = False
+
+
+class _Hyp:
+    """Pass-through stand-ins so the class body parses without hypothesis
+    (the skipif keeps its tests from ever running)."""
+
+    def __getattr__(self, name):
+        return self
+
+    def __call__(self, *a, **k):
+        return lambda f: f
+
+
+if not _HAVE_HYPOTHESIS:
+    given = settings = st = _Hyp()
+
+
+@pytest.mark.skipif(not _HAVE_HYPOTHESIS, reason="hypothesis not installed")
 class TestInferenceProperty:
     """Hypothesis fuzz: inference + construction round-trips on arbitrary
     field structures, and never mis-identifies perturbed matrices."""
-
-    from hypothesis import given, settings
-    from hypothesis import strategies as st
 
     @staticmethod
     def _build(sizes, n, seed):

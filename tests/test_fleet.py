@@ -257,6 +257,11 @@ def test_jobset_nonpositive_tpu_quantity_rejected(tmp_path):
         tpu_fleet.validate_jobset(_write(tmp_path, doc))
 
 
+@pytest.mark.skipif(
+    "not __import__('conftest').CPU_CLUSTER_SUPPORTED",
+    reason="this jaxlib's CPU backend cannot compile multiprocess "
+    "computations (see conftest.CPU_CLUSTER_SUPPORTED)",
+)
 def test_jobset_command_executes_in_local_pod_emulation(tmp_path):
     """Beyond structural validation (VERDICT r4 weak #6): execute the
     manifest's ACTUAL container command as a local 2-process

@@ -12,11 +12,17 @@ import os
 import subprocess
 import sys
 
-from conftest import cpu_cluster_env, free_port
+from conftest import CPU_CLUSTER_SUPPORTED, cpu_cluster_env, free_port
 import textwrap
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.skipif(
+    not CPU_CLUSTER_SUPPORTED,
+    reason="this jaxlib's CPU backend cannot compile multiprocess "
+    "computations (see conftest.CPU_CLUSTER_SUPPORTED)",
+)
 
 W, ROUNDS, COLS = 4, 3, 16
 

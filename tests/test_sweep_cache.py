@@ -1,0 +1,370 @@
+"""Sweep engine (train/cache.py): run-to-run executable/data caching,
+the seed-vmapped batched runner, and the ADVICE r5 bugfix regressions.
+
+The load-bearing invariants:
+  - cached and fresh runs are BITWISE identical (the cached executable was
+    compiled from an identical lowering — anything less means the cache
+    key is missing a knob);
+  - the key covers everything that changes the lowering: dtype, resolved
+    grad lowering, mesh, shapes — each change must MISS;
+  - a multi-scheme compare() at one shape compiles once and uploads once
+    in deduped mode (partition stacking is scheme-independent);
+  - train_batch() over seeds matches per-seed train() and dispatches once.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from erasurehead_tpu.data.synthetic import generate_gmm
+from erasurehead_tpu.parallel.mesh import worker_mesh
+from erasurehead_tpu.train import cache, experiments, trainer
+from erasurehead_tpu.utils.config import RunConfig
+
+W, ROUNDS = 8, 8
+N_ROWS, N_COLS = 512, 24
+
+
+@pytest.fixture(scope="module")
+def gmm():
+    return generate_gmm(N_ROWS, N_COLS, n_partitions=W, seed=0)
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    """Every test starts and ends with empty caches and zero counters."""
+    cache.clear()
+    cache.set_enabled(True)
+    yield
+    cache.clear()
+
+
+def _cfg(**kw):
+    base = dict(
+        scheme="approx",
+        n_workers=W,
+        n_stragglers=1,
+        num_collect=6,
+        rounds=ROUNDS,
+        n_rows=N_ROWS,
+        n_cols=N_COLS,
+        update_rule="AGD",
+        lr_schedule=0.5,
+        add_delay=True,
+        seed=3,
+    )
+    base.update(kw)
+    return RunConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# executable + data cache
+
+
+class TestRunToRunCache:
+    def test_second_run_hits_and_is_bitwise_identical(self, gmm):
+        r1 = trainer.train(_cfg(), gmm)
+        assert r1.cache_info["exec_misses"] == 1
+        assert r1.cache_info["data_hit"] is False
+        r2 = trainer.train(_cfg(), gmm)
+        assert r2.cache_info["exec_hits"] == 1
+        assert r2.cache_info["exec_misses"] == 0
+        assert r2.cache_info["data_hit"] is True
+        assert r2.cache_info["compile_seconds_saved"] > 0
+        assert r2.cache_info["bytes_reused"] > 0
+        # the hard correctness bar: BITWISE equality, not allclose
+        assert np.array_equal(
+            np.asarray(r1.params_history), np.asarray(r2.params_history)
+        )
+        assert np.array_equal(
+            np.asarray(r1.final_params), np.asarray(r2.final_params)
+        )
+
+    def test_cached_matches_cache_disabled_bitwise(self, gmm):
+        """A cache-served run == the same run with the engine off."""
+        trainer.train(_cfg(), gmm)  # populate
+        cached = trainer.train(_cfg(), gmm)
+        assert cached.cache_info["exec_hits"] == 1
+        cache.set_enabled(False)
+        fresh = trainer.train(_cfg(), gmm)
+        assert fresh.cache_info["enabled"] is False
+        assert np.array_equal(
+            np.asarray(cached.params_history),
+            np.asarray(fresh.params_history),
+        )
+
+    def test_weight_tables_are_arguments_not_keys(self, gmm):
+        """Different scheme, same shapes/lowering -> executable HIT (the
+        per-round weight tables are traced arguments; sharing across them
+        is the engine's whole point). FRC shares AGC's assignment, so the
+        data upload is shared too."""
+        trainer.train(_cfg(scheme="approx"), gmm)
+        r = trainer.train(_cfg(scheme="repcoded"), gmm)
+        assert r.cache_info["exec_hits"] == 1
+        assert r.cache_info["data_hit"] is True
+
+    @pytest.mark.parametrize(
+        "change",
+        [
+            dict(dtype="bfloat16"),
+            dict(flat_grad="on"),
+            dict(update_rule="GD"),
+            dict(scan_unroll=2),
+            dict(compute_mode="deduped"),
+        ],
+    )
+    def test_lowering_changes_invalidate(self, gmm, change):
+        trainer.train(_cfg(), gmm)
+        r = trainer.train(_cfg(**change), gmm)
+        assert r.cache_info["exec_hits"] == 0, change
+        assert r.cache_info["exec_misses"] == 1, change
+
+    def test_mesh_change_invalidates(self, gmm):
+        trainer.train(_cfg(), gmm, mesh=worker_mesh(8))
+        r = trainer.train(_cfg(), gmm, mesh=worker_mesh(4))
+        assert r.cache_info["exec_hits"] == 0
+        assert r.cache_info["data_hit"] is False
+
+    def test_dataset_identity_keys_data_cache(self, gmm):
+        """A different dataset object of the same shape must re-upload."""
+        other = generate_gmm(N_ROWS, N_COLS, n_partitions=W, seed=9)
+        trainer.train(_cfg(), gmm)
+        r = trainer.train(_cfg(), other)
+        assert r.cache_info["data_hit"] is False
+        # but the executable is shape-keyed and hits
+        assert r.cache_info["exec_hits"] == 1
+
+    def test_two_scheme_compare_accounting(self, gmm):
+        """compare() across two schemes: one compile + one upload total,
+        telemetry carried into the experiment rows."""
+        configs = {
+            "approx": _cfg(scheme="approx"),
+            "repcoded": _cfg(scheme="repcoded"),
+        }
+        rows = experiments.compare(configs, gmm)
+        assert len(rows) == 2
+        by_label = {r.label: r.cache for r in rows}
+        assert by_label["approx"]["exec_misses"] == 1
+        assert by_label["repcoded"]["exec_hits"] == 1
+        assert by_label["repcoded"]["exec_misses"] == 0
+        assert by_label["repcoded"]["data_hit"] is True
+        assert "cache" in rows[1].row()
+        s = cache.stats()
+        assert s.exec_misses == 1 and s.data_misses == 1
+
+    def test_seven_scheme_compare_one_compile_one_upload(self):
+        """The acceptance bar: seven schemes at the canonical W=30 shape,
+        deduped mode (partition stacking is scheme-independent), perform
+        exactly ONE scan compile and ONE data upload."""
+        W30 = 30
+        data = generate_gmm(W30 * 16, N_COLS, n_partitions=W30, seed=0)
+        common = dict(
+            n_workers=W30, n_stragglers=2, rounds=4, n_rows=W30 * 16,
+            n_cols=N_COLS, update_rule="AGD", lr_schedule=0.5,
+            add_delay=True, seed=0, compute_mode="deduped",
+        )
+        configs = {
+            "naive": RunConfig(scheme="naive", **common),
+            "cyccoded": RunConfig(scheme="cyccoded", **common),
+            "repcoded": RunConfig(scheme="repcoded", **common),
+            "approx": RunConfig(
+                scheme="approx", **{**common, "num_collect": 15}
+            ),
+            "avoidstragg": RunConfig(scheme="avoidstragg", **common),
+            "randreg": RunConfig(
+                scheme="randreg", **{**common, "num_collect": 15}
+            ),
+            "deadline": RunConfig(
+                scheme="deadline", **{**common, "deadline": 1.0}
+            ),
+        }
+        assert len(configs) == 7
+        rows = experiments.compare(configs, data)
+        assert len(rows) == 7
+        s = cache.stats()
+        assert s.exec_misses == 1, s.snapshot()
+        assert s.data_misses == 1, s.snapshot()
+        assert s.exec_hits == 6 and s.data_hits == 6
+
+    def test_disabled_cache_never_counts(self, gmm):
+        cache.set_enabled(False)
+        trainer.train(_cfg(), gmm)
+        trainer.train(_cfg(), gmm)
+        s = cache.stats()
+        assert s.exec_hits == s.exec_misses == 0
+        assert s.data_hits == s.data_misses == 0
+
+    def test_lru_eviction_bounds_memory(self, gmm):
+        for r in range(cache.DATA_CACHE_MAX + 2):
+            trainer.train(_cfg(rounds=2, seed=r, dtype="float32"), gmm)
+        assert len(cache._data_cache) <= cache.DATA_CACHE_MAX
+        assert len(cache._exec_cache) <= cache.EXEC_CACHE_MAX
+
+
+# ---------------------------------------------------------------------------
+# seed-vmapped batched runner
+
+
+class TestTrainBatch:
+    def test_matches_per_seed_train(self, gmm):
+        seeds = [3, 11, 42, 123]
+        batch = trainer.train_batch(_cfg(), gmm, seeds)
+        assert len(batch) == len(seeds)
+        info = batch[0].cache_info
+        assert info["batch_size"] == 4 and info["batch_dispatches"] == 1
+        for s, res in zip(seeds, batch):
+            single = trainer.train(_cfg(seed=s), gmm)
+            np.testing.assert_allclose(
+                np.asarray(res.params_history),
+                np.asarray(single.params_history),
+                rtol=2e-5, atol=1e-6,
+            )
+            assert res.config.seed == s
+            # per-seed control plane flows through: same simulated clocks
+            np.testing.assert_array_equal(res.timeset, single.timeset)
+            np.testing.assert_array_equal(res.collected, single.collected)
+
+    def test_single_dispatch_and_cache_reuse(self, gmm):
+        seeds = [0, 1, 2, 3]
+        b1 = trainer.train_batch(_cfg(), gmm, seeds)
+        assert b1[0].cache_info["exec_misses"] == 1
+        b2 = trainer.train_batch(_cfg(), gmm, seeds)
+        assert b2[0].cache_info["exec_hits"] == 1
+        # batch results share the one dispatch's wall clock
+        assert len({r.wall_time for r in b2}) == 1
+        for a, b in zip(b1, b2):
+            assert np.array_equal(
+                np.asarray(a.params_history), np.asarray(b.params_history)
+            )
+
+    def test_deduped_mode_batches(self, gmm):
+        seeds = [5, 6]
+        batch = trainer.train_batch(
+            _cfg(compute_mode="deduped"), gmm, seeds
+        )
+        for s, res in zip(seeds, batch):
+            single = trainer.train(
+                _cfg(compute_mode="deduped", seed=s), gmm
+            )
+            np.testing.assert_allclose(
+                np.asarray(res.params_history),
+                np.asarray(single.params_history),
+                rtol=2e-5, atol=1e-6,
+            )
+
+    def test_seed_dependent_layout_refused(self, gmm):
+        with pytest.raises(ValueError, match="seed-dependent"):
+            trainer.train_batch(_cfg(scheme="cyccoded"), gmm, [0, 1])
+
+    def test_measured_mode_refused(self, gmm):
+        with pytest.raises(ValueError, match="measured"):
+            trainer.train_batch(
+                _cfg(arrival_mode="measured", compute_mode="faithful"),
+                gmm, [0, 1],
+            )
+
+    def test_empty_seeds_refused(self, gmm):
+        with pytest.raises(ValueError, match="at least one"):
+            trainer.train_batch(_cfg(), gmm, [])
+
+
+# ---------------------------------------------------------------------------
+# ADVICE r5 bugfix regressions
+
+
+class TestAdviceFixes:
+    def test_partial_gather_tree_fixed_dtype_both_branches(self):
+        """ADVICE r5 #1: a worker-holding process's (possibly bf16/f32
+        mixed) weighted leaves and a workerless process's zero leaves must
+        reach process_allgather in ONE identical dtype."""
+        weighted = {
+            "a": jnp.ones((3,), jnp.bfloat16),
+            "b": jnp.ones((2, 2), jnp.float32),
+        }
+        zero_g = {
+            "a": jnp.zeros((3,), jnp.bfloat16),
+            "b": jnp.zeros((2, 2), jnp.float32),
+        }
+        holding = trainer._partial_gather_tree(weighted, zero_g)
+        empty = trainer._partial_gather_tree(None, zero_g)
+        for tree in (holding, empty):
+            dtypes = {l.dtype for l in jax.tree.leaves(tree)}
+            assert dtypes == {np.dtype(np.float32)}, dtypes
+        for k in ("a", "b"):
+            assert holding[k].shape == empty[k].shape
+        assert (empty["a"] == 0).all()
+        np.testing.assert_array_equal(
+            holding["b"], np.ones((2, 2), np.float32)
+        )
+
+    def test_np_global_rejects_unaddressable_single_device(self, monkeypatch):
+        """ADVICE r5 #2: SingleDeviceSharding + not fully addressable (an
+        explicit placement on another host's device) must raise, not do a
+        local read of a value this process does not hold."""
+        from unittest import mock
+
+        from jax.sharding import SingleDeviceSharding
+
+        from erasurehead_tpu.data import sharding as sharding_lib
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        x = mock.MagicMock(spec=jax.Array)
+        x.sharding = SingleDeviceSharding(jax.devices()[0])
+        x.is_fully_addressable = False
+        with pytest.raises(ValueError, match="does not own"):
+            sharding_lib.np_global(x)
+        # the host-local case still reads locally
+        ok = jax.device_put(jnp.arange(3.0), jax.devices()[0])
+        np.testing.assert_array_equal(
+            sharding_lib.np_global(ok), np.arange(3.0)
+        )
+
+    def test_backend_rank_without_num_processes_raises(self, monkeypatch):
+        """ADVICE r5 #3: a consumed rank env var with no process count
+        must raise a ValueError naming JAX_NUM_PROCESSES, not forward the
+        partial pair to jax.distributed.initialize."""
+        from erasurehead_tpu.parallel import backend
+
+        monkeypatch.setattr(backend, "_initialized", False)
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:9999")
+        monkeypatch.setenv("JOB_COMPLETION_INDEX", "1")
+        monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+        monkeypatch.delenv("MEGASCALE_COORDINATOR_ADDRESS", raising=False)
+        called = []
+        monkeypatch.setattr(
+            jax.distributed, "initialize",
+            lambda *a, **k: called.append((a, k)),
+        )
+        with pytest.raises(ValueError, match="JAX_NUM_PROCESSES"):
+            backend.initialize_distributed()
+        assert not called  # raised BEFORE touching jax.distributed
+        # the full pair still initializes
+        monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+        info = backend.initialize_distributed()
+        assert called and called[0][1]["num_processes"] == 2
+        assert info["process_count"] >= 1
+        monkeypatch.setattr(backend, "_initialized", False)
+
+
+# ---------------------------------------------------------------------------
+# key-builder unit behavior
+
+
+def test_dataset_token_is_stable_per_object(gmm):
+    t1 = cache.dataset_token(gmm)
+    t2 = cache.dataset_token(gmm)
+    assert t1 == t2
+    other = generate_gmm(64, 8, n_partitions=4, seed=1)
+    assert cache.dataset_token(other) != t1
+
+
+def test_tree_signature_distinguishes_shape_and_dtype():
+    a = {"x": jnp.zeros((2, 3), jnp.float32)}
+    b = {"x": jnp.zeros((2, 3), jnp.bfloat16)}
+    c = {"x": jnp.zeros((3, 2), jnp.float32)}
+    sigs = {cache.tree_signature(t) for t in (a, b, c)}
+    assert len(sigs) == 3
