@@ -97,6 +97,14 @@ if _MARGIN_COLS_ENV:
 COMPUTE_MODE = os.environ.get("BENCH_MODE", "faithful")
 if COMPUTE_MODE == "deduped":
     METRIC_SUFFIX += "_deduped"
+# stack-transport knob (utils/config.stack_mode): "ring" keeps only the
+# partition-major stack and streams the faithful redundancy over ppermute
+# neighbor hops inside the step — the memory-side counterpart of deduped
+# mode, with bitwise-identical trajectories. Tagged so ring entries never
+# collide with the canonical materialized captures.
+STACK_MODE = os.environ.get("BENCH_STACK", "materialized")
+if STACK_MODE == "ring":
+    METRIC_SUFFIX += "_ring"
 # flat-stack lowering knob (parallel/step.make_flat_grad_fn): "on"/"off"
 # force the flat vs per-slot closed-form lowering; unset = cfg default
 # ("auto", step.resolve_flat_grad's per-stack-kind rules). Tagged so sweep entries
@@ -231,6 +239,7 @@ def _record_or_annotate(payload: dict) -> dict:
         payload.get("dtype", "float32") == "float32"
         and not _MARGIN_COLS_ENV
         and COMPUTE_MODE == "faithful"
+        and STACK_MODE == "materialized"
         and not FLAT_GRAD
         and not MARGIN_FLAT
     )
@@ -304,6 +313,8 @@ def child() -> None:
         dense_margin_cols=MARGIN_COLS,
         # BENCH_MODE=deduped: per-partition compute, 1/(s+1) the traffic
         compute_mode=COMPUTE_MODE,
+        # BENCH_STACK=ring: partition-major stack + ppermute hop transport
+        stack_mode=STACK_MODE,
         # BENCH_FLAT: force the flat-stack closed-form lowering on/off
         # (unset = "auto", step.resolve_flat_grad decides per stack kind)
         flat_grad=FLAT_GRAD or "auto",
@@ -338,6 +349,15 @@ def child() -> None:
         }
     except Exception as e:  # noqa: BLE001 — extras must never kill the bench
         print(f"bench: sweep-engine extra failed: {e}", file=sys.stderr)
+
+    # ---- memory telemetry (the stack_mode=ring (s+1)x claim, by numbers) --
+    mem_extra = {}
+    if result.cache_info:
+        mem_extra = {
+            "stack_mode": result.cache_info.get("stack_mode"),
+            "stack_bytes": result.cache_info.get("stack_bytes"),
+            "memory_analysis": result.cache_info.get("memory_analysis"),
+        }
 
     steps_per_sec = result.steps_per_sec
     # reference-protocol effective rate on the identical straggler schedule
@@ -383,6 +403,7 @@ def child() -> None:
                 "bytes_per_step": bytes_per_step,
                 "achieved_gbps": round(float(achieved_gbps), 2),
                 "pct_roofline": pct_roofline,
+                **mem_extra,
                 **sweep_extra,
             }
         )
@@ -416,6 +437,26 @@ if __name__ == "__main__":
                 _failure_record(
                     f"BENCH_MODE must be faithful or deduped, "
                     f"got {COMPUTE_MODE!r}"
+                )
+            )
+        )
+        sys.exit(0 if "--child" not in sys.argv else 1)
+    if STACK_MODE not in ("materialized", "ring", "auto"):
+        print(
+            json.dumps(
+                _failure_record(
+                    f"BENCH_STACK must be materialized, ring, or auto, "
+                    f"got {STACK_MODE!r}"
+                )
+            )
+        )
+        sys.exit(0 if "--child" not in sys.argv else 1)
+    if STACK_MODE == "ring" and COMPUTE_MODE == "deduped":
+        print(
+            json.dumps(
+                _failure_record(
+                    "BENCH_STACK=ring streams the faithful stack; it does "
+                    "not compose with BENCH_MODE=deduped"
                 )
             )
         )

@@ -130,6 +130,14 @@ def _flags_parser() -> argparse.ArgumentParser:
                    help="uniform per-worker speed spread in [1-s,1+s]")
     p.add_argument("--partitions-per-worker", type=int, default=0)
     p.add_argument("--compute-mode", default="faithful", choices=["faithful", "deduped"])
+    p.add_argument("--stack-mode", default="materialized",
+                   choices=["materialized", "ring", "auto"],
+                   help="faithful-mode stack transport: 'ring' keeps only "
+                        "the partition-major stack and streams each "
+                        "device's redundant slots from its ring neighbors "
+                        "per step (bitwise-identical trajectories, (s+1)x "
+                        "less device data); 'auto' switches to ring past a "
+                        "footprint estimate")
     p.add_argument("--use-pallas", default="auto", choices=["auto", "on", "off"],
                    help="fused pallas gradient kernel (ops/kernels.py)")
     p.add_argument("--dtype", default="float32",
@@ -254,6 +262,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         is_real_data=ns.input_dir is not None and ns.dataset != "artificial",
         partitions_per_worker=ns.partitions_per_worker,
         compute_mode=ns.compute_mode,
+        stack_mode=ns.stack_mode,
         use_pallas=ns.use_pallas,
         dtype=ns.dtype,
         arrival_mode=ns.arrival_mode,

@@ -20,9 +20,17 @@ import scipy.sparse as sps
 from erasurehead_tpu.data.synthetic import Dataset
 
 
-def save_dense_text(path: str, m: np.ndarray) -> None:
+#: The reference's label writer truncates every value to three decimals
+#: ("%5.3f", src/util.py:32-36) — label files written BY the reference
+#: carry that precision loss, and our loaders must tolerate the form
+#: (pinned in tests/test_data.py). We default to full precision instead;
+#: pass ``fmt=REFERENCE_LABEL_FMT`` to write byte-compatible files.
+REFERENCE_LABEL_FMT = "%5.3f"
+
+
+def save_dense_text(path: str, m: np.ndarray, fmt: str = "%.18g") -> None:
     """Whitespace text matrix, reference format (src/util.py:26-30)."""
-    np.savetxt(path, np.atleast_2d(m), fmt="%.18g")
+    np.savetxt(path, np.atleast_2d(m), fmt=fmt)
 
 
 def load_dense_text(path: str) -> np.ndarray:

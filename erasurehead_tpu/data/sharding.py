@@ -9,6 +9,13 @@ compute mode — a worker-major stack [W, S, rows, F] gathered through
 disk+RAM in the reference). Stacks are then device_put sharded over the
 worker mesh axis.
 
+``stack_mode="ring"`` drops the materialized redundancy: only the
+partition-major stack is resident, and each device reconstructs its
+workers' slot buffer per step from its ring neighbors' shards over
+``lax.ppermute`` hops (:class:`RingPlan`; the grad body lives in
+parallel/step.make_ring_faithful_grad_fn). Same science, (s+1)x less
+device data.
+
 Row-count convention matched to the reference: rows_per_partition =
 n_samples // P with trailing remainder rows dropped from training
 (src/coded.py:23's integer division; the remainder still appears in the
@@ -115,6 +122,141 @@ def worker_stack(layout: CodingLayout, Xp, yp):
     return take(Xp), yp[layout.assignment]
 
 
+# ---------------------------------------------------------------------------
+# Ring-streamed faithful stack (stack_mode="ring")
+# ---------------------------------------------------------------------------
+
+#: stack_mode="auto" switches faithful runs to the ring transport once the
+#: MATERIALIZED worker stack would exceed this many device bytes (per
+#: replica of the data, summed over the mesh). Below it, the redundant
+#: stack is cheap and the materialized mode keeps its zero-transport step.
+RING_AUTO_MIN_BYTES = 1 << 30
+
+
+@dataclasses.dataclass(frozen=True)
+class RingPlan:
+    """Static transport plan turning the partition-major stack into each
+    device's worker-major slot buffer via ring neighbor hops.
+
+    The faithful mode's redundancy is *structured*: cyclic MDS/AGC
+    assignments give worker ``w`` partitions ``{w..w+s} mod P`` and FRC
+    groups are block-local, so every redundant partition is the primary
+    partition of a near ring-neighbor device. Instead of materializing the
+    ``[W, S, rows, F]`` stack ((s+1)x the data in HBM), each device keeps
+    only its ``[Pl, rows, F]`` partition shard and receives the blocks it
+    is missing over ``n_hops - 1`` ``lax.ppermute`` neighbor hops (the
+    same ICI pattern as parallel/ring.py's ring attention).
+
+    ``sel[d, h, wl, s]`` is the index INTO THE VISITING BLOCK (the
+    partition shard originally owned by device ``(d + h) % D``) that fills
+    local worker ``wl``'s slot ``s`` on device ``d`` at fill-step ``h``,
+    or -1 when that slot is not filled at this hop. Hop 0 is the device's
+    own block (no communication); ring-local assignments need
+    ``1 + ceil(s / Pl)`` fill steps, and an arbitrary (non-ring-local)
+    assignment degrades gracefully to at most a full rotation of ``D``
+    fill steps — the general fallback is the same program with more hops,
+    never a different code path.
+    """
+
+    n_devices: int
+    n_hops: int  # fill steps; n_hops - 1 ppermutes per gradient step
+    sel: np.ndarray  # [D, n_hops, Wl, S] int32, -1 = not filled this hop
+
+    @property
+    def local_workers(self) -> int:
+        return self.sel.shape[2]
+
+    @property
+    def n_slots(self) -> int:
+        return self.sel.shape[3]
+
+
+def plan_ring_transport(layout: CodingLayout, n_devices: int) -> RingPlan:
+    """Build the :class:`RingPlan` for ``layout`` on a ``n_devices`` ring.
+
+    Requires both the worker axis (W, the compute sharding) and the
+    partition axis (P, the data sharding) to fold evenly onto the ring;
+    every layout family here has P a multiple of W, so any device count
+    dividing W works.
+    """
+    W, S, P = layout.n_workers, layout.n_slots, layout.n_partitions
+    D = int(n_devices)
+    if W % D or P % D:
+        raise ValueError(
+            f"ring stack mode needs n_workers={W} and n_partitions={P} "
+            f"divisible by the {D} worker-axis devices"
+        )
+    Wl, Pl = W // D, P // D
+    assignment = np.asarray(layout.assignment)
+    sel = np.full((D, _ring_hops(layout, D), Wl, S), -1, dtype=np.int32)
+    for w in range(W):
+        d = w // Wl
+        for s in range(S):
+            p = int(assignment[w, s])
+            hop = (p // Pl - d) % D
+            sel[d, hop, w % Wl, s] = p % Pl
+    return RingPlan(n_devices=D, n_hops=sel.shape[1], sel=sel)
+
+
+def _ring_hops(layout: CodingLayout, n_devices: int) -> int:
+    """Fill steps needed: 1 + the farthest forward ring distance from any
+    worker's device to a device owning one of its assigned partitions."""
+    W, P = layout.n_workers, layout.n_partitions
+    D = n_devices
+    Wl, Pl = W // D, P // D
+    assignment = np.asarray(layout.assignment)
+    dev_of_w = np.arange(W)[:, None] // Wl
+    hop = (assignment // Pl - dev_of_w) % D
+    return int(hop.max()) + 1
+
+
+def estimate_worker_stack_bytes(dataset: Dataset, layout: CodingLayout, dtype) -> int:
+    """Host-side estimate of the MATERIALIZED faithful stack's device bytes
+    (the stack_mode="auto" footprint gate). Dense: W * S * rows * F *
+    itemsize; sparse stacks are scaled from the CSR payload (indices +
+    values per stored entry). An estimate, not an accounting — the gate
+    only has to separate "redundancy is real HBM pressure" from "noise"."""
+    X = dataset.X_train
+    rows = dataset.n_samples // layout.n_partitions
+    dtype = np.dtype(dtype)
+    if sps.issparse(X):
+        nnz_per_row = X.nnz / max(1, X.shape[0])
+        per_row = nnz_per_row * (np.dtype(np.int32).itemsize + dtype.itemsize)
+    else:
+        per_row = X.shape[1] * dtype.itemsize
+    return int(layout.n_workers * layout.n_slots * rows * per_row)
+
+
+def resolve_ring_stack(
+    stack_mode: str,
+    layout: CodingLayout,
+    dataset: Dataset,
+    n_devices: int,
+    dtype,
+    *,
+    supported: bool = True,
+) -> bool:
+    """Should this faithful run stream its stack over the ring?
+
+    "ring" forces (divisibility is validated by plan_ring_transport at use
+    time); "materialized" keeps the reference's redundancy as real HBM;
+    "auto" picks ring only when the redundant stack is actually redundant
+    (storage_overhead > 1), folds onto this mesh, and its footprint
+    estimate crosses RING_AUTO_MIN_BYTES. ``supported=False`` (a trainer
+    path with no ring body, e.g. measured mode) pins auto to materialized.
+    """
+    if stack_mode == "ring":
+        return True
+    if stack_mode != "auto" or not supported:
+        return False
+    if layout.storage_overhead <= 1.0:
+        return False  # nothing redundant to stream
+    W, P, D = layout.n_workers, layout.n_partitions, int(n_devices)
+    if W % D or P % D:
+        return False
+    return estimate_worker_stack_bytes(dataset, layout, dtype) >= RING_AUTO_MIN_BYTES
+
+
 def np_global(x, dtype=None):
     """np.asarray that also works in a multi-controller cluster — the
     fetch-side counterpart of :func:`put_global`.
@@ -219,12 +361,17 @@ def shard_run_data(
     faithful: bool,
     dtype=np.float32,
     sparse_format: str = "padded",
+    ring: bool = False,
 ) -> ShardedData:
     """Build and device_put the stack the compute mode needs.
 
     Deduped mode shards partitions across devices (P % n_devices == 0);
     faithful mode shards logical workers (W % n_devices == 0) and skips the
-    partition-major copy entirely (it would only waste HBM).
+    partition-major copy entirely (it would only waste HBM). Faithful with
+    ``ring=True`` (stack_mode="ring") keeps ONLY the partition-major stack
+    — the worker-major redundancy is reconstructed per step over ppermute
+    neighbor hops (plan_ring_transport), so device and upload bytes drop
+    by the layout's storage overhead ((s+1)x for the plain coded schemes).
 
     ``dtype`` is the DATA dtype: float32 default; bfloat16 halves HBM
     traffic on the bandwidth-bound gradient pass (params and optimizer
@@ -251,7 +398,14 @@ def shard_run_data(
     rows = yp_h.shape[1]
 
     Xp = yp = Xw = yw = None
-    if faithful:
+    if faithful and ring:
+        # ring transport shards COMPUTE by worker and DATA by partition;
+        # both axes must fold onto the mesh
+        mesh_lib.check_divisible(layout.n_workers, mesh, "n_workers")
+        mesh_lib.check_divisible(layout.n_partitions, mesh, "n_partitions")
+        Xp = put(Xp_h)
+        yp = put_global(_cast(yp_h), sharding)
+    elif faithful:
         mesh_lib.check_divisible(layout.n_workers, mesh, "n_workers")
         Xw_h, yw_h = worker_stack(layout, Xp_h, yp_h)
         Xw, yw = put(Xw_h), put_global(_cast(yw_h), sharding)

@@ -24,10 +24,50 @@ WORKER_AXIS = "workers"
 MODEL_AXIS = "model"
 
 
+def ring_order_devices(devices: Sequence) -> list:
+    """Order devices so consecutive mesh positions are physical ICI
+    neighbors (boustrophedon / snake walk over the chip coordinates), so
+    the ring collectives this axis carries — the stack-mode="ring"
+    ppermute hops (parallel/step._ring_fill) and ring attention
+    (parallel/ring.py) — ride single-hop ICI links instead of hashing
+    across the torus.
+
+    Backends without chip coordinates (CPU test meshes, the forced-host
+    driver meshes) keep the given order — the alignment is a TPU locality
+    optimization, never a semantic change (mesh position, not device id,
+    defines the logical ring everywhere).
+    """
+    devs = list(devices)
+    coords = []
+    for d in devs:
+        c = getattr(d, "coords", None)
+        if c is None:
+            return devs
+        coords.append(tuple(c) + (int(getattr(d, "core_on_chip", 0) or 0),))
+    dims = max(len(c) for c in coords)
+    coords = [c + (0,) * (dims - len(c)) for c in coords]
+    span = [sorted({c[i] for c in coords}) for i in range(dims)]
+
+    def snake_key(c):
+        # nested snake: dimension i+1 runs backward whenever the traversal
+        # position in dimension i is odd, so successive keys differ by one
+        # coordinate step
+        key, flip = [], False
+        for i in range(dims):
+            pos = span[i].index(c[i])
+            kpos = (len(span[i]) - 1 - pos) if flip else pos
+            key.append(kpos)
+            flip ^= kpos % 2 == 1
+        return tuple(key)
+
+    order = sorted(range(len(devs)), key=lambda k: snake_key(coords[k]))
+    return [devs[k] for k in order]
+
+
 def worker_mesh(
     n_devices: Optional[int] = None, devices: Optional[Sequence] = None
 ) -> Mesh:
-    """1-D mesh over the worker axis.
+    """1-D mesh over the worker axis, ring-aligned (see ring_order_devices).
 
     ``n_devices`` trims to a prefix of the available devices (useful when the
     logical worker count W must divide the device count's multiple).
@@ -37,7 +77,7 @@ def worker_mesh(
         if n_devices > len(devs):
             raise ValueError(f"asked for {n_devices} devices, have {len(devs)}")
         devs = devs[:n_devices]
-    return Mesh(np.asarray(devs), (WORKER_AXIS,))
+    return Mesh(np.asarray(ring_order_devices(devs)), (WORKER_AXIS,))
 
 
 def worker_plus_axis_mesh(

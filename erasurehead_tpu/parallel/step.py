@@ -170,6 +170,17 @@ def _hybrid_margin_flat_grad(model, params, Xs, ys, ws):
     )
 
 
+def _margin_flat_local_body(model) -> GradFn:
+    """Per-device body of the hybrid lowering (see make_margin_flat_grad_fn);
+    also reusable as the ring transport's local grad (make_ring_faithful_grad_fn)."""
+
+    def local(params, Xs, ys, ws):
+        g = _hybrid_margin_flat_grad(model, params, Xs, ys, ws)
+        return lax.psum(g, WORKER_AXIS)
+
+    return local
+
+
 def make_margin_flat_grad_fn(model, mesh: Mesh) -> GradFn:
     """The hybrid lowering as a whole-grad_fn swap (the _apply_flat_grad
     pattern): drop-in for make_faithful_grad_fn (worker-major
@@ -178,16 +189,33 @@ def make_margin_flat_grad_fn(model, mesh: Mesh) -> GradFn:
     into one slot axis either way. Caller gates on supports_margin_flat.
     """
 
-    def local(params, Xs, ys, ws):
-        g = _hybrid_margin_flat_grad(model, params, Xs, ys, ws)
-        return lax.psum(g, WORKER_AXIS)
-
     return shard_map(
-        local,
+        _margin_flat_local_body(model),
         mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
         out_specs=P(),
     )
+
+
+def _faithful_local_body(model, mesh: Mesh) -> GradFn:
+    """Per-device body of the faithful per-slot step: slot gradients of
+    this device's workers, weighted contraction, psum decode. Shared by
+    make_faithful_grad_fn (materialized stacks) and
+    make_ring_faithful_grad_fn (ring-reconstructed buffers) so the two
+    stack modes can never drift numerically."""
+
+    def local(params, Xw, yw, slot_weights):
+        if _grads_via_loss(model):
+            return _weighted_loss_grad(
+                model, params, Xw, yw, slot_weights, "ws", mesh
+            )
+        per_slot = jax.vmap(
+            jax.vmap(lambda X, y: model.grad_sum(params, X, y))
+        )(Xw, yw)  # leaves [Wl, S, ...]
+        g = _weighted_tree_sum(slot_weights, per_slot, "ws")
+        return lax.psum(g, WORKER_AXIS)
+
+    return local
 
 
 def make_faithful_grad_fn(model, mesh: Mesh) -> GradFn:
@@ -205,23 +233,100 @@ def make_faithful_grad_fn(model, mesh: Mesh) -> GradFn:
     Returns the decoded gradient pytree, replicated.
     """
 
-    def local(params, Xw, yw, slot_weights):
-        if _grads_via_loss(model):
-            return _weighted_loss_grad(
-                model, params, Xw, yw, slot_weights, "ws", mesh
+    return shard_map(
+        _faithful_local_body(model, mesh),
+        mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
+        out_specs=P(),
+        check_vma=_vma_check(model),
+    )
+
+
+def _ring_fill(plan, Xp, yp):
+    """Inside the shard_map body: reconstruct this device's worker-major
+    slot buffer [Wl, S, rows, ...] from the partition-major local shard
+    [Pl, rows, ...] via ``plan.n_hops - 1`` lax.ppermute neighbor hops.
+
+    Hop 0 copies from the device's own block; each further hop rotates the
+    visiting partition block one ring position forward (device d receives
+    device d+1's block, the direction the cyclic codes' w..w+s supports
+    point) and scatters whatever slots that block owns into the buffer.
+    The buffer is a per-step temporary — the (s+1)x redundancy never
+    becomes persistent HBM, and the hops run under lax.scan so XLA can
+    overlap each transfer with the previous hop's fills (the
+    parallel/ring.py pattern). Values are moved, never transformed, so
+    the downstream slot-gradient contraction sees bit-identical inputs to
+    the materialized stack's.
+    """
+    D, H = plan.n_devices, plan.n_hops
+    idx = lax.axis_index(WORKER_AXIS)
+    sel_dev = jnp.asarray(plan.sel)[idx]  # [H, Wl, S], this device's plan
+
+    def fill(buf, blk, sel_h):
+        take = jnp.where(sel_h >= 0, sel_h, 0)  # [Wl, S] safe gather index
+
+        def one(buf_leaf, blk_leaf):
+            cand = blk_leaf[take]  # [Wl, S, rows, ...]
+            mask = (sel_h >= 0).reshape(
+                sel_h.shape + (1,) * (cand.ndim - 2)
             )
-        per_slot = jax.vmap(
-            jax.vmap(lambda X, y: model.grad_sum(params, X, y))
-        )(Xw, yw)  # leaves [Wl, S, ...]
-        g = _weighted_tree_sum(slot_weights, per_slot, "ws")
-        return lax.psum(g, WORKER_AXIS)
+            # buf=None on the first fill: the background is cand*0 so the
+            # buffer inherits the data's exact varying-axes set (the scan
+            # carry type must be stable under shard_map's vma checking —
+            # same trick as parallel/ring.py's accumulator init)
+            prev = cand * 0 if buf_leaf is None else buf_leaf
+            return jnp.where(mask, cand, prev)
+
+        if buf is None:
+            return jax.tree.map(lambda b: one(None, b), blk)
+        return jax.tree.map(one, buf, blk)
+
+    blk = (Xp, yp)
+    buf = fill(None, blk, sel_dev[0])
+    if H > 1:
+        perm = [(i, (i - 1) % D) for i in range(D)]
+
+        def hop(carry, sel_h):
+            buf, blk = carry
+            blk = jax.tree.map(
+                lambda l: lax.ppermute(l, WORKER_AXIS, perm), blk
+            )
+            return (fill(buf, blk, sel_h), blk), None
+
+        (buf, _), _ = lax.scan(hop, (buf, blk), sel_dev[1:])
+    return buf
+
+
+def make_ring_faithful_grad_fn(
+    model, mesh: Mesh, plan, local_body: GradFn = None, check_vma=None
+) -> GradFn:
+    """Faithful-mode decoded gradient from the PARTITION-major stack
+    (stack_mode="ring"): per-step ring transport (:func:`_ring_fill`)
+    rebuilds each device's [Wl, S, rows, ...] slot buffer, then the SAME
+    local grad body as the materialized mode computes and contracts the
+    slot gradients in canonical slot order — trajectories are bitwise
+    identical to materialized faithful; only the transport differs.
+
+    Args of the returned fn:
+      params: replicated pytree.
+      Xp, yp: partition-major stacks [P, rows, ...] / [P, rows], sharded.
+      slot_weights: [W, S] decode x coding weight per slot message.
+    ``local_body`` swaps in an alternative per-device grad body (the flat /
+    margin-flat lowerings) — it receives the reconstructed worker-major
+    buffer exactly as the materialized fn would.
+    """
+    body = local_body or _faithful_local_body(model, mesh)
+
+    def local(params, Xp, yp, slot_weights):
+        Xw, yw = _ring_fill(plan, Xp, yp)
+        return body(params, Xw, yw, slot_weights)
 
     return shard_map(
         local,
         mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
         out_specs=P(),
-        check_vma=_vma_check(model),
+        check_vma=_vma_check(model) if check_vma is None else check_vma,
     )
 
 
@@ -337,6 +442,18 @@ def make_flat_grad_fn(model, mesh: Mesh) -> GradFn:
     differs (tests pin the two to allclose, not bitwise).
     """
 
+    return shard_map(
+        _flat_local_body(model),
+        mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
+        out_specs=P(),
+    )
+
+
+def _flat_local_body(model) -> GradFn:
+    """Per-device body of make_flat_grad_fn; also the ring transport's
+    local grad when flat_grad resolves on (make_ring_faithful_grad_fn)."""
+
     def local(params, Xs, ys, ws):
         from erasurehead_tpu.ops import features as features_lib
 
@@ -351,12 +468,7 @@ def make_flat_grad_fn(model, mesh: Mesh) -> GradFn:
         g = -features_lib.rmatvec(Xf, wf.astype(r.dtype) * r)
         return lax.psum(g, WORKER_AXIS)
 
-    return shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
-        out_specs=P(),
-    )
+    return local
 
 
 def make_fused_grad_fn(kind: str, mesh: Mesh, *, interpret: bool = False) -> GradFn:

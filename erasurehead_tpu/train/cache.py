@@ -147,10 +147,12 @@ def tree_signature(tree) -> tuple:
     )
 
 
-def _device_nbytes(obj) -> int:
+def device_nbytes(obj) -> int:
     """Total device bytes of the jax Arrays inside ``obj`` — which may be
     a plain (unregistered) dataclass like ShardedData, so unpack its
-    fields before the pytree walk."""
+    fields before the pytree walk. Public: the trainers report it as the
+    per-run ``stack_bytes`` telemetry (the number that drops (s+1)x under
+    stack_mode="ring")."""
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         parts = [getattr(obj, f.name) for f in dataclasses.fields(obj)]
     else:
@@ -182,7 +184,7 @@ def get_or_build_data(key, build: Callable[[], Any]):
         return data, True
     data = build()
     _stats.data_misses += 1
-    _data_cache[key] = (data, _device_nbytes(data))
+    _data_cache[key] = (data, device_nbytes(data))
     while len(_data_cache) > DATA_CACHE_MAX:
         _data_cache.popitem(last=False)
     return data, False

@@ -33,7 +33,9 @@ from erasurehead_tpu.data.sharding import (
     ShardedData,
     np_global,
     partition_stack,
+    plan_ring_transport,
     put_global,
+    resolve_ring_stack,
     shard_run_data,
     worker_stack,
 )
@@ -42,7 +44,11 @@ from erasurehead_tpu.models.glm import LinearModel, LogisticModel
 from erasurehead_tpu.models.mlp import MLPModel
 from erasurehead_tpu.ops import codes
 from erasurehead_tpu.parallel import collect, step as step_lib, straggler
-from erasurehead_tpu.parallel.mesh import replicated, worker_mesh
+from erasurehead_tpu.parallel.mesh import (
+    WORKER_AXIS,
+    replicated,
+    worker_mesh,
+)
 from erasurehead_tpu.train import optimizer
 from erasurehead_tpu.utils.config import (
     ComputeMode,
@@ -169,6 +175,11 @@ class _RunSetup:
     # did the sweep-engine data cache (train/cache.py) serve the device
     # stacks, skipping the host re-stack + upload?
     data_cache_hit: bool = False
+    # RESOLVED stack transport for faithful mode (cfg.stack_mode; "auto"
+    # resolves by sharding.resolve_ring_stack's footprint estimate): True
+    # = only the partition-major stack is resident and the step rebuilds
+    # worker slot buffers over ppermute ring hops
+    ring: bool = False
 
 
 def _with_run_sparse_lanes(fn):
@@ -205,6 +216,14 @@ def _with_run_sparse_lanes(fn):
     return wrapper
 
 
+def _worker_axis_size(mesh) -> int:
+    return (
+        int(mesh.shape[WORKER_AXIS])
+        if WORKER_AXIS in mesh.axis_names
+        else int(mesh.devices.size)
+    )
+
+
 def _setup_run(
     cfg: RunConfig,
     dataset: Dataset,
@@ -212,6 +231,7 @@ def _setup_run(
     *,
     faithful: bool,
     single_device: bool = False,
+    ring_ok: bool = True,
 ) -> _RunSetup:
     layout = build_layout(cfg)
     model = build_model(cfg)
@@ -243,15 +263,30 @@ def _setup_run(
         model = model.for_mesh(mesh)
     from erasurehead_tpu.train import cache as cache_lib
 
+    # resolved stack transport: ring streams the faithful redundancy over
+    # ppermute hops instead of materializing it (paths with no ring body —
+    # measured mode — pass ring_ok=False; use_pallas='on' forces the fused
+    # body, so auto pins to materialized there)
+    use_ring = faithful and resolve_ring_stack(
+        cfg.stack_mode,
+        layout,
+        dataset,
+        _worker_axis_size(mesh),
+        jnp.dtype(cfg.dtype),
+        supported=ring_ok and cfg.use_pallas != "on",
+    )
     # device-data cache: repeated runs of the same (dataset, layout
     # stacking, mesh, dtype) reuse the uploaded stacks. The key carries
     # exactly what the stacking consumes — NOT the scheme name: deduped
     # mode stacks partition-major (partition_stack reads only
     # n_partitions, so all non-partial schemes share one upload), while
-    # faithful mode gathers through layout.assignment, so the key carries
-    # the assignment CONTENT (FRC and AGC share an assignment and
-    # therefore a stack; cyclic MDS has its own).
-    if faithful:
+    # materialized faithful mode gathers through layout.assignment, so the
+    # key carries the assignment CONTENT (FRC and AGC share an assignment
+    # and therefore a stack; cyclic MDS has its own). Ring faithful keeps
+    # only the partition-major stack and re-keys on partition content like
+    # deduped — the cache payload shrinks by the same (s+1)x as the stack,
+    # and ring runs share uploads with deduped runs of the same shape.
+    if faithful and not use_ring:
         assignment = np.asarray(layout.assignment)
         stack_sig = ("workers", assignment.shape, assignment.tobytes())
     else:
@@ -270,6 +305,7 @@ def _setup_run(
         lambda: shard_run_data(
             dataset, layout, mesh, faithful=faithful,
             dtype=jnp.dtype(cfg.dtype), sparse_format=cfg.sparse_format,
+            ring=use_ring,
         ),
     )
     params0 = _init_params_f32(cfg, model, dataset.n_features)
@@ -285,6 +321,7 @@ def _setup_run(
         alpha=cfg.effective_alpha,
         n_train=data.n_train,
         data_cache_hit=data_hit,
+        ring=use_ring,
     )
 
 
@@ -317,6 +354,33 @@ def _hard_sync(x) -> None:
             # would ship the leaf over DCN inside timed regions, and a
             # ready buffer is already an unambiguous completion signal
             np.asarray(leaves[0])
+
+
+def _ring_signature(ring_plan) -> tuple:
+    """Executable-cache key component for the ring transport: the hop
+    tables are compiled into the program as constants, so their CONTENT
+    (not just shape) distinguishes executables."""
+    if ring_plan is None:
+        return ("materialized",)
+    return ("ring", ring_plan.n_hops, ring_plan.sel.tobytes())
+
+
+def _memory_analysis(compiled) -> Optional[dict]:
+    """Byte accounting of an AOT-compiled executable (XLA's
+    CompiledMemoryStats), or None where the backend doesn't expose it.
+    Argument bytes are where the ring stack mode's (s+1)x drop shows up;
+    temp bytes carry the per-step reconstruction buffer."""
+    try:
+        ma = compiled.memory_analysis()
+        return {
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+            "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+        }
+    except Exception:  # noqa: BLE001 — telemetry must never fail a run
+        return None
 
 
 @dataclasses.dataclass
@@ -424,7 +488,12 @@ def train(
             np.asarray(layout.slot_is_coded),
         )
     )  # [R, W, S]
-    if faithful:
+    ring_plan = None
+    if faithful and setup.ring:
+        ring_plan = plan_ring_transport(layout, _worker_axis_size(mesh))
+        grad_fn = step_lib.make_ring_faithful_grad_fn(model, mesh, ring_plan)
+        weights_seq, X, y = jnp.asarray(slot_w, dtype), data.Xp, data.yp
+    elif faithful:
         grad_fn = step_lib.make_faithful_grad_fn(model, mesh)
         weights_seq, X, y = jnp.asarray(slot_w, dtype), data.Xw, data.yw
     else:
@@ -432,8 +501,8 @@ def train(
         pw = layout.fold_slot_weights(slot_w)
         weights_seq, X, y = jnp.asarray(pw, dtype), data.Xp, data.yp
 
-    grad_fn = _apply_margin_flat(cfg, model, mesh, X, grad_fn)
-    grad_fn = _apply_flat_grad(cfg, model, mesh, X, grad_fn)
+    grad_fn = _apply_margin_flat(cfg, model, mesh, X, grad_fn, ring_plan)
+    grad_fn = _apply_flat_grad(cfg, model, mesh, X, grad_fn, ring_plan)
 
     # fused single-HBM-pass pallas kernel for dense GLM stacks
     from erasurehead_tpu.ops import kernels as kernels_lib
@@ -453,7 +522,9 @@ def train(
                 "use_pallas='on' and flat_grad='on' are mutually exclusive "
                 "gradient lowerings; force at most one"
             )
-        if dense_glm:
+        # ring transport wins over the auto-fused kernel (the fused body
+        # has no ring variant; use_pallas='on' + ring is config-refused)
+        if dense_glm and not setup.ring:
             grad_fn = step_lib.make_fused_grad_fn(
                 kind, mesh, interpret=(platform != "tpu")
             )
@@ -527,6 +598,7 @@ def train(
     state0 = replicate(state0)
 
     exec_hits = exec_misses = 0
+    mem_info = None
     if start_round >= cfg.rounds:
         # the checkpoint already covers the requested rounds: nothing to run
         empty_hist = jax.tree.map(
@@ -554,6 +626,15 @@ def train(
             cfg.static_signature(),
             step_lib.lowering_signature(cfg, model, X),
             use_fused,
+            # resolved ring transport: "auto" depends on a footprint
+            # estimate the static signature cannot see. The hop plan is
+            # baked into the compiled program as constants, and under ring
+            # the X stack no longer carries the slot count — so the plan
+            # CONTENT and the weight-table shape must key the executable
+            # (two schemes can share every array shape but differ in
+            # assignment, e.g. cyclic MDS vs randreg).
+            _ring_signature(ring_plan),
+            tuple(weights_seq.shape),
             cache_lib.mesh_signature(mesh),
             cache_lib.tree_signature(state0),
             cache_lib.tree_signature((X, y)),
@@ -615,6 +696,7 @@ def train(
             if len(pieces) == 1
             else jax.tree.map(lambda *xs: jnp.concatenate(xs), *pieces)
         )
+        mem_info = _memory_analysis(next(iter(compiled.values())))
 
     stats_after = cache_lib.stats().snapshot()
     return TrainResult(
@@ -643,6 +725,17 @@ def train(
             ),
             "bytes_reused": stats_after["bytes_reused"]
             - stats_before["bytes_reused"],
+            # memory telemetry: the (s+1)x ring claim asserted by numbers —
+            # resident device bytes of the training stacks (what upload /
+            # cache payload / HBM residency scale with) plus the compiled
+            # executable's own accounting (argument/temp/output bytes)
+            "stack_mode": (
+                "ring"
+                if setup.ring
+                else ("materialized" if faithful else "deduped")
+            ),
+            "stack_bytes": cache_lib.device_nbytes(data),
+            "memory_analysis": mem_info,
         },
     )
 
@@ -741,15 +834,20 @@ def train_batch(
             for s in schedules
         ]
     )  # [B, R, W, S]
-    if faithful:
+    ring_plan = None
+    if faithful and setup.ring:
+        ring_plan = plan_ring_transport(layout, _worker_axis_size(mesh))
+        grad_fn = step_lib.make_ring_faithful_grad_fn(model, mesh, ring_plan)
+        weights_seq, X, y = jnp.asarray(slot_w, dtype), data.Xp, data.yp
+    elif faithful:
         grad_fn = step_lib.make_faithful_grad_fn(model, mesh)
         weights_seq, X, y = jnp.asarray(slot_w, dtype), data.Xw, data.yw
     else:
         grad_fn = step_lib.make_deduped_grad_fn(model, mesh)
         pw = np.stack([layout.fold_slot_weights(w) for w in slot_w])
         weights_seq, X, y = jnp.asarray(pw, dtype), data.Xp, data.yp
-    grad_fn = _apply_margin_flat(cfg, model, mesh, X, grad_fn)
-    grad_fn = _apply_flat_grad(cfg, model, mesh, X, grad_fn)
+    grad_fn = _apply_margin_flat(cfg, model, mesh, X, grad_fn, ring_plan)
+    grad_fn = _apply_flat_grad(cfg, model, mesh, X, grad_fn, ring_plan)
 
     # per-seed init, stacked on a leading batch axis then replicated
     states = [
@@ -791,6 +889,8 @@ def train_batch(
         len(seeds),
         cfg.static_signature(),
         step_lib.lowering_signature(cfg, model, X),
+        _ring_signature(ring_plan),
+        tuple(weights_seq.shape),
         cache_lib.mesh_signature(mesh),
         cache_lib.tree_signature(state0),
         cache_lib.tree_signature((X, y)),
@@ -828,6 +928,13 @@ def train_batch(
         - stats_before["bytes_reused"],
         "batch_size": len(seeds),
         "batch_dispatches": 1,
+        "stack_mode": (
+            "ring"
+            if setup.ring
+            else ("materialized" if faithful else "deduped")
+        ),
+        "stack_bytes": cache_lib.device_nbytes(data),
+        "memory_analysis": _memory_analysis(ex),
     }
     results = []
     agg_rate = cfg.rounds * len(seeds) / wall if wall > 0 else 0.0
@@ -961,7 +1068,25 @@ def train_measured(
             "lax.scan to unroll); scan_unroll has no measured-mode "
             "implementation — leave it at 1"
         )
-    setup = _setup_run(cfg, dataset, mesh, faithful=True, single_device=True)
+    if cfg.scheme in (Scheme.PARTIAL_CYCLIC, Scheme.PARTIAL_FRC):
+        # the reference's partial worker really sends its uncoded first
+        # part BEFORE computing the coded second (src/partial_coded.py:
+        # 226-234); this mode times ONE combined message per worker, so it
+        # cannot observe the staggered two-part arrival it exists to
+        # measure — refuse rather than silently measure a different
+        # protocol (the refuse-unimplemented-knobs policy above)
+        raise ValueError(
+            "arrival_mode='measured' has no two-part message timing: the "
+            "partial schemes send their uncoded part before the coded part "
+            "is computed, and timing one combined dispatch would "
+            "misattribute the arrival the mode exists to measure — use the "
+            "simulated trainer for partial schemes"
+        )
+    # ring_ok=False: this mode times each worker's own resident slot stack
+    # per dispatch; the ring transport only exists inside the SPMD step
+    setup = _setup_run(
+        cfg, dataset, mesh, faithful=True, single_device=True, ring_ok=False
+    )
     layout, model, data = setup.layout, setup.model, setup.data
     W = layout.n_workers
     mult = (
@@ -1337,12 +1462,14 @@ def _train_measured_cluster(cfg, dataset, setup, mult, dtype, mesh=None):
     )
 
 
-def _apply_margin_flat(cfg, model, mesh, X, grad_fn):
+def _apply_margin_flat(cfg, model, mesh, X, grad_fn, ring_plan=None):
     """Swap in the hybrid dense lowering (step.make_margin_flat_grad_fn)
     per cfg.margin_flat: flat 2-D margin matmul + batched per-slot
     transpose. "on" forces (raising off the dense closed-form path);
     "auto" defers to step.resolve_margin_flat (MARGIN_FLAT_DEFAULT,
-    pending the dense_f32_marginflat race)."""
+    pending the dense_f32_marginflat race). With ``ring_plan`` set (the
+    ring stack mode), the same per-device body runs behind the ring
+    transport — the lowering choice composes with either transport."""
     if cfg.margin_flat == "on" and not step_lib.supports_margin_flat(model, X):
         raise ValueError(
             "margin_flat='on' needs a closed-form GLM on a dense stack; "
@@ -1350,15 +1477,21 @@ def _apply_margin_flat(cfg, model, mesh, X, grad_fn):
             f"X={type(X).__name__}"
         )
     if step_lib.resolve_margin_flat(cfg.margin_flat, model, X):
+        if ring_plan is not None:
+            return step_lib.make_ring_faithful_grad_fn(
+                model, mesh, ring_plan,
+                local_body=step_lib._margin_flat_local_body(model),
+            )
         return step_lib.make_margin_flat_grad_fn(model, mesh)
     return grad_fn
 
 
-def _apply_flat_grad(cfg, model, mesh, X, grad_fn):
+def _apply_flat_grad(cfg, model, mesh, X, grad_fn, ring_plan=None):
     """Swap in the flat-stack closed-form lowering (step.make_flat_grad_fn)
     per cfg.flat_grad: one matvec/rmatvec pair instead of the batched
     per-slot contraction. "on" forces (raising off the closed-form path),
-    "auto" defers to step.resolve_flat_grad's measurement-pinned rules."""
+    "auto" defers to step.resolve_flat_grad's measurement-pinned rules.
+    Composes with the ring transport like _apply_margin_flat."""
     if cfg.flat_grad == "on" and not step_lib.supports_flat_grad(model, X):
         raise ValueError(
             "flat_grad='on' needs a closed-form GLM (logistic/linear) on a "
@@ -1367,6 +1500,11 @@ def _apply_flat_grad(cfg, model, mesh, X, grad_fn):
             f"X={type(X).__name__}"
         )
     if step_lib.resolve_flat_grad(cfg.flat_grad, model, X):
+        if ring_plan is not None:
+            return step_lib.make_ring_faithful_grad_fn(
+                model, mesh, ring_plan,
+                local_body=step_lib._flat_local_body(model),
+            )
         return step_lib.make_flat_grad_fn(model, mesh)
     return grad_fn
 
@@ -1408,12 +1546,18 @@ def train_dynamic(
         cfg.scheme, layout, cfg.num_collect, cfg.delay_mean, cfg.add_delay,
         deadline=cfg.deadline,
     )
+    if setup.ring:
+        ring_plan = plan_ring_transport(layout, _worker_axis_size(mesh))
+        base_fn = step_lib.make_ring_faithful_grad_fn(model, mesh, ring_plan)
+        X, y = data.Xp, data.yp
+    else:
+        ring_plan = None
+        base_fn = step_lib.make_faithful_grad_fn(model, mesh)
+        X, y = data.Xw, data.yw
     grad_fn = _apply_flat_grad(
-        cfg, model, mesh, data.Xw,
-        _apply_margin_flat(
-            cfg, model, mesh, data.Xw,
-            step_lib.make_faithful_grad_fn(model, mesh),
-        ),
+        cfg, model, mesh, X,
+        _apply_margin_flat(cfg, model, mesh, X, base_fn, ring_plan),
+        ring_plan,
     )
     update_fn = setup.update_fn
     dtype = jnp.float32  # param/update dtype (cfg.dtype is the data dtype)
@@ -1422,7 +1566,6 @@ def train_dynamic(
     lr_seq = jnp.asarray(setup.lr, dtype)
     alpha = setup.alpha
     n_train = setup.n_train
-    X, y = data.Xw, data.yw
 
     state0 = setup.state0
     start = 0

@@ -132,6 +132,21 @@ class RunConfig:
     is_real_data: bool = False
     partitions_per_worker: int = 0  # >0 selects partial schemes' slot count
     compute_mode: ComputeMode = ComputeMode.FAITHFUL
+    # how FAITHFUL mode materializes its (s+1)x-redundant worker stack:
+    #   "materialized" — the worker-major [W, S, rows, F] stack is real HBM
+    #                    (the redundancy is real memory, as it was real
+    #                    disk+RAM in the reference);
+    #   "ring"         — only the partition-major [P, rows, F] stack is
+    #                    resident; each device rebuilds its workers' slot
+    #                    buffer per step over lax.ppermute ring hops
+    #                    (data/sharding.plan_ring_transport,
+    #                    parallel/step.make_ring_faithful_grad_fn) —
+    #                    bitwise-identical trajectories, (s+1)x less
+    #                    device data;
+    #   "auto"         — ring once the materialized stack's footprint
+    #                    estimate crosses sharding.RING_AUTO_MIN_BYTES.
+    # Deduped mode has no redundancy to stream and ignores/refuses it.
+    stack_mode: str = "materialized"
     seed: int = 0  # model init + generator matrix (reference: unseeded)
     # DATA dtype: bfloat16 halves HBM traffic on the gradient pass; model
     # params and optimizer updates always run in float32 (mixed precision)
@@ -252,6 +267,32 @@ class RunConfig:
                 f"arrival_mode must be simulated/measured, got "
                 f"{self.arrival_mode!r}"
             )
+        if self.stack_mode not in ("materialized", "ring", "auto"):
+            raise ValueError(
+                f"stack_mode must be materialized/ring/auto, got "
+                f"{self.stack_mode!r}"
+            )
+        if self.stack_mode == "ring":
+            if self.compute_mode != ComputeMode.FAITHFUL:
+                raise ValueError(
+                    "stack_mode='ring' streams the faithful mode's "
+                    "redundant worker stack; deduped mode has no "
+                    "redundancy to stream — drop one of the two"
+                )
+            if self.arrival_mode == "measured":
+                raise ValueError(
+                    "arrival_mode='measured' times each worker's own "
+                    "resident slot stack per dispatch; the ring transport "
+                    "only exists inside the SPMD step — use "
+                    "stack_mode='materialized' (or 'auto') with measured "
+                    "mode"
+                )
+            if self.use_pallas == "on":
+                raise ValueError(
+                    "use_pallas='on' forces the fused kernel, which has no "
+                    "ring-transport body; force at most one of "
+                    "stack_mode='ring' / use_pallas='on'"
+                )
         from erasurehead_tpu.ops.features import validate_lanes
 
         self.sparse_lanes = validate_lanes(self.sparse_lanes)
@@ -404,6 +445,10 @@ class RunConfig:
         return (
             self.model.value,
             self.compute_mode.value,
+            # the RESOLVED ring choice also enters the trainer-side key
+            # (auto depends on a footprint estimate cfg alone cannot see);
+            # the raw knob here keeps explicit/auto requests distinct
+            self.stack_mode,
             self.update_rule.value,
             self.dtype,
             self.scan_unroll,

@@ -51,6 +51,31 @@ def test_cli_canonical_scale(tmp_path, scheme, extra):
     assert losses[-1] < losses[0]
 
 
+def test_cli_stack_mode_ring(tmp_path):
+    """--stack-mode ring drives the full entry point at W=30: faithful
+    science from the partition-major stack + ring transport, artifacts on
+    disk, loss decreasing — the CLI face of tests/test_ring_stack.py."""
+    data_dir = str(tmp_path / "data")
+    rc = cli.main(
+        [
+            "--scheme", "approx", "--workers", str(W), "--stragglers", "2",
+            "--num-collect", "15", "--rounds", "5", "--rows", str(60 * W),
+            "--cols", "24", "--update-rule", "AGD", "--lr", "1.0",
+            "--add-delay", "--stack-mode", "ring", "--input-dir", data_dir,
+            "--quiet",
+        ]
+    )
+    assert rc == 0
+    results = os.path.join(
+        data_dir, "artificial-data", f"{60 * W}x24", str(W), "results"
+    )
+    files = os.listdir(results)
+    loss_file = next(f for f in files if "training_loss" in f)
+    losses = np.loadtxt(os.path.join(results, loss_file))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
 def test_cli_legacy_13_args(tmp_path):
     """The reference's exact positional calling convention (main.py:20-27):
     n_procs n_rows n_cols input_dir is_real dataset is_coded n_stragglers

@@ -2,6 +2,7 @@
 reference on-disk layout round-trip, prepare CLI, and sparse end-to-end
 training from a prepared directory."""
 
+import dataclasses
 import os
 
 import numpy as np
@@ -171,6 +172,55 @@ def test_sparse_layout_roundtrip(tmp_path, amazon_raw):
     n = 4 * (ds.X_train.shape[0] // 4)
     assert (back.X_train != ds.X_train[:n]).nnz == 0
     assert np.allclose(back.y_train, ds.y_train[:n])
+
+
+def test_roundtrip_tolerates_reference_truncated_labels(tmp_path):
+    """VERDICT r5 #8: the reference's label writer truncates values to
+    three decimals ("%5.3f", src/util.py:32-36), so label files prepared
+    BY the reference carry that precision loss. Our loaders must accept
+    the truncated form — both the classification ±1 labels (exact under
+    truncation) and regression labels (recovered to 5e-4)."""
+    # regression-style labels exercise real truncation (fractional values)
+    ds = generate_gmm(128, 10, n_partitions=4, seed=0)
+    rng = np.random.default_rng(0)
+    ds = dataclasses.replace(
+        ds,
+        y_train=rng.normal(size=ds.y_train.shape) * 3.0,
+        y_test=rng.normal(size=ds.y_test.shape) * 3.0,
+    )
+    out = str(tmp_path / "trunc")
+    data_io.write_reference_layout(ds, out, 4)
+    # rewrite the label files exactly as the reference would have
+    for name, vals in (
+        ("label.dat", ds.y_train[: 4 * (ds.n_samples // 4)]),
+        ("label_test.dat", ds.y_test),
+    ):
+        data_io.save_dense_text(
+            os.path.join(out, name), vals, fmt=data_io.REFERENCE_LABEL_FMT
+        )
+    back = data_io.read_reference_layout(out, 4, sparse=False)
+    n = back.y_train.shape[0]
+    # truncated form parses cleanly and recovers to the written precision
+    assert np.allclose(back.y_train, ds.y_train[:n], atol=5e-4)
+    assert np.allclose(back.y_test, ds.y_test, atol=5e-4)
+    # and is BYTE-faithful to %5.3f: re-reading equals the truncation
+    assert np.array_equal(
+        back.y_train,
+        np.array([float("%5.3f" % v) for v in ds.y_train[:n]]),
+    )
+    # ±1 classification labels survive truncation exactly
+    ds2 = generate_gmm(64, 8, n_partitions=4, seed=1)
+    out2 = str(tmp_path / "trunc2")
+    data_io.write_reference_layout(ds2, out2, 4)
+    data_io.save_dense_text(
+        os.path.join(out2, "label.dat"),
+        ds2.y_train[: 4 * (ds2.n_samples // 4)],
+        fmt=data_io.REFERENCE_LABEL_FMT,
+    )
+    back2 = data_io.read_reference_layout(out2, 4, sparse=False)
+    assert np.array_equal(
+        back2.y_train, ds2.y_train[: back2.y_train.shape[0]]
+    )
 
 
 def test_prepare_cli_synthetic(tmp_path):

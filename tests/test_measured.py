@@ -162,3 +162,23 @@ def test_measured_mode_rejects_unsupported_knobs(data):
         trainer.train_measured(_cfg(margin_flat="on"), data)
     with pytest.raises(ValueError, match="scan_unroll"):
         trainer.train_measured(_cfg(scan_unroll=4), data)
+
+
+def test_measured_mode_refuses_partial_schemes(data):
+    """VERDICT r5 #4: the reference's partial worker sends its uncoded
+    first part BEFORE computing the coded second
+    (src/partial_coded.py:226-234); measured mode times ONE combined
+    message per worker and therefore cannot observe the staggered
+    two-part arrival. The contract is a documented refusal — pinned here
+    so the error (and its reasoning) can't silently regress into a
+    wrong-protocol measurement."""
+    for scheme in ("partialcyccoded", "partialrepcoded"):
+        cfg = _cfg(
+            scheme=scheme, n_stragglers=1, partitions_per_worker=3,
+        )
+        with pytest.raises(ValueError, match="two-part"):
+            trainer.train_measured(cfg, data)
+    # the ring stack transport likewise has no measured-mode body; the
+    # config layer refuses the combination before any trainer runs
+    with pytest.raises(ValueError, match="measured"):
+        _cfg(stack_mode="ring", arrival_mode="measured")

@@ -1,0 +1,87 @@
+"""Packaging smoke tests (VERDICT r5 Missing #5): the project must be
+installable with `pip install -e .` and expose the `erasurehead-tpu`
+console entry point — the first step MIGRATION.md asks a reference user to
+take. The editable install runs offline (--no-deps --no-build-isolation;
+every dependency is already in the image) into a throwaway --prefix so the
+test never mutates the environment's site-packages."""
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_pyproject():
+    try:
+        import tomllib  # py >= 3.11
+    except ModuleNotFoundError:
+        tomllib = pytest.importorskip("tomli")
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        return tomllib.load(f)
+
+
+def test_pyproject_metadata():
+    meta = _load_pyproject()
+    proj = meta["project"]
+    assert proj["name"] == "erasurehead-tpu"
+    # the console entry point the README/MIGRATION Install sections promise
+    assert proj["scripts"]["erasurehead-tpu"] == "erasurehead_tpu.cli:main"
+    deps = " ".join(proj["dependencies"])
+    # the reference's pre_run.sh role: the runtime deps are declared
+    for pkg in ("jax", "numpy", "scipy", "scikit-learn", "orbax"):
+        assert pkg in deps, f"{pkg} missing from dependencies"
+
+
+def test_console_entry_resolves():
+    """The entry-point target must exist and be callable before any pip
+    machinery runs — a typo'd `module:attr` would otherwise only surface
+    at install time."""
+    from erasurehead_tpu import cli
+
+    assert callable(cli.main)
+
+
+def test_pip_editable_install_smoke(tmp_path):
+    """`pip install -e .` into a scratch prefix: metadata parses, the
+    build backend accepts the project, and the installed console script +
+    package import from OUTSIDE the repo root (the failure mode the
+    packaging fixes: the CLI used to run only from the checkout cwd via
+    implicit path)."""
+    prefix = tmp_path / "prefix"
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU tunnel from pip's children
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "pip", "install", "-e", REPO,
+            "--no-deps", "--no-build-isolation", "--quiet",
+            "--prefix", str(prefix), "--no-warn-script-location",
+        ],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    # the console script landed in <prefix>/bin
+    script = prefix / "bin" / "erasurehead-tpu"
+    assert script.exists(), list((prefix / "bin").iterdir())
+
+    # the editable hook resolves the package from a NEUTRAL cwd (purelib
+    # holds the __editable__ .pth/finder pointing back at the checkout;
+    # .pth processing needs a SITE dir, not a PYTHONPATH entry)
+    purelib = sysconfig.get_paths(vars={"base": str(prefix)})["purelib"]
+    probe = subprocess.run(
+        [
+            sys.executable, "-c",
+            f"import site; site.addsitedir({str(purelib)!r}); "
+            "import erasurehead_tpu, erasurehead_tpu.cli; "
+            "print(erasurehead_tpu.cli.main is not None)",
+        ],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(tmp_path),
+    )
+    assert probe.returncode == 0, probe.stderr[-2000:]
+    assert probe.stdout.strip().endswith("True")

@@ -43,9 +43,25 @@ def load(path: str) -> dict[str, dict]:
     return out
 
 
+#: repeat-capture suffixes (VERDICT r5 #5): `_rep` from the flat program's
+#: headline repeats, `_rep2` from tools/tpu_measurements_rep2.sh. A
+#: decision is marked n>=2 only when its winner AND baseline each have at
+#: least two captures; n=1 decisions print as provisional.
+REP_SUFFIXES = ("", "_rep", "_rep2")
+
+
 def val(entries, tag):
     r = entries.get(tag)
     return None if r is None else r.get("value")
+
+
+def captures(entries, tag):
+    """All captured values for ``tag`` across the repeat suffixes."""
+    return [
+        v
+        for suf in REP_SUFFIXES
+        if (v := val(entries, tag + suf)) is not None
+    ]
 
 
 def best(entries, tags):
@@ -55,20 +71,39 @@ def best(entries, tags):
     return have, missing
 
 
+def _rep_note(entries, tag):
+    vals = captures(entries, tag)
+    if len(vals) <= 1:
+        return " [n=1 — repeat missing]" if vals else ""
+    return f" [n={len(vals)}, spread {min(vals)}-{max(vals)}]"
+
+
+def decision_n(entries, *tags):
+    """min capture count across the tags a decision rests on."""
+    return min((len(captures(entries, t)) for t in tags), default=0)
+
+
 def section(entries, title, tags, extra=None):
-    """Print one decision section: each tag's value or MISSING, then the
-    current winner. Returns (have, missing) for any follow-up rule."""
+    """Print one decision section: each tag's value or MISSING (with its
+    repeat count/spread), then the current winner annotated with the
+    decision's n. Returns (have, missing) for any follow-up rule."""
     have, missing = best(entries, tags)
     print(f"\n## {title}\n")
     for t, v in have:
         line = f"- {t}: {v} steps/s"
         if extra:
             line += f" (vs_baseline {entries.get(t, {}).get('vs_baseline')})"
-        print(line)
+        print(line + _rep_note(entries, t))
     for t in missing:
         print(f"- {t}: MISSING")
     if have:
+        n = decision_n(entries, have[0][0], tags[0])
+        strength = (
+            f"n>={n}" if n >= 2 else "PROVISIONAL n=1 — run "
+            "tools/tpu_measurements_rep2.sh before flipping a default"
+        )
         print(f"\n=> current winner: {have[0][0]} at {have[0][1]} steps/s"
+              f" ({strength})"
               + (" (entries still missing)" if missing else ""))
     return have, missing
 
@@ -86,15 +121,26 @@ def main() -> None:
     )
     if have and not missing:
         winner, base = have[0][0], val(e, "dense_f32")
+        n = decision_n(e, winner, "dense_f32")
+        tag = f"n>={n}" if n >= 2 else "PROVISIONAL n=1"
         if winner == "dense_f32_marginflat" and have[0][1] > base:
-            print(f"=> FLIP MARGIN_FLAT_DEFAULT=True ({have[0][1]} > {base})")
+            print(f"=> FLIP MARGIN_FLAT_DEFAULT=True ({have[0][1]} > {base}; "
+                  f"{tag})")
         else:
-            print(f"=> keep per-slot defaults; winner is {winner}")
+            print(f"=> keep per-slot defaults; winner is {winner} ({tag})")
     else:
         print("=> UNDECIDED (entries missing)")
 
     section(e, "dense bf16 frontier",
             ["dense_bf16", "dense_bf16_flat", "dense_bf16_marginflat"])
+
+    # ring stack mode (stack_mode="ring", this round): the default is
+    # footprint-gated (sharding.RING_AUTO_MIN_BYTES), not race-gated —
+    # these captures price the per-round ppermute hops against the
+    # materialized baseline and carry the on-silicon stack_bytes /
+    # memory_analysis evidence for the (s+1)x claim
+    section(e, "ring-streamed faithful stack (stack_mode, informational)",
+            ["dense_f32", "dense_f32_ring", "dense_bf16_ring"])
 
     # scan unroll: the in-scan bandwidth-gap candidate (r5). A winner
     # here composes with whatever margin lowering wins above — decide
@@ -133,18 +179,26 @@ def main() -> None:
         r = e.get(tag)
         print(f"- {tag}: " + ("MISSING" if r is None else json.dumps(r)[:300]))
 
-    # --- repeat captures (VERDICT r4 #8: window variance for the single-
-    # capture round-3 headline numbers) --------------------------------------
+    # --- repeat captures (VERDICT r4 #8 / r5 #5: window variance for every
+    # headline number; tpu_measurements_rep2.sh feeds the _rep2 column) -----
     print("\n## headline repeats (window variance)\n")
     for base_tag in ("sparse_covtype_faithful_fields_flat",
-                     "sparse_amazon_faithful_fields_flat"):
-        v0, v1 = val(e, base_tag), val(e, base_tag + "_rep")
-        pair = [x for x in (v0, v1) if x is not None]
+                     "sparse_amazon_faithful_fields_flat",
+                     "sparse_covtype_faithful",
+                     "sparse_amazon_faithful",
+                     "dense_f32",
+                     "dense_f32_ring"):
+        vals = captures(e, base_tag)
+        if not vals:
+            print(f"- {base_tag}: MISSING")
+            continue
         spread = (
-            f" spread {min(pair)}-{max(pair)} steps/s" if len(pair) == 2 else ""
+            f", spread {min(vals)}-{max(vals)} steps/s"
+            if len(vals) > 1
+            else " — repeat missing (tpu_measurements_rep2.sh)"
         )
-        print(f"- {base_tag}: {v0 if v0 is not None else 'MISSING'}"
-              f" / repeat {v1 if v1 is not None else 'MISSING'}{spread}")
+        print(f"- {base_tag}: n={len(vals)} ({', '.join(map(str, vals))})"
+              f"{spread}")
 
 
 if __name__ == "__main__":

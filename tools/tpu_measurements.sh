@@ -72,6 +72,12 @@ run sparse_amazon_deduped           1200 python tools/bench_sparse.py --shape am
 # bench.py manages wedge-probing internally — give it its full budget
 run dense_f32      1800 python bench.py
 run dense_bf16     1800 env BENCH_DTYPE=bfloat16 python bench.py
+# ring-streamed faithful stack (stack_mode=ring): bitwise-identical
+# science at 1/(s+1) the device data — races the materialized canonical
+# for the step-time cost of the per-round ppermute hops, and captures the
+# memory_analysis/stack_bytes telemetry on real silicon
+run dense_f32_ring  1800 env BENCH_STACK=ring python bench.py
+run dense_bf16_ring 1800 env BENCH_STACK=ring BENCH_DTYPE=bfloat16 python bench.py
 # deduped compute mode on the dense flagship: bit-compatible gradients at
 # 1/(s+1) the HBM traffic — the framework's structural win over the
 # faithful reference protocol, never yet TPU-measured for dense
