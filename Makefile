@@ -41,7 +41,8 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 .PHONY: naive cyccoded repcoded avoidstragg approxcoded \
 	partialrepcoded partialcyccoded randreg deadline \
 	generate_random_data arrange_real_data \
-	test tier1 bench sweep rehearse watch compare real_data dryrun clean
+	test tier1 bench sweep rehearse watch compare real_data dryrun \
+	telemetry-smoke clean
 
 naive:            ## uncoded wait-for-all baseline (src/naive.py)
 	$(RUN) --scheme naive
@@ -98,6 +99,16 @@ tier1:            ## the ROADMAP tier-1 verify line (what CI gates on)
 
 bench:
 	$(PY) bench.py
+
+TELEMETRY_SMOKE_DIR ?= /tmp/eh-telemetry-smoke
+telemetry-smoke:  ## tiny CPU run with --telemetry on, then schema-check + render the event log
+	rm -rf $(TELEMETRY_SMOKE_DIR)
+	JAX_PLATFORMS=cpu $(PY) -m erasurehead_tpu.cli --scheme approx \
+		--workers 4 --stragglers 1 --num-collect 3 --rounds 3 \
+		--rows 64 --cols 8 --lr 1.0 --add-delay --compute-mode deduped \
+		--telemetry on --output-dir $(TELEMETRY_SMOKE_DIR) --quiet
+	$(PY) tools/validate_events.py $(TELEMETRY_SMOKE_DIR)/events.jsonl
+	$(PY) -m erasurehead_tpu.cli report $(TELEMETRY_SMOKE_DIR)/events.jsonl
 
 sweep:            ## the full on-TPU measurement program (resumable, tagged)
 	bash tools/tpu_measurements.sh

@@ -329,26 +329,79 @@ def child() -> None:
     )
     data = generate_gmm(n_rows, N_COLS, n_partitions=W, seed=0)
 
-    t0 = time.perf_counter()
-    result = trainer.train(cfg, data)  # compiles, then times the scan
-    total = time.perf_counter() - t0
+    # ---- run-telemetry capture (obs/): events.jsonl beside the repo's
+    # bench artifacts. Observation-only (emission is host-side, after the
+    # timed scan) and never allowed to break the one-JSON-line contract.
+    import contextlib
 
-    # ---- sweep-engine extra: wall-clock of a CACHED rerun -----------------
-    # The sweep engine (train/cache.py) makes the Nth run of this
-    # signature skip trace+compile+upload; a second identical train() call
-    # measures exactly what a 7-scheme compare() pays per additional run.
-    # Never let the extra break the one-JSON-line contract.
-    sweep_extra = {}
+    events_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "artifacts", "bench_events.jsonl",
+    )
     try:
-        t1 = time.perf_counter()
-        rerun = trainer.train(cfg, data)
-        sweep_extra = {
-            "sweep_cached_run_s": round(time.perf_counter() - t1, 4),
-            "sweep_first_run_s": round(total, 4),
-            "sweep_cache": rerun.cache_info,
+        from erasurehead_tpu.obs import events as events_lib
+
+        capture = events_lib.capture(events_path)
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: telemetry capture unavailable: {e}", file=sys.stderr)
+        events_path = None
+        capture = contextlib.nullcontext()
+
+    with capture:
+        t0 = time.perf_counter()
+        result = trainer.train(cfg, data)  # compiles, then times the scan
+        total = time.perf_counter() - t0
+
+        # ---- sweep-engine extra: wall-clock of a CACHED rerun -------------
+        # The sweep engine (train/cache.py) makes the Nth run of this
+        # signature skip trace+compile+upload; a second identical train()
+        # call measures exactly what a 7-scheme compare() pays per
+        # additional run. Never let the extra break the one-JSON-line
+        # contract.
+        sweep_extra = {}
+        try:
+            t1 = time.perf_counter()
+            rerun = trainer.train(cfg, data)
+            sweep_extra = {
+                "sweep_cached_run_s": round(time.perf_counter() - t1, 4),
+                "sweep_first_run_s": round(total, 4),
+                "sweep_cache": rerun.cache_info,
+            }
+        except Exception as e:  # noqa: BLE001 — extras must never kill bench
+            print(f"bench: sweep-engine extra failed: {e}", file=sys.stderr)
+
+    # ---- telemetry extra: the same fields the event log carries -----------
+    telemetry_extra = {}
+    try:
+        from erasurehead_tpu.train import cache as cache_lib
+
+        stats = cache_lib.stats().snapshot()
+        telemetry_extra = {
+            "telemetry": {
+                # total seconds this process spent compiling (misses) and
+                # the seconds the exec cache saved on hits
+                "compile_seconds_saved": round(
+                    stats["compile_seconds_saved"], 4
+                ),
+                "exec_cache": {
+                    "hits": stats["exec_hits"],
+                    "misses": stats["exec_misses"],
+                },
+                "data_cache": {
+                    "hits": stats["data_hits"],
+                    "misses": stats["data_misses"],
+                },
+                "mean_decode_error": (
+                    round(float(sum(result.decode_error))
+                          / max(len(result.decode_error), 1), 8)
+                    if result.decode_error is not None
+                    else None
+                ),
+                "events_path": events_path,
+            }
         }
     except Exception as e:  # noqa: BLE001 — extras must never kill the bench
-        print(f"bench: sweep-engine extra failed: {e}", file=sys.stderr)
+        print(f"bench: telemetry extra failed: {e}", file=sys.stderr)
 
     # ---- memory telemetry (the stack_mode=ring (s+1)x claim, by numbers) --
     mem_extra = {}
@@ -405,6 +458,7 @@ def child() -> None:
                 "pct_roofline": pct_roofline,
                 **mem_extra,
                 **sweep_extra,
+                **telemetry_extra,
             }
         )
     )

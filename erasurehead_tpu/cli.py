@@ -24,7 +24,12 @@ Two invocation forms:
 
 Run flow: load or generate the dataset, train on the device mesh, replay the
 eval, write the five artifacts into ``<input_dir>/.../results/`` (the
-reference's layout, src/naive.py:200-208).
+reference's layout, src/naive.py:200-208). With ``--telemetry on`` (or
+``auto`` + ``--output-dir``) an ``events.jsonl`` run log lands beside them.
+
+A third form renders that log::
+
+       erasurehead-tpu report <events.jsonl> [more.jsonl ...]
 """
 
 from __future__ import annotations
@@ -205,6 +210,15 @@ def _flags_parser() -> argparse.ArgumentParser:
                         "recompile and re-upload (debugging; memory "
                         "pressure). ERASUREHEAD_SWEEP_CACHE=0 in the env "
                         "does the same")
+    p.add_argument("--telemetry", default=None, choices=["on", "off", "auto"],
+                   help="run-telemetry event log (obs/): writes "
+                        "events.jsonl beside the artifacts — typed "
+                        "run_start/compile/data_upload/rounds/decode/"
+                        "run_end records, rendered by `erasurehead-tpu "
+                        "report`. Default: ERASUREHEAD_TELEMETRY env, "
+                        "else off; auto = on when --output-dir is given. "
+                        "Observation-only: trajectories are bitwise "
+                        "identical either way")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--checkpoint-dir", default=None,
                    help="save optimizer state here every --checkpoint-every "
@@ -391,6 +405,7 @@ def run(
     kill_workers: str | None = None,
     on_death: str = "error",
     death_timeout: float | None = None,
+    telemetry: str | None = None,
 ):
     # argument-only checks: fail before backend init / dataset load
     if (checkpoint_dir or resume) and cfg.arrival_mode == "measured":
@@ -414,10 +429,33 @@ def run(
             f"--kill-workers ids {sorted(deaths)} outside "
             f"[0, {cfg.n_workers})"
         )
+    # telemetry resolution (utils/config.resolve_telemetry): flag > env >
+    # off; "auto" = on exactly when the caller passed an explicit output
+    # dir. Resolved BEFORE the default output_dir is synthesized so auto
+    # keys off the user's request, not the fallback path.
+    from erasurehead_tpu.utils.config import resolve_telemetry
+
+    telemetry_on = resolve_telemetry(telemetry, output_dir is not None)
+    if output_dir is None:
+        # reference parity: results live beside the dataset,
+        # <input_dir>/<dataset>/<W>/results/ (src/naive.py:200-202)
+        base = dataset_dir(cfg) or "."
+        output_dir = os.path.join(base, "results")
+
     initialize_distributed()
     dataset = load_dataset(cfg)
+    import contextlib
+
+    from erasurehead_tpu.obs import events as events_lib
     from erasurehead_tpu.utils.tracing import device_trace
-    with device_trace(trace_dir):
+
+    events_path = os.path.join(output_dir, "events.jsonl")
+    capture = (
+        events_lib.capture(events_path)
+        if telemetry_on
+        else contextlib.nullcontext()
+    )
+    with capture, device_trace(trace_dir):
         if cfg.arrival_mode == "measured":
             result = trainer.train_measured(cfg, dataset)
         elif deaths and on_death == "elastic":
@@ -460,31 +498,47 @@ def run(
                 checkpoint_every=checkpoint_every,
                 resume=resume,
             )
-    model = trainer.build_model(cfg)
-    n = result.n_train
-    ev = evaluate.replay(
-        model,
-        cfg.model,
-        result.params_history,
-        dataset.X_train[:n],
-        dataset.y_train[:n],
-        dataset.X_test,
-        dataset.y_test,
-    )
-    if output_dir is None:
-        # reference parity: results live beside the dataset,
-        # <input_dir>/<dataset>/<W>/results/ (src/naive.py:200-202)
-        base = dataset_dir(cfg) or "."
-        output_dir = os.path.join(base, "results")
+        model = trainer.build_model(cfg)
+        n = result.n_train
+        ev = evaluate.replay(
+            model,
+            cfg.model,
+            result.params_history,
+            dataset.X_train[:n],
+            dataset.y_train[:n],
+            dataset.X_test,
+            dataset.y_test,
+        )
+        if result.run_id is not None:
+            # the eval replay runs here, not in the trainer — attach its
+            # summary to the run's event stream
+            auc = float(ev.auc[-1])
+            events_lib.emit(
+                "eval",
+                run_id=result.run_id,
+                final_train_loss=float(ev.training_loss[-1]),
+                final_test_loss=float(ev.testing_loss[-1]),
+                final_auc=auc if np.isfinite(auc) else None,
+            )
     paths = artifacts.write_run_artifacts(result, ev, output_dir)
+    if telemetry_on:
+        paths["events"] = events_path
     if not quiet:
         artifacts.print_iteration_table(result, ev)
         print(f"artifacts -> {output_dir}")
+        if telemetry_on:
+            print(f"events -> {events_path}")
     return result, ev, paths
 
 
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "report":
+        # `erasurehead-tpu report <events.jsonl> ...` — render a run
+        # telemetry event log into the human summary table (obs/report.py)
+        from erasurehead_tpu.obs import report as report_lib
+
+        return report_lib.main(argv[1:])
     if len(argv) == 13 and not argv[0].startswith("-"):
         cfg = _legacy_to_config(argv)
         run(cfg)
@@ -508,6 +562,7 @@ def main(argv: list[str] | None = None) -> int:
         kill_workers=ns.kill_workers,
         on_death=ns.on_death,
         death_timeout=ns.death_timeout,
+        telemetry=ns.telemetry,
     )
     return 0
 

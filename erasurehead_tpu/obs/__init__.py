@@ -1,0 +1,27 @@
+"""Run-telemetry subsystem: structured event log, metrics, decode error.
+
+The paper's entire claim is a measurement (wall-clock vs. convergence under
+straggler delays), so observability is a first-class subsystem, not an
+afterthought:
+
+  - :mod:`erasurehead_tpu.obs.events` — structured JSONL event log: typed
+    ``run_start`` / ``compile`` / ``data_upload`` / ``rounds`` / ``decode``
+    / ``run_end`` records per training run, emitted strictly host-side and
+    outside jit (telemetry is observation-only: trajectories are bitwise
+    identical with it on or off, pinned in tests/test_telemetry.py);
+  - :mod:`erasurehead_tpu.obs.metrics` — labeled counters/gauges/histograms
+    with snapshot export (the sweep caches in train/cache.py report
+    through it);
+  - :mod:`erasurehead_tpu.obs.decode` — the per-round AGC decode-error norm
+    (ErasureHead arXiv:1901.09671 / arXiv:2006.09638's central quantity),
+    computed host-side from the collection weights the run already built;
+  - :mod:`erasurehead_tpu.obs.detect` — recompile detector: warns when an
+    executable-cache miss lands on a signature family already compiled
+    in-process, naming the key fields that differed;
+  - :mod:`erasurehead_tpu.obs.report` — renders an events.jsonl into the
+    human summary table behind ``erasurehead-tpu report``.
+"""
+
+from erasurehead_tpu.obs import events, metrics  # noqa: F401
+from erasurehead_tpu.obs.events import capture, current, emit  # noqa: F401
+from erasurehead_tpu.obs.metrics import REGISTRY  # noqa: F401

@@ -1,0 +1,107 @@
+"""Recompile detector: catch sweeps that silently stop sharing executables.
+
+The sweep engine's whole value is that the Nth run of a lowering signature
+skips trace+compile (train/cache.py). The failure mode is quiet: a config
+knob, mesh assignment, or resolved-lowering default drifts between "the
+same" runs, every run recompiles, and nothing says why — a 7-scheme
+compare degrades from 1 compile to 7 with identical-looking output.
+
+This module watches executable-cache *misses*. The trainer reports each
+compile as a LABELED signature (field name -> value, the same content as
+the cache key); when a miss lands in a signature family that was already
+compiled in-process, :func:`observe` returns the most similar prior
+signature's diff — the names of the key fields that differed — and the
+trainer emits a ``warning`` event naming them. Expected-to-vary fields
+(chunk length under checkpointing) are excluded so legitimate chunk
+compiles don't cry wolf; an empty diff means the identical signature
+recompiled (cache disabled or evicted).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+#: signature fields expected to differ between compiles of one logical run
+#: (checkpointing compiles one executable per distinct chunk length)
+EXPECTED_VARYING = frozenset({"chunk_rounds"})
+
+#: prior signatures kept per family — sweeps cycle over a handful
+_MAX_SEEN = 64
+
+_seen: dict = {}  # family (fields["kind"]) -> deque[dict]
+
+
+def reset() -> None:
+    _seen.clear()
+
+
+def _truncate(v, width: int = 120) -> str:
+    s = repr(v)
+    return s if len(s) <= width else s[: width - 3] + "..."
+
+
+def observe(fields: dict) -> Optional[dict]:
+    """Record one executable-cache miss; return diff info when this family
+    (``fields['kind']``) was already compiled in-process.
+
+    Returns None for the family's first compile, or for misses that differ
+    from every prior signature only in :data:`EXPECTED_VARYING` fields.
+    Otherwise ``{"changed": [...], "detail": {name: "old -> new"},
+    "n_prior": int}`` against the closest prior signature (fewest differing
+    fields) — "changed" empty means an exact signature recompiled.
+    """
+    family = fields.get("kind", "?")
+    prior = _seen.setdefault(family, deque(maxlen=_MAX_SEEN))
+    best = None
+    best_changed = None
+    for p in prior:
+        keys = set(p) | set(fields)
+        changed = sorted(
+            k for k in keys if p.get(k) != fields.get(k)
+        )
+        if best_changed is None or len(changed) < len(best_changed):
+            best, best_changed = p, changed
+    prior.append(dict(fields))
+    if best is None:
+        return None
+    essential = [k for k in best_changed if k not in EXPECTED_VARYING]
+    if best_changed and not essential:
+        return None  # only expected-to-vary fields differed
+    return {
+        "changed": essential,
+        "detail": {
+            k: f"{_truncate(best.get(k))} -> {_truncate(fields.get(k))}"
+            for k in essential
+        },
+        "n_prior": len(prior) - 1,
+    }
+
+
+def observe_and_warn(fields: dict, run_id: Optional[str] = None) -> None:
+    """The trainer-side hook: observe a miss and emit a ``warning`` event
+    into the current capture when it looks like an unintended recompile."""
+    diff = observe(fields)
+    if diff is None:
+        return
+    from erasurehead_tpu.obs import events
+
+    if diff["changed"]:
+        msg = (
+            f"executable recompiled: {len(diff['changed'])} signature "
+            f"field(s) differ from a prior in-process compile: "
+            f"{', '.join(diff['changed'])}"
+        )
+    else:
+        msg = (
+            "executable recompiled with an identical signature "
+            "(sweep cache disabled or entry evicted)"
+        )
+    events.emit(
+        "warning",
+        kind="recompile",
+        message=msg,
+        run_id=run_id,
+        changed=diff["changed"],
+        detail=diff["detail"],
+    )

@@ -1,0 +1,320 @@
+"""Structured JSONL event log: the machine-readable record of a run.
+
+One line per event, every line a JSON object with three envelope fields —
+``type`` (one of :data:`SCHEMA`), ``seq`` (monotonic per logger), ``t``
+(unix seconds) — plus the type's payload. The reference's observability was
+two hand-rolled artifacts (``timeset`` / ``worker_timeset``, SURVEY.md
+§5.1); the event log supersedes them as the analysis substrate while the
+.dat artifacts stay for reference-script parity (see MIGRATION.md §4).
+
+Contract (pinned in tests/test_telemetry.py): emission is strictly
+host-side and outside jit. Telemetry is observation-only — with the log on
+or off, ``params_history`` is bitwise identical and the executable cache
+records zero extra compiles. The trainers emit into whatever logger
+:func:`capture` has installed; with none installed every ``emit`` is a
+no-op, so library callers pay nothing.
+
+Validation logic lives here (:func:`validate_lines`) so the CLI wrapper
+(tools/validate_events.py), the smoke target (``make telemetry-smoke``)
+and the tests all check the same schema.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import itertools
+import json
+import os
+import time
+from typing import IO, Iterable, Optional
+
+import numpy as np
+
+#: record type -> required payload keys (the envelope ``type``/``seq``/``t``
+#: is always present). Optional fields may ride along; unknown TYPES are a
+#: validation error — add new types here first.
+SCHEMA: dict[str, tuple] = {
+    # one per run: identity of what was trained and how it lowered
+    "run_start": ("run_id", "scheme", "platform", "config_hash", "mesh"),
+    # one per AOT chunk compile (hit or miss) of the training executable
+    "compile": ("run_id", "seconds", "cache_hit"),
+    # one per device-data stacking/upload (hit = stacks reused)
+    "data_upload": ("run_id", "bytes", "cache_hit"),
+    # chunked per-round telemetry: simulated clock + masked arrival stats
+    "rounds": ("run_id", "first_round", "n_rounds", "sim_time_s"),
+    # chunked per-round AGC decode-error norms (obs/decode.py)
+    "decode": ("run_id", "first_round", "n_rounds", "error_mean",
+               "error_max", "exact"),
+    # eval replay summary (emitted by callers that run the eval, e.g. cli)
+    "eval": ("run_id", "final_train_loss", "final_test_loss"),
+    # anomaly channel (recompile detector, obs/detect.py)
+    "warning": ("kind", "message"),
+    # one per run: the wall-clock / cache / arrival / decode summary the
+    # report command renders (obs/report.py)
+    "run_end": ("run_id", "wall_time_s", "steps_per_sec"),
+    # registry snapshot written once when a capture closes (obs/metrics.py)
+    "metrics": ("snapshot",),
+}
+
+#: rounds-style chunk size: small runs get one chunk, long runs stay O(R/100)
+ROUND_CHUNK = 100
+
+
+def _jsonable(v):
+    """Best-effort JSON coercion for event payload values."""
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if hasattr(v, "value") and not isinstance(v, (int, float, str, bool)):
+        return v.value  # enums
+    return v
+
+
+class EventLogger:
+    """Append-only JSONL writer with per-line flush (a crashed run keeps
+    every event emitted before the crash)."""
+
+    def __init__(self, path: str, mode: str = "w"):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f: Optional[IO[str]] = open(path, mode)
+        self._seq = itertools.count()
+
+    def emit(self, type: str, **fields) -> None:
+        if self._f is None:
+            raise ValueError(f"event logger {self.path!r} is closed")
+        required = SCHEMA.get(type)
+        if required is None:
+            raise ValueError(
+                f"unknown event type {type!r}; known: {sorted(SCHEMA)}"
+            )
+        missing = [k for k in required if k not in fields]
+        if missing:
+            raise ValueError(f"event {type!r} missing required {missing}")
+        rec = {"type": type, "seq": next(self._seq), "t": round(time.time(), 3)}
+        rec.update({k: _jsonable(v) for k, v in fields.items()})
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+# --------------------------------------------------------------------------
+# current-logger plumbing: the trainers emit into whatever capture() set,
+# so no training entry point grows a logger parameter
+
+_current: Optional[EventLogger] = None
+_run_counter = itertools.count(1)
+
+
+def current() -> Optional[EventLogger]:
+    return _current
+
+
+def emit(type: str, **fields) -> bool:
+    """Emit into the current capture; no-op (False) when none installed."""
+    if _current is None:
+        return False
+    _current.emit(type, **fields)
+    return True
+
+
+@contextlib.contextmanager
+def capture(path: str, mode: str = "w"):
+    """Install an :class:`EventLogger` at ``path`` as the process-current
+    event sink for the duration of the block. On exit, a final ``metrics``
+    record snapshots the registry (obs/metrics.py) and the file is closed.
+    Nested captures stack (inner wins, outer restored)."""
+    global _current
+    logger = EventLogger(path, mode=mode)
+    prev = _current
+    _current = logger
+    try:
+        yield logger
+    finally:
+        _current = prev
+        try:
+            from erasurehead_tpu.obs.metrics import REGISTRY
+
+            logger.emit("metrics", snapshot=REGISTRY.snapshot())
+        except ValueError:
+            pass  # already closed by the caller
+        logger.close()
+
+
+def new_run_id() -> str:
+    """Short process-unique run id; the pid suffix keeps ids distinct when
+    several processes append to one file (mode='a')."""
+    return f"run-{next(_run_counter):03d}-{os.getpid():x}"
+
+
+def config_hash(cfg) -> str:
+    """Stable short hash of a RunConfig's full field set — the run_start
+    identity key (the manifest carries the readable form)."""
+    d = {
+        k: _jsonable(v) for k, v in sorted(dataclasses.asdict(cfg).items())
+    }
+    blob = json.dumps(d, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+# --------------------------------------------------------------------------
+# arrival statistics: THE masking home for the -1 never-arrived sentinel
+
+def arrival_summary(worker_times) -> dict:
+    """Masked latency stats over a [.., W] arrival block.
+
+    ``worker_times`` carries the reference's ``-1`` sentinel for workers
+    the master never collected (src/coded.py:171-173, parallel/collect.py
+    NEVER); averaging it in would silently *lower* every latency stat, so
+    this is the single shared masking point for artifacts
+    (train/artifacts.py) and event emission. Quantiles are None when no
+    worker arrived at all (e.g. an all-dead deadline round)."""
+    wt = np.asarray(worker_times, dtype=np.float64)
+    arrived = wt[wt >= 0.0]
+    n_never = int(wt.size - arrived.size)
+    if arrived.size == 0:
+        return {
+            "p50": None, "p90": None, "p99": None, "mean": None,
+            "n_arrivals": 0, "n_never": n_never,
+        }
+    q50, q90, q99 = np.quantile(arrived, [0.5, 0.9, 0.99])
+    return {
+        "p50": round(float(q50), 6),
+        "p90": round(float(q90), 6),
+        "p99": round(float(q99), 6),
+        "mean": round(float(arrived.mean()), 6),
+        "n_arrivals": int(arrived.size),
+        "n_never": n_never,
+    }
+
+
+def emit_round_chunks(
+    run_id: str,
+    *,
+    start_round: int,
+    timeset: np.ndarray,
+    worker_times: np.ndarray,
+    decode_error: Optional[np.ndarray] = None,
+    update_norm: Optional[np.ndarray] = None,
+    chunk: int = ROUND_CHUNK,
+) -> None:
+    """Emit the per-run ``rounds`` (and ``decode``) chunk records into the
+    current capture. All inputs are host numpy the run already produced;
+    no-op without a capture. ``update_norm`` is the [R-1] per-round
+    optimizer-step norm (the host-visible gradient-magnitude proxy — the
+    exact grad norm would need extra device programs, which telemetry must
+    never add); its round r entry describes the step INTO round r+1."""
+    if _current is None:
+        return
+    rounds = len(timeset)
+    for lo in range(start_round, rounds, chunk):
+        hi = min(lo + chunk, rounds)
+        fields = dict(
+            run_id=run_id,
+            first_round=lo,
+            n_rounds=hi - lo,
+            sim_time_s=round(float(np.sum(timeset[lo:hi])), 6),
+            arrival=arrival_summary(worker_times[lo:hi]),
+        )
+        if update_norm is not None and len(update_norm):
+            un = update_norm[max(lo - start_round - 1, 0):hi - start_round - 1]
+            if len(un):
+                fields["update_norm_mean"] = round(float(np.mean(un)), 8)
+        emit("rounds", **fields)
+        if decode_error is not None:
+            err = np.asarray(decode_error[lo:hi], dtype=np.float64)
+            emit(
+                "decode",
+                run_id=run_id,
+                first_round=lo,
+                n_rounds=hi - lo,
+                error_mean=round(float(err.mean()), 10) if err.size else 0.0,
+                error_max=round(float(err.max()), 10) if err.size else 0.0,
+                exact=bool((err == 0.0).all()),
+            )
+
+
+# --------------------------------------------------------------------------
+# validation (shared by tools/validate_events.py, make telemetry-smoke,
+# and the tests)
+
+def validate_lines(lines: Iterable[str]) -> list[str]:
+    """Schema-check an events.jsonl; returns human-readable error strings
+    (empty = valid). Checks: every line parses as a JSON object; record
+    types are known; required keys are present; ``seq`` is strictly
+    monotonic per emitting logger run; chunked ``rounds``/``decode``
+    records have strictly increasing ``first_round`` per run_id; every
+    ``run_start`` has a matching later ``run_end``."""
+    errors: list[str] = []
+    last_seq: Optional[int] = None
+    last_round: dict = {}  # (run_id, type) -> last first_round
+    started: set = set()
+    ended: set = set()
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            errors.append(f"line {i}: not JSON ({e})")
+            continue
+        if not isinstance(rec, dict):
+            errors.append(f"line {i}: not a JSON object")
+            continue
+        rtype = rec.get("type")
+        if rtype not in SCHEMA:
+            errors.append(f"line {i}: unknown record type {rtype!r}")
+            continue
+        missing = [k for k in SCHEMA[rtype] if k not in rec]
+        if missing:
+            errors.append(f"line {i}: {rtype} missing required {missing}")
+        seq = rec.get("seq")
+        if not isinstance(seq, int):
+            errors.append(f"line {i}: missing/invalid seq")
+        else:
+            # seq restarts at 0 when another logger appended to the file;
+            # within a logger's run it must strictly increase
+            if last_seq is not None and seq != 0 and seq <= last_seq:
+                errors.append(
+                    f"line {i}: non-monotonic seq {seq} after {last_seq}"
+                )
+            last_seq = seq
+        if rtype in ("rounds", "decode"):
+            key = (rec.get("run_id"), rtype)
+            fr = rec.get("first_round")
+            if isinstance(fr, int):
+                prev = last_round.get(key)
+                if prev is not None and fr <= prev:
+                    errors.append(
+                        f"line {i}: {rtype} first_round {fr} not after "
+                        f"{prev} for run {key[0]!r}"
+                    )
+                last_round[key] = fr
+        if rtype == "run_start":
+            started.add(rec.get("run_id"))
+        if rtype == "run_end":
+            ended.add(rec.get("run_id"))
+    for rid in sorted(started - ended, key=str):
+        errors.append(f"run {rid!r}: run_start without run_end")
+    return errors
+
+
+def validate_file(path: str) -> list[str]:
+    with open(path) as f:
+        return validate_lines(f)

@@ -1,0 +1,171 @@
+"""Render an events.jsonl into the human run summary table.
+
+``erasurehead-tpu report <events.jsonl> [more.jsonl ...]`` — one row per
+run: scheme, real steps/sec, compile vs run seconds, exec/data cache hits,
+straggler-arrival p50/p90/p99 (sentinel-masked, obs/events.arrival_summary)
+and the mean AGC decode-error norm (obs/decode.py; exact schemes read 0).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Sequence
+
+
+def load_runs(paths: Sequence[str]) -> list[dict]:
+    """Group event records by run_id across files, in first-seen order.
+
+    Returns one dict per run: {"run_id", "start": run_start|None,
+    "end": run_end|None, "compiles": [...], "uploads": [...],
+    "rounds": [...], "decode": [...], "warnings": [...]}.
+    Unparseable lines are skipped (the validator's job is strictness;
+    the report renders what it can)."""
+    runs: dict = {}
+    order: list = []
+    warnings: list = []
+
+    def run(rid):
+        if rid not in runs:
+            runs[rid] = {
+                "run_id": rid, "start": None, "end": None, "compiles": [],
+                "uploads": [], "rounds": [], "decode": [], "warnings": [],
+            }
+            order.append(rid)
+        return runs[rid]
+
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                rtype = rec.get("type")
+                rid = rec.get("run_id")
+                if rtype == "run_start":
+                    run(rid)["start"] = rec
+                elif rtype == "run_end":
+                    run(rid)["end"] = rec
+                elif rtype == "compile":
+                    run(rid)["compiles"].append(rec)
+                elif rtype == "data_upload":
+                    run(rid)["uploads"].append(rec)
+                elif rtype == "rounds":
+                    run(rid)["rounds"].append(rec)
+                elif rtype == "decode":
+                    run(rid)["decode"].append(rec)
+                elif rtype == "warning":
+                    (run(rid)["warnings"] if rid else warnings).append(rec)
+    out = [runs[rid] for rid in order]
+    if warnings:
+        out.append({"run_id": None, "warnings": warnings})
+    return out
+
+
+def _fmt(v, spec: str, none: str = "-") -> str:
+    return format(v, spec) if v is not None else none
+
+
+def _arrival_cell(end: Optional[dict]) -> str:
+    arr = (end or {}).get("arrival") or {}
+    if arr.get("n_arrivals"):
+        cell = (
+            f"{_fmt(arr.get('p50'), '.3f')}/{_fmt(arr.get('p90'), '.3f')}"
+            f"/{_fmt(arr.get('p99'), '.3f')}"
+        )
+        if arr.get("n_never"):
+            cell += f" ({arr['n_never']} never)"
+        return cell
+    return "-"
+
+
+def render(paths: Sequence[str]) -> str:
+    """The summary table for one or more event logs."""
+    loaded = load_runs(paths)
+    groups = [g for g in loaded if g["run_id"] is not None]
+    stray = [g for g in loaded if g["run_id"] is None]
+    header = (
+        f"{'run':16s} {'scheme':16s} {'steps/s':>9s} {'compile_s':>10s} "
+        f"{'run_s':>8s} {'exec h/m':>9s} {'data':>5s} "
+        f"{'arrival p50/p90/p99':>22s} {'decode err':>11s}"
+    )
+    lines = [header, "-" * len(header)]
+    for g in groups:
+        start, end = g["start"] or {}, g["end"] or {}
+        scheme = start.get("scheme", "?")
+        compile_s = sum(
+            c.get("seconds", 0.0) for c in g["compiles"]
+            if not c.get("cache_hit")
+        )
+        hits = end.get("exec_hits")
+        misses = end.get("exec_misses")
+        hm = f"{hits}/{misses}" if hits is not None else "-"
+        data = "-"
+        if g["uploads"]:
+            data = "hit" if all(
+                u.get("cache_hit") for u in g["uploads"]
+            ) else "miss"
+        err = end.get("decode_error_mean")
+        if err is None and g["decode"]:
+            n = sum(d.get("n_rounds", 0) for d in g["decode"])
+            if n:
+                err = sum(
+                    d.get("error_mean", 0.0) * d.get("n_rounds", 0)
+                    for d in g["decode"]
+                ) / n
+        lines.append(
+            f"{str(g['run_id'])[:16]:16s} {str(scheme)[:16]:16s} "
+            f"{_fmt(end.get('steps_per_sec'), '9.1f'):>9s} "
+            f"{compile_s:10.3f} "
+            f"{_fmt(end.get('wall_time_s'), '8.3f'):>8s} {hm:>9s} "
+            f"{data:>5s} {_arrival_cell(end):>22s} "
+            f"{_fmt(err, '11.6f'):>11s}"
+        )
+    n_warn = sum(len(g["warnings"]) for g in groups) + sum(
+        len(g["warnings"]) for g in stray
+    )
+    if n_warn:
+        lines.append(f"\n{n_warn} warning(s):")
+        for g in groups + stray:
+            for w in g["warnings"]:
+                lines.append(
+                    f"  [{w.get('kind', '?')}] {w.get('message', '')}"
+                )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """`erasurehead-tpu report` / `python -m erasurehead_tpu.obs.report`."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="erasurehead-tpu report",
+        description="Render events.jsonl run telemetry into a summary table",
+    )
+    p.add_argument("events", nargs="+", help="events.jsonl path(s)")
+    p.add_argument("--validate", action="store_true",
+                   help="schema-check the files first (exit 1 on errors)")
+    ns = p.parse_args(argv)
+    if ns.validate:
+        from erasurehead_tpu.obs import events as events_lib
+
+        errors = [
+            f"{path}: {e}"
+            for path in ns.events
+            for e in events_lib.validate_file(path)
+        ]
+        if errors:
+            for e in errors:
+                print(e)
+            return 1
+    print(render(ns.events))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from erasurehead_tpu.parallel.mesh import WORKER_AXIS
 from erasurehead_tpu.utils import compat
 from erasurehead_tpu.utils.compat import shard_map
+from erasurehead_tpu.utils.tracing import annotate
 
 GradFn = Callable[..., Any]  # (params, X, y, weights) -> gradient pytree
 
@@ -175,8 +176,10 @@ def _margin_flat_local_body(model) -> GradFn:
     also reusable as the ring transport's local grad (make_ring_faithful_grad_fn)."""
 
     def local(params, Xs, ys, ws):
-        g = _hybrid_margin_flat_grad(model, params, Xs, ys, ws)
-        return lax.psum(g, WORKER_AXIS)
+        with annotate("eh_step/partial_grads"):
+            g = _hybrid_margin_flat_grad(model, params, Xs, ys, ws)
+        with annotate("eh_step/decode"):
+            return lax.psum(g, WORKER_AXIS)
 
     return local
 
@@ -206,14 +209,17 @@ def _faithful_local_body(model, mesh: Mesh) -> GradFn:
 
     def local(params, Xw, yw, slot_weights):
         if _grads_via_loss(model):
-            return _weighted_loss_grad(
-                model, params, Xw, yw, slot_weights, "ws", mesh
-            )
-        per_slot = jax.vmap(
-            jax.vmap(lambda X, y: model.grad_sum(params, X, y))
-        )(Xw, yw)  # leaves [Wl, S, ...]
-        g = _weighted_tree_sum(slot_weights, per_slot, "ws")
-        return lax.psum(g, WORKER_AXIS)
+            with annotate("eh_step/partial_grads"):
+                return _weighted_loss_grad(
+                    model, params, Xw, yw, slot_weights, "ws", mesh
+                )
+        with annotate("eh_step/partial_grads"):
+            per_slot = jax.vmap(
+                jax.vmap(lambda X, y: model.grad_sum(params, X, y))
+            )(Xw, yw)  # leaves [Wl, S, ...]
+        with annotate("eh_step/decode"):
+            g = _weighted_tree_sum(slot_weights, per_slot, "ws")
+            return lax.psum(g, WORKER_AXIS)
 
     return local
 
@@ -281,20 +287,21 @@ def _ring_fill(plan, Xp, yp):
             return jax.tree.map(lambda b: one(None, b), blk)
         return jax.tree.map(one, buf, blk)
 
-    blk = (Xp, yp)
-    buf = fill(None, blk, sel_dev[0])
-    if H > 1:
-        perm = [(i, (i - 1) % D) for i in range(D)]
+    with annotate("eh_step/ring_fill"):
+        blk = (Xp, yp)
+        buf = fill(None, blk, sel_dev[0])
+        if H > 1:
+            perm = [(i, (i - 1) % D) for i in range(D)]
 
-        def hop(carry, sel_h):
-            buf, blk = carry
-            blk = jax.tree.map(
-                lambda l: lax.ppermute(l, WORKER_AXIS, perm), blk
-            )
-            return (fill(buf, blk, sel_h), blk), None
+            def hop(carry, sel_h):
+                buf, blk = carry
+                blk = jax.tree.map(
+                    lambda l: lax.ppermute(l, WORKER_AXIS, perm), blk
+                )
+                return (fill(buf, blk, sel_h), blk), None
 
-        (buf, _), _ = lax.scan(hop, (buf, blk), sel_dev[1:])
-    return buf
+            (buf, _), _ = lax.scan(hop, (buf, blk), sel_dev[1:])
+        return buf
 
 
 def make_ring_faithful_grad_fn(
@@ -346,12 +353,17 @@ def make_deduped_grad_fn(model, mesh: Mesh) -> GradFn:
 
     def local(params, Xp, yp, part_weights):
         if _grads_via_loss(model):
-            return _weighted_loss_grad(
-                model, params, Xp, yp, part_weights, "p", mesh
-            )
-        per_part = jax.vmap(lambda X, y: model.grad_sum(params, X, y))(Xp, yp)
-        g = _weighted_tree_sum(part_weights, per_part, "p")
-        return lax.psum(g, WORKER_AXIS)
+            with annotate("eh_step/partial_grads"):
+                return _weighted_loss_grad(
+                    model, params, Xp, yp, part_weights, "p", mesh
+                )
+        with annotate("eh_step/partial_grads"):
+            per_part = jax.vmap(
+                lambda X, y: model.grad_sum(params, X, y)
+            )(Xp, yp)
+        with annotate("eh_step/decode"):
+            g = _weighted_tree_sum(part_weights, per_part, "p")
+            return lax.psum(g, WORKER_AXIS)
 
     return shard_map(
         local,
@@ -457,16 +469,22 @@ def _flat_local_body(model) -> GradFn:
     def local(params, Xs, ys, ws):
         from erasurehead_tpu.ops import features as features_lib
 
-        M = int(np.prod(ys.shape[:-1]))
-        R = ys.shape[-1]
-        Xf = features_lib.flatten_rows(Xs)
-        yf = ys.reshape(M * R)
-        # [M] slot weights -> [M*R] row weights
-        wf = jnp.broadcast_to(ws.reshape(M)[:, None], (M, R)).reshape(M * R)
-        p = features_lib.matvec(Xf, params)  # bf16-data + lanes/cols aware
-        r = model.margin_residual(p, yf)
-        g = -features_lib.rmatvec(Xf, wf.astype(r.dtype) * r)
-        return lax.psum(g, WORKER_AXIS)
+        with annotate("eh_step/partial_grads"):
+            M = int(np.prod(ys.shape[:-1]))
+            R = ys.shape[-1]
+            Xf = features_lib.flatten_rows(Xs)
+            yf = ys.reshape(M * R)
+            # [M] slot weights -> [M*R] row weights: the decode CONTRACTION
+            # is folded into the residual here, so this region carries both
+            # the partial-gradient compute and the weighted combine
+            wf = jnp.broadcast_to(
+                ws.reshape(M)[:, None], (M, R)
+            ).reshape(M * R)
+            p = features_lib.matvec(Xf, params)  # bf16 + lanes/cols aware
+            r = model.margin_residual(p, yf)
+            g = -features_lib.rmatvec(Xf, wf.astype(r.dtype) * r)
+        with annotate("eh_step/decode"):
+            return lax.psum(g, WORKER_AXIS)
 
     return local
 
@@ -490,10 +508,12 @@ def make_fused_grad_fn(kind: str, mesh: Mesh, *, interpret: bool = False) -> Gra
         Xf = Xs.reshape((M,) + Xs.shape[-2:])
         yf = ys.reshape(M, -1)
         wf = ws.reshape(M)
-        g = kernels.fused_glm_grad(
-            params, Xf, yf, wf, kind, interpret=interpret
-        )
-        return lax.psum(g, WORKER_AXIS)
+        with annotate("eh_step/partial_grads"):
+            g = kernels.fused_glm_grad(
+                params, Xf, yf, wf, kind, interpret=interpret
+            )
+        with annotate("eh_step/decode"):
+            return lax.psum(g, WORKER_AXIS)
 
     return shard_map(
         local,

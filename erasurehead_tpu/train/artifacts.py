@@ -30,6 +30,7 @@ from typing import Optional
 
 import numpy as np
 
+from erasurehead_tpu.obs.events import arrival_summary
 from erasurehead_tpu.train.evaluate import EvalResult
 from erasurehead_tpu.train.trainer import TrainResult
 from erasurehead_tpu.utils.config import RunConfig
@@ -125,8 +126,19 @@ def write_run_artifacts(
         "wall_time": result.wall_time,
         "steps_per_sec": result.steps_per_sec,
         "n_train": result.n_train,
+        # straggler-arrival latency stats over the emitted window, with
+        # the -1 never-arrived sentinel MASKED OUT (obs/events.py): a
+        # deadline/failover run where some workers never arrive must not
+        # average sentinels into its latency quantiles
+        "arrival": arrival_summary(result.worker_times[sr:]),
         "artifacts": paths,
     }
+    if result.decode_error is not None:
+        err = np.asarray(result.decode_error[sr:], dtype=np.float64)
+        manifest["decode_error_mean"] = (
+            float(err.mean()) if err.size else 0.0
+        )
+        manifest["decode_error_max"] = float(err.max()) if err.size else 0.0
     mpath = os.path.join(output_dir, f"{prefix}_run_manifest.json")
     with open(mpath, "w") as f:
         json.dump(manifest, f, indent=2, default=str)
@@ -138,7 +150,10 @@ def print_iteration_table(result: TrainResult, ev: EvalResult) -> None:
     """The reference's per-iteration eval printout (src/naive.py:198).
 
     Rows are labeled with true round numbers: a resumed run's eval curves
-    start at result.start_round, and the clocks are indexed to match."""
+    start at result.start_round, and the clocks are indexed to match.
+    Per-iteration arrival latency averages only the workers that actually
+    arrived — the -1 never-arrived sentinel is masked, never averaged in
+    (regression: tests/test_telemetry.py's deadline case)."""
     sr = result.start_round
     for i in range(len(ev.training_loss)):
         line = (
@@ -148,6 +163,15 @@ def print_iteration_table(result: TrainResult, ev: EvalResult) -> None:
         if not np.isnan(ev.auc[i]):
             line += f", AUC = {ev.auc[i]:.5f}"
         line += f", Sim time = {result.timeset[sr + i]:.4f}s"
+        wt = np.asarray(result.worker_times[sr + i], dtype=np.float64)
+        arrived = wt[wt >= 0.0]
+        if arrived.size:
+            line += (
+                f", Mean arrival = {arrived.mean():.4f}s "
+                f"({arrived.size}/{wt.size})"
+            )
+        else:
+            line += ", no arrivals"
         print(line)
     # the total matches the rows just printed (the resumed window, when
     # start_round > 0 — result.sim_total_time covers the full schedule)

@@ -44,28 +44,49 @@ from typing import Any, Callable
 import jax
 import numpy as np
 
+from erasurehead_tpu.obs.metrics import REGISTRY as _METRICS
+
 #: LRU bounds — sweeps cycle over a handful of signatures; the caps only
 #: guard against unbounded growth in long-lived servers.
 EXEC_CACHE_MAX = 32
 DATA_CACHE_MAX = 8
 
 
-@dataclasses.dataclass
 class CacheStats:
-    """Cumulative cache telemetry (process lifetime; reset via clear())."""
+    """Cumulative cache telemetry (process lifetime; reset via clear()).
 
-    exec_hits: int = 0
-    exec_misses: int = 0
-    data_hits: int = 0
-    data_misses: int = 0
-    #: compile+warmup seconds NOT spent thanks to executable hits (each hit
-    #: credits the measured cost of the miss that populated its entry)
-    compile_seconds_saved: float = 0.0
-    #: device bytes NOT re-uploaded thanks to data hits
-    bytes_reused: int = 0
+    A live VIEW over the ``sweep_cache.*`` counters in the obs metrics
+    registry (obs/metrics.py) — the cache reports through the registry
+    like every other telemetry source, and this class keeps the historical
+    attribute/snapshot() interface the trainers and tests consume.
+
+    Fields: ``exec_hits`` / ``exec_misses`` / ``data_hits`` /
+    ``data_misses``; ``compile_seconds_saved`` — compile+warmup seconds
+    NOT spent thanks to executable hits (each hit credits the measured
+    cost of the miss that populated its entry); ``bytes_reused`` — device
+    bytes NOT re-uploaded thanks to data hits.
+    """
+
+    FIELDS = (
+        "exec_hits", "exec_misses", "data_hits", "data_misses",
+        "compile_seconds_saved", "bytes_reused",
+    )
+
+    @staticmethod
+    def counter(field: str):
+        if field not in CacheStats.FIELDS:
+            raise AttributeError(field)
+        return _METRICS.counter(f"sweep_cache.{field}")
+
+    def __getattr__(self, name: str):
+        return CacheStats.counter(name).value
 
     def snapshot(self) -> dict:
-        return dataclasses.asdict(self)
+        return {f: CacheStats.counter(f).value for f in self.FIELDS}
+
+    def reset(self) -> None:
+        for f in self.FIELDS:
+            CacheStats.counter(f).reset()
 
 
 _stats = CacheStats()
@@ -92,10 +113,13 @@ def set_enabled(on: bool) -> None:
 
 def clear() -> None:
     """Drop both caches and reset the counters (tests; memory pressure)."""
-    global _stats
     _exec_cache.clear()
     _data_cache.clear()
-    _stats = CacheStats()
+    _stats.reset()
+    from erasurehead_tpu.obs import detect
+
+    # the caches ARE the detector's notion of "already compiled in-process"
+    detect.reset()
 
 
 def stats() -> CacheStats:
@@ -179,11 +203,11 @@ def get_or_build_data(key, build: Callable[[], Any]):
     if key in _data_cache:
         data, nbytes = _data_cache[key]
         _data_cache.move_to_end(key)
-        _stats.data_hits += 1
-        _stats.bytes_reused += nbytes
+        CacheStats.counter("data_hits").inc()
+        CacheStats.counter("bytes_reused").inc(nbytes)
         return data, True
     data = build()
-    _stats.data_misses += 1
+    CacheStats.counter("data_misses").inc()
     _data_cache[key] = (data, device_nbytes(data))
     while len(_data_cache) > DATA_CACHE_MAX:
         _data_cache.popitem(last=False)
@@ -202,11 +226,11 @@ def get_or_compile(key, compile_fn: Callable[[], tuple[Any, float]]):
     if key in _exec_cache:
         ex, secs = _exec_cache[key]
         _exec_cache.move_to_end(key)
-        _stats.exec_hits += 1
-        _stats.compile_seconds_saved += secs
+        CacheStats.counter("exec_hits").inc()
+        CacheStats.counter("compile_seconds_saved").inc(secs)
         return ex, True
     ex, secs = compile_fn()
-    _stats.exec_misses += 1
+    CacheStats.counter("exec_misses").inc()
     _exec_cache[key] = (ex, secs)
     while len(_exec_cache) > EXEC_CACHE_MAX:
         _exec_cache.popitem(last=False)

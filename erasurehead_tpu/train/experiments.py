@@ -53,6 +53,11 @@ class RunSummary:
     #: TrainResult.cache_info): data/exec hit-miss, compile seconds saved,
     #: bytes not re-uploaded — how much of the sweep the caches absorbed
     cache: Optional[dict] = None
+    #: mean per-round AGC decode-error norm (obs/decode.py via
+    #: TrainResult.decode_error): 0.0 for exact schemes, > 0 where the
+    #: decode was genuinely approximate — the papers' central quantity,
+    #: now a first-class sweep column
+    decode_error_mean: Optional[float] = None
 
     def row(self) -> dict:
         out = {
@@ -70,6 +75,9 @@ class RunSummary:
             else None,
             "time_to_target": round(self.time_to_target, 4)
             if self.time_to_target is not None
+            else None,
+            "decode_error_mean": round(self.decode_error_mean, 8)
+            if self.decode_error_mean is not None
             else None,
         }
         if self.suite:
@@ -158,6 +166,12 @@ def compare(
                 training_loss=ev.training_loss,
                 timeset=res.timeset,
                 cache=res.cache_info,
+                decode_error_mean=(
+                    float(np.mean(res.decode_error))
+                    if res.decode_error is not None
+                    and len(res.decode_error)
+                    else None
+                ),
             )
         )
     return out
@@ -382,7 +396,8 @@ def save_summaries(summaries: list[RunSummary], path: str) -> None:
 def format_table(summaries: list[RunSummary]) -> str:
     header = (
         f"{'label':22s} {'sim it/s':>9s} {'real it/s':>10s} "
-        f"{'train loss':>11s} {'AUC':>7s} {'t->target':>10s}"
+        f"{'train loss':>11s} {'AUC':>7s} {'t->target':>10s} "
+        f"{'dec err':>8s}"
     )
     lines = [header, "-" * len(header)]
     for s in summaries:
@@ -392,10 +407,15 @@ def format_table(summaries: list[RunSummary]) -> str:
             if s.time_to_target is not None
             else "         -"
         )
+        derr = (
+            f"{s.decode_error_mean:8.4f}"
+            if s.decode_error_mean is not None
+            else "       -"
+        )
         lines.append(
             f"{s.label:22s} {s.sim_steps_per_sec:9.3f} "
             f"{s.real_steps_per_sec:10.1f} {s.final_train_loss:11.6f} "
-            f"{auc} {ttt}"
+            f"{auc} {ttt} {derr}"
         )
     return "\n".join(lines)
 
@@ -405,6 +425,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     run the BASELINE.json suite (scaled down by default) and print tables."""
     import argparse
 
+    import contextlib
+
     p = argparse.ArgumentParser(prog="erasurehead-tpu-experiments")
     p.add_argument("--scale", type=float, default=1.0)
     p.add_argument("--rounds", type=int, default=30)
@@ -412,9 +434,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--out", default=None, help="write summaries JSON here")
     p.add_argument("--figures", default=None,
                    help="render comparison PNGs into this directory")
+    p.add_argument("--events", default=None,
+                   help="write a run-telemetry events.jsonl for the whole "
+                        "suite here (obs/; render with `erasurehead-tpu "
+                        "report`)")
     ns = p.parse_args(argv)
 
-    suite = baseline_suite(scale=ns.scale, data_dir=ns.data_dir, rounds=ns.rounds)
+    if ns.events:
+        from erasurehead_tpu.obs import events as events_lib
+
+        sink = events_lib.capture(ns.events)
+    else:
+        sink = contextlib.nullcontext()
+    with sink:
+        suite = baseline_suite(
+            scale=ns.scale, data_dir=ns.data_dir, rounds=ns.rounds
+        )
     all_rows: list[RunSummary] = []
     for name, summaries in suite.items():
         print(f"\n== {name} ==")
@@ -431,6 +466,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if ns.out:
         save_summaries(all_rows, ns.out)
         print(f"\nsummaries -> {ns.out}")
+    if ns.events:
+        print(f"events -> {ns.events} (render: erasurehead-tpu report)")
     return 0
 
 

@@ -365,6 +365,91 @@ def _ring_signature(ring_plan) -> tuple:
     return ("ring", ring_plan.n_hops, ring_plan.sel.tobytes())
 
 
+def _history_update_norms(history) -> np.ndarray:
+    """[R-1] L2 norms of successive iterate differences — the host-visible
+    gradient-magnitude proxy the ``rounds`` telemetry chunks carry
+    (obs/events.emit_round_chunks). The exact per-round gradient norm
+    would need an extra device program, and telemetry must add zero
+    compiles; the optimizer-step norm comes free from the history the
+    caller fetches for eval anyway. Entry j is the step into round j+1
+    of the covered window."""
+    leaves = jax.tree.leaves(history)
+    if not leaves or int(leaves[0].shape[0]) < 2:
+        return np.zeros(0)
+    total = None
+    for leaf in leaves:
+        a = np.asarray(leaf, dtype=np.float64)
+        d = a[1:] - a[:-1]
+        s = (d.reshape(d.shape[0], -1) ** 2).sum(axis=1)
+        total = s if total is None else total + s
+    return np.sqrt(total)
+
+
+def _exec_signature_fields(
+    kind, platform, cfg, model, X, y, use_fused, ring_plan, weights_shape,
+    mesh, state0, alpha, n_train, **extra
+):
+    """LABELED executable-cache signature: field name -> value, same
+    content as the flat cache key (``tuple(fields.values())``). The names
+    feed the recompile detector (obs/detect.py), which must be able to
+    say WHICH field made two compiles differ. Anything that changes the
+    compiled program must appear here — the single home replacing the
+    hand-built exec_sig tuples."""
+    from erasurehead_tpu.train import cache as cache_lib
+
+    fields = {
+        "kind": kind,
+        "platform": platform,
+        **cfg.static_signature_fields(),
+        "lowering": step_lib.lowering_signature(cfg, model, X),
+        "fused": use_fused,
+        "ring": _ring_signature(ring_plan),
+        "weights_shape": tuple(weights_shape),
+        "mesh": cache_lib.mesh_signature(mesh),
+        "state_tree": cache_lib.tree_signature(state0),
+        "data_tree": cache_lib.tree_signature((X, y)),
+        "alpha": float(alpha),
+        "n_train": int(n_train),
+    }
+    fields.update(extra)
+    return fields
+
+
+def _emit_run_start(run_id, cfg, setup, platform, lowering, faithful) -> None:
+    """run_start + data_upload events for a trainer entry (no-ops without
+    a capture installed; obs/events.py)."""
+    from erasurehead_tpu.obs import events as obs_events
+    from erasurehead_tpu.train import cache as cache_lib
+
+    obs_events.emit(
+        "run_start",
+        run_id=run_id,
+        scheme=cfg.scheme.value,
+        model=cfg.model.value,
+        platform=platform,
+        config_hash=obs_events.config_hash(cfg),
+        mesh=cache_lib.mesh_signature(setup.mesh),
+        lowering=repr(lowering),
+        static_signature=cfg.static_signature_fields(),
+        n_workers=cfg.n_workers,
+        n_stragglers=cfg.n_stragglers,
+        rounds=cfg.rounds,
+        compute_mode=cfg.compute_mode.value,
+        stack_mode=(
+            "ring" if setup.ring
+            else ("materialized" if faithful else "deduped")
+        ),
+        dtype=cfg.dtype,
+    )
+    obs_events.emit(
+        "data_upload",
+        run_id=run_id,
+        bytes=cache_lib.device_nbytes(setup.data),
+        cache_hit=setup.data_cache_hit,
+        ring=setup.ring,
+    )
+
+
 def _memory_analysis(compiled) -> Optional[dict]:
     """Byte accounting of an AOT-compiled executable (XLA's
     CompiledMemoryStats), or None where the backend doesn't expose it.
@@ -411,6 +496,15 @@ class TrainResult:
     # hit-miss counts, compile seconds saved, bytes not re-uploaded; None
     # when the trainer path has no cache integration (measured mode)
     cache_info: Optional[dict] = None
+    # [rounds] per-round AGC decode-error norm ||pw - 1||/sqrt(P)
+    # (obs/decode.py) — 0.0 for exact schemes, > 0 where the decode was
+    # genuinely approximate; None where the weights live on device only
+    # (train_dynamic)
+    decode_error: Optional[np.ndarray] = None
+    # event-log run id (obs/events.py) when a telemetry capture was active
+    # during the run, else None — callers (cli eval, experiments) reference
+    # it to attach their own records to this run
+    run_id: Optional[str] = None
 
 
 @_with_run_sparse_lanes
@@ -472,6 +566,18 @@ def train(
             cfg.scheme, arrivals, layout, num_collect=cfg.num_collect,
             deadline=cfg.deadline,
         )
+    # per-round decode-error norm (obs/decode.py): host float64 from the
+    # weights the run decodes with — computed unconditionally (cheap, and
+    # TrainResult.decode_error feeds bench/experiment rows even without an
+    # event capture)
+    from erasurehead_tpu.obs import decode as obs_decode
+    from erasurehead_tpu.obs import detect as obs_detect
+    from erasurehead_tpu.obs import events as obs_events
+
+    decode_err = obs_decode.decode_error_series(
+        layout, schedule.message_weights
+    )
+    run_id = obs_events.new_run_id() if obs_events.current() else None
     lr = setup.lr
     alpha = setup.alpha
     n_train = setup.n_train
@@ -537,6 +643,12 @@ def train(
 
     update_fn = setup.update_fn
 
+    if run_id is not None:
+        _emit_run_start(
+            run_id, cfg, setup, platform,
+            step_lib.lowering_signature(cfg, model, X), faithful,
+        )
+
     def replicate(state):
         # np_global: a donor initial_state may live on a DIFFERENT mesh
         # (an elastic restart), including a submesh of the cluster
@@ -555,10 +667,18 @@ def train(
     # embedded as HLO literal constants, which made XLA compile ~100x slower
     # and pushed a per-call constant upload into the timed region (measured:
     # 147s compile + 25s first call vs 1.7s + 4ms with argument passing).
+    from erasurehead_tpu.utils.tracing import annotate
+
     def body(Xa, ya, state, xs):
         eta, w_t, i = xs
-        g = grad_fn(state.params, Xa, ya, w_t)
-        new_state = update_fn(state, g, eta, alpha, n_train, i)
+        # trace-region names (utils/tracing.annotate -> jax.named_scope):
+        # the coded-step region subsumes the replicated-params broadcast
+        # and contains the eh_step/* sub-phases (ring fill, partial-grad
+        # contraction, decode psum — parallel/step.py)
+        with annotate("eh_scan/coded_step"):
+            g = grad_fn(state.params, Xa, ya, w_t)
+        with annotate("eh_scan/update"):
+            new_state = update_fn(state, g, eta, alpha, n_train, i)
         return new_state, new_state.params
 
     @jax.jit
@@ -598,6 +718,7 @@ def train(
     state0 = replicate(state0)
 
     exec_hits = exec_misses = 0
+    compile_seconds = 0.0
     mem_info = None
     if start_round >= cfg.rounds:
         # the checkpoint already covers the requested rounds: nothing to run
@@ -616,31 +737,21 @@ def train(
         # executable-cache signature: everything that changes the compiled
         # scan besides argument shapes — the cfg-side lowering knobs, the
         # RESOLVED grad lowering (step.lowering_signature + the pallas
-        # gate), the mesh's exact device assignment, and the closure
-        # constants baked into body (alpha, n_train). Per-round weight
-        # tables / lr / arrivals are traced arguments: sharing the
-        # executable across them is the sweep engine's whole point.
-        exec_sig = (
-            "scan",
-            platform,
-            cfg.static_signature(),
-            step_lib.lowering_signature(cfg, model, X),
-            use_fused,
-            # resolved ring transport: "auto" depends on a footprint
-            # estimate the static signature cannot see. The hop plan is
-            # baked into the compiled program as constants, and under ring
-            # the X stack no longer carries the slot count — so the plan
-            # CONTENT and the weight-table shape must key the executable
-            # (two schemes can share every array shape but differ in
-            # assignment, e.g. cyclic MDS vs randreg).
-            _ring_signature(ring_plan),
-            tuple(weights_seq.shape),
-            cache_lib.mesh_signature(mesh),
-            cache_lib.tree_signature(state0),
-            cache_lib.tree_signature((X, y)),
-            float(alpha),
-            int(n_train),
+        # gate), the resolved ring transport ("auto" depends on a
+        # footprint estimate the static signature cannot see; the hop plan
+        # is baked into the program as constants, and under ring the X
+        # stack no longer carries the slot count — so the plan CONTENT and
+        # the weight-table shape must key the executable), the mesh's
+        # exact device assignment, and the closure constants baked into
+        # body (alpha, n_train). Per-round weight tables / lr / arrivals
+        # are traced arguments: sharing the executable across them is the
+        # sweep engine's whole point. The LABELED form feeds the recompile
+        # detector, which names the fields that force a recompile.
+        sig_fields = _exec_signature_fields(
+            "scan", platform, cfg, model, X, y, use_fused, ring_plan,
+            weights_seq.shape, mesh, state0, alpha, n_train,
         )
+        exec_sig = tuple(sig_fields.values())
 
         # AOT-compile each distinct chunk length so timing excludes
         # compilation; the module-level executable cache (train/cache.py)
@@ -665,13 +776,31 @@ def train(
                         _hard_sync(ex(state0, X, y, *slices(lo, hi))[0])
                     return ex, time.perf_counter() - t0
 
+                t_cmp = time.perf_counter()
                 compiled[n], hit = cache_lib.get_or_compile(
                     exec_sig + (n,), _compile
                 )
+                cmp_secs = time.perf_counter() - t_cmp
+                compile_seconds += cmp_secs
                 if hit:
                     exec_hits += 1
                 else:
                     exec_misses += 1
+                    # recompile detector: always observed (it tracks what
+                    # compiled in-process); warns into the event log when
+                    # a near-identical signature forced this compile
+                    obs_detect.observe_and_warn(
+                        {**sig_fields, "chunk_rounds": n}, run_id
+                    )
+                if run_id is not None:
+                    obs_events.emit(
+                        "compile",
+                        run_id=run_id,
+                        seconds=round(cmp_secs, 4),
+                        cache_hit=hit,
+                        chunk_rounds=n,
+                        memory_analysis=_memory_analysis(compiled[n]),
+                    )
 
         state = state0
         pieces = []
@@ -699,6 +828,34 @@ def train(
         mem_info = _memory_analysis(next(iter(compiled.values())))
 
     stats_after = cache_lib.stats().snapshot()
+    steps_per_sec = (cfg.rounds - start_round) / wall if wall > 0 else 0.0
+    if run_id is not None:
+        # all emission host-side, AFTER the timed scan: the event log can
+        # never perturb the measurement or the trajectory
+        obs_events.emit_round_chunks(
+            run_id,
+            start_round=start_round,
+            timeset=schedule.sim_time,
+            worker_times=schedule.worker_times,
+            decode_error=decode_err,
+            update_norm=_history_update_norms(history),
+        )
+        obs_events.emit(
+            "run_end",
+            run_id=run_id,
+            wall_time_s=round(wall, 6),
+            steps_per_sec=round(steps_per_sec, 4),
+            sim_total_time_s=float(schedule.sim_time.sum()),
+            exec_hits=exec_hits,
+            exec_misses=exec_misses,
+            data_cache_hit=setup.data_cache_hit,
+            compile_seconds=round(compile_seconds, 4),
+            stack_bytes=cache_lib.device_nbytes(data),
+            arrival=obs_events.arrival_summary(
+                schedule.worker_times[start_round:]
+            ),
+            **obs_decode.summarize(decode_err),
+        )
     return TrainResult(
         params_history=history,
         final_params=final_state.params,
@@ -707,12 +864,14 @@ def train(
         collected=schedule.collected,
         sim_total_time=float(schedule.sim_time.sum()),
         wall_time=wall,
-        steps_per_sec=(cfg.rounds - start_round) / wall if wall > 0 else 0.0,
+        steps_per_sec=steps_per_sec,
         n_train=n_train,
         start_round=start_round,
         config=cfg,
         layout=layout,
         final_state=final_state,
+        decode_error=decode_err,
+        run_id=run_id,
         cache_info={
             "enabled": cache_lib.enabled(),
             "data_hit": setup.data_cache_hit,
@@ -883,21 +1042,22 @@ def train_batch(
         )
 
     platform = jax.devices()[0].platform
-    exec_sig = (
-        "batch_scan",
-        platform,
-        len(seeds),
-        cfg.static_signature(),
-        step_lib.lowering_signature(cfg, model, X),
-        _ring_signature(ring_plan),
-        tuple(weights_seq.shape),
-        cache_lib.mesh_signature(mesh),
-        cache_lib.tree_signature(state0),
-        cache_lib.tree_signature((X, y)),
-        float(alpha),
-        int(n_train),
-        cfg.rounds,
+    from erasurehead_tpu.obs import decode as obs_decode
+    from erasurehead_tpu.obs import detect as obs_detect
+    from erasurehead_tpu.obs import events as obs_events
+
+    run_id = obs_events.new_run_id() if obs_events.current() else None
+    if run_id is not None:
+        _emit_run_start(
+            run_id, cfg, setup, platform,
+            step_lib.lowering_signature(cfg, model, X), faithful,
+        )
+    sig_fields = _exec_signature_fields(
+        "batch_scan", platform, cfg, model, X, y, False, ring_plan,
+        weights_seq.shape, mesh, state0, alpha, n_train,
+        batch_size=len(seeds), chunk_rounds=cfg.rounds,
     )
+    exec_sig = tuple(sig_fields.values())
 
     def _compile():
         t0 = time.perf_counter()
@@ -906,7 +1066,20 @@ def train_batch(
             _hard_sync(ex(state0, X, y, lr_seq, weights_seq, iters)[0])
         return ex, time.perf_counter() - t0
 
+    t_cmp = time.perf_counter()
     ex, hit = cache_lib.get_or_compile(exec_sig, _compile)
+    cmp_secs = time.perf_counter() - t_cmp
+    if not hit:
+        obs_detect.observe_and_warn(sig_fields, run_id)
+    if run_id is not None:
+        obs_events.emit(
+            "compile",
+            run_id=run_id,
+            seconds=round(cmp_secs, 4),
+            cache_hit=hit,
+            chunk_rounds=cfg.rounds,
+            memory_analysis=_memory_analysis(ex),
+        )
 
     t0 = time.perf_counter()
     final_state, history = ex(state0, X, y, lr_seq, weights_seq, iters)
@@ -938,8 +1111,11 @@ def train_batch(
     }
     results = []
     agg_rate = cfg.rounds * len(seeds) / wall if wall > 0 else 0.0
+    batch_err = []
     for b, (c, sched) in enumerate(zip(cfgs, schedules)):
         fs = jax.tree.map(lambda l: l[b], final_state)
+        err = obs_decode.decode_error_series(layout, sched.message_weights)
+        batch_err.append(err)
         results.append(
             TrainResult(
                 params_history=jax.tree.map(lambda l: l[b], history),
@@ -954,8 +1130,29 @@ def train_batch(
                 n_train=n_train,
                 config=c,
                 layout=layout,
+                decode_error=err,
+                run_id=run_id,
                 cache_info=dict(cache_info),
             )
+        )
+    if run_id is not None:
+        # one run_end for the whole batch (it WAS one dispatch); per-seed
+        # detail lives in the returned TrainResults
+        obs_events.emit(
+            "run_end",
+            run_id=run_id,
+            wall_time_s=round(wall, 6),
+            steps_per_sec=round(agg_rate, 4),
+            batch_size=len(seeds),
+            exec_hits=int(hit),
+            exec_misses=int(not hit),
+            data_cache_hit=setup.data_cache_hit,
+            compile_seconds=round(cmp_secs, 4),
+            stack_bytes=cache_lib.device_nbytes(data),
+            arrival=obs_events.arrival_summary(
+                np.stack([s.worker_times for s in schedules])
+            ),
+            **obs_decode.summarize(np.concatenate(batch_err)),
         )
     return results
 
@@ -1178,9 +1375,20 @@ def train_measured(
         )
     )
 
+    from erasurehead_tpu.obs import decode as obs_decode
+    from erasurehead_tpu.obs import events as obs_events
+
+    run_id = obs_events.new_run_id() if obs_events.current() else None
+    if run_id is not None:
+        _emit_run_start(
+            run_id, cfg, setup, jax.devices()[0].platform,
+            ("measured",), True,
+        )
+
     timeset = np.zeros(cfg.rounds)
     worker_times = np.zeros((cfg.rounds, W))
     collected = np.zeros((cfg.rounds, W), dtype=bool)
+    mw_rows = []  # per-round decode weights -> decode-error telemetry
     history = []
     wall0 = time.perf_counter()
     for r in range(cfg.rounds):
@@ -1253,10 +1461,32 @@ def train_measured(
         timeset[r] = sched.sim_time[0]
         worker_times[r] = sched.worker_times[0]
         collected[r] = sched.collected[0]
+        mw_rows.append(sched.message_weights[0])
         history.append(state.params)
     _hard_sync(state)
     wall = time.perf_counter() - wall0
 
+    decode_err = obs_decode.decode_error_series(
+        layout, np.stack(mw_rows) if mw_rows else np.zeros((0, W))
+    )
+    steps_per_sec = cfg.rounds / wall if wall > 0 else 0.0
+    if run_id is not None:
+        obs_events.emit_round_chunks(
+            run_id,
+            start_round=0,
+            timeset=timeset,
+            worker_times=worker_times,
+            decode_error=decode_err,
+        )
+        obs_events.emit(
+            "run_end",
+            run_id=run_id,
+            wall_time_s=round(wall, 6),
+            steps_per_sec=round(steps_per_sec, 4),
+            sim_total_time_s=float(timeset.sum()),
+            arrival=obs_events.arrival_summary(worker_times),
+            **obs_decode.summarize(decode_err),
+        )
     return TrainResult(
         params_history=jax.tree.map(lambda *xs: jnp.stack(xs), *history),
         final_params=state.params,
@@ -1266,10 +1496,12 @@ def train_measured(
         collected=collected,
         sim_total_time=float(timeset.sum()),
         wall_time=wall,
-        steps_per_sec=cfg.rounds / wall if wall > 0 else 0.0,
+        steps_per_sec=steps_per_sec,
         n_train=n_train,
         config=cfg,
         layout=layout,
+        decode_error=decode_err,
+        run_id=run_id,
     )
 
 
@@ -1386,6 +1618,7 @@ def _train_measured_cluster(cfg, dataset, setup, mult, dtype, mesh=None):
     timeset = np.zeros(cfg.rounds)
     worker_times = np.zeros((cfg.rounds, W))
     collected = np.zeros((cfg.rounds, W), dtype=bool)
+    mw_rows = []  # decode-error telemetry (identical on every replica)
     history = []
     wall0 = time.perf_counter()
     for r in range(cfg.rounds):
@@ -1442,10 +1675,19 @@ def _train_measured_cluster(cfg, dataset, setup, mult, dtype, mesh=None):
         timeset[r] = sched.sim_time[0]
         worker_times[r] = sched.worker_times[0]
         collected[r] = sched.collected[0]
+        mw_rows.append(sched.message_weights[0])
         history.append(state.params)
     _hard_sync(state)
     wall = time.perf_counter() - wall0
 
+    from erasurehead_tpu.obs import decode as obs_decode
+
+    # decode-error telemetry only (no event emission here: every replica
+    # computes the identical schedule, and N processes appending to one
+    # event file would interleave — the single-process path emits)
+    decode_err = obs_decode.decode_error_series(
+        layout, np.stack(mw_rows) if mw_rows else np.zeros((0, W))
+    )
     return TrainResult(
         params_history=jax.tree.map(lambda *xs: jnp.stack(xs), *history),
         final_params=state.params,
@@ -1459,6 +1701,7 @@ def _train_measured_cluster(cfg, dataset, setup, mult, dtype, mesh=None):
         n_train=n_train,
         config=cfg,
         layout=layout,
+        decode_error=decode_err,
     )
 
 
@@ -1531,6 +1774,10 @@ def train_dynamic(
     covers rounds [initial_round, rounds); telemetry rows before that
     carry zero time / -1 clocks / nothing-collected, and params_history
     has ``rounds - initial_round`` entries.
+
+    No event-log / decode-error telemetry (obs/): the collection weights
+    are traced values inside the scan, so the host never sees them — use
+    :func:`train` for instrumented runs.
     """
     from erasurehead_tpu.parallel import dynamic as dynamic_lib
 

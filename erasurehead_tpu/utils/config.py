@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import os
 from typing import Optional, Sequence
 
 import numpy as np
@@ -433,6 +434,39 @@ class RunConfig:
                     f"(got {self.deadline!r})"
                 )
 
+    def static_signature_fields(self) -> dict:
+        """LABELED form of :meth:`static_signature`: field name -> value.
+
+        The names feed the recompile detector (obs/detect.py), which
+        diffs executable-cache miss signatures against prior in-process
+        compiles and must be able to NAME the knob that differed ("dtype
+        changed", not "element 4 changed"). :meth:`static_signature`
+        derives from this dict so the two can never drift."""
+        return {
+            "model": self.model.value,
+            "compute_mode": self.compute_mode.value,
+            # the RESOLVED ring choice also enters the trainer-side key
+            # (auto depends on a footprint estimate cfg alone cannot see);
+            # the raw knob here keeps explicit/auto requests distinct
+            "stack_mode": self.stack_mode,
+            "update_rule": self.update_rule.value,
+            "dtype": self.dtype,
+            "scan_unroll": self.scan_unroll,
+            # features-module lowering knobs (scoped per run by
+            # trainer._with_run_sparse_lanes; they retrace every jit)
+            "sparse_lanes": self.sparse_lanes,
+            "dense_margin_cols": self.dense_margin_cols,
+            "sparse_format": self.sparse_format,
+            "fields_scatter": self.fields_scatter,
+            "fields_margin": self.fields_margin,
+            # model-family internal axes (change for_mesh's model variant)
+            "sp_form": self.sp_form,
+            "seq_shards": self.seq_shards,
+            "tp_shards": self.tp_shards,
+            "pp_shards": self.pp_shards,
+            "ep_shards": self.ep_shards,
+        }
+
     def static_signature(self) -> tuple:
         """The config-derived half of the sweep-engine executable cache key
         (train/cache.py): every knob that changes the compiled scan's
@@ -440,32 +474,9 @@ class RunConfig:
         by the trainer's resolved-lowering tuple. Per-round weight tables,
         the arrival schedule, and lr values are traced ARGUMENTS and
         deliberately absent — sharing the executable across them is the
-        whole point. When adding a lowering knob to RunConfig, add it
-        here."""
-        return (
-            self.model.value,
-            self.compute_mode.value,
-            # the RESOLVED ring choice also enters the trainer-side key
-            # (auto depends on a footprint estimate cfg alone cannot see);
-            # the raw knob here keeps explicit/auto requests distinct
-            self.stack_mode,
-            self.update_rule.value,
-            self.dtype,
-            self.scan_unroll,
-            # features-module lowering knobs (scoped per run by
-            # trainer._with_run_sparse_lanes; they retrace every jit)
-            self.sparse_lanes,
-            self.dense_margin_cols,
-            self.sparse_format,
-            self.fields_scatter,
-            self.fields_margin,
-            # model-family internal axes (change for_mesh's model variant)
-            self.sp_form,
-            self.seq_shards,
-            self.tp_shards,
-            self.pp_shards,
-            self.ep_shards,
-        )
+        whole point. When adding a lowering knob to RunConfig, add it to
+        :meth:`static_signature_fields` (this derives from it)."""
+        return tuple(self.static_signature_fields().values())
 
     @property
     def effective_alpha(self) -> float:
@@ -487,3 +498,46 @@ class RunConfig:
         if kind == "exp":
             return exponential_decay_schedule(args[0], args[1], self.rounds)
         raise ValueError(f"unknown lr schedule kind {kind!r}")
+
+
+#: env var controlling run telemetry when the CLI flag is absent
+#: (mirrors ERASUREHEAD_SWEEP_CACHE's flag > env > default precedence)
+TELEMETRY_ENV = "ERASUREHEAD_TELEMETRY"
+
+_TELEMETRY_ON = ("1", "on", "true", "yes")
+_TELEMETRY_OFF = ("0", "off", "false", "no")
+
+
+def resolve_telemetry(
+    flag: Optional[str] = None,
+    out_dir_set: bool = False,
+    env: Optional[str] = None,
+) -> bool:
+    """Should this invocation write a run-telemetry event log (obs/)?
+
+    Precedence mirrors the ``--sweep-cache`` pattern: the explicit CLI
+    ``--telemetry {on,off,auto}`` flag wins, else the
+    :data:`TELEMETRY_ENV` env var, else the default ``off``. The ``auto``
+    setting resolves to on exactly when the caller passed an explicit
+    output directory (``out_dir_set`` — the CLI's ``--output-dir``): a run
+    that asked for a place to keep artifacts wants the event log beside
+    them, while ad-hoc runs stay zero-overhead by default.
+
+    ``env`` overrides the real environment lookup (tests).
+    """
+    val = flag
+    if val is None:
+        val = env if env is not None else os.environ.get(TELEMETRY_ENV)
+    if val is None or val == "":
+        val = "off"
+    val = str(val).strip().lower()
+    if val in _TELEMETRY_ON:
+        return True
+    if val in _TELEMETRY_OFF:
+        return False
+    if val == "auto":
+        return bool(out_dir_set)
+    raise ValueError(
+        f"telemetry setting must be on/off/auto (or a truthy/falsy "
+        f"{TELEMETRY_ENV} value), got {val!r}"
+    )

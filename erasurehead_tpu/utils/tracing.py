@@ -34,9 +34,24 @@ def device_trace(log_dir: Optional[str]) -> Iterator[None]:
         jax.profiler.stop_trace()
 
 
-def annotate(name: str):
-    """Named region in the device trace (TraceAnnotation)."""
-    return jax.profiler.TraceAnnotation(name)
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region in BOTH trace surfaces.
+
+    - ``jax.named_scope``: pushes ``name`` onto the op-name stack during
+      tracing, so every HLO op emitted inside carries it — this is what
+      makes the jitted scan's phases (broadcast, ring fill,
+      partial-gradient contraction, decode, update; parallel/step.py)
+      navigable in a ``--trace-dir`` Perfetto/TensorBoard device capture.
+    - ``jax.profiler.TraceAnnotation``: a host-timeline span for eager
+      regions (the measured-arrival trainer's per-worker dispatches).
+
+    Safe under jit (tests/test_tracing.py pins the round-trip) and always
+    on: op names never change the compiled math, so annotating
+    unconditionally keeps telemetry-on and -off lowerings identical.
+    """
+    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+        yield
 
 
 class StepTimer:

@@ -247,6 +247,85 @@ def test_cli_dense_margin_cols_validation():
     assert cfg.dense_margin_cols == 8  # normalized to int
 
 
+def _telemetry_base(data_dir, workers=4):
+    return [
+        "--scheme", "approx", "--workers", str(workers), "--stragglers",
+        "1", "--num-collect", "3", "--rounds", "3", "--rows",
+        str(60 * workers), "--cols", "8", "--lr", "1.0", "--add-delay",
+        "--compute-mode", "deduped", "--input-dir", data_dir, "--quiet",
+    ]
+
+
+def test_cli_telemetry_on_writes_and_validates_events(tmp_path):
+    """--telemetry on: events.jsonl lands beside the artifacts, passes the
+    schema validator, and carries the run bracket + the CLI's eval record;
+    `erasurehead-tpu report` renders it."""
+    from erasurehead_tpu.obs import events as events_lib
+
+    out_dir = str(tmp_path / "out")
+    rc = cli.main(
+        _telemetry_base(str(tmp_path / "data"))
+        + ["--telemetry", "on", "--output-dir", out_dir]
+    )
+    assert rc == 0
+    path = os.path.join(out_dir, "events.jsonl")
+    assert os.path.exists(path)
+    assert events_lib.validate_file(path) == []
+    import json
+
+    types = [
+        json.loads(line)["type"] for line in open(path) if line.strip()
+    ]
+    for required in ("run_start", "compile", "rounds", "decode", "eval",
+                     "run_end"):
+        assert required in types, (required, types)
+    assert cli.main(["report", path]) == 0
+
+
+def test_cli_telemetry_auto_follows_output_dir(tmp_path, monkeypatch):
+    """auto = on exactly when --output-dir was given (and the env var
+    fills in when the flag is absent — the --sweep-cache precedence)."""
+    monkeypatch.delenv("ERASUREHEAD_TELEMETRY", raising=False)
+    out_dir = str(tmp_path / "out")
+    rc = cli.main(
+        _telemetry_base(str(tmp_path / "d1"))
+        + ["--telemetry", "auto", "--output-dir", out_dir]
+    )
+    assert rc == 0
+    assert os.path.exists(os.path.join(out_dir, "events.jsonl"))
+
+    # auto WITHOUT an explicit output dir: off — no events.jsonl anywhere
+    data_dir = str(tmp_path / "d2")
+    rc = cli.main(_telemetry_base(data_dir) + ["--telemetry", "auto"])
+    assert rc == 0
+    results = os.path.join(
+        data_dir, "artificial-data", "240x8", "4", "results"
+    )
+    assert os.path.isdir(results)
+    assert "events.jsonl" not in os.listdir(results)
+
+
+def test_cli_telemetry_env_resolution(tmp_path, monkeypatch):
+    """ERASUREHEAD_TELEMETRY=on enables the log with no flag; an explicit
+    --telemetry off beats the env."""
+    monkeypatch.setenv("ERASUREHEAD_TELEMETRY", "on")
+    data_dir = str(tmp_path / "d1")
+    rc = cli.main(_telemetry_base(data_dir))
+    assert rc == 0
+    results = os.path.join(
+        data_dir, "artificial-data", "240x8", "4", "results"
+    )
+    assert "events.jsonl" in os.listdir(results)
+
+    data_dir = str(tmp_path / "d2")
+    rc = cli.main(_telemetry_base(data_dir) + ["--telemetry", "off"])
+    assert rc == 0
+    results = os.path.join(
+        data_dir, "artificial-data", "240x8", "4", "results"
+    )
+    assert "events.jsonl" not in os.listdir(results)
+
+
 def test_cli_deadline_scheme_artifacts(tmp_path):
     """scheme=deadline end to end through the CLI: artifacts carry the
     scheme's own prefix (regression: run_prefix lacked the new scheme)."""

@@ -1,0 +1,417 @@
+"""Run-telemetry subsystem (erasurehead_tpu/obs): event log, decode error,
+metrics registry, recompile detector, sentinel-masked arrival stats.
+
+The two contracts that matter most are pinned here:
+  - telemetry is OBSERVATION-ONLY: with a capture installed vs not,
+    ``params_history`` is bitwise identical across schemes (incl. an
+    approximate one) and the executable cache records zero extra compiles;
+  - the per-round decode-error norm reads exactly 0 for exact schemes
+    (cyclic MDS, FRC, naive) and > 0 for approximate decodes (AGC,
+    randreg, avoidstragg) under nonzero straggling.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from erasurehead_tpu.data.synthetic import generate_gmm
+from erasurehead_tpu.obs import decode as obs_decode
+from erasurehead_tpu.obs import detect as obs_detect
+from erasurehead_tpu.obs import events as obs_events
+from erasurehead_tpu.obs import metrics as obs_metrics
+from erasurehead_tpu.obs import report as obs_report
+from erasurehead_tpu.train import cache, trainer
+from erasurehead_tpu.utils.config import RunConfig, resolve_telemetry
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+)
+
+W = 6
+ROWS, COLS, ROUNDS = 240, 12, 5
+
+
+def _dataset():
+    return generate_gmm(ROWS, COLS, n_partitions=W, seed=0)
+
+
+def _cfg(scheme, **kw):
+    base = dict(
+        scheme=scheme, n_workers=W, n_stragglers=1, rounds=ROUNDS,
+        n_rows=ROWS, n_cols=COLS, lr_schedule=1.0, add_delay=True,
+        compute_mode="deduped", seed=0,
+    )
+    base.update(kw)
+    return RunConfig(**base)
+
+
+def _flat_history(res):
+    return np.concatenate(
+        [np.asarray(l).ravel() for l in jax.tree.leaves(res.params_history)]
+    )
+
+
+def _events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# decode error: the papers' central quantity, test-pinned per scheme
+
+
+def test_decode_error_exact_schemes_zero_approx_positive():
+    """Exact decodes read EXACTLY 0.0; approximate decodes are > 0 under
+    nonzero straggling. (Scheme.APPROX is the paper's FRC-layout AGC —
+    the 'FRC/AGC' approximate scheme; Scheme.FRC waits for every group
+    and is exact.)"""
+    ds = _dataset()
+    exact = {
+        "cyccoded": _cfg("cyccoded"),
+        "repcoded": _cfg("repcoded"),
+        "naive": _cfg("naive"),
+    }
+    for name, cfg in exact.items():
+        res = trainer.train(cfg, ds)
+        assert res.decode_error is not None
+        assert (res.decode_error == 0.0).all(), (name, res.decode_error)
+
+    # num_collect=2 of 3 FRC groups: >= 1 group erased EVERY round
+    agc = trainer.train(_cfg("approx", num_collect=2), ds)
+    assert (agc.decode_error > 0.0).all(), agc.decode_error
+    # randreg at 3 of 6 rows: lstsq over an underdetermined receive set
+    rr = trainer.train(_cfg("randreg", num_collect=3), ds)
+    assert (rr.decode_error > 0.0).all(), rr.decode_error
+    # avoidstragg's W/(W-s) rescale is biased per round
+    avoid = trainer.train(_cfg("avoidstragg"), ds)
+    assert (avoid.decode_error > 0.0).all(), avoid.decode_error
+
+
+def test_decode_error_series_matches_manual():
+    """decode_error_series == ||fold(expand(weights)) - 1|| / sqrt(P)."""
+    from erasurehead_tpu.parallel import collect, step as step_lib
+
+    cfg = _cfg("approx", num_collect=2)
+    layout = trainer.build_layout(cfg)
+    arrivals = trainer.default_arrivals(cfg)
+    sched = collect.build_schedule(
+        cfg.scheme, arrivals, layout, num_collect=cfg.num_collect
+    )
+    err = obs_decode.decode_error_series(layout, sched.message_weights)
+    slot_w = np.asarray(
+        step_lib.expand_slot_weights(
+            sched.message_weights,
+            np.asarray(layout.coeffs),
+            np.asarray(layout.slot_is_coded),
+        )
+    )
+    pw = layout.fold_slot_weights(slot_w)
+    manual = np.linalg.norm(pw - 1.0, axis=-1) / np.sqrt(layout.n_partitions)
+    np.testing.assert_allclose(err, manual, atol=obs_decode.EXACT_TOL)
+
+
+# ---------------------------------------------------------------------------
+# observation-only: bitwise identity + zero extra compiles (acceptance)
+
+
+@pytest.mark.parametrize(
+    "scheme,extra",
+    [
+        ("approx", {"num_collect": 2}),  # approximate
+        ("cyccoded", {}),  # exact MDS
+        ("randreg", {"num_collect": 3}),  # approximate, optimal decode
+    ],
+)
+def test_telemetry_is_observation_only(tmp_path, scheme, extra):
+    cache.clear()
+    ds = _dataset()
+    cfg = _cfg(scheme, **extra)
+    off = trainer.train(cfg, ds)
+    assert off.run_id is None  # no capture -> no event identity
+
+    path = str(tmp_path / "events.jsonl")
+    with obs_events.capture(path):
+        on = trainer.train(cfg, ds)
+    # bitwise identical trajectory
+    np.testing.assert_array_equal(_flat_history(off), _flat_history(on))
+    # zero extra compiles: the telemetry-on run hit the executable (and
+    # data) caches populated by the telemetry-off run — emission changed
+    # neither the signature nor the lowering
+    assert on.cache_info["exec_misses"] == 0
+    assert on.cache_info["exec_hits"] >= 1
+    assert on.cache_info["data_hit"] is True
+    assert obs_events.validate_file(path) == []
+
+
+# ---------------------------------------------------------------------------
+# event log + report: the 2-scheme compare acceptance
+
+
+def test_event_log_and_report_two_scheme_compare(tmp_path, capsys):
+    from erasurehead_tpu.train import experiments
+
+    cache.clear()
+    ds = _dataset()
+    path = str(tmp_path / "events.jsonl")
+    with obs_events.capture(path):
+        summaries = experiments.compare(
+            {
+                "cyccoded": _cfg("cyccoded"),
+                "agc": _cfg("approx", num_collect=2),
+            },
+            ds,
+        )
+    # sweep rows carry the decode-error column
+    by_label = {s.label: s for s in summaries}
+    assert by_label["cyccoded"].decode_error_mean == 0.0
+    assert by_label["agc"].decode_error_mean > 0.0
+    assert "decode_error_mean" in by_label["agc"].row()
+
+    assert obs_events.validate_file(path) == []
+    recs = _events(path)
+    types = [r["type"] for r in recs]
+    for required in ("run_start", "compile", "data_upload", "rounds",
+                     "decode", "run_end", "metrics"):
+        assert required in types, (required, types)
+    # two runs, each bracketed
+    assert types.count("run_start") == 2
+    assert types.count("run_end") == 2
+    # decode events: exact scheme all-zero, AGC positive
+    decode_by_run = {}
+    scheme_by_run = {
+        r["run_id"]: r["scheme"] for r in recs if r["type"] == "run_start"
+    }
+    for r in recs:
+        if r["type"] == "decode":
+            decode_by_run[scheme_by_run[r["run_id"]]] = r
+    assert decode_by_run["cyccoded"]["exact"] is True
+    assert decode_by_run["cyccoded"]["error_max"] == 0.0
+    assert decode_by_run["approx"]["exact"] is False
+    assert decode_by_run["approx"]["error_mean"] > 0.0
+
+    # the report command renders one row per run with both schemes
+    from erasurehead_tpu import cli
+
+    assert cli.main(["report", path]) == 0
+    out = capsys.readouterr().out
+    assert "cyccoded" in out and "approx" in out
+    assert "steps/s" in out and "decode err" in out
+
+
+def test_validator_catches_malformed_logs(tmp_path):
+    path = str(tmp_path / "bad.jsonl")
+    good = {"type": "rounds", "seq": 0, "t": 0.0, "run_id": "r1",
+            "first_round": 0, "n_rounds": 2, "sim_time_s": 1.0}
+    lines = [
+        json.dumps(good),
+        json.dumps({**good, "seq": 1, "type": "nosuchtype"}),
+        json.dumps({"type": "compile", "seq": 2, "t": 0.0, "run_id": "r1"}),
+        "{not json",
+        json.dumps({**good, "seq": 1, "first_round": 0}),  # seq + round
+    ]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    errors = obs_events.validate_file(path)
+    msgs = "\n".join(errors)
+    assert "unknown record type" in msgs
+    assert "missing required" in msgs  # compile lacks seconds/cache_hit
+    assert "not JSON" in msgs
+    assert "first_round" in msgs  # non-monotonic round index
+    assert "non-monotonic seq" in msgs
+
+    # the tools/ CLI wrapper agrees (same logic, exit code contract)
+    import validate_events as validate_tool
+
+    assert validate_tool.main([path]) == 1
+    ok_path = str(tmp_path / "ok.jsonl")
+    with open(ok_path, "w") as f:
+        f.write(json.dumps(good) + "\n")
+    assert validate_tool.main([ok_path]) == 0
+
+
+def test_emit_requires_known_type_and_keys(tmp_path):
+    with obs_events.capture(str(tmp_path / "e.jsonl")) as logger:
+        with pytest.raises(ValueError, match="unknown event type"):
+            logger.emit("bogus", x=1)
+        with pytest.raises(ValueError, match="missing required"):
+            logger.emit("compile", run_id="r1")  # no seconds/cache_hit
+        assert obs_events.current() is logger
+    assert obs_events.current() is None
+
+
+# ---------------------------------------------------------------------------
+# arrival sentinel masking (satellite): never average -1 into latency stats
+
+
+def test_arrival_summary_masks_sentinel():
+    wt = np.array([[0.5, -1.0, 1.5], [-1.0, -1.0, 2.0]])
+    s = obs_events.arrival_summary(wt)
+    assert s["n_never"] == 3 and s["n_arrivals"] == 3
+    arrived = np.array([0.5, 1.5, 2.0])
+    assert np.isclose(s["mean"], arrived.mean())
+    assert s["p50"] >= 0.0 and s["p99"] <= 2.0
+    empty = obs_events.arrival_summary(np.full((2, 3), -1.0))
+    assert empty["n_arrivals"] == 0 and empty["p50"] is None
+
+
+def test_artifacts_mask_never_arrived_sentinel(tmp_path, capsys):
+    """Deadline run where some workers never arrive: the manifest's
+    arrival stats and the per-iteration log lines must exclude the -1
+    sentinel (regression: averaging it in silently lowers latencies)."""
+    from erasurehead_tpu.train import artifacts, evaluate
+
+    ds = _dataset()
+    cfg = _cfg("deadline", deadline=0.3, delay_mean=0.5)
+    res = trainer.train(cfg, ds)
+    assert (res.worker_times == -1.0).any(), "need never-arrived workers"
+    assert (res.worker_times[res.worker_times != -1.0] >= 0).all()
+
+    model = trainer.build_model(cfg)
+    n = res.n_train
+    ev = evaluate.replay(
+        model, cfg.model, res.params_history, ds.X_train[:n],
+        ds.y_train[:n], ds.X_test, ds.y_test,
+    )
+    out_dir = str(tmp_path / "results")
+    paths = artifacts.write_run_artifacts(res, ev, out_dir)
+    with open(paths["manifest"]) as f:
+        manifest = json.load(f)
+    arr = manifest["arrival"]
+    wt = res.worker_times
+    arrived = wt[wt >= 0.0]
+    assert arr["n_never"] == int((wt == -1.0).sum())
+    assert np.isclose(arr["mean"], arrived.mean(), atol=1e-6)
+    assert arr["p50"] >= 0.0  # a sentinel-polluted quantile could go < 0
+    assert np.isclose(arr["p90"], np.quantile(arrived, 0.9), atol=1e-6)
+    # decode-error fields ride along (deadline rescale is approximate)
+    assert manifest["decode_error_mean"] > 0.0
+
+    artifacts.print_iteration_table(res, ev)
+    table = capsys.readouterr().out
+    assert "Mean arrival" in table or "no arrivals" in table
+    assert "-1.0" not in table
+    for line in table.splitlines():
+        if "Mean arrival = " in line:
+            val = float(line.split("Mean arrival = ")[1].split("s ")[0])
+            assert val >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# recompile detector
+
+
+def test_recompile_detector_names_changed_fields():
+    obs_detect.reset()
+    a = {"kind": "scan", "dtype": "float32", "scan_unroll": 1,
+         "chunk_rounds": 5}
+    assert obs_detect.observe(dict(a)) is None  # first compile: no prior
+    diff = obs_detect.observe({**a, "scan_unroll": 2})
+    assert diff is not None and diff["changed"] == ["scan_unroll"]
+    assert "1 -> 2" in diff["detail"]["scan_unroll"]
+    # expected-to-vary fields alone (chunk length) do not warn
+    assert obs_detect.observe({**a, "chunk_rounds": 3}) is None
+    # identical signature recompiled -> empty diff (eviction/disabled)
+    diff = obs_detect.observe(dict(a))
+    assert diff is not None and diff["changed"] == []
+
+
+def test_recompile_warning_event_from_trainer(tmp_path):
+    """Two runs differing only in scan_unroll: the second compile's
+    warning event names the knob."""
+    cache.clear()
+    ds = _dataset()
+    path = str(tmp_path / "events.jsonl")
+    with obs_events.capture(path):
+        trainer.train(_cfg("approx", num_collect=2), ds)
+        trainer.train(_cfg("approx", num_collect=2, scan_unroll=2), ds)
+    warnings = [r for r in _events(path) if r["type"] == "warning"]
+    assert warnings, "expected a recompile warning"
+    w = warnings[-1]
+    assert w["kind"] == "recompile"
+    assert "scan_unroll" in w["changed"]
+    assert obs_events.validate_file(path) == []
+
+
+# ---------------------------------------------------------------------------
+# metrics registry (tentpole: cache_info plumbing now reports through it)
+
+
+def test_metrics_registry_basics():
+    reg = obs_metrics.MetricsRegistry()
+    c = reg.counter("x.hits")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("x.rate")
+    g.set(1.5)
+    h = reg.histogram("x.lat")
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.observe(v)
+    snap = reg.snapshot()
+    assert snap["x.hits"] == 3
+    assert snap["x.rate"] == 1.5
+    assert snap["x.lat"]["count"] == 4
+    assert snap["x.lat"]["mean"] == 2.5
+    assert snap["x.lat"]["min"] == 1.0 and snap["x.lat"]["max"] == 4.0
+    # same-name different-kind is a loud error, not silent aliasing
+    with pytest.raises(TypeError):
+        reg.gauge("x.hits")
+    reg.reset()
+    assert reg.snapshot()["x.hits"] == 0
+    assert reg.counter("x.hits") is c  # names persist across reset
+
+
+def test_cache_stats_are_registry_backed():
+    cache.clear()
+    s = cache.stats()
+    assert s.exec_misses == 0 and s.data_misses == 0
+    before = obs_metrics.REGISTRY.snapshot()
+    assert before.get("sweep_cache.exec_misses", 0) == 0
+    ds = _dataset()
+    trainer.train(_cfg("cyccoded"), ds)
+    after = obs_metrics.REGISTRY.snapshot()
+    assert after["sweep_cache.exec_misses"] == 1
+    assert after["sweep_cache.data_misses"] == 1
+    assert cache.stats().snapshot()["exec_misses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI flag / env resolution (satellite; integration lives in test_cli.py)
+
+
+def test_resolve_telemetry_precedence():
+    # explicit flag wins over everything
+    assert resolve_telemetry("on", out_dir_set=False, env="off") is True
+    assert resolve_telemetry("off", out_dir_set=True, env="on") is False
+    # env when no flag
+    assert resolve_telemetry(None, out_dir_set=False, env="on") is True
+    assert resolve_telemetry(None, out_dir_set=False, env="0") is False
+    assert resolve_telemetry(None, out_dir_set=False, env="1") is True
+    # default off
+    assert resolve_telemetry(None, out_dir_set=True, env="") is False
+    # auto keys off the explicit output dir
+    assert resolve_telemetry("auto", out_dir_set=True) is True
+    assert resolve_telemetry("auto", out_dir_set=False) is False
+    assert resolve_telemetry(None, out_dir_set=True, env="auto") is True
+    assert resolve_telemetry(None, out_dir_set=False, env="auto") is False
+    with pytest.raises(ValueError, match="telemetry"):
+        resolve_telemetry(None, env="sometimes")
+
+
+def test_report_renders_measured_style_minimal(tmp_path, capsys):
+    """The report degrades gracefully on partial logs (no run_end)."""
+    path = str(tmp_path / "partial.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "type": "run_start", "seq": 0, "t": 0.0, "run_id": "r9",
+            "scheme": "approx", "platform": "cpu", "config_hash": "x",
+            "mesh": [], "lowering": "()",
+        }) + "\n")
+    out = obs_report.render([path])
+    assert "approx" in out and "r9" in out

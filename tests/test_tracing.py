@@ -1,0 +1,87 @@
+"""utils/tracing.py coverage: the profiling/tracing helpers.
+
+``annotate`` must be safe both eagerly and under jit (it names the scan
+phases inside the compiled training step, parallel/step.py — an op-name
+scope can never change the math), ``device_trace(None)`` must be a no-op,
+and StepTimer's aggregates must handle the empty-laps case.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from erasurehead_tpu.utils import tracing
+
+
+def test_device_trace_none_is_noop():
+    """No log dir -> no profiler session; computation inside unaffected."""
+    with tracing.device_trace(None):
+        out = jnp.sum(jnp.arange(4.0))
+    assert float(out) == 6.0
+    with tracing.device_trace(""):  # falsy string: same contract
+        pass
+
+
+def test_annotate_round_trips_under_cpu_jit():
+    """annotate inside a jitted function must not change results — the
+    named scope is op metadata only. Pin eager == jit == unannotated."""
+
+    def plain(x):
+        return x * 2.0 + 1.0
+
+    def annotated(x):
+        with tracing.annotate("eh_test/phase"):
+            y = x * 2.0
+        with tracing.annotate("eh_test/other"):
+            return y + 1.0
+
+    x = jnp.arange(6.0).reshape(2, 3)
+    expected = np.asarray(plain(x))
+    np.testing.assert_array_equal(np.asarray(annotated(x)), expected)
+    np.testing.assert_array_equal(
+        np.asarray(jax.jit(annotated)(x)), expected
+    )
+
+
+def test_annotate_under_grad_and_scan():
+    """The training scan wraps its body phases in annotate; differentiation
+    and scan tracing must pass through the scopes untouched."""
+
+    def loss(p, x):
+        with tracing.annotate("eh_test/grad_region"):
+            return jnp.sum((p * x) ** 2)
+
+    g = jax.grad(loss)(2.0, jnp.ones(3))
+    assert np.isclose(float(g), 12.0)
+
+    def body(c, x):
+        with tracing.annotate("eh_test/scan_body"):
+            return c + x, c
+
+    @jax.jit
+    def run(xs):
+        return jax.lax.scan(body, 0.0, xs)
+
+    final, hist = run(jnp.arange(4.0))
+    assert float(final) == 6.0
+    np.testing.assert_array_equal(np.asarray(hist), [0.0, 0.0, 1.0, 3.0])
+
+
+def test_steptimer_empty_laps():
+    t = tracing.StepTimer()
+    assert t.laps == []
+    assert t.total == 0.0
+    assert t.mean == 0.0  # no ZeroDivisionError on the empty case
+
+
+def test_steptimer_accumulates():
+    t = tracing.StepTimer()
+    for _ in range(3):
+        with t:
+            time.sleep(0.001)
+    assert len(t.laps) == 3
+    assert all(lap > 0.0 for lap in t.laps)
+    assert np.isclose(t.total, sum(t.laps))
+    assert np.isclose(t.mean, t.total / 3)

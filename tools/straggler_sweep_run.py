@@ -29,6 +29,10 @@ def main() -> int:
     ap.add_argument("--cols", type=int, default=100)
     ap.add_argument("--num-collect", type=int, default=None,
                     help="AGC collection target (default W/2)")
+    ap.add_argument("--events", action="store_true",
+                    help="also write a run-telemetry event log "
+                         "(artifacts/straggler_sweep_w{W}_events.jsonl; "
+                         "render with `erasurehead-tpu report`)")
     ns = ap.parse_args()
     W = ns.workers
     collect = ns.num_collect or W // 2
@@ -54,12 +58,23 @@ def main() -> int:
         "repcoded": frc_s,
         "approx": frc_s,
     }
+    out_dir = os.path.join(os.path.dirname(__file__), "..", "artifacts")
+    if ns.events:
+        from erasurehead_tpu.obs import events as events_lib
+
+        epath = os.path.join(out_dir, f"straggler_sweep_w{W}_events.jsonl")
+        sink = events_lib.capture(epath)
+    else:
+        epath, sink = None, None
     t0 = time.time()
-    summaries = experiments.straggler_sweep(base, data, sweep)
+    if sink is not None:
+        with sink:
+            summaries = experiments.straggler_sweep(base, data, sweep)
+        print(f"events -> {epath}", file=sys.stderr)
+    else:
+        summaries = experiments.straggler_sweep(base, data, sweep)
     print(f"sweep: {len(summaries)} runs in {time.time() - t0:.0f}s",
           file=sys.stderr)
-
-    out_dir = os.path.join(os.path.dirname(__file__), "..", "artifacts")
     jpath = os.path.join(out_dir, f"straggler_sweep_w{W}.json")
     with open(jpath, "w") as f:
         json.dump([s.row() for s in summaries], f, indent=1)

@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Schema-check a run-telemetry events.jsonl (obs/events.py).
+
+Thin CLI over erasurehead_tpu.obs.events.validate_file — the validation
+logic lives in the package so the tests, `make telemetry-smoke`, and this
+tool can never drift. Checks: every line parses, record types are known,
+required keys are present, seq is monotonic per logger, chunked
+rounds/decode records have strictly increasing round indices per run, and
+every run_start has a matching run_end.
+
+Usage: python tools/validate_events.py events.jsonl [more.jsonl ...]
+Exit 0 = all files valid; 1 = errors (printed, one per line).
+"""
+
+import os
+import sys
+
+# runnable from anywhere without an install (the tools/ convention)
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if not argv:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    from erasurehead_tpu.obs import events as events_lib
+
+    n_errors = 0
+    for path in argv:
+        try:
+            errors = events_lib.validate_file(path)
+        except OSError as e:
+            errors = [str(e)]
+        for err in errors:
+            print(f"{path}: {err}")
+        n_errors += len(errors)
+        if not errors:
+            print(f"{path}: OK")
+    return 1 if n_errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
