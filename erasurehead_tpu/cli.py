@@ -144,7 +144,13 @@ def _flags_parser() -> argparse.ArgumentParser:
                         "less device data); 'auto' switches to ring past a "
                         "footprint estimate")
     p.add_argument("--use-pallas", default="auto", choices=["auto", "on", "off"],
-                   help="fused pallas gradient kernel (ops/kernels.py)")
+                   help="fused pallas gradient kernel (ops/kernels.py). "
+                        "A correctness/reference path, NOT a performance "
+                        "option: the end-to-end races measured it VPU-"
+                        "bound and XLA's own lowering won all three "
+                        "(supports_fused is pinned off everywhere; 'on' "
+                        "forces it anyway, and excludes the batched "
+                        "trajectory-cohort dispatch)")
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "bfloat16"],
                    help="DATA dtype (params/updates stay float32)")

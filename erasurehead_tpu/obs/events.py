@@ -51,6 +51,12 @@ SCHEMA: dict[str, tuple] = {
     "eval": ("run_id", "final_train_loss", "final_test_loss"),
     # anomaly channel (recompile detector, obs/detect.py)
     "warning": ("kind", "message"),
+    # one per trajectory-batched cohort dispatch (trainer.train_cohort):
+    # composition (schemes/seeds) and how many compiled dispatches the
+    # cohort cost — the record behind report's "7 schemes x 4 seeds = N
+    # dispatches" line
+    "cohort": ("run_id", "n_trajectories", "schemes", "seeds",
+               "dispatches"),
     # one per run: the wall-clock / cache / arrival / decode summary the
     # report command renders (obs/report.py)
     "run_end": ("run_id", "wall_time_s", "steps_per_sec"),
@@ -212,16 +218,25 @@ def emit_round_chunks(
     decode_error: Optional[np.ndarray] = None,
     update_norm: Optional[np.ndarray] = None,
     chunk: int = ROUND_CHUNK,
+    trajectory: Optional[str] = None,
 ) -> None:
     """Emit the per-run ``rounds`` (and ``decode``) chunk records into the
     current capture. All inputs are host numpy the run already produced;
     no-op without a capture. ``update_norm`` is the [R-1] per-round
     optimizer-step norm (the host-visible gradient-magnitude proxy — the
     exact grad norm would need extra device programs, which telemetry must
-    never add); its round r entry describes the step INTO round r+1."""
+    never add); its round r entry describes the step INTO round r+1.
+
+    ``trajectory`` tags a cohort member's series (trainer.train_cohort
+    emits one chunk stream per trajectory under the cohort's single
+    run_id): the per-round monotonicity check then applies per (run_id,
+    trajectory) stream. Arrival stats flow through
+    :func:`arrival_summary`, so the -1 never-arrived sentinel is masked
+    in batched emission exactly as in single-run emission."""
     if _current is None:
         return
     rounds = len(timeset)
+    traj = {} if trajectory is None else {"trajectory": trajectory}
     for lo in range(start_round, rounds, chunk):
         hi = min(lo + chunk, rounds)
         fields = dict(
@@ -230,6 +245,7 @@ def emit_round_chunks(
             n_rounds=hi - lo,
             sim_time_s=round(float(np.sum(timeset[lo:hi])), 6),
             arrival=arrival_summary(worker_times[lo:hi]),
+            **traj,
         )
         if update_norm is not None and len(update_norm):
             un = update_norm[max(lo - start_round - 1, 0):hi - start_round - 1]
@@ -246,6 +262,7 @@ def emit_round_chunks(
                 error_mean=round(float(err.mean()), 10) if err.size else 0.0,
                 error_max=round(float(err.max()), 10) if err.size else 0.0,
                 exact=bool((err == 0.0).all()),
+                **traj,
             )
 
 
@@ -258,7 +275,10 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
     (empty = valid). Checks: every line parses as a JSON object; record
     types are known; required keys are present; ``seq`` is strictly
     monotonic per emitting logger run; chunked ``rounds``/``decode``
-    records have strictly increasing ``first_round`` per run_id; every
+    records have strictly increasing ``first_round`` per (run_id,
+    trajectory) stream (cohort dispatches emit one tagged stream per
+    trajectory); ``cohort`` records are internally consistent
+    (n_trajectories matches the seeds list, dispatches >= 1); every
     ``run_start`` has a matching later ``run_end``."""
     errors: list[str] = []
     last_seq: Optional[int] = None
@@ -296,7 +316,7 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
                 )
             last_seq = seq
         if rtype in ("rounds", "decode"):
-            key = (rec.get("run_id"), rtype)
+            key = (rec.get("run_id"), rtype, rec.get("trajectory"))
             fr = rec.get("first_round")
             if isinstance(fr, int):
                 prev = last_round.get(key)
@@ -304,8 +324,26 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
                     errors.append(
                         f"line {i}: {rtype} first_round {fr} not after "
                         f"{prev} for run {key[0]!r}"
+                        + (
+                            f" trajectory {key[2]!r}"
+                            if key[2] is not None
+                            else ""
+                        )
                     )
                 last_round[key] = fr
+        if rtype == "cohort":
+            n = rec.get("n_trajectories")
+            seeds = rec.get("seeds")
+            if isinstance(seeds, list) and isinstance(n, int) and len(seeds) != n:
+                errors.append(
+                    f"line {i}: cohort n_trajectories {n} != "
+                    f"{len(seeds)} seeds"
+                )
+            disp = rec.get("dispatches")
+            if isinstance(disp, int) and disp < 1:
+                errors.append(
+                    f"line {i}: cohort dispatches must be >= 1, got {disp}"
+                )
         if rtype == "run_start":
             started.add(rec.get("run_id"))
         if rtype == "run_end":

@@ -17,7 +17,8 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
 
     Returns one dict per run: {"run_id", "start": run_start|None,
     "end": run_end|None, "compiles": [...], "uploads": [...],
-    "rounds": [...], "decode": [...], "warnings": [...]}.
+    "rounds": [...], "decode": [...], "cohort": cohort|None,
+    "warnings": [...]}.
     Unparseable lines are skipped (the validator's job is strictness;
     the report renders what it can)."""
     runs: dict = {}
@@ -28,7 +29,8 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
         if rid not in runs:
             runs[rid] = {
                 "run_id": rid, "start": None, "end": None, "compiles": [],
-                "uploads": [], "rounds": [], "decode": [], "warnings": [],
+                "uploads": [], "rounds": [], "decode": [], "cohort": None,
+                "warnings": [],
             }
             order.append(rid)
         return runs[rid]
@@ -57,6 +59,8 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
                     run(rid)["rounds"].append(rec)
                 elif rtype == "decode":
                     run(rid)["decode"].append(rec)
+                elif rtype == "cohort":
+                    run(rid)["cohort"] = rec
                 elif rtype == "warning":
                     (run(rid)["warnings"] if rid else warnings).append(rec)
     out = [runs[rid] for rid in order]
@@ -124,6 +128,20 @@ def render(paths: Sequence[str]) -> str:
             f"{data:>5s} {_arrival_cell(end):>22s} "
             f"{_fmt(err, '11.6f'):>11s}"
         )
+    cohorts = [g for g in groups if g.get("cohort")]
+    if cohorts:
+        lines.append("\ncohort dispatches (trajectory-batched sweeps):")
+        for g in cohorts:
+            c = g["cohort"]
+            schemes = c.get("schemes") or []
+            seeds = c.get("seeds") or []
+            disp = c.get("dispatches", 1)
+            lines.append(
+                f"  {str(g['run_id'])[:16]:16s} "
+                f"{len(schemes)} scheme(s) x {len(set(seeds))} seed(s) = "
+                f"{c.get('n_trajectories', len(seeds))} trajectories in "
+                f"{disp} dispatch(es) [{c.get('lowering', '?')}]"
+            )
     n_warn = sum(len(g["warnings"]) for g in groups) + sum(
         len(g["warnings"]) for g in stray
     )

@@ -337,19 +337,10 @@ def make_ring_faithful_grad_fn(
     )
 
 
-def make_deduped_grad_fn(model, mesh: Mesh) -> GradFn:
-    """Each partition gradient is computed exactly once, then combined with
-    folded decode weights (CodingLayout.fold_slot_weights).
-
-    No reference counterpart (the dedup is this framework's optimization);
-    produces bit-comparable gradients to the faithful mode — tests pin the
-    two together.
-
-    Args of the returned fn:
-      params: replicated pytree.
-      Xp, yp: partition-major stacks [Pn, rows, F] / [Pn, rows], sharded.
-      part_weights: [Pn] folded per-partition weights.
-    """
+def _deduped_local_body(model, mesh: Mesh) -> GradFn:
+    """Per-device body of the deduped per-partition step; shared by
+    make_deduped_grad_fn and the cohort-batched factory
+    (make_cohort_grad_fn) so the two dispatch shapes can never drift."""
 
     def local(params, Xp, yp, part_weights):
         if _grads_via_loss(model):
@@ -365,10 +356,159 @@ def make_deduped_grad_fn(model, mesh: Mesh) -> GradFn:
             g = _weighted_tree_sum(part_weights, per_part, "p")
             return lax.psum(g, WORKER_AXIS)
 
+    return local
+
+
+def make_deduped_grad_fn(model, mesh: Mesh) -> GradFn:
+    """Each partition gradient is computed exactly once, then combined with
+    folded decode weights (CodingLayout.fold_slot_weights).
+
+    No reference counterpart (the dedup is this framework's optimization);
+    produces bit-comparable gradients to the faithful mode — tests pin the
+    two together.
+
+    Args of the returned fn:
+      params: replicated pytree.
+      Xp, yp: partition-major stacks [Pn, rows, F] / [Pn, rows], sharded.
+      part_weights: [Pn] folded per-partition weights.
+    """
+
     return shard_map(
-        local,
+        _deduped_local_body(model, mesh),
         mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
+        out_specs=P(),
+        check_vma=_vma_check(model),
+    )
+
+
+# ---------------------------------------------------------------------------
+# trajectory-cohort batched dispatch: ONE stream of the device data stack
+# serves B trajectories (scheme x seed x lr/alpha variants) at once
+
+
+def _batched_local_body(local_body: GradFn) -> GradFn:
+    """[B]-batched (params, weights) wrapper of a per-device local grad
+    body: vmap over the leading trajectory axis of params and weights
+    while the device's data shard enters UNBATCHED (in_axes None) — one
+    HBM pass of X feeds every trajectory, and the per-slot margin matvecs
+    become batched matmuls the MXU can tile. Falls out of the same local
+    bodies the sequential trainers use, so the math can never drift."""
+
+    def local(params_B, Xs, ys, ws_B):
+        return jax.vmap(lambda p, w: local_body(p, Xs, ys, w))(
+            params_B, ws_B
+        )
+
+    return local
+
+
+def supports_cohort_matmul(model, X) -> bool:
+    """The dedicated cohort body needs a closed-form GLM on a DENSE stack
+    (the same support surface as the hybrid margin-flat lowering): the
+    whole cohort's margins then lower as ONE [M*R, F] x [F, B] matmul."""
+    return supports_margin_flat(model, X)
+
+
+def _cohort_matmul_local_body(model) -> GradFn:
+    """Dense closed-form GLM cohort body: the arithmetic-intensity lever.
+
+    The sequential step's margin is a matVEC (X streams from HBM per
+    trajectory); here the B trajectories' parameter vectors stack into a
+    [F, B] operand so the margin lowers as one flat [M*R, F] x [F, B]
+    matMUL and the transpose as [B, N] x [N, F] — B x the FLOPs per byte
+    of X streamed, which is exactly what the bandwidth-bound roofline
+    rewards (BASELINE.md "Arithmetic intensity"). Same math as B
+    sequential steps; only the reduction order differs (tests pin
+    allclose). dtype rules mirror _hybrid_margin_flat_grad / features:
+    bf16 X streams as stored, the small operand casts down, the MXU
+    accumulates f32."""
+    from erasurehead_tpu.ops import features as features_lib
+
+    def local(params_B, Xs, ys, ws_B):
+        B = ws_B.shape[0]
+        R = ys.shape[-1]
+        F = Xs.shape[-1]
+        M = int(np.prod(ys.shape[:-1]))
+        N = M * R
+        X2 = Xs.reshape(N, F)
+        yf = ys.reshape(N)
+        with annotate("eh_step/partial_grads"):
+            if X2.dtype == jnp.bfloat16 and params_B.dtype != X2.dtype:
+                margins = jnp.einsum(
+                    "nf,bf->nb", X2, params_B.astype(X2.dtype),
+                    preferred_element_type=jnp.float32,
+                )
+            else:
+                margins = jnp.einsum(
+                    "nf,bf->nb", X2, params_B,
+                    precision=features_lib.get_default_precision(),
+                )
+            r = jax.vmap(model.margin_residual, in_axes=(1, None), out_axes=1)(
+                margins, yf
+            )  # [N, B]
+            w_rows = jnp.broadcast_to(
+                ws_B.reshape(B, M)[:, :, None], (B, M, R)
+            ).reshape(B, N)
+            wr = w_rows.astype(r.dtype) * jnp.swapaxes(r, 0, 1)  # [B, N]
+            if X2.dtype == jnp.bfloat16 and wr.dtype != X2.dtype:
+                g = -jnp.einsum(
+                    "bn,nf->bf", wr.astype(X2.dtype), X2,
+                    preferred_element_type=jnp.float32,
+                )
+            else:
+                g = -jnp.einsum(
+                    "bn,nf->bf", wr, X2,
+                    precision=features_lib.get_default_precision(),
+                )
+        with annotate("eh_step/decode"):
+            return lax.psum(g, WORKER_AXIS)
+
+    return local
+
+
+def make_cohort_grad_fn(
+    model, mesh: Mesh, *, faithful: bool, ring_plan=None,
+    local_body: GradFn = None,
+) -> GradFn:
+    """Trajectory-cohort decoded gradients: one shard_map step whose
+    params/weights lead with a [B] trajectory axis while the data stack is
+    shared — the whole cohort rides ONE HBM stream of X per round.
+
+    Args of the returned fn:
+      params_B: pytree, leaves lead with [B]; replicated.
+      X, y: the mode's stacks (partition-major for deduped and ring
+        faithful, worker-major for materialized faithful), sharded on
+        their leading axis.
+      weights_B: [B, W, S] slot weights (faithful) or [B, Pn] folded
+        per-partition weights (deduped), sharded on dim 1.
+    Returns the decoded gradient pytree with leaves [B, ...], replicated.
+
+    ``local_body`` must already be batched (``_cohort_matmul_local_body``
+    or ``_batched_local_body(...)``); None picks the vmapped default body
+    of the compute mode. ``ring_plan`` composes the ring transport exactly
+    as make_ring_faithful_grad_fn does — the reconstructed worker buffer
+    is shared across the cohort too.
+    """
+    if local_body is None:
+        local_body = _batched_local_body(
+            _faithful_local_body(model, mesh)
+            if faithful
+            else _deduped_local_body(model, mesh)
+        )
+    if faithful and ring_plan is not None:
+        inner = local_body
+
+        def body(params_B, Xp, yp, ws_B):
+            Xw, yw = _ring_fill(ring_plan, Xp, yp)
+            return inner(params_B, Xw, yw, ws_B)
+
+    else:
+        body = local_body
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(None, WORKER_AXIS)),
         out_specs=P(),
         check_vma=_vma_check(model),
     )

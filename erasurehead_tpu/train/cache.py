@@ -149,6 +149,25 @@ def dataset_token(dataset) -> Any:
     return tok
 
 
+def layout_stack_signature(layout, *, worker_major: bool) -> tuple:
+    """Content signature of the device stack a (layout, stacking mode)
+    materializes — the data-cache key component AND the cohort grouping
+    key (trainer.train_cohort / cohort_signature).
+
+    Partition-major stacking (deduped mode, ring faithful) reads only
+    ``n_partitions`` — it is scheme-independent, which is the structural
+    fact that lets a whole multi-scheme compare() share one upload and one
+    batched dispatch. Worker-major stacking (materialized faithful)
+    gathers through ``layout.assignment``, so its CONTENT keys the stack:
+    schemes sharing an assignment (FRC and AGC) share a stack; cyclic MDS
+    has its own.
+    """
+    if worker_major:
+        assignment = np.asarray(layout.assignment)
+        return ("workers", assignment.shape, assignment.tobytes())
+    return ("parts", int(layout.n_partitions))
+
+
 def mesh_signature(mesh) -> tuple:
     """Axes, sizes, and the exact device assignment (executables bind
     input shardings to concrete devices)."""

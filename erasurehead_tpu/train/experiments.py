@@ -101,16 +101,87 @@ def time_to_target_loss(
     return float(np.cumsum(timeset)[reached[0]])
 
 
+def plan_cohorts(
+    configs: dict[str, RunConfig],
+) -> list[tuple[list[str], bool]]:
+    """Group config labels into trajectory cohorts for batched dispatch.
+
+    Returns ``[(labels, batchable), ...]`` in first-seen order: every
+    group with ``batchable=True`` maps to one :func:`trainer.
+    cohort_signature` key (same data stack + lowering, so
+    ``train_cohort`` can run it as ONE compiled scan); ineligible configs
+    (measured mode, forced pallas) come back as their own
+    ``batchable=False`` singletons. In deduped mode the partition-major
+    stack is scheme-independent, so a whole 7-scheme x N-seed compare()
+    collapses into a single cohort."""
+    groups: dict = {}
+    order: list = []
+    for label, cfg in configs.items():
+        key = trainer.cohort_signature(cfg)
+        if key is None:
+            key = ("__sequential__", label)
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(label)
+    return [
+        (groups[k], k[0] != "__sequential__") for k in order
+    ]
+
+
+def _run_configs(
+    configs: dict[str, RunConfig],
+    dataset: Dataset,
+    arrivals,
+    batch: str,
+) -> dict[str, "trainer.TrainResult"]:
+    """Train every config, dispatching cohorts through train_cohort per
+    the resolved ``batch`` mode ('on'/'off'/'auto'); returns label ->
+    TrainResult. Sequential fallbacks (mode 'off', singletons under
+    'auto', ineligible configs) go through plain train()."""
+    from erasurehead_tpu.obs.metrics import REGISTRY as _metrics
+
+    raw: dict = {}
+    if batch == "off":
+        plan = [([label], False) for label in configs]
+    else:
+        plan = plan_cohorts(configs)
+    min_size = 1 if batch == "on" else 2
+    for labels, batchable in plan:
+        if batchable and len(labels) >= min_size:
+            results = trainer.train_cohort(
+                [configs[l] for l in labels], dataset, arrivals=arrivals
+            )
+            raw.update(zip(labels, results))
+        else:
+            for l in labels:
+                _metrics.counter("cohort.sequential_runs").inc()
+                raw[l] = trainer.train(
+                    configs[l], dataset, arrivals=arrivals
+                )
+    return raw
+
+
 def compare(
     configs: dict[str, RunConfig],
     dataset: Dataset,
     target_loss: Optional[float] = None,
     arrivals: Optional[np.ndarray] = None,
+    batch: Optional[str] = None,
 ) -> list[RunSummary]:
     """Train every config on ``dataset`` under one shared arrival schedule
     and summarize. ``target_loss`` default: 1.05x the uncoded baseline's
     final train loss (if a config labeled 'naive' is present), else the
-    worst final loss across runs."""
+    worst final loss across runs.
+
+    ``batch`` picks the trajectory-batched dispatch mode ('on'/'off'/
+    'auto'; None = the --batch-trajectories flag/env default, see
+    utils.config.resolve_batch_trajectories): under 'auto'/'on', configs
+    sharing a device data stack (plan_cohorts) run as ONE compiled cohort
+    scan — a deduped 7-scheme sweep streams X from HBM once per round for
+    all schemes instead of once per scheme."""
+    from erasurehead_tpu.utils.config import resolve_batch_trajectories
+
     rounds = {c.rounds for c in configs.values()}
     workers = {c.n_workers for c in configs.values()}
     assert len(rounds) == 1 and len(workers) == 1, "configs must share shape"
@@ -120,9 +191,13 @@ def compare(
             rounds.pop(), workers.pop(), add_delay=True, mean=any_cfg.delay_mean
         )
 
+    results = _run_configs(
+        configs, dataset, arrivals, resolve_batch_trajectories(batch)
+    )
     raw = {}
-    for label, cfg in configs.items():
-        res = trainer.train(cfg, dataset, arrivals=arrivals)
+    for label in configs:
+        res = results[label]
+        cfg = configs[label]
         model = trainer.build_model(cfg)
         n = res.n_train
         ev = evaluate.replay(
@@ -200,6 +275,7 @@ def baseline_suite(
     scale: float = 1.0,
     data_dir: Optional[str] = None,
     rounds: int = 100,
+    batch: Optional[str] = None,
 ) -> dict[str, list[RunSummary]]:
     """Reproduce the five BASELINE.json comparison configs.
 
@@ -208,6 +284,9 @@ def baseline_suite(
     a synthetic stand-in of the same structure (GMM for logistic tasks,
     linear-model data for least-squares) at ``scale`` x a canonical size, and
     the suite labels record the substitution. Returns {config_name: summaries}.
+    ``batch`` is the trajectory-batched dispatch mode threaded into every
+    compare() (see :func:`compare`; the suite's configs are mostly
+    singletons, so 'auto' leaves them sequential).
     """
     from erasurehead_tpu.data.synthetic import (
         generate_gmm,
@@ -325,7 +404,9 @@ def baseline_suite(
         update_rule="GD",
     )
     name = f"1_naive_covtype[{src}]"
-    out[name] = tag(compare({"naive": cfg}, ds), name, src, "covtype")
+    out[name] = tag(
+        compare({"naive": cfg}, ds, batch=batch), name, src, "covtype"
+    )
 
     # 2. Logistic on amazon, exact cyclic-MDS coding, s=2 (configs[1])
     ds, src = get_data("amazon", W, (2048, 64))
@@ -334,7 +415,9 @@ def baseline_suite(
         update_rule="AGD",
     )
     name = f"2_egc_amazon[{src}]"
-    out[name] = tag(compare({"cyccoded_s2": cfg}, ds), name, src, "amazon")
+    out[name] = tag(
+        compare({"cyccoded_s2": cfg}, ds, batch=batch), name, src, "amazon"
+    )
 
     # 3. Least-squares on kc_house, AGC with num_collect=N-3 (configs[2])
     W3 = 9  # AGC needs (s+1) | W
@@ -345,7 +428,8 @@ def baseline_suite(
     )
     name = f"3_agc_kc_house[{src}]"
     out[name] = tag(
-        compare({"agc_collect_N-3": cfg}, ds), name, src, "kc_house_data"
+        compare({"agc_collect_N-3": cfg}, ds, batch=batch), name, src,
+        "kc_house_data"
     )
 
     # 4. Synthetic: partial_replication vs avoidstragg over n_stragglers
@@ -367,7 +451,9 @@ def baseline_suite(
                 "artificial", d, scheme=scheme, n_workers=W4, n_stragglers=s,
                 update_rule="AGD", partitions_per_worker=ppw,
             )
-            sweep.extend(compare({f"{scheme}_s{s}": c}, d, arrivals=arr))
+            sweep.extend(
+                compare({f"{scheme}_s{s}": c}, d, arrivals=arr, batch=batch)
+            )
     shared_target = 1.05 * min(s.final_train_loss for s in sweep)
     for s in sweep:
         s.time_to_target = time_to_target_loss(
@@ -384,7 +470,9 @@ def baseline_suite(
         n_stragglers=1, num_collect=W - 2, update_rule="GD",
     )
     name = f"5_mlp_agc[{src}]"
-    out[name] = tag(compare({"mlp_agc": cfg}, ds), name, src, "covtype")
+    out[name] = tag(
+        compare({"mlp_agc": cfg}, ds, batch=batch), name, src, "covtype"
+    )
     return out
 
 
@@ -438,6 +526,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="write a run-telemetry events.jsonl for the whole "
                         "suite here (obs/; render with `erasurehead-tpu "
                         "report`)")
+    p.add_argument("--batch-trajectories", default=None,
+                   choices=["on", "off", "auto"],
+                   help="trajectory-batched sweep dispatch "
+                        "(trainer.train_cohort): configs sharing a device "
+                        "data stack run as ONE compiled scan — a deduped "
+                        "multi-scheme compare streams X once per round "
+                        "for the whole cohort. Default: "
+                        "ERASUREHEAD_BATCH_TRAJECTORIES env, else auto "
+                        "(batch cohorts of >= 2)")
     ns = p.parse_args(argv)
 
     if ns.events:
@@ -448,7 +545,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         sink = contextlib.nullcontext()
     with sink:
         suite = baseline_suite(
-            scale=ns.scale, data_dir=ns.data_dir, rounds=ns.rounds
+            scale=ns.scale, data_dir=ns.data_dir, rounds=ns.rounds,
+            batch=ns.batch_trajectories,
         )
     all_rows: list[RunSummary] = []
     for name, summaries in suite.items():

@@ -23,7 +23,7 @@ import os
 import sys
 import time
 from functools import partial, wraps
-from typing import Any, Optional
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -286,11 +286,9 @@ def _setup_run(
     # only the partition-major stack and re-keys on partition content like
     # deduped — the cache payload shrinks by the same (s+1)x as the stack,
     # and ring runs share uploads with deduped runs of the same shape.
-    if faithful and not use_ring:
-        assignment = np.asarray(layout.assignment)
-        stack_sig = ("workers", assignment.shape, assignment.tobytes())
-    else:
-        stack_sig = ("parts", layout.n_partitions)
+    stack_sig = cache_lib.layout_stack_signature(
+        layout, worker_major=faithful and not use_ring
+    )
     data_key = (
         "stacks",
         cache_lib.dataset_token(dataset),
@@ -899,116 +897,224 @@ def train(
     )
 
 
-@_with_run_sparse_lanes
-def train_batch(
-    cfg: RunConfig,
+def cohort_eligible(cfg: RunConfig) -> bool:
+    """Can this config run inside a trajectory-batched cohort dispatch?
+    The cohort engine batches the scan trainer only: measured-arrival mode
+    dispatches per worker, and the forced pallas kernel has no batched
+    body (it is a correctness/reference path, not a performance option)."""
+    return cfg.arrival_mode == "simulated" and cfg.use_pallas != "on"
+
+
+def cohort_signature(cfg: RunConfig) -> Optional[tuple]:
+    """Grouping key for trajectory-batched dispatch (experiments.
+    plan_cohorts): configs mapping to the same key share a device data
+    stack and a compiled-scan lowering, so they can run as ONE cohort
+    dispatch (train_cohort). None = not batchable (run sequentially).
+
+    Deduped trajectories group by partition count alone — the
+    partition-major stack is scheme-independent, so the whole 7-scheme
+    compare() is one cohort. Faithful trajectories group by assignment
+    CONTENT (materialized stacks and ring hop plans are both
+    assignment-derived), so e.g. FRC and AGC share a cohort while cyclic
+    MDS gets its own."""
+    if not cohort_eligible(cfg):
+        return None
+    from erasurehead_tpu.train import cache as cache_lib
+
+    layout = build_layout(cfg)
+    faithful = cfg.compute_mode == ComputeMode.FAITHFUL
+    return (
+        cfg.static_signature(),
+        cfg.rounds,
+        cfg.n_workers,
+        cache_lib.layout_stack_signature(layout, worker_major=faithful),
+    )
+
+
+def train_cohort(
+    cfgs: "Sequence[RunConfig] | RunConfig",
     dataset: Dataset,
-    seeds,
+    seeds=None,
     mesh=None,
+    arrivals=None,
     measure: bool = True,
 ) -> list[TrainResult]:
-    """Seed-vmapped batched runner: one compiled dispatch for a whole
-    seed sweep.
+    """Trajectory-batched dispatch: run a COHORT of training trajectories
+    — (scheme, seed, lr/alpha variant) triples — as ONE compiled scan.
 
-    Equivalent to ``[train(replace(cfg, seed=s), dataset) for s in
-    seeds]`` — per-seed weight tables, delay streams, and initial params
-    become a leading batch axis of ONE vmapped scan, so an S-seed variance
-    study costs one compile and one device dispatch instead of S. The
-    shared quantities (data stacks, mesh, lr schedule) stay unbatched.
+    The generalization of the seed-only ``train_batch``: every trajectory
+    that shares a device data stack rides one vmapped/batched scan, so the
+    gradient pass streams X from HBM once per round for the whole cohort
+    instead of once per trajectory. For dense closed-form GLMs the margin
+    lowers as a flat [M*R, F] x [F, B] matmul (parallel/step.
+    _cohort_matmul_local_body) — a real MXU matmul fed by one HBM pass,
+    which is the roofline lever kernel fusion could not move
+    (BASELINE.md "Arithmetic intensity").
+
+    ``cfgs`` is a sequence of fully-formed trajectory configs (a single
+    config is accepted too); ``seeds`` optionally expands each config
+    across a seed sweep (``replace(cfg, seed=s)``). ``arrivals`` is None
+    (each trajectory builds its own default schedule, exactly as
+    ``train()`` would), one shared [R, W] matrix (the paired-comparison
+    contract of ``experiments.compare``), or a per-trajectory list.
 
     Contract and limits:
-      - per-seed results match ``train()`` to float tolerance (vmap
-        batches the einsums, so the reduction order differs — same math);
-      - the data stacks are shared, so schemes whose LAYOUT depends on
-        the seed (cyclic MDS, random-regular, partial cyclic) are refused
-        when the seeds actually produce different layouts;
-      - the scan trainer only (no measured mode, no checkpointing), and
-        the XLA lowering only (``use_pallas='on'`` is refused: the fused
-        kernel has no batched-dispatch path);
-      - every returned TrainResult carries the BATCH wall-clock (it was
-        one dispatch) and the batch-aggregate steps_per_sec.
+      - per-trajectory results match ``train()`` to float tolerance (the
+        batched lowering changes only the reduction order — same math);
+        control-plane artifacts (timeset, worker_times, collected,
+        decode_error) are IDENTICAL, computed per trajectory on host;
+      - all trajectories must share one device data stack: same rounds,
+        workers, static lowering signature, and stack signature (deduped:
+        partition count; faithful: assignment content). Group arbitrary
+        config sets with ``experiments.plan_cohorts``;
+      - the scan trainer only (no measured mode, no checkpointing, no
+        forced pallas kernel);
+      - every returned TrainResult carries the COHORT wall-clock (it was
+        one dispatch) and the cohort-aggregate steps_per_sec.
     """
-    seeds = [int(s) for s in seeds]
-    if not seeds:
-        raise ValueError("train_batch needs at least one seed")
-    if cfg.arrival_mode != "simulated":
-        raise ValueError(
-            "train_batch batches the scan trainer; arrival_mode='measured' "
-            "has no batched implementation"
-        )
-    if cfg.use_pallas == "on":
-        raise ValueError(
-            "train_batch has no batched fused-kernel dispatch; "
-            "use use_pallas='auto' or 'off'"
-        )
+    if isinstance(cfgs, RunConfig):
+        cfgs = [cfgs]
+    cfgs = list(cfgs)
+    if seeds is not None:
+        seeds = [int(s) for s in seeds]
+        cfgs = [
+            dataclasses.replace(c, seed=s) for c in cfgs for s in seeds
+        ]
+    if not cfgs:
+        raise ValueError("train_cohort needs at least one trajectory config")
+    cfg0 = cfgs[0]
+    for c in cfgs:
+        if c.arrival_mode != "simulated":
+            raise ValueError(
+                "train_cohort batches the scan trainer; "
+                "arrival_mode='measured' has no batched implementation"
+            )
+        if c.use_pallas == "on":
+            raise ValueError(
+                "train_cohort has no batched fused-kernel dispatch; "
+                "use use_pallas='auto' or 'off'"
+            )
+    sig0 = cfg0.static_signature()
+    for c in cfgs[1:]:
+        if (
+            c.static_signature() != sig0
+            or c.rounds != cfg0.rounds
+            or c.n_workers != cfg0.n_workers
+        ):
+            raise ValueError(
+                "cohort trajectories must share rounds, workers, and the "
+                "full static lowering signature (model, compute_mode, "
+                "dtype, update_rule, ...); group mixed config sets with "
+                "experiments.plan_cohorts"
+            )
+    return _train_cohort_impl(cfg0, dataset, cfgs, mesh, arrivals, measure)
+
+
+@_with_run_sparse_lanes
+def _train_cohort_impl(cfg, dataset, cfgs, mesh, arrivals, measure):
     from erasurehead_tpu.train import cache as cache_lib
 
     stats_before = cache_lib.stats().snapshot()
+    B = len(cfgs)
     faithful = cfg.compute_mode == ComputeMode.FAITHFUL
-    cfgs = [dataclasses.replace(cfg, seed=s) for s in seeds]
 
-    # one shared data stack across the batch: refuse seed-dependent
-    # layouts rather than silently training a different code than the
-    # per-seed train() would
+    # one shared device stack across the cohort: deduped/ring stack
+    # partition-major (scheme-independent), materialized faithful gathers
+    # through the assignment — refuse mismatches rather than silently
+    # training a different code than per-trajectory train() would
     layouts = [build_layout(c) for c in cfgs]
-    a0 = np.asarray(layouts[0].assignment)
-    c0 = np.asarray(layouts[0].coeffs)
-    for lay in layouts[1:]:
-        if not (
-            np.array_equal(a0, np.asarray(lay.assignment))
-            and np.array_equal(c0, np.asarray(lay.coeffs))
+    stack0 = cache_lib.layout_stack_signature(
+        layouts[0], worker_major=faithful
+    )
+    for c, lay in zip(cfgs[1:], layouts[1:]):
+        if (
+            cache_lib.layout_stack_signature(lay, worker_major=faithful)
+            != stack0
         ):
             raise ValueError(
-                f"scheme {cfg.scheme.value!r} builds a seed-dependent "
-                "layout across these seeds; train_batch shares one data "
-                "stack — run per-seed train() for seed-dependent codes"
+                f"trajectory {c.scheme.value!r} (seed {c.seed}) builds a "
+                "different device data stack than the cohort's first "
+                "trajectory; train_cohort shares one stack — group by "
+                "cohort_signature (experiments.plan_cohorts) or run "
+                "per-trajectory train()"
             )
     setup = _setup_run(cfg, dataset, mesh, faithful=faithful)
     layout, model, mesh, data = setup.layout, setup.model, setup.mesh, setup.data
-    lr = setup.lr
-    alpha = setup.alpha
     n_train = setup.n_train
     update_fn = setup.update_fn
     dtype = jnp.float32
 
-    # per-seed control plane: arrivals + schedule exactly as train() would
-    # build them for replace(cfg, seed=s)
-    schedules = []
-    slot_coded = np.asarray(layout.slot_is_coded)
-    for c in cfgs:
-        arr = default_arrivals(c)
-        schedules.append(
-            collect.build_schedule(
-                c.scheme, arr, layout, num_collect=c.num_collect,
-                deadline=c.deadline,
+    # per-trajectory control plane: arrivals + schedule + weight table
+    # exactly as train() would build them for each config
+    R, W = cfg.rounds, cfg.n_workers
+    if arrivals is None:
+        arr_list = [default_arrivals(c) for c in cfgs]
+    elif isinstance(arrivals, (list, tuple)):
+        if len(arrivals) != B:
+            raise ValueError(
+                f"got {len(arrivals)} arrival matrices for {B} trajectories"
+            )
+        arr_list = [np.asarray(a) for a in arrivals]
+    else:
+        arr_list = [np.asarray(arrivals)] * B
+    schedules = [
+        collect.build_schedule(
+            c.scheme, a, lay, num_collect=c.num_collect, deadline=c.deadline
+        )
+        for c, a, lay in zip(cfgs, arr_list, layouts)
+    ]
+    slot_ws = [
+        np.asarray(
+            step_lib.expand_slot_weights(
+                s.message_weights, lay.coeffs, np.asarray(lay.slot_is_coded)
             )
         )
-    slot_w = np.stack(
-        [
-            np.asarray(
-                step_lib.expand_slot_weights(
-                    s.message_weights, layout.coeffs, slot_coded
-                )
-            )
-            for s in schedules
-        ]
-    )  # [B, R, W, S]
+        for s, lay in zip(schedules, layouts)
+    ]  # each [R, W, S] (S may differ only across stacks, refused above)
+
     ring_plan = None
     if faithful and setup.ring:
         ring_plan = plan_ring_transport(layout, _worker_axis_size(mesh))
-        grad_fn = step_lib.make_ring_faithful_grad_fn(model, mesh, ring_plan)
-        weights_seq, X, y = jnp.asarray(slot_w, dtype), data.Xp, data.yp
+        weights_seq = jnp.asarray(np.stack(slot_ws, axis=1), dtype)
+        X, y = data.Xp, data.yp
     elif faithful:
-        grad_fn = step_lib.make_faithful_grad_fn(model, mesh)
-        weights_seq, X, y = jnp.asarray(slot_w, dtype), data.Xw, data.yw
+        weights_seq = jnp.asarray(np.stack(slot_ws, axis=1), dtype)
+        X, y = data.Xw, data.yw
     else:
-        grad_fn = step_lib.make_deduped_grad_fn(model, mesh)
-        pw = np.stack([layout.fold_slot_weights(w) for w in slot_w])
-        weights_seq, X, y = jnp.asarray(pw, dtype), data.Xp, data.yp
-    grad_fn = _apply_margin_flat(cfg, model, mesh, X, grad_fn, ring_plan)
-    grad_fn = _apply_flat_grad(cfg, model, mesh, X, grad_fn, ring_plan)
+        pws = [
+            lay.fold_slot_weights(w) for lay, w in zip(layouts, slot_ws)
+        ]
+        weights_seq = jnp.asarray(np.stack(pws, axis=1), dtype)
+        X, y = data.Xp, data.yp
+    # weights_seq: [R, B, W, S] (faithful) or [R, B, Pn] (deduped) — round
+    # axis leading for the ONE scan, trajectory axis next for the step
 
-    # per-seed init, stacked on a leading batch axis then replicated
+    # batched grad lowering: dense closed-form GLMs take the dedicated
+    # cohort body (all B margins in one [N, F] x [F, B] matmul); other
+    # stacks vmap the exact local bodies the sequential trainers use
+    if cfg.flat_grad == "on" and not step_lib.supports_flat_grad(model, X):
+        raise ValueError(
+            "flat_grad='on' needs a closed-form GLM stack; "
+            f"got model={getattr(model, 'name', type(model).__name__)!r}, "
+            f"X={type(X).__name__}"
+        )
+    if step_lib.supports_cohort_matmul(model, X):
+        local_body = step_lib._cohort_matmul_local_body(model)
+        cohort_lowering = "cohort_matmul"
+    elif step_lib.resolve_flat_grad(cfg.flat_grad, model, X):
+        local_body = step_lib._batched_local_body(
+            step_lib._flat_local_body(model)
+        )
+        cohort_lowering = "flat_vmap"
+    else:
+        local_body = None  # the compute mode's default body, vmapped
+        cohort_lowering = "per_slot_vmap"
+    grad_fn = step_lib.make_cohort_grad_fn(
+        model, mesh, faithful=faithful, ring_plan=ring_plan,
+        local_body=local_body,
+    )
+
+    # per-trajectory init + optimizer state, stacked on a leading [B] axis
     states = [
         optimizer.init_state(
             _init_params_f32(c, model, dataset.n_features), cfg.update_rule
@@ -1019,51 +1125,82 @@ def train_batch(
     state0 = jax.tree.map(
         lambda l: put_global(np_global(l), replicated(mesh)), state0
     )
-    lr_seq = jnp.asarray(lr, dtype)
+    lr_seq = jnp.asarray(
+        np.stack([c.resolve_lr_schedule() for c in cfgs], axis=1), dtype
+    )  # [R, B] — lr variants are first-class trajectory axes
+    alpha_B = jnp.asarray([c.effective_alpha for c in cfgs], dtype)  # [B]
     iters = jnp.arange(cfg.rounds, dtype=dtype)
 
-    def body(Xa, ya, state, xs):
-        eta, w_t, i = xs
-        g = grad_fn(state.params, Xa, ya, w_t)
-        new_state = update_fn(state, g, eta, alpha, n_train, i)
+    # per-trajectory update: vmap over (state, grad, lr, alpha); the
+    # round index and sample count are shared scalars
+    b_update = jax.vmap(update_fn, in_axes=(0, 0, 0, 0, None, None))
+
+    from erasurehead_tpu.utils.tracing import annotate
+
+    def body(Xa, ya, alphas, state, xs):
+        eta_t, w_t, i = xs
+        with annotate("eh_scan/coded_step"):
+            g = grad_fn(state.params, Xa, ya, w_t)
+        with annotate("eh_scan/update"):
+            new_state = b_update(state, g, eta_t, alphas, n_train, i)
         return new_state, new_state.params
 
-    def run_one(state, Xa, ya, lr_c, w_c, it_c):
-        return jax.lax.scan(
-            partial(body, Xa, ya), state, (lr_c, w_c, it_c),
-            unroll=cfg.scan_unroll,
-        )
-
     @jax.jit
-    def run(state, Xa, ya, lr_c, w_c, it_c):
-        # batch axis: state + weight tables; data/lr/iters broadcast
-        return jax.vmap(run_one, in_axes=(0, None, None, None, 0, None))(
-            state, Xa, ya, lr_c, w_c, it_c
+    def run(state, Xa, ya, alphas, lr_c, w_c, it_c):
+        return jax.lax.scan(
+            partial(body, Xa, ya, alphas), state, (lr_c, w_c, it_c),
+            unroll=cfg.scan_unroll,
         )
 
     platform = jax.devices()[0].platform
     from erasurehead_tpu.obs import decode as obs_decode
     from erasurehead_tpu.obs import detect as obs_detect
     from erasurehead_tpu.obs import events as obs_events
+    from erasurehead_tpu.obs.metrics import REGISTRY as _metrics
 
+    schemes = sorted({c.scheme.value for c in cfgs})
     run_id = obs_events.new_run_id() if obs_events.current() else None
     if run_id is not None:
         _emit_run_start(
             run_id, cfg, setup, platform,
             step_lib.lowering_signature(cfg, model, X), faithful,
         )
+        obs_events.emit(
+            "cohort",
+            run_id=run_id,
+            n_trajectories=B,
+            schemes=schemes,
+            seeds=[c.seed for c in cfgs],
+            dispatches=1,
+            lowering=cohort_lowering,
+        )
+    # dispatch-amortization counters (obs/metrics.py): what the smoke
+    # target and the acceptance test read — N trajectories per dispatch
+    _metrics.counter("cohort.dispatches").inc()
+    _metrics.counter("cohort.trajectories").inc(B)
+
+    # executable cache key: cohort stack signature rides in via the
+    # data/weights shapes + mesh; B via batch_size; the lowering via
+    # static_signature + the resolved cohort_lowering. Per-trajectory
+    # alpha/lr/weights are traced ARGUMENTS — cohorts differing only in
+    # hyperparameters share the compiled scan (the amortization point).
     sig_fields = _exec_signature_fields(
-        "batch_scan", platform, cfg, model, X, y, False, ring_plan,
-        weights_seq.shape, mesh, state0, alpha, n_train,
-        batch_size=len(seeds), chunk_rounds=cfg.rounds,
+        "cohort_scan", platform, cfg, model, X, y, False, ring_plan,
+        weights_seq.shape, mesh, state0, 0.0, n_train,
+        batch_size=B, chunk_rounds=cfg.rounds,
+        cohort_lowering=cohort_lowering,
     )
     exec_sig = tuple(sig_fields.values())
 
     def _compile():
         t0 = time.perf_counter()
-        ex = run.lower(state0, X, y, lr_seq, weights_seq, iters).compile()
+        ex = run.lower(
+            state0, X, y, alpha_B, lr_seq, weights_seq, iters
+        ).compile()
         if measure:
-            _hard_sync(ex(state0, X, y, lr_seq, weights_seq, iters)[0])
+            _hard_sync(
+                ex(state0, X, y, alpha_B, lr_seq, weights_seq, iters)[0]
+            )
         return ex, time.perf_counter() - t0
 
     t_cmp = time.perf_counter()
@@ -1082,7 +1219,9 @@ def train_batch(
         )
 
     t0 = time.perf_counter()
-    final_state, history = ex(state0, X, y, lr_seq, weights_seq, iters)
+    final_state, history = ex(
+        state0, X, y, alpha_B, lr_seq, weights_seq, iters
+    )
     _hard_sync(final_state)
     wall = time.perf_counter() - t0
 
@@ -1099,8 +1238,13 @@ def train_batch(
         ),
         "bytes_reused": stats_after["bytes_reused"]
         - stats_before["bytes_reused"],
-        "batch_size": len(seeds),
+        # seed-sweep-era names kept for compatibility + the cohort view
+        "batch_size": B,
         "batch_dispatches": 1,
+        "cohort_size": B,
+        "cohort_dispatches": 1,
+        "cohort_schemes": schemes,
+        "cohort_lowering": cohort_lowering,
         "stack_mode": (
             "ring"
             if setup.ring
@@ -1110,15 +1254,16 @@ def train_batch(
         "memory_analysis": _memory_analysis(ex),
     }
     results = []
-    agg_rate = cfg.rounds * len(seeds) / wall if wall > 0 else 0.0
+    agg_rate = cfg.rounds * B / wall if wall > 0 else 0.0
     batch_err = []
-    for b, (c, sched) in enumerate(zip(cfgs, schedules)):
+    for b, (c, sched, lay) in enumerate(zip(cfgs, schedules, layouts)):
         fs = jax.tree.map(lambda l: l[b], final_state)
-        err = obs_decode.decode_error_series(layout, sched.message_weights)
+        err = obs_decode.decode_error_series(lay, sched.message_weights)
         batch_err.append(err)
         results.append(
             TrainResult(
-                params_history=jax.tree.map(lambda l: l[b], history),
+                # scan history leaves are [R, B, ...]: round axis leading
+                params_history=jax.tree.map(lambda l: l[:, b], history),
                 final_params=fs.params,
                 final_state=fs,
                 timeset=sched.sim_time,
@@ -1129,21 +1274,35 @@ def train_batch(
                 steps_per_sec=agg_rate,
                 n_train=n_train,
                 config=c,
-                layout=layout,
+                layout=lay,
                 decode_error=err,
                 run_id=run_id,
                 cache_info=dict(cache_info),
             )
         )
     if run_id is not None:
-        # one run_end for the whole batch (it WAS one dispatch); per-seed
-        # detail lives in the returned TrainResults
+        # one run_end for the whole cohort (it WAS one dispatch);
+        # per-trajectory round/decode series carry a trajectory tag, and
+        # all arrival stats flow through arrival_summary, which masks the
+        # -1 never-arrived sentinel (obs/events.py)
+        for b, (c, sched, err) in enumerate(
+            zip(cfgs, schedules, batch_err)
+        ):
+            obs_events.emit_round_chunks(
+                run_id,
+                start_round=0,
+                timeset=sched.sim_time,
+                worker_times=sched.worker_times,
+                decode_error=err,
+                trajectory=f"{b}:{c.scheme.value}:s{c.seed}",
+            )
         obs_events.emit(
             "run_end",
             run_id=run_id,
             wall_time_s=round(wall, 6),
             steps_per_sec=round(agg_rate, 4),
-            batch_size=len(seeds),
+            batch_size=B,
+            cohort_size=B,
             exec_hits=int(hit),
             exec_misses=int(not hit),
             data_cache_hit=setup.data_cache_hit,
@@ -1155,6 +1314,54 @@ def train_batch(
             **obs_decode.summarize(np.concatenate(batch_err)),
         )
     return results
+
+
+def train_batch(
+    cfg: RunConfig,
+    dataset: Dataset,
+    seeds,
+    mesh=None,
+    measure: bool = True,
+) -> list[TrainResult]:
+    """Seed-sweep batched runner — now a thin wrapper over the
+    trajectory-cohort engine (:func:`train_cohort`); see MIGRATION.md.
+
+    Equivalent to ``[train(replace(cfg, seed=s), dataset) for s in
+    seeds]`` as one compiled dispatch. Kept for compatibility with its
+    original contract: schemes whose LAYOUT depends on the seed (cyclic
+    MDS, random-regular, partial cyclic) are refused whenever the seeds
+    actually produce different layouts — even in deduped mode, where
+    ``train_cohort`` itself could batch them (its per-trajectory weight
+    tables handle differing layouts over one partition-major stack).
+    """
+    seeds = [int(s) for s in seeds]
+    if not seeds:
+        raise ValueError("train_batch needs at least one seed")
+    if cfg.arrival_mode != "simulated":
+        raise ValueError(
+            "train_batch batches the scan trainer; arrival_mode='measured' "
+            "has no batched implementation"
+        )
+    if cfg.use_pallas == "on":
+        raise ValueError(
+            "train_batch has no batched fused-kernel dispatch; "
+            "use use_pallas='auto' or 'off'"
+        )
+    cfgs = [dataclasses.replace(cfg, seed=s) for s in seeds]
+    layouts = [build_layout(c) for c in cfgs]
+    a0 = np.asarray(layouts[0].assignment)
+    c0 = np.asarray(layouts[0].coeffs)
+    for lay in layouts[1:]:
+        if not (
+            np.array_equal(a0, np.asarray(lay.assignment))
+            and np.array_equal(c0, np.asarray(lay.coeffs))
+        ):
+            raise ValueError(
+                f"scheme {cfg.scheme.value!r} builds a seed-dependent "
+                "layout across these seeds; train_batch shares one data "
+                "stack — run per-seed train() for seed-dependent codes"
+            )
+    return train_cohort(cfgs, dataset, mesh=mesh, measure=measure)
 
 
 def _make_worker_msg(model):

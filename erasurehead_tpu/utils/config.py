@@ -500,6 +500,47 @@ class RunConfig:
         raise ValueError(f"unknown lr schedule kind {kind!r}")
 
 
+#: env var controlling the sweep engine's trajectory-batched dispatch when
+#: the CLI flag is absent (same flag > env > default precedence as the
+#: sweep cache and telemetry knobs)
+BATCH_TRAJECTORIES_ENV = "ERASUREHEAD_BATCH_TRAJECTORIES"
+
+
+def resolve_batch_trajectories(
+    flag: Optional[str] = None, env: Optional[str] = None
+) -> str:
+    """Resolve the sweep engine's trajectory-batching mode to one of
+    ``"on"`` / ``"off"`` / ``"auto"``.
+
+    ``"auto"`` (the default) dispatches every cohort of >= 2 eligible
+    trajectories through :func:`trainer.train_cohort` (one compiled scan
+    per cohort) and runs singletons sequentially; ``"on"`` routes even
+    singletons through the cohort engine; ``"off"`` forces strictly
+    sequential :func:`trainer.train` calls (debugging; bitwise-reference
+    trajectories). Precedence: explicit ``flag`` >
+    :data:`BATCH_TRAJECTORIES_ENV` env var > ``"auto"``. ``env`` overrides
+    the real environment lookup (tests).
+    """
+    val = flag
+    if val is None:
+        val = env if env is not None else os.environ.get(
+            BATCH_TRAJECTORIES_ENV
+        )
+    if val is None or val == "":
+        return "auto"
+    val = str(val).strip().lower()
+    if val in _TELEMETRY_ON:
+        return "on"
+    if val in _TELEMETRY_OFF:
+        return "off"
+    if val in ("on", "off", "auto"):
+        return val
+    raise ValueError(
+        f"batch-trajectories setting must be on/off/auto (or a "
+        f"truthy/falsy {BATCH_TRAJECTORIES_ENV} value), got {val!r}"
+    )
+
+
 #: env var controlling run telemetry when the CLI flag is absent
 #: (mirrors ERASUREHEAD_SWEEP_CACHE's flag > env > default precedence)
 TELEMETRY_ENV = "ERASUREHEAD_TELEMETRY"

@@ -1,0 +1,441 @@
+"""Trajectory-batched cohort dispatch (trainer.train_cohort, PR 4).
+
+The load-bearing invariants:
+  - the cohort structural fact: deduped partition-major stacks are
+    BITWISE identical across all 7 reference schemes at fixed
+    (n_partitions, dataset, dtype), and the sweep data cache serves ONE
+    upload for the whole cohort;
+  - cohort-batched trajectories match sequential train() to float
+    tolerance across schemes, lowerings, and dtypes, with IDENTICAL
+    control-plane artifacts (timeset / collected / decode_error);
+  - a deduped 7-scheme x 4-seed compare() executes as <= 2 compiled scan
+    dispatches, telemetry-verified (the ISSUE 4 acceptance bar);
+  - batched event emission keeps the -1 never-arrived sentinel masked.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from erasurehead_tpu.data.sharding import partition_stack
+from erasurehead_tpu.data.synthetic import generate_gmm
+from erasurehead_tpu.obs import events as events_lib
+from erasurehead_tpu.obs.metrics import REGISTRY
+from erasurehead_tpu.train import cache, experiments, trainer
+from erasurehead_tpu.utils.config import (
+    RunConfig,
+    resolve_batch_trajectories,
+)
+
+W, ROUNDS = 8, 6
+N_ROWS, N_COLS = 512, 24
+
+SCHEME_EXTRAS = {
+    "naive": {},
+    "cyccoded": {},
+    "repcoded": {},
+    "approx": {"num_collect": 6},
+    "avoidstragg": {},
+    "randreg": {"num_collect": 6},
+    "deadline": {"deadline": 1.0},
+}
+
+
+@pytest.fixture(scope="module")
+def gmm():
+    return generate_gmm(N_ROWS, N_COLS, n_partitions=W, seed=0)
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    cache.clear()
+    cache.set_enabled(True)
+    for name in ("cohort.dispatches", "cohort.trajectories",
+                 "cohort.sequential_runs"):
+        REGISTRY.counter(name).reset()
+    yield
+    cache.clear()
+
+
+def _cfg(**kw):
+    base = dict(
+        scheme="approx",
+        n_workers=W,
+        n_stragglers=1,
+        num_collect=6,
+        rounds=ROUNDS,
+        n_rows=N_ROWS,
+        n_cols=N_COLS,
+        update_rule="AGD",
+        lr_schedule=0.5,
+        add_delay=True,
+        seed=3,
+    )
+    base.update(kw)
+    return RunConfig(**base)
+
+
+def _seven(**common_kw):
+    common = dict(compute_mode="deduped")
+    common.update(common_kw)
+    return {
+        scheme: _cfg(scheme=scheme, **{**common, **extra})
+        for scheme, extra in SCHEME_EXTRAS.items()
+    }
+
+
+def _assert_traj_close(res, single, rtol=2e-5, atol=1e-6):
+    for a, b in zip(
+        jax.tree.leaves(res.params_history),
+        jax.tree.leaves(single.params_history),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=rtol, atol=atol,
+        )
+    # control plane is computed per trajectory on host: IDENTICAL
+    np.testing.assert_array_equal(res.timeset, single.timeset)
+    np.testing.assert_array_equal(res.worker_times, single.worker_times)
+    np.testing.assert_array_equal(res.collected, single.collected)
+    np.testing.assert_array_equal(res.decode_error, single.decode_error)
+
+
+# ---------------------------------------------------------------------------
+# the cohort structural invariant
+
+
+class TestCohortStackInvariant:
+    def test_deduped_partition_stacks_bitwise_identical_across_schemes(
+        self, gmm
+    ):
+        """The fact the tentpole rests on: the partition-major stack
+        depends only on (n_partitions, dataset, dtype) — every one of the
+        7 reference schemes sees the SAME bytes."""
+        ref_X, ref_y = partition_stack(gmm, W)
+        for scheme, extra in SCHEME_EXTRAS.items():
+            cfg = _cfg(scheme=scheme, compute_mode="deduped", **extra)
+            lay = trainer.build_layout(cfg)
+            assert lay.n_partitions == W, scheme
+            Xp, yp = partition_stack(gmm, lay.n_partitions)
+            assert np.asarray(Xp).tobytes() == np.asarray(ref_X).tobytes()
+            assert np.asarray(yp).tobytes() == np.asarray(ref_y).tobytes()
+
+    def test_cohort_signature_groups_all_seven_schemes(self):
+        keys = {
+            trainer.cohort_signature(cfg)
+            for cfg in _seven().values()
+        }
+        assert len(keys) == 1
+        # faithful mode groups by assignment content instead: FRC and AGC
+        # share one, cyclic MDS differs
+        faithful = {
+            s: trainer.cohort_signature(_cfg(scheme=s, **e))
+            for s, e in SCHEME_EXTRAS.items()
+        }
+        assert faithful["approx"] == faithful["repcoded"]
+        assert faithful["approx"] != faithful["cyccoded"]
+
+    def test_one_upload_serves_the_whole_cohort(self, gmm):
+        trainer.train_cohort(list(_seven().values()), gmm)
+        s = cache.stats()
+        assert s.data_misses == 1, s.snapshot()
+        assert s.exec_misses == 1, s.snapshot()
+
+    def test_ineligible_configs_have_no_signature(self):
+        assert trainer.cohort_signature(_cfg(use_pallas="on")) is None
+        assert (
+            trainer.cohort_signature(
+                _cfg(arrival_mode="measured", compute_mode="faithful")
+            )
+            is None
+        )
+
+
+# ---------------------------------------------------------------------------
+# cross-scheme batch equivalence
+
+
+class TestCohortEquivalence:
+    SCHEMES = ("approx", "cyccoded", "repcoded", "randreg")
+
+    @pytest.mark.parametrize(
+        "lowering_kw",
+        [{}, {"flat_grad": "on"}, {"margin_flat": "on"}],
+        ids=["default", "flat", "margin-flat"],
+    )
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_cross_scheme_matches_sequential(self, gmm, lowering_kw, dtype):
+        cfgs = [
+            _cfg(
+                scheme=s, compute_mode="deduped", dtype=dtype,
+                **{**SCHEME_EXTRAS[s], **lowering_kw},
+            )
+            for s in self.SCHEMES
+        ]
+        batch = trainer.train_cohort(cfgs, gmm)
+        assert len(batch) == len(cfgs)
+        tol = dict(rtol=2e-5, atol=1e-6)
+        if dtype == "bfloat16":
+            # bf16 margins round per reduction order (eps = 2^-8): the
+            # cohort matmul vs the per-slot matvecs legitimately differ
+            # at the ~1e-2 relative level after several AGD rounds
+            tol = dict(rtol=5e-2, atol=5e-3)
+        for c, res in zip(cfgs, batch):
+            _assert_traj_close(res, trainer.train(c, gmm), **tol)
+
+    def test_faithful_and_ring_cohorts(self, gmm):
+        """Faithful cohorts (shared assignment): materialized and ring
+        transports both match sequential train()."""
+        for stack in ("materialized", "ring"):
+            cfgs = [
+                _cfg(scheme="repcoded", stack_mode=stack, seed=s)
+                for s in (0, 1)
+            ]
+            for c, res in zip(cfgs, trainer.train_cohort(cfgs, gmm)):
+                _assert_traj_close(res, trainer.train(c, gmm))
+
+    def test_lr_and_alpha_variants_are_trajectory_axes(self, gmm):
+        cfgs = [
+            _cfg(compute_mode="deduped", lr_schedule=lr, alpha=a, seed=s)
+            for (lr, a, s) in (
+                (0.5, None, 0), (0.2, 0.01, 0), (1.0, 0.001, 7),
+            )
+        ]
+        for c, res in zip(cfgs, trainer.train_cohort(cfgs, gmm)):
+            _assert_traj_close(res, trainer.train(c, gmm))
+
+    def test_grads_via_loss_model_batches(self, gmm):
+        """Autodiff families (MLP) ride the vmapped local body."""
+        cfgs = [
+            _cfg(
+                compute_mode="deduped", model="mlp", update_rule="GD",
+                lr_schedule=0.1, seed=s,
+            )
+            for s in (0, 1)
+        ]
+        results = trainer.train_cohort(cfgs, gmm)
+        assert results[0].cache_info["cohort_lowering"] == "per_slot_vmap"
+        for c, res in zip(cfgs, results):
+            _assert_traj_close(
+                res, trainer.train(c, gmm), rtol=5e-4, atol=5e-5
+            )
+
+    def test_seeds_expansion_and_shared_arrivals(self, gmm):
+        from erasurehead_tpu.parallel import straggler
+
+        arr = straggler.arrival_schedule(ROUNDS, W, add_delay=True, mean=0.5)
+        cfgs = [_cfg(compute_mode="deduped")]
+        batch = trainer.train_cohort(cfgs, gmm, seeds=[0, 5], arrivals=arr)
+        assert [r.config.seed for r in batch] == [0, 5]
+        for res in batch:
+            single = trainer.train(res.config, gmm, arrivals=arr)
+            _assert_traj_close(res, single)
+
+    def test_mixed_static_signature_refused(self, gmm):
+        with pytest.raises(ValueError, match="static lowering signature"):
+            trainer.train_cohort(
+                [_cfg(), _cfg(dtype="bfloat16")], gmm
+            )
+
+    def test_mixed_stack_refused(self, gmm):
+        # faithful cyccoded vs repcoded: different assignments, one cohort
+        with pytest.raises(ValueError, match="different device data stack"):
+            trainer.train_cohort(
+                [_cfg(scheme="repcoded"), _cfg(scheme="cyccoded")], gmm
+            )
+
+    def test_cohort_exec_cache_reuse(self, gmm):
+        cfgs = list(_seven().values())
+        b1 = trainer.train_cohort(cfgs, gmm)
+        assert b1[0].cache_info["exec_misses"] == 1
+        b2 = trainer.train_cohort(cfgs, gmm)
+        assert b2[0].cache_info["exec_hits"] == 1
+        for a, b in zip(b1, b2):
+            assert np.array_equal(
+                np.asarray(a.params_history), np.asarray(b.params_history)
+            )
+
+
+# ---------------------------------------------------------------------------
+# the acceptance bar: compare() collapses into <= 2 dispatches
+
+
+class TestCompareBatched:
+    def test_seven_scheme_four_seed_compare_two_dispatches_max(self):
+        W30 = 30
+        data = generate_gmm(W30 * 16, N_COLS, n_partitions=W30, seed=0)
+        common = dict(
+            n_workers=W30, n_stragglers=2, rounds=3, n_rows=W30 * 16,
+            n_cols=N_COLS, update_rule="AGD", lr_schedule=0.5,
+            add_delay=True, compute_mode="deduped",
+        )
+        extras = dict(SCHEME_EXTRAS, approx={"num_collect": 15},
+                      randreg={"num_collect": 15})
+        configs = {
+            f"{s}_seed{seed}": RunConfig(
+                scheme=s, seed=seed, **{**common, **extras[s]}
+            )
+            for s in SCHEME_EXTRAS
+            for seed in range(4)
+        }
+        assert len(configs) == 28
+        rows = experiments.compare(configs, data, batch="auto")
+        assert len(rows) == 28
+        # telemetry-verified dispatch count (the acceptance criterion)
+        assert REGISTRY.counter("cohort.dispatches").value <= 2
+        assert REGISTRY.counter("cohort.trajectories").value == 28
+        s = cache.stats()
+        assert s.exec_misses <= 2, s.snapshot()
+        assert s.data_misses <= 2, s.snapshot()
+        # and the batched rows carry the cohort telemetry
+        assert all(r.cache.get("cohort_dispatches") == 1 for r in rows)
+
+    def test_compare_batched_matches_sequential(self, gmm):
+        configs = {
+            s: _cfg(scheme=s, compute_mode="deduped", **SCHEME_EXTRAS[s])
+            for s in ("approx", "repcoded", "naive")
+        }
+        batched = experiments.compare(dict(configs), gmm, batch="auto")
+        cache.clear()
+        sequential = experiments.compare(dict(configs), gmm, batch="off")
+        by_b = {r.label: r for r in batched}
+        by_s = {r.label: r for r in sequential}
+        assert set(by_b) == set(by_s)
+        for label in configs:
+            np.testing.assert_allclose(
+                by_b[label].training_loss, by_s[label].training_loss,
+                rtol=2e-5, atol=1e-6,
+            )
+            assert (
+                by_b[label].decode_error_mean
+                == by_s[label].decode_error_mean
+            )
+
+    def test_plan_cohorts_orders_and_flags(self, gmm):
+        configs = {
+            "a": _cfg(scheme="approx", compute_mode="deduped"),
+            "m": _cfg(arrival_mode="measured", compute_mode="faithful"),
+            "b": _cfg(scheme="repcoded", compute_mode="deduped"),
+        }
+        plan = experiments.plan_cohorts(configs)
+        assert plan[0] == (["a", "b"], True)
+        assert plan[1] == (["m"], False)
+
+    def test_batch_off_never_dispatches_cohorts(self, gmm):
+        configs = {
+            s: _cfg(scheme=s, compute_mode="deduped", **SCHEME_EXTRAS[s])
+            for s in ("approx", "repcoded")
+        }
+        experiments.compare(configs, gmm, batch="off")
+        assert REGISTRY.counter("cohort.dispatches").value == 0
+        assert REGISTRY.counter("cohort.sequential_runs").value == 2
+
+    def test_resolve_batch_trajectories(self):
+        assert resolve_batch_trajectories(None, env="") == "auto"
+        assert resolve_batch_trajectories("on") == "on"
+        assert resolve_batch_trajectories(None, env="0") == "off"
+        assert resolve_batch_trajectories(None, env="true") == "on"
+        with pytest.raises(ValueError, match="on/off/auto"):
+            resolve_batch_trajectories("sometimes")
+
+
+# ---------------------------------------------------------------------------
+# telemetry: cohort events, per-trajectory series, sentinel masking
+
+
+class TestCohortTelemetry:
+    def test_cohort_event_and_per_trajectory_series(self, gmm, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        cfgs = [
+            _cfg(scheme=s, compute_mode="deduped", seed=sd,
+                 **SCHEME_EXTRAS[s])
+            for s in ("approx", "repcoded")
+            for sd in (0, 1)
+        ]
+        with events_lib.capture(path):
+            trainer.train_cohort(cfgs, gmm)
+        assert events_lib.validate_file(path) == []
+        recs = [json.loads(l) for l in open(path)]
+        cohort = [r for r in recs if r["type"] == "cohort"]
+        assert len(cohort) == 1
+        assert cohort[0]["n_trajectories"] == 4
+        assert cohort[0]["schemes"] == ["approx", "repcoded"]
+        assert cohort[0]["dispatches"] == 1
+        # one tagged rounds/decode stream per trajectory
+        tags = {
+            r.get("trajectory") for r in recs if r["type"] == "rounds"
+        }
+        assert len(tags) == 4 and None not in tags
+        decode_tags = {
+            r.get("trajectory") for r in recs if r["type"] == "decode"
+        }
+        assert decode_tags == tags
+        # report renders the composition line
+        from erasurehead_tpu.obs import report
+
+        txt = report.render([path])
+        assert "2 scheme(s) x 2 seed(s) = 4 trajectories in 1 dispatch" in txt
+
+    def test_never_arrived_sentinel_masked_in_batched_emission(
+        self, gmm, tmp_path
+    ):
+        """Deadline trajectories leave -1 sentinels in worker_times; every
+        arrival stat in the cohort's batched emission must mask them."""
+        path = str(tmp_path / "events.jsonl")
+        cfgs = [
+            _cfg(scheme="deadline", compute_mode="deduped", deadline=0.2,
+                 delay_mean=2.0, seed=s)
+            for s in (0, 1)
+        ]
+        with events_lib.capture(path):
+            results = trainer.train_cohort(cfgs, gmm)
+        # the run genuinely produced never-arrived workers
+        assert any((r.worker_times == -1).any() for r in results)
+        recs = [json.loads(l) for l in open(path)]
+        arrival_blocks = [
+            r["arrival"] for r in recs if r["type"] in ("rounds", "run_end")
+        ]
+        assert any(a["n_never"] > 0 for a in arrival_blocks)
+        for a in arrival_blocks:
+            for q in ("p50", "p90", "p99", "mean"):
+                if a[q] is not None:
+                    assert a[q] >= 0.0, a
+
+    def test_telemetry_off_is_observation_only(self, gmm):
+        cfgs = [
+            _cfg(scheme=s, compute_mode="deduped", **SCHEME_EXTRAS[s])
+            for s in ("approx", "repcoded")
+        ]
+        plain = trainer.train_cohort(cfgs, gmm)
+        cache.clear()
+        with events_lib.capture("/dev/null"):
+            logged = trainer.train_cohort(cfgs, gmm)
+        for a, b in zip(plain, logged):
+            assert np.array_equal(
+                np.asarray(a.params_history), np.asarray(b.params_history)
+            )
+
+
+# ---------------------------------------------------------------------------
+# train_batch compatibility wrapper
+
+
+def test_train_batch_delegates_to_cohort(gmm):
+    batch = trainer.train_batch(_cfg(), gmm, [3, 11])
+    info = batch[0].cache_info
+    assert info["batch_size"] == 2 and info["batch_dispatches"] == 1
+    assert info["cohort_size"] == 2 and info["cohort_dispatches"] == 1
+    # the historical refusal contract survives the rewrite
+    with pytest.raises(ValueError, match="seed-dependent"):
+        trainer.train_batch(_cfg(scheme="cyccoded"), gmm, [0, 1])
+
+
+def test_cohort_empty_and_pallas_refused(gmm):
+    with pytest.raises(ValueError, match="at least one"):
+        trainer.train_cohort([], gmm)
+    with pytest.raises(ValueError, match="fused-kernel"):
+        trainer.train_cohort([_cfg(use_pallas="on")], gmm)

@@ -139,13 +139,15 @@ class TestRunToRunCache:
         assert r.cache_info["exec_hits"] == 1
 
     def test_two_scheme_compare_accounting(self, gmm):
-        """compare() across two schemes: one compile + one upload total,
-        telemetry carried into the experiment rows."""
+        """Sequential compare() (batch='off') across two schemes: one
+        compile + one upload total, telemetry carried into the experiment
+        rows. The batched default collapses this into ONE cohort dispatch
+        instead — that contract is pinned in tests/test_cohort.py."""
         configs = {
             "approx": _cfg(scheme="approx"),
             "repcoded": _cfg(scheme="repcoded"),
         }
-        rows = experiments.compare(configs, gmm)
+        rows = experiments.compare(configs, gmm, batch="off")
         assert len(rows) == 2
         by_label = {r.label: r.cache for r in rows}
         assert by_label["approx"]["exec_misses"] == 1
@@ -157,9 +159,12 @@ class TestRunToRunCache:
         assert s.exec_misses == 1 and s.data_misses == 1
 
     def test_seven_scheme_compare_one_compile_one_upload(self):
-        """The acceptance bar: seven schemes at the canonical W=30 shape,
-        deduped mode (partition stacking is scheme-independent), perform
-        exactly ONE scan compile and ONE data upload."""
+        """The sweep-CACHE acceptance bar: seven schemes at the canonical
+        W=30 shape, deduped mode (partition stacking is
+        scheme-independent), run SEQUENTIALLY (batch='off') perform
+        exactly ONE scan compile and ONE data upload. The trajectory-
+        batched default goes further — one cohort DISPATCH — pinned in
+        tests/test_cohort.py."""
         W30 = 30
         data = generate_gmm(W30 * 16, N_COLS, n_partitions=W30, seed=0)
         common = dict(
@@ -183,7 +188,7 @@ class TestRunToRunCache:
             ),
         }
         assert len(configs) == 7
-        rows = experiments.compare(configs, data)
+        rows = experiments.compare(configs, data, batch="off")
         assert len(rows) == 7
         s = cache.stats()
         assert s.exec_misses == 1, s.snapshot()

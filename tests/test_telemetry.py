@@ -157,6 +157,9 @@ def test_event_log_and_report_two_scheme_compare(tmp_path, capsys):
     cache.clear()
     ds = _dataset()
     path = str(tmp_path / "events.jsonl")
+    # batch='off' pins the per-run event shape (one run_start/run_end
+    # pair per scheme); the cohort-mode event shape is pinned in
+    # tests/test_cohort.py
     with obs_events.capture(path):
         summaries = experiments.compare(
             {
@@ -164,6 +167,7 @@ def test_event_log_and_report_two_scheme_compare(tmp_path, capsys):
                 "agc": _cfg("approx", num_collect=2),
             },
             ds,
+            batch="off",
         )
     # sweep rows carry the decode-error column
     by_label = {s.label: s for s in summaries}

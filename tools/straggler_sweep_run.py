@@ -33,6 +33,17 @@ def main() -> int:
                     help="also write a run-telemetry event log "
                          "(artifacts/straggler_sweep_w{W}_events.jsonl; "
                          "render with `erasurehead-tpu report`)")
+    ap.add_argument("--batch-trajectories", default=None,
+                    choices=["on", "off", "auto"],
+                    help="trajectory-batched dispatch (trainer."
+                         "train_cohort): sweep entries sharing a device "
+                         "data stack run as ONE compiled scan. Default: "
+                         "ERASUREHEAD_BATCH_TRAJECTORIES env, else auto")
+    ap.add_argument("--compute-mode", default="faithful",
+                    choices=["faithful", "deduped"],
+                    help="deduped stacks partition-major (scheme-"
+                         "independent), letting --batch-trajectories "
+                         "collapse the whole sweep into a few dispatches")
     ns = ap.parse_args()
     W = ns.workers
     collect = ns.num_collect or W // 2
@@ -46,6 +57,7 @@ def main() -> int:
         scheme="naive", n_workers=W, n_stragglers=0, num_collect=collect,
         rounds=ns.rounds, n_rows=rows, n_cols=ns.cols, lr_schedule=1.0,
         update_rule="AGD", add_delay=True, seed=0,
+        compute_mode=ns.compute_mode,
     )
     data = generate_gmm(rows, ns.cols, n_partitions=W, seed=0)
 
@@ -69,10 +81,14 @@ def main() -> int:
     t0 = time.time()
     if sink is not None:
         with sink:
-            summaries = experiments.straggler_sweep(base, data, sweep)
+            summaries = experiments.straggler_sweep(
+                base, data, sweep, batch=ns.batch_trajectories
+            )
         print(f"events -> {epath}", file=sys.stderr)
     else:
-        summaries = experiments.straggler_sweep(base, data, sweep)
+        summaries = experiments.straggler_sweep(
+            base, data, sweep, batch=ns.batch_trajectories
+        )
     print(f"sweep: {len(summaries)} runs in {time.time() - t0:.0f}s",
           file=sys.stderr)
     jpath = os.path.join(out_dir, f"straggler_sweep_w{W}.json")

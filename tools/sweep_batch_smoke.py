@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Smoke-check the trajectory-batched sweep engine on CPU
+(`make sweep-batch-smoke`).
+
+Runs a 7-scheme x 2-seed deduped compare() with --batch-trajectories auto
+under a telemetry capture, then asserts the dispatch-amortization contract
+via the obs/metrics counters:
+
+  - cohort.dispatches <= the number of cohorts plan_cohorts planned
+    (the whole deduped sweep must collapse, not run per-config);
+  - cohort.trajectories == the number of configs;
+  - the sweep caches performed exactly one scan compile and one data
+    upload for the whole cohort;
+  - the events.jsonl (cohort record included) passes the schema check.
+
+Exit 0 = all assertions hold; 1 = failure (printed).
+"""
+
+import os
+import sys
+import tempfile
+
+# runnable from anywhere without an install (the tools/ convention)
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU relay
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.obs import events as events_lib
+    from erasurehead_tpu.obs.metrics import REGISTRY
+    from erasurehead_tpu.train import cache, experiments
+    from erasurehead_tpu.utils.config import RunConfig
+
+    W, rounds, seeds = 8, 4, (0, 1)
+    data = generate_gmm(W * 16, 24, n_partitions=W, seed=0)
+    common = dict(
+        n_workers=W, n_stragglers=1, rounds=rounds, n_rows=W * 16,
+        n_cols=24, update_rule="AGD", lr_schedule=0.5, add_delay=True,
+        compute_mode="deduped",
+    )
+    schemes = [
+        ("naive", {}),
+        ("cyccoded", {}),
+        ("repcoded", {}),
+        ("approx", {"num_collect": 6}),
+        ("avoidstragg", {}),
+        ("randreg", {"num_collect": 6}),
+        ("deadline", {"deadline": 1.0}),
+    ]
+    configs = {
+        f"{s}_seed{sd}": RunConfig(
+            **{**common, **extra, "scheme": s, "seed": sd}
+        )
+        for s, extra in schemes
+        for sd in seeds
+    }
+    n_cohorts = sum(1 for _, b in experiments.plan_cohorts(configs) if b)
+
+    cache.clear()
+    for name in ("cohort.dispatches", "cohort.trajectories",
+                 "cohort.sequential_runs"):
+        REGISTRY.counter(name).reset()
+    events_path = os.path.join(
+        tempfile.mkdtemp(prefix="eh-sweep-batch-smoke-"), "events.jsonl"
+    )
+    with events_lib.capture(events_path):
+        rows = experiments.compare(configs, data, batch="auto")
+
+    dispatches = REGISTRY.counter("cohort.dispatches").value
+    trajectories = REGISTRY.counter("cohort.trajectories").value
+    stats = cache.stats()
+    failures = []
+    if len(rows) != len(configs):
+        failures.append(f"expected {len(configs)} rows, got {len(rows)}")
+    if dispatches > n_cohorts:
+        failures.append(
+            f"cohort.dispatches={dispatches} exceeds the {n_cohorts} "
+            "planned cohort(s): the sweep did not batch"
+        )
+    if trajectories != len(configs):
+        failures.append(
+            f"cohort.trajectories={trajectories} != {len(configs)} configs"
+        )
+    if stats.exec_misses > n_cohorts:
+        failures.append(
+            f"{stats.exec_misses} scan compiles for {n_cohorts} cohort(s)"
+        )
+    if stats.data_misses > n_cohorts:
+        failures.append(
+            f"{stats.data_misses} data uploads for {n_cohorts} cohort(s)"
+        )
+    schema_errors = events_lib.validate_file(events_path)
+    failures.extend(f"events schema: {e}" for e in schema_errors)
+    if not any(
+        r.cache and r.cache.get("cohort_dispatches") for r in rows
+    ):
+        failures.append("no row carries cohort cache telemetry")
+
+    print(
+        f"sweep-batch-smoke: {len(configs)} trajectories "
+        f"({len(schemes)} schemes x {len(seeds)} seeds) -> "
+        f"{dispatches} dispatch(es) of {n_cohorts} planned cohort(s); "
+        f"compiles={stats.exec_misses} uploads={stats.data_misses}"
+    )
+    print(f"events -> {events_path}")
+    for f in failures:
+        print(f"FAIL: {f}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
