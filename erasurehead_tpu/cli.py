@@ -30,6 +30,11 @@ reference's layout, src/naive.py:200-208). With ``--telemetry on`` (or
 A third form renders that log::
 
        erasurehead-tpu report <events.jsonl> [more.jsonl ...]
+
+A fourth runs the comparison-suite sweep (train/experiments.py) behind the
+same console entry, with the resilient-sweep flags::
+
+       erasurehead-tpu sweep --rounds 30 --sweep-journal DIR --resume-sweep
 """
 
 from __future__ import annotations
@@ -545,6 +550,12 @@ def main(argv: list[str] | None = None) -> int:
         from erasurehead_tpu.obs import report as report_lib
 
         return report_lib.main(argv[1:])
+    if argv and argv[0] == "sweep":
+        # `erasurehead-tpu sweep ...` — the comparison-suite sweep runner
+        # (train/experiments.main), incl. --sweep-journal/--resume-sweep
+        from erasurehead_tpu.train import experiments as experiments_lib
+
+        return experiments_lib.main(argv[1:])
     if len(argv) == 13 and not argv[0].startswith("-"):
         cfg = _legacy_to_config(argv)
         run(cfg)

@@ -62,7 +62,19 @@ SCHEMA: dict[str, tuple] = {
     "run_end": ("run_id", "wall_time_s", "steps_per_sec"),
     # registry snapshot written once when a capture closes (obs/metrics.py)
     "metrics": ("snapshot",),
+    # sweep-journal record (train/journal.py): one per finished sweep
+    # trajectory — its identity key (config signature + data/arrival
+    # digest), completion status ("ok" | "diverged"), and the full
+    # RunSummary rehydration payload that lets --resume-sweep reproduce the
+    # row without re-training. The journal file is an events.jsonl like any
+    # other (same envelope, same validator).
+    "sweep_trajectory": ("key", "label", "status", "row"),
 }
+
+#: sweep_trajectory completion statuses (train/journal.py); "diverged"
+#: rows are quarantined, not retried — divergence is deterministic under
+#: the journaled (config, data, arrivals) key
+TRAJECTORY_STATUSES = ("ok", "diverged")
 
 #: rounds-style chunk size: small runs get one chunk, long runs stay O(R/100)
 ROUND_CHUNK = 100
@@ -278,8 +290,10 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
     records have strictly increasing ``first_round`` per (run_id,
     trajectory) stream (cohort dispatches emit one tagged stream per
     trajectory); ``cohort`` records are internally consistent
-    (n_trajectories matches the seeds list, dispatches >= 1); every
-    ``run_start`` has a matching later ``run_end``."""
+    (n_trajectories matches the seeds list, dispatches >= 1);
+    ``sweep_trajectory`` journal records carry a known status, a non-empty
+    key, and an object row; every ``run_start`` has a matching later
+    ``run_end``."""
     errors: list[str] = []
     last_seq: Optional[int] = None
     last_round: dict = {}  # (run_id, type) -> last first_round
@@ -343,6 +357,24 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
             if isinstance(disp, int) and disp < 1:
                 errors.append(
                     f"line {i}: cohort dispatches must be >= 1, got {disp}"
+                )
+        if rtype == "sweep_trajectory":
+            status = rec.get("status")
+            if status not in TRAJECTORY_STATUSES:
+                errors.append(
+                    f"line {i}: sweep_trajectory status must be one of "
+                    f"{TRAJECTORY_STATUSES}, got {status!r}"
+                )
+            if "row" in rec and not isinstance(rec.get("row"), dict):
+                errors.append(
+                    f"line {i}: sweep_trajectory row must be an object "
+                    f"(the RunSummary rehydration payload)"
+                )
+            key = rec.get("key")
+            if not isinstance(key, str) or not key:
+                errors.append(
+                    f"line {i}: sweep_trajectory key must be a non-empty "
+                    f"string"
                 )
         if rtype == "run_start":
             started.add(rec.get("run_id"))

@@ -168,3 +168,30 @@ class MetricsRegistry:
 
 #: process-default registry (the sweep caches and trainers report here)
 REGISTRY = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# first-occurrence stderr warnings: telemetry failures and degradations must
+# be LOUD once, not silent (the r5 audit found a blanket except swallowing
+# them) and not a line per round either
+
+_warned: set = set()
+
+
+def warn_once(key: str, message: str) -> bool:
+    """Print ``message`` to stderr the FIRST time ``key`` is seen in this
+    process; later calls are no-ops. Returns whether it printed. Callers
+    pair this with a counter so the repeat count stays observable
+    (e.g. ``telemetry.emit_errors``) while stderr stays readable."""
+    if key in _warned:
+        return False
+    _warned.add(key)
+    import sys
+
+    print(message, file=sys.stderr)
+    return True
+
+
+def reset_warnings() -> None:
+    """Forget which one-time warnings fired (tests)."""
+    _warned.clear()

@@ -18,12 +18,16 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
     Returns one dict per run: {"run_id", "start": run_start|None,
     "end": run_end|None, "compiles": [...], "uploads": [...],
     "rounds": [...], "decode": [...], "cohort": cohort|None,
-    "warnings": [...]}.
+    "warnings": [...]}. A trailing run_id=None entry carries stray
+    warnings and any ``sweep_trajectory`` journal records (a sweep
+    journal is an events.jsonl like any other — `report` renders its
+    rows, diverged ones flagged).
     Unparseable lines are skipped (the validator's job is strictness;
     the report renders what it can)."""
     runs: dict = {}
     order: list = []
     warnings: list = []
+    trajectories: list = []
 
     def run(rid):
         if rid not in runs:
@@ -63,9 +67,14 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
                     run(rid)["cohort"] = rec
                 elif rtype == "warning":
                     (run(rid)["warnings"] if rid else warnings).append(rec)
+                elif rtype == "sweep_trajectory":
+                    trajectories.append(rec)
     out = [runs[rid] for rid in order]
-    if warnings:
-        out.append({"run_id": None, "warnings": warnings})
+    if warnings or trajectories:
+        out.append({
+            "run_id": None, "warnings": warnings,
+            "trajectories": trajectories,
+        })
     return out
 
 
@@ -141,6 +150,24 @@ def render(paths: Sequence[str]) -> str:
                 f"{len(schemes)} scheme(s) x {len(set(seeds))} seed(s) = "
                 f"{c.get('n_trajectories', len(seeds))} trajectories in "
                 f"{disp} dispatch(es) [{c.get('lowering', '?')}]"
+            )
+    trajectories = [
+        t for g in stray for t in g.get("trajectories", [])
+    ]
+    if trajectories:
+        n_div = sum(1 for t in trajectories if t.get("status") == "diverged")
+        lines.append(
+            f"\nsweep journal: {len(trajectories)} trajectory record(s)"
+            + (f", {n_div} DIVERGED" if n_div else "")
+        )
+        for t in trajectories:
+            row = t.get("row") or {}
+            loss = row.get("final_train_loss")
+            status = t.get("status", "?")
+            lines.append(
+                f"  {str(t.get('label', '?'))[:24]:24s} "
+                f"{status:>9s} "
+                f"final_train_loss={_fmt(loss, '.6f') if isinstance(loss, (int, float)) else '-'}"
             )
     n_warn = sum(len(g["warnings"]) for g in groups) + sum(
         len(g["warnings"]) for g in stray

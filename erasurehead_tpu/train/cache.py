@@ -126,6 +126,23 @@ def stats() -> CacheStats:
     return _stats
 
 
+def drop_data_cache() -> int:
+    """Release the data cache's references to device-resident stacks;
+    returns the device bytes whose cache pin was dropped (counted in
+    ``sweep_cache.data_dropped_bytes``).
+
+    The memory-pressure response: after a RESOURCE_EXHAUSTED cohort
+    dispatch, the sweep's degradation guard (experiments._dispatch_cohort)
+    calls this before retrying the bisected halves, so the retries don't
+    contend with HBM pinned by stacks no live run is using. Stacks still
+    referenced by an in-flight run stay alive (jax Arrays are refcounted);
+    only the cache's own pins go."""
+    released = sum(nbytes for _, nbytes in _data_cache.values())
+    _data_cache.clear()
+    _METRICS.counter("sweep_cache.data_dropped_bytes").inc(released)
+    return released
+
+
 # ---------------------------------------------------------------------------
 # key builders
 

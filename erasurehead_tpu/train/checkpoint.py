@@ -7,7 +7,15 @@ orbax-backed save/restore of the optimizer state plus the round cursor, and
 the trainer exposes ``checkpoint_every`` by running its scan in chunks with
 a save between chunks (chunking costs one extra dispatch per chunk, not a
 recompile — the chunked scan is jitted once per chunk length).
-"""
+
+Preemptions also strike MID-save: a killed process can leave a partially
+written or corrupt ``round_N`` directory that a naive "newest wins" resume
+would then crash on — losing the run a checkpoint exists to protect. So
+:func:`latest` structurally validates candidates (orbax's commit marker)
+before returning one, and :func:`restore_latest` goes further: it attempts
+the restore newest-first and falls back to the next-older checkpoint — with
+a ``warning`` event and a counter per rejected candidate — when the data
+itself is torn (truncated array files pass the structural check)."""
 
 from __future__ import annotations
 
@@ -18,6 +26,10 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from erasurehead_tpu.train.optimizer import OptState
+
+#: orbax's commit marker: written when a save finalizes. A round_N
+#: directory without it is a save that never completed (killed mid-write).
+_COMMIT_MARKER = "_CHECKPOINT_METADATA"
 
 
 def _pack(state: OptState, next_round: int) -> dict:
@@ -34,6 +46,12 @@ def _pack(state: OptState, next_round: int) -> dict:
 
 def save(path: str, state: OptState, next_round: int) -> None:
     """Write a checkpoint directory (overwrites)."""
+    from erasurehead_tpu.utils import chaos as chaos_lib
+
+    # chaos site "checkpoint": an injected kill here is a preemption
+    # mid-checkpoint — the save never commits, and resume must fall back
+    # to the previous round_N (restore_latest)
+    chaos_lib.maybe_fire("checkpoint")
     path = os.path.abspath(path)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, _pack(state, next_round), force=True)
@@ -49,10 +67,20 @@ def restore(path: str, template_state: OptState) -> Tuple[OptState, int]:
     return state, int(back["next_round"])
 
 
-def latest(checkpoint_dir: str) -> Optional[str]:
-    """Most recent ``round_<N>`` checkpoint under ``checkpoint_dir``."""
+def is_valid(path: str) -> bool:
+    """Structural validity of one ``round_N`` directory: it exists and
+    orbax's commit marker is present (a kill mid-save leaves the marker
+    missing). Cheap by design — torn DATA inside a committed layout is
+    caught by :func:`restore_latest`'s restore attempt instead."""
+    return os.path.isdir(path) and os.path.exists(
+        os.path.join(path, _COMMIT_MARKER)
+    )
+
+
+def _candidates(checkpoint_dir: str) -> list:
+    """``round_N`` subdirectories, newest round first."""
     if not os.path.isdir(checkpoint_dir):
-        return None
+        return []
     rounds = []
     for name in os.listdir(checkpoint_dir):
         if name.startswith("round_"):
@@ -60,6 +88,62 @@ def latest(checkpoint_dir: str) -> Optional[str]:
                 rounds.append((int(name.split("_", 1)[1]), name))
             except ValueError:
                 continue
-    if not rounds:
-        return None
-    return os.path.join(checkpoint_dir, max(rounds)[1])
+    return [
+        os.path.join(checkpoint_dir, name)
+        for _, name in sorted(rounds, reverse=True)
+    ]
+
+
+def _warn_invalid(path: str, why: str) -> None:
+    from erasurehead_tpu.obs import events as obs_events
+    from erasurehead_tpu.obs.metrics import REGISTRY, warn_once
+
+    REGISTRY.counter("checkpoint.invalid").inc()
+    msg = (
+        f"checkpoint: skipping {path!r} ({why}); falling back to the "
+        f"next-older checkpoint"
+    )
+    obs_events.emit("warning", kind="checkpoint_invalid", message=msg)
+    warn_once(f"checkpoint_invalid:{path}", msg)
+
+
+def latest(checkpoint_dir: str) -> Optional[str]:
+    """Most recent VALID ``round_<N>`` checkpoint under ``checkpoint_dir``.
+
+    Partially written candidates (killed mid-save: commit marker missing)
+    are skipped with a ``warning`` event rather than returned — the old
+    newest-wins behavior handed resume a directory restore() would crash
+    on, destroying the run the checkpoint existed to protect."""
+    for path in _candidates(checkpoint_dir):
+        if is_valid(path):
+            return path
+        _warn_invalid(path, "partially written: commit marker missing")
+    return None
+
+
+def restore_latest(
+    checkpoint_dir: str, template_state: OptState
+) -> Optional[Tuple[OptState, int, str]]:
+    """Restore the newest checkpoint that actually loads.
+
+    Candidates are tried newest-first; structurally invalid ones AND ones
+    whose restore raises (truncated/corrupt data files — a committed
+    layout with torn contents) are skipped with a ``warning`` event and a
+    ``checkpoint.invalid`` count. Returns ``(state, next_round, path)``,
+    or None when no candidate survives (callers start from round 0, as
+    with no checkpoint at all)."""
+    for path in _candidates(checkpoint_dir):
+        if not is_valid(path):
+            _warn_invalid(path, "partially written: commit marker missing")
+            continue
+        try:
+            state, next_round = restore(path, template_state)
+        except Exception as e:  # noqa: BLE001 — any torn checkpoint must
+            # fall back, whatever layer of orbax/tensorstore it broke in
+            _warn_invalid(
+                path, f"restore failed: {type(e).__name__}: "
+                f"{str(e).splitlines()[0][:160]}"
+            )
+            continue
+        return state, next_round, path
+    return None

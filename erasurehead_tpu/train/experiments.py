@@ -18,13 +18,15 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Optional, Sequence
+import time
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 
 from erasurehead_tpu.data.synthetic import Dataset
 from erasurehead_tpu.parallel import straggler
 from erasurehead_tpu.train import evaluate, trainer
+from erasurehead_tpu.utils import chaos as chaos_lib
 from erasurehead_tpu.utils.config import RunConfig
 
 
@@ -58,21 +60,30 @@ class RunSummary:
     #: decode was genuinely approximate — the papers' central quantity,
     #: now a first-class sweep column
     decode_error_mean: Optional[float] = None
+    #: trajectory outcome: "ok", or "diverged" when the final params / loss
+    #: tail went NaN/Inf (divergence quarantine: the row is kept — rendered
+    #: distinctly, excluded from target-loss aggregation — and the sweep
+    #: continues instead of propagating NaNs into min()/time_to_target)
+    status: str = "ok"
 
     def row(self) -> dict:
+        def fin(v, nd):
+            # diverged rows carry NaN losses; round(NaN) would make
+            # save_summaries emit non-strict JSON (bare NaN tokens)
+            return round(v, nd) if v is not None and np.isfinite(v) else None
+
         out = {
             "label": self.label,
             "scheme": self.config.scheme.value,
             "n_stragglers": self.config.n_stragglers,
             "num_collect": self.config.num_collect,
+            "status": self.status,
             "sim_total_time": round(self.sim_total_time, 4),
             "sim_steps_per_sec": round(self.sim_steps_per_sec, 4),
             "real_steps_per_sec": round(self.real_steps_per_sec, 2),
-            "final_train_loss": round(self.final_train_loss, 6),
-            "final_test_loss": round(self.final_test_loss, 6),
-            "final_auc": round(self.final_auc, 6)
-            if np.isfinite(self.final_auc)
-            else None,
+            "final_train_loss": fin(self.final_train_loss, 6),
+            "final_test_loss": fin(self.final_test_loss, 6),
+            "final_auc": fin(self.final_auc, 6),
             "time_to_target": round(self.time_to_target, 4)
             if self.time_to_target is not None
             else None,
@@ -129,19 +140,200 @@ def plan_cohorts(
     ]
 
 
+# --------------------------------------------------------------------------
+# graceful cohort degradation: a sweep must survive its dispatch engine.
+# One cohort OOM (or a transient runtime failure) used to kill the whole
+# multi-scheme/multi-seed sweep; now the dispatch guard retries transients
+# with capped backoff, bisects failing cohorts into halves, and bottoms out
+# at sequential train() — no trajectory is ever lost to a cohort failure.
+
+#: max backoff retries per dispatch for TRANSIENT failures (OOM skips
+#: straight to bisection — retrying the same allocation would fail again)
+COHORT_MAX_RETRIES = 2
+#: backoff base/cap in seconds (doubles per retry; tests shrink the base)
+COHORT_BACKOFF_S = 0.05
+COHORT_BACKOFF_CAP_S = 2.0
+
+#: substrings classifying a runtime error as an out-of-memory failure
+#: (bisection halves the cohort — and with it the dispatch's live set)
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+#: substrings classifying a runtime error as transient (retry with backoff
+#: before degrading — remote-backend hiccups, preempted dispatch slots)
+_TRANSIENT_MARKERS = (
+    "DEADLINE_EXCEEDED", "UNAVAILABLE", "ABORTED", "CANCELLED", "INTERNAL",
+)
+
+
+def _guarded_error_types() -> tuple:
+    """Exception types the dispatch guard may classify: XLA runtime errors
+    (plus the chaos stand-in). Anything else — ValueError from config
+    validation, user bugs — propagates untouched."""
+    types: list = [chaos_lib.ChaosInjection]
+    import jax
+
+    err = getattr(jax.errors, "JaxRuntimeError", None)
+    if err is not None:
+        types.append(err)
+    try:
+        from jax._src.lib import xla_client
+
+        types.append(xla_client.XlaRuntimeError)
+    except Exception:  # noqa: BLE001 — optional import, version-dependent
+        pass
+    return tuple(types)
+
+
+def _dispatch_error_kind(e: BaseException) -> Optional[str]:
+    """"oom" / "transient" / None (= not ours to handle, re-raise)."""
+    msg = str(e)
+    if any(m in msg for m in _OOM_MARKERS):
+        return "oom"
+    if any(m in msg for m in _TRANSIENT_MARKERS):
+        return "transient"
+    return None
+
+
+def _backoff(attempt: int) -> float:
+    return min(COHORT_BACKOFF_S * (2 ** (attempt - 1)), COHORT_BACKOFF_CAP_S)
+
+
+def _train_one_guarded(
+    label: str, cfg: RunConfig, dataset: Dataset, arrivals
+) -> "trainer.TrainResult":
+    """Sequential train() with capped-backoff retry for transient runtime
+    failures. OOM and persistent failures propagate — sequential is the
+    bottom of the degradation ladder."""
+    from erasurehead_tpu.obs import events as obs_events
+    from erasurehead_tpu.obs.metrics import REGISTRY as _metrics
+
+    attempts = 0
+    while True:
+        try:
+            return trainer.train(cfg, dataset, arrivals=arrivals)
+        except _guarded_error_types() as e:
+            if (
+                _dispatch_error_kind(e) != "transient"
+                or attempts >= COHORT_MAX_RETRIES
+            ):
+                raise
+            attempts += 1
+            _metrics.counter("cohort.retry").inc()
+            obs_events.emit(
+                "warning",
+                kind="cohort_retry",
+                message=(
+                    f"sequential train of {label!r} hit a transient "
+                    f"failure (attempt {attempts}): "
+                    f"{str(e).splitlines()[0][:160]}"
+                ),
+            )
+            time.sleep(_backoff(attempts))
+
+
+def _dispatch_cohort(
+    labels: list, configs: dict, dataset: Dataset, arrivals
+) -> dict:
+    """Guarded trajectory-batched dispatch: try the cohort as ONE compiled
+    scan; on RESOURCE_EXHAUSTED bisect into halves (half the live set per
+    dispatch), on transients retry with capped backoff first; bottom out
+    at sequential train(). Every degradation step increments a counter
+    (``cohort.retry`` / ``cohort.split`` / ``cohort.sequential_fallback``)
+    and emits a ``warning`` event naming the failed cohort composition, so
+    a degraded sweep is diagnosable from its event log."""
+    from erasurehead_tpu.obs import events as obs_events
+    from erasurehead_tpu.obs.metrics import REGISTRY as _metrics, warn_once
+
+    attempts = 0
+    while True:
+        try:
+            results = trainer.train_cohort(
+                [configs[l] for l in labels], dataset, arrivals=arrivals
+            )
+            return dict(zip(labels, results))
+        except _guarded_error_types() as e:
+            kind = _dispatch_error_kind(e)
+            if kind is None:
+                raise
+            head = str(e).splitlines()[0][:160]
+            obs_events.emit(
+                "warning",
+                kind="cohort_dispatch",
+                message=(
+                    f"cohort dispatch failed ({kind}) for "
+                    f"{len(labels)} trajectories {list(labels)}: {head}"
+                ),
+            )
+            warn_once(
+                "cohort_dispatch",
+                f"sweep: cohort dispatch failed ({kind}); degrading via "
+                f"retry/bisection — first failure: {list(labels)}: {head}",
+            )
+            if kind == "oom":
+                # release the data cache's HBM pins before the bisected
+                # retries: the halves re-upload what they need, but they
+                # don't contend with stacks no live run is using
+                from erasurehead_tpu.train import cache as cache_lib
+
+                cache_lib.drop_data_cache()
+            if kind == "transient" and attempts < COHORT_MAX_RETRIES:
+                attempts += 1
+                _metrics.counter("cohort.retry").inc()
+                time.sleep(_backoff(attempts))
+                continue
+            break  # degrade: bisect (or sequential for a singleton)
+    if len(labels) == 1:
+        _metrics.counter("cohort.sequential_fallback").inc()
+        obs_events.emit(
+            "warning",
+            kind="cohort_fallback",
+            message=(
+                f"trajectory {labels[0]!r} falls back to sequential "
+                f"train() after cohort dispatch failure"
+            ),
+        )
+        return {
+            labels[0]: _train_one_guarded(
+                labels[0], configs[labels[0]], dataset, arrivals
+            )
+        }
+    mid = len(labels) // 2
+    lo, hi = list(labels[:mid]), list(labels[mid:])
+    _metrics.counter("cohort.split").inc()
+    obs_events.emit(
+        "warning",
+        kind="cohort_split",
+        message=f"bisecting failed cohort {list(labels)} -> {lo} + {hi}",
+    )
+    out = _dispatch_cohort(lo, configs, dataset, arrivals)
+    out.update(_dispatch_cohort(hi, configs, dataset, arrivals))
+    return out
+
+
 def _run_configs(
     configs: dict[str, RunConfig],
     dataset: Dataset,
     arrivals,
     batch: str,
+    on_result: Optional[Callable] = None,
 ) -> dict[str, "trainer.TrainResult"]:
-    """Train every config, dispatching cohorts through train_cohort per
-    the resolved ``batch`` mode ('on'/'off'/'auto'); returns label ->
-    TrainResult. Sequential fallbacks (mode 'off', singletons under
-    'auto', ineligible configs) go through plain train()."""
+    """Train every config, dispatching cohorts through the guarded
+    train_cohort path per the resolved ``batch`` mode ('on'/'off'/'auto');
+    returns label -> TrainResult. Sequential fallbacks (mode 'off',
+    singletons under 'auto', ineligible configs) go through plain train().
+
+    ``on_result(label, result)`` is invoked as each trajectory's result
+    becomes available (per member after a cohort dispatch lands; per run
+    on the sequential path) — the journaling/quarantine hook: a sweep
+    interrupted mid-plan keeps everything already handed over."""
     from erasurehead_tpu.obs.metrics import REGISTRY as _metrics
 
     raw: dict = {}
+
+    def _finish(label, result):
+        raw[label] = result
+        if on_result is not None:
+            on_result(label, result)
+
     if batch == "off":
         plan = [([label], False) for label in configs]
     else:
@@ -149,17 +341,73 @@ def _run_configs(
     min_size = 1 if batch == "on" else 2
     for labels, batchable in plan:
         if batchable and len(labels) >= min_size:
-            results = trainer.train_cohort(
-                [configs[l] for l in labels], dataset, arrivals=arrivals
+            results = _dispatch_cohort(
+                list(labels), configs, dataset, arrivals
             )
-            raw.update(zip(labels, results))
+            for l in labels:
+                _finish(l, results[l])
         else:
             for l in labels:
                 _metrics.counter("cohort.sequential_runs").inc()
-                raw[l] = trainer.train(
-                    configs[l], dataset, arrivals=arrivals
+                _finish(
+                    l, _train_one_guarded(l, configs[l], dataset, arrivals)
                 )
     return raw
+
+
+def _diverged(result, ev, tail: int = 8) -> bool:
+    """Did this trajectory diverge? NaN/Inf anywhere in the final params,
+    or in the tail of the training-loss curve (a trajectory that blew up
+    and 'recovered' to NaN stays NaN — checking only the last entry would
+    miss an Inf overshoot that saturated)."""
+    import jax
+
+    for leaf in jax.tree.leaves(result.final_params):
+        if not np.isfinite(np.asarray(leaf)).all():
+            return True
+    tail_losses = np.asarray(ev.training_loss)[-tail:]
+    return bool(tail_losses.size) and not bool(
+        np.isfinite(tail_losses).all()
+    )
+
+
+def _validate_shared_shape(configs: dict[str, RunConfig]) -> None:
+    """compare()'s paired-schedule contract: every config shares rounds
+    and n_workers. A ValueError (asserts vanish under ``python -O``)
+    naming the offending labels, not just "configs must share shape"."""
+    if not configs:
+        raise ValueError("compare() needs at least one config")
+    rounds = {c.rounds for c in configs.values()}
+    workers = {c.n_workers for c in configs.values()}
+    if len(rounds) != 1 or len(workers) != 1:
+        detail = ", ".join(
+            f"{label!r}: rounds={cfg.rounds}, workers={cfg.n_workers}"
+            for label, cfg in configs.items()
+        )
+        raise ValueError(
+            "compare() configs must share rounds and n_workers (one "
+            f"arrival schedule pairs the whole set); got {detail}"
+        )
+
+
+def _default_target_loss(
+    summaries: dict[str, RunSummary],
+) -> Optional[float]:
+    """compare()'s default loss target: 1.05x the uncoded baseline's final
+    train loss when a 'naive' row exists (and converged), else the worst
+    final loss across converged rows. Diverged rows are quarantined out —
+    a NaN target would silently void every time_to_target. None when
+    nothing converged."""
+    ok = {
+        label: s
+        for label, s in summaries.items()
+        if s.status == "ok" and np.isfinite(s.final_train_loss)
+    }
+    if "naive" in ok:
+        return 1.05 * float(ok["naive"].final_train_loss)
+    if ok:
+        return float(max(s.final_train_loss for s in ok.values()))
+    return None
 
 
 def compare(
@@ -168,36 +416,73 @@ def compare(
     target_loss: Optional[float] = None,
     arrivals: Optional[np.ndarray] = None,
     batch: Optional[str] = None,
+    journal=None,
 ) -> list[RunSummary]:
     """Train every config on ``dataset`` under one shared arrival schedule
     and summarize. ``target_loss`` default: 1.05x the uncoded baseline's
     final train loss (if a config labeled 'naive' is present), else the
-    worst final loss across runs.
+    worst final loss across runs (diverged rows excluded — see below).
 
     ``batch`` picks the trajectory-batched dispatch mode ('on'/'off'/
     'auto'; None = the --batch-trajectories flag/env default, see
     utils.config.resolve_batch_trajectories): under 'auto'/'on', configs
     sharing a device data stack (plan_cohorts) run as ONE compiled cohort
     scan — a deduped 7-scheme sweep streams X from HBM once per round for
-    all schemes instead of once per scheme."""
+    all schemes instead of once per scheme. Cohort dispatch failures
+    degrade gracefully (retry / bisect / sequential, ``_dispatch_cohort``)
+    instead of killing the sweep.
+
+    ``journal`` is a :class:`train.journal.SweepJournal` (None = the
+    ambient ``ERASUREHEAD_SWEEP_JOURNAL`` journal, if any): every finished
+    trajectory's summary row is journaled as it completes, and in resume
+    mode trajectories whose (label, config, data, arrivals) key is already
+    journaled are REHYDRATED instead of re-trained — a resumed sweep's
+    rows are identical to an uninterrupted one's (time_to_target is
+    re-derived from the journaled curves for fresh and rehydrated rows
+    alike, so the shared target can never drift between them).
+
+    Divergence quarantine: a trajectory whose final params or loss tail
+    went NaN/Inf gets ``status="diverged"`` — kept in the output (rendered
+    distinctly), excluded from target aggregation, ``time_to_target=None``
+    — and the sweep continues.
+    """
+    from erasurehead_tpu.obs import events as obs_events
+    from erasurehead_tpu.obs.metrics import REGISTRY as _metrics
+    from erasurehead_tpu.train import journal as journal_lib
     from erasurehead_tpu.utils.config import resolve_batch_trajectories
 
-    rounds = {c.rounds for c in configs.values()}
-    workers = {c.n_workers for c in configs.values()}
-    assert len(rounds) == 1 and len(workers) == 1, "configs must share shape"
+    _validate_shared_shape(configs)
     if arrivals is None:
         any_cfg = next(iter(configs.values()))
         arrivals = straggler.arrival_schedule(
-            rounds.pop(), workers.pop(), add_delay=True, mean=any_cfg.delay_mean
+            any_cfg.rounds, any_cfg.n_workers, add_delay=True,
+            mean=any_cfg.delay_mean,
         )
 
-    results = _run_configs(
-        configs, dataset, arrivals, resolve_batch_trajectories(batch)
-    )
-    raw = {}
-    for label in configs:
-        res = results[label]
-        cfg = configs[label]
+    if journal is None:
+        journal = journal_lib.from_env()
+    keys: dict[str, str] = {}
+    summaries: dict[str, RunSummary] = {}
+    pending: dict[str, RunConfig] = {}
+    for label, cfg in configs.items():
+        if journal is not None:
+            keys[label] = journal_lib.trajectory_key(
+                label, cfg, dataset, arrivals
+            )
+            rec = journal.lookup(keys[label])
+            if rec is not None:
+                summaries[label] = journal_lib.rehydrate_summary(
+                    rec["row"], cfg
+                )
+                _metrics.counter("sweep_journal.resumed").inc()
+                continue
+        pending[label] = cfg
+
+    def _finish(label, res):
+        """Per-trajectory completion: eval replay, divergence quarantine,
+        journal append, chaos hook — runs as each result lands, so an
+        interruption mid-sweep loses at most the in-flight dispatch."""
+        cfg = pending[label]
         model = trainer.build_model(cfg)
         n = res.n_train
         ev = evaluate.replay(
@@ -209,47 +494,66 @@ def compare(
             dataset.X_test,
             dataset.y_test,
         )
-        raw[label] = (res, ev)
-
-    if target_loss is None:
-        if "naive" in raw:
-            target_loss = 1.05 * float(raw["naive"][1].training_loss[-1])
-        else:
-            target_loss = float(
-                max(ev.training_loss[-1] for _, ev in raw.values())
-            )
-
-    out = []
-    for label, (res, ev) in raw.items():
-        out.append(
-            RunSummary(
-                label=label,
-                config=res.config,
-                sim_total_time=res.sim_total_time,
-                sim_steps_per_sec=(
-                    res.config.rounds / res.sim_total_time
-                    if res.sim_total_time > 0
-                    else float("inf")  # zero arrival schedule (no delays)
-                ),
-                real_steps_per_sec=res.steps_per_sec,
-                final_train_loss=float(ev.training_loss[-1]),
-                final_test_loss=float(ev.testing_loss[-1]),
-                final_auc=float(ev.auc[-1]),
-                time_to_target=time_to_target_loss(
-                    ev.training_loss, res.timeset, target_loss
-                ),
-                training_loss=ev.training_loss,
-                timeset=res.timeset,
-                cache=res.cache_info,
-                decode_error_mean=(
-                    float(np.mean(res.decode_error))
-                    if res.decode_error is not None
-                    and len(res.decode_error)
-                    else None
+        diverged = _diverged(res, ev)
+        if diverged:
+            _metrics.counter("sweep.diverged").inc()
+            obs_events.emit(
+                "warning",
+                kind="divergence",
+                message=(
+                    f"trajectory {label!r} (scheme "
+                    f"{res.config.scheme.value}, seed {res.config.seed}) "
+                    "diverged (NaN/Inf final params or loss tail); row "
+                    "quarantined as status=diverged, sweep continues"
                 ),
             )
+        summaries[label] = RunSummary(
+            label=label,
+            config=res.config,
+            sim_total_time=res.sim_total_time,
+            sim_steps_per_sec=(
+                res.config.rounds / res.sim_total_time
+                if res.sim_total_time > 0
+                else float("inf")  # zero arrival schedule (no delays)
+            ),
+            real_steps_per_sec=res.steps_per_sec,
+            final_train_loss=float(ev.training_loss[-1]),
+            final_test_loss=float(ev.testing_loss[-1]),
+            final_auc=float(ev.auc[-1]),
+            time_to_target=None,  # assigned below, once the target exists
+            training_loss=ev.training_loss,
+            timeset=res.timeset,
+            cache=res.cache_info,
+            decode_error_mean=(
+                float(np.mean(res.decode_error))
+                if res.decode_error is not None
+                and len(res.decode_error)
+                else None
+            ),
+            status="diverged" if diverged else "ok",
         )
-    return out
+        if journal is not None:
+            journal.record(keys[label], label, summaries[label])
+        chaos_lib.maybe_fire("trajectory")
+
+    if pending:
+        _run_configs(
+            pending, dataset, arrivals, resolve_batch_trajectories(batch),
+            on_result=_finish,
+        )
+
+    # one shared target across rehydrated + fresh rows, re-derived every
+    # time from the (bit-stable) journaled curves — a resumed sweep and an
+    # uninterrupted one agree row for row
+    if target_loss is None:
+        target_loss = _default_target_loss(summaries)
+    for s in summaries.values():
+        s.time_to_target = (
+            time_to_target_loss(s.training_loss, s.timeset, target_loss)
+            if s.status == "ok" and target_loss is not None
+            else None
+        )
+    return [summaries[label] for label in configs]
 
 
 def straggler_sweep(
@@ -259,7 +563,14 @@ def straggler_sweep(
     **compare_kw,
 ) -> list[RunSummary]:
     """The reference's headline figure: each scheme across straggler counts
-    (time-to-target-loss vs n_stragglers, BASELINE.json metric)."""
+    (time-to-target-loss vs n_stragglers, BASELINE.json metric).
+    ``compare_kw`` passes through to :func:`compare` (``batch``,
+    ``journal``, ``target_loss``, ...)."""
+    if not scheme_stragglers or not any(scheme_stragglers.values()):
+        raise ValueError(
+            "straggler_sweep needs at least one (scheme, straggler-count) "
+            f"entry; got {scheme_stragglers!r}"
+        )
     configs = {}
     for scheme, s_values in scheme_stragglers.items():
         for s in s_values:
@@ -276,6 +587,7 @@ def baseline_suite(
     data_dir: Optional[str] = None,
     rounds: int = 100,
     batch: Optional[str] = None,
+    journal=None,
 ) -> dict[str, list[RunSummary]]:
     """Reproduce the five BASELINE.json comparison configs.
 
@@ -286,7 +598,10 @@ def baseline_suite(
     the suite labels record the substitution. Returns {config_name: summaries}.
     ``batch`` is the trajectory-batched dispatch mode threaded into every
     compare() (see :func:`compare`; the suite's configs are mostly
-    singletons, so 'auto' leaves them sequential).
+    singletons, so 'auto' leaves them sequential). ``journal`` threads a
+    sweep journal (train/journal.py) into every compare(), making the
+    whole suite preemption-safe: trajectories persist as they finish and
+    a resumed suite skips them.
     """
     from erasurehead_tpu.data.synthetic import (
         generate_gmm,
@@ -405,7 +720,8 @@ def baseline_suite(
     )
     name = f"1_naive_covtype[{src}]"
     out[name] = tag(
-        compare({"naive": cfg}, ds, batch=batch), name, src, "covtype"
+        compare({"naive": cfg}, ds, batch=batch, journal=journal),
+        name, src, "covtype"
     )
 
     # 2. Logistic on amazon, exact cyclic-MDS coding, s=2 (configs[1])
@@ -416,7 +732,8 @@ def baseline_suite(
     )
     name = f"2_egc_amazon[{src}]"
     out[name] = tag(
-        compare({"cyccoded_s2": cfg}, ds, batch=batch), name, src, "amazon"
+        compare({"cyccoded_s2": cfg}, ds, batch=batch, journal=journal),
+        name, src, "amazon"
     )
 
     # 3. Least-squares on kc_house, AGC with num_collect=N-3 (configs[2])
@@ -428,8 +745,9 @@ def baseline_suite(
     )
     name = f"3_agc_kc_house[{src}]"
     out[name] = tag(
-        compare({"agc_collect_N-3": cfg}, ds, batch=batch), name, src,
-        "kc_house_data"
+        compare({"agc_collect_N-3": cfg}, ds, batch=batch,
+                journal=journal),
+        name, src, "kc_house_data"
     )
 
     # 4. Synthetic: partial_replication vs avoidstragg over n_stragglers
@@ -452,12 +770,23 @@ def baseline_suite(
                 update_rule="AGD", partitions_per_worker=ppw,
             )
             sweep.extend(
-                compare({f"{scheme}_s{s}": c}, d, arrivals=arr, batch=batch)
+                compare({f"{scheme}_s{s}": c}, d, arrivals=arr, batch=batch,
+                        journal=journal)
             )
-    shared_target = 1.05 * min(s.final_train_loss for s in sweep)
+    # diverged rows are quarantined out of the anchor: a NaN min() would
+    # silently void every row's time_to_target (and min() over an empty
+    # all-diverged sweep would crash the suite)
+    anchors = [
+        s.final_train_loss
+        for s in sweep
+        if s.status == "ok" and np.isfinite(s.final_train_loss)
+    ]
+    shared_target = 1.05 * min(anchors) if anchors else None
     for s in sweep:
-        s.time_to_target = time_to_target_loss(
-            s.training_loss, s.timeset, shared_target
+        s.time_to_target = (
+            time_to_target_loss(s.training_loss, s.timeset, shared_target)
+            if shared_target is not None and s.status == "ok"
+            else None
         )
     out["4_partialrep_vs_avoidstragg_sweep"] = tag(
         sweep, "4_partialrep_vs_avoidstragg_sweep"
@@ -471,7 +800,8 @@ def baseline_suite(
     )
     name = f"5_mlp_agc[{src}]"
     out[name] = tag(
-        compare({"mlp_agc": cfg}, ds, batch=batch), name, src, "covtype"
+        compare({"mlp_agc": cfg}, ds, batch=batch, journal=journal),
+        name, src, "covtype"
     )
     return out
 
@@ -500,9 +830,16 @@ def format_table(summaries: list[RunSummary]) -> str:
             if s.decode_error_mean is not None
             else "       -"
         )
+        # quarantined rows render distinctly: a NaN printed as a number
+        # reads like a measurement; "diverged" reads like the verdict it is
+        loss = (
+            f"{s.final_train_loss:11.6f}"
+            if s.status == "ok" and np.isfinite(s.final_train_loss)
+            else f"{'diverged' if s.status == 'diverged' else '-':>11s}"
+        )
         lines.append(
             f"{s.label:22s} {s.sim_steps_per_sec:9.3f} "
-            f"{s.real_steps_per_sec:10.1f} {s.final_train_loss:11.6f} "
+            f"{s.real_steps_per_sec:10.1f} {loss} "
             f"{auc} {ttt} {derr}"
         )
     return "\n".join(lines)
@@ -535,7 +872,38 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "for the whole cohort. Default: "
                         "ERASUREHEAD_BATCH_TRAJECTORIES env, else auto "
                         "(batch cohorts of >= 2)")
+    p.add_argument("--sweep-journal", default=None, metavar="DIR",
+                   help="journal each trajectory's summary row into "
+                        "DIR/sweep_journal.jsonl as it finishes "
+                        "(train/journal.py) — the suite becomes "
+                        "preemption-safe. Default: "
+                        "ERASUREHEAD_SWEEP_JOURNAL env, else off")
+    p.add_argument("--resume-sweep", action="store_true",
+                   help="skip trajectories the sweep journal already "
+                        "completed (matching config + data + arrival "
+                        "digests), rehydrating their rows — a resumed "
+                        "suite's output is row-for-row identical to an "
+                        "uninterrupted one. Requires --sweep-journal (or "
+                        "the env var); ERASUREHEAD_RESUME_SWEEP=1 does "
+                        "the same")
     ns = p.parse_args(argv)
+
+    from erasurehead_tpu.train import journal as journal_lib
+    from erasurehead_tpu.utils.config import (
+        resolve_resume_sweep,
+        resolve_sweep_journal,
+    )
+
+    journal_dir = resolve_sweep_journal(ns.sweep_journal)
+    resume = resolve_resume_sweep(True if ns.resume_sweep else None)
+    if resume and journal_dir is None:
+        p.error("--resume-sweep requires --sweep-journal DIR (or "
+                "ERASUREHEAD_SWEEP_JOURNAL)")
+    journal = (
+        journal_lib.SweepJournal(journal_dir, resume=resume)
+        if journal_dir
+        else None
+    )
 
     if ns.events:
         from erasurehead_tpu.obs import events as events_lib
@@ -543,11 +911,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         sink = events_lib.capture(ns.events)
     else:
         sink = contextlib.nullcontext()
-    with sink:
-        suite = baseline_suite(
-            scale=ns.scale, data_dir=ns.data_dir, rounds=ns.rounds,
-            batch=ns.batch_trajectories,
-        )
+    try:
+        with sink:
+            suite = baseline_suite(
+                scale=ns.scale, data_dir=ns.data_dir, rounds=ns.rounds,
+                batch=ns.batch_trajectories, journal=journal,
+            )
+    finally:
+        if journal is not None:
+            journal.close()
+    if journal is not None:
+        print(f"sweep journal -> {journal.path}")
     all_rows: list[RunSummary] = []
     for name, summaries in suite.items():
         print(f"\n== {name} ==")

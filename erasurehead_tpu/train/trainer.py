@@ -462,7 +462,19 @@ def _memory_analysis(compiled) -> Optional[dict]:
             "alias_bytes": int(ma.alias_size_in_bytes),
             "generated_code_bytes": int(ma.generated_code_size_in_bytes),
         }
-    except Exception:  # noqa: BLE001 — telemetry must never fail a run
+    except Exception as e:  # noqa: BLE001 — telemetry must never fail a run
+        # ...but it must not fail SILENTLY either: count every swallow and
+        # say so once on stderr, so a sweep whose memory telemetry went
+        # dark is diagnosable instead of just mysteriously column-less
+        from erasurehead_tpu.obs.metrics import REGISTRY, warn_once
+
+        REGISTRY.counter("telemetry.emit_errors").inc()
+        warn_once(
+            "memory_analysis",
+            f"telemetry: memory_analysis unavailable on this backend "
+            f"({type(e).__name__}: {e}); memory columns will be null "
+            f"(counted in telemetry.emit_errors)",
+        )
         return None
 
 
@@ -699,19 +711,22 @@ def train(
     if resume and checkpoint_dir:
         from erasurehead_tpu.train import checkpoint as ckpt_lib
 
-        path = ckpt_lib.latest(checkpoint_dir)
-        if path is None:
+        # restore_latest skips partially-written/corrupt round_N dirs
+        # (killed mid-save) with a warning, falling back to the next-older
+        # valid checkpoint instead of crashing the resume on a torn one
+        restored = ckpt_lib.restore_latest(checkpoint_dir, state0)
+        if restored is None:
             # loud, not fatal: restart loops (k8s JobSet, tpu_fleet
             # launch_run) legitimately pass resume=True on the FIRST
             # attempt, before any checkpoint exists. A typo'd dir gets the
             # same message rather than silently overwriting prior artifacts.
             print(
-                f"train: resume requested but no checkpoint found under "
-                f"{checkpoint_dir!r}; starting from round 0",
+                f"train: resume requested but no usable checkpoint found "
+                f"under {checkpoint_dir!r}; starting from round 0",
                 file=sys.stderr,
             )
         else:
-            state0, start_round = ckpt_lib.restore(path, state0)
+            state0, start_round, _ = restored
 
     state0 = replicate(state0)
 
@@ -1013,7 +1028,13 @@ def train_cohort(
 @_with_run_sparse_lanes
 def _train_cohort_impl(cfg, dataset, cfgs, mesh, arrivals, measure):
     from erasurehead_tpu.train import cache as cache_lib
+    from erasurehead_tpu.utils import chaos as chaos_lib
 
+    # chaos site "cohort": an injected kill here is a preemption mid-cohort
+    # (nothing of the cohort persisted); an injected raise exercises the
+    # sweep guard's OOM-bisection / transient-retry path
+    # (experiments._dispatch_cohort)
+    chaos_lib.maybe_fire("cohort")
     stats_before = cache_lib.stats().snapshot()
     B = len(cfgs)
     faithful = cfg.compute_mode == ComputeMode.FAITHFUL

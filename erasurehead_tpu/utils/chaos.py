@@ -1,0 +1,136 @@
+"""Chaos injection hook: deterministic fault injection for the sweep runner.
+
+The resilience machinery (sweep journal + resume, cohort OOM bisection,
+checkpoint fallback) exists to survive failures that are awkward to produce
+on demand — a preemption mid-sweep, a cohort dispatch blowing HBM, a kill
+mid-checkpoint-save. This module makes those failures *reproducible*: the
+``ERASUREHEAD_CHAOS`` env var arms exactly one fault, and instrumented call
+sites (:func:`maybe_fire`) trigger it at a deterministic invocation count.
+The chaos harness (tools/chaos_sweep.py, ``make chaos-smoke``) drives
+kill→resume cycles through it and asserts the resumed sweep's rows are
+identical to an uninterrupted baseline.
+
+Spec grammar (``ERASUREHEAD_CHAOS=mode:site:count[:message]``):
+
+  - ``mode``   — ``kill`` (the process dies via ``os._exit`` with
+                 :data:`KILL_EXIT`, simulating a preemption: no cleanup, no
+                 atexit, nothing flushed beyond what already hit disk) or
+                 ``raise`` (a :class:`ChaosInjection` whose message carries
+                 an XLA-style status marker, default ``RESOURCE_EXHAUSTED``,
+                 so the cohort-degradation guard exercises its real
+                 classification path);
+  - ``site``   — which instrumented hook arms: ``trajectory`` (after a
+                 sweep trajectory's summary row is finalized/journaled —
+                 experiments.compare), ``cohort`` (at the head of a
+                 trajectory-batched cohort dispatch — trainer.train_cohort),
+                 ``checkpoint`` (at the head of checkpoint.save, i.e. the
+                 save never commits);
+  - ``count``  — fire on the Nth invocation of that site (``2``), or on the
+                 Nth and every later one (``2+`` — e.g. ``raise:cohort:1+``
+                 fails every cohort dispatch, forcing full degradation to
+                 sequential train());
+  - ``message``— optional fault text; the guard classifies transients vs
+                 OOM from it (``raise:cohort:1:UNAVAILABLE`` produces a
+                 retryable transient instead of an OOM-style failure).
+
+The hook is a no-op when the env var is unset; library code pays one dict
+lookup. Invocation counters are process-global (:func:`reset` for tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+#: env var arming the fault
+CHAOS_ENV = "ERASUREHEAD_CHAOS"
+
+#: exit code of a chaos kill — distinctive, so harnesses can tell an
+#: injected preemption from a genuine crash
+KILL_EXIT = 43
+
+#: instrumented call sites
+SITES = ("trajectory", "cohort", "checkpoint")
+
+
+class ChaosInjection(RuntimeError):
+    """An injected fault (mode ``raise``); the message carries the
+    configured status marker so error classifiers treat it like the real
+    failure it stands in for."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSpec:
+    mode: str  # "kill" | "raise"
+    site: str
+    count: int  # 1-based invocation number that fires
+    sticky: bool  # True = fire on count and every later invocation
+    message: str
+
+
+def parse_spec(spec: str) -> ChaosSpec:
+    """Parse ``mode:site:count[:message]``; loud on malformed specs — a
+    typo'd chaos run silently doing nothing would invalidate the harness."""
+    parts = spec.split(":", 3)
+    if len(parts) < 3:
+        raise ValueError(
+            f"{CHAOS_ENV}={spec!r}: want mode:site:count[:message]"
+        )
+    mode, site, count = parts[0], parts[1], parts[2]
+    message = parts[3] if len(parts) > 3 else "RESOURCE_EXHAUSTED"
+    if mode not in ("kill", "raise"):
+        raise ValueError(f"{CHAOS_ENV}={spec!r}: mode must be kill|raise")
+    if site not in SITES:
+        raise ValueError(
+            f"{CHAOS_ENV}={spec!r}: site must be one of {SITES}"
+        )
+    sticky = count.endswith("+")
+    try:
+        n = int(count[:-1] if sticky else count)
+    except ValueError:
+        raise ValueError(
+            f"{CHAOS_ENV}={spec!r}: count must be an int or 'N+'"
+        ) from None
+    if n < 1:
+        raise ValueError(f"{CHAOS_ENV}={spec!r}: count must be >= 1")
+    return ChaosSpec(
+        mode=mode, site=site, count=n, sticky=sticky, message=message
+    )
+
+
+_counts: dict[str, int] = {}
+
+
+def reset() -> None:
+    """Zero the per-site invocation counters (tests)."""
+    _counts.clear()
+
+
+def active() -> Optional[ChaosSpec]:
+    """The armed spec, or None when chaos is off."""
+    spec = os.environ.get(CHAOS_ENV)
+    return parse_spec(spec) if spec else None
+
+
+def maybe_fire(site: str) -> None:
+    """Count one invocation of ``site``; fire the armed fault if its
+    trigger condition is met. No-op (beyond one env lookup) when unarmed."""
+    if CHAOS_ENV not in os.environ:
+        return
+    spec = active()
+    if spec is None or spec.site != site:
+        return
+    _counts[site] = _counts.get(site, 0) + 1
+    n = _counts[site]
+    if n != spec.count and not (spec.sticky and n > spec.count):
+        return
+    if spec.mode == "kill":
+        # preemption semantics: no cleanup, no atexit — only what already
+        # reached disk (the journal flushes per line) survives
+        os._exit(KILL_EXIT)
+    raise ChaosInjection(
+        f"{spec.message}: chaos injection at site {site!r} "
+        f"(invocation {n}, spec {spec.mode}:{spec.site}:"
+        f"{spec.count}{'+' if spec.sticky else ''})"
+    )

@@ -541,6 +541,51 @@ def resolve_batch_trajectories(
     )
 
 
+#: env var enabling the sweep journal (train/journal.py) when no journal
+#: is passed explicitly: its value is the journal DIRECTORY
+SWEEP_JOURNAL_ENV = "ERASUREHEAD_SWEEP_JOURNAL"
+
+#: env var enabling resume-from-journal (skip already-completed
+#: trajectories) when the CLI flag is absent
+RESUME_SWEEP_ENV = "ERASUREHEAD_RESUME_SWEEP"
+
+
+def resolve_sweep_journal(
+    flag: Optional[str] = None, env: Optional[str] = None
+) -> Optional[str]:
+    """The sweep-journal directory, or None (journaling off).
+
+    Precedence mirrors the other sweep knobs: explicit CLI ``--sweep-
+    journal DIR`` flag > :data:`SWEEP_JOURNAL_ENV` env var > off. ``env``
+    overrides the real environment lookup (tests)."""
+    val = flag
+    if val is None:
+        val = env if env is not None else os.environ.get(SWEEP_JOURNAL_ENV)
+    return val or None
+
+
+def resolve_resume_sweep(
+    flag: Optional[bool] = None, env: Optional[str] = None
+) -> bool:
+    """Should a journaled sweep SKIP trajectories its journal already
+    completed? Explicit flag > :data:`RESUME_SWEEP_ENV` truthy/falsy env
+    value > False (record-only). ``env`` overrides the real environment
+    lookup (tests)."""
+    if flag is not None:
+        return bool(flag)
+    val = env if env is not None else os.environ.get(RESUME_SWEEP_ENV)
+    if val is None or val == "":
+        return False
+    val = str(val).strip().lower()
+    if val in _TELEMETRY_ON:
+        return True
+    if val in _TELEMETRY_OFF:
+        return False
+    raise ValueError(
+        f"{RESUME_SWEEP_ENV} must be truthy/falsy, got {val!r}"
+    )
+
+
 #: env var controlling run telemetry when the CLI flag is absent
 #: (mirrors ERASUREHEAD_SWEEP_CACHE's flag > env > default precedence)
 TELEMETRY_ENV = "ERASUREHEAD_TELEMETRY"

@@ -189,6 +189,64 @@ def test_failover_requires_finite_timeout(arrivals):
         )
 
 
+def test_failover_all_dead_round_raises(arrivals):
+    """The k == 0 path: a round where EVERY worker is presumed dead has no
+    survivors to rescale over — failover must raise InfeasibleRunError,
+    not divide by zero or emit a zero-weight round masquerading as
+    progress."""
+    layout = codes.uncoded_layout(W)
+    t = failures.inject_worker_death(arrivals, {w: 2 for w in range(W)})
+    rep = failures.analyze(Scheme.NAIVE, layout, t, timeout=50.0)
+    assert not rep.all_feasible
+    sched = collect.build_schedule(Scheme.NAIVE, t, layout)
+    with pytest.raises(failures.InfeasibleRunError):
+        failures.failover_schedule(sched, layout, t, rep, timeout=50.0)
+
+
+def test_failover_schedule_rejects_partial_layout_directly(arrivals):
+    """failover_schedule itself (not just plan_run) refuses partial
+    layouts: their uncoded first-parts are structurally required, so no
+    best-effort decode exists."""
+    layout = codes.partial_cyclic_layout(W, S + 2, S, seed=0)
+    t = failures.inject_worker_death(arrivals, {0: 0})
+    rep = failures.analyze(Scheme.PARTIAL_CYCLIC, layout, t, timeout=50.0)
+    assert not rep.all_feasible
+    sched = collect.build_schedule(Scheme.PARTIAL_CYCLIC, t, layout)
+    with pytest.raises(failures.InfeasibleRunError):
+        failures.failover_schedule(sched, layout, t, rep, timeout=50.0)
+
+
+def test_failover_finite_timeout_rule_applies_to_deadline_scheme(arrivals):
+    """The finite-timeout requirement interacts with the deadline scheme:
+    deadline collection is ALWAYS feasible (a dead worker just never
+    arrives), yet on_infeasible='failover' still demands a finite timeout
+    up front — the check guards the sim-clock contract, not a particular
+    schedule. With a finite timeout, the deadline schedule sails through
+    untouched."""
+    t = failures.inject_worker_death(arrivals, {0: 0, 1: 0})
+    layout = codes.uncoded_layout(W)
+    # infinite timeout refused regardless of feasibility
+    with pytest.raises(ValueError, match="finite timeout"):
+        failures.plan_run(
+            Scheme.DEADLINE, layout, t, deadline=1.0,
+            on_infeasible="failover",
+        )
+    # finite timeout: all rounds feasible, schedule identical to plain
+    sched, rep = failures.plan_run(
+        Scheme.DEADLINE, layout, t, deadline=1.0, timeout=50.0,
+        on_infeasible="failover",
+    )
+    assert rep.all_feasible
+    ref = collect.build_schedule(Scheme.DEADLINE, t, layout, deadline=1.0)
+    np.testing.assert_array_equal(
+        sched.message_weights, ref.message_weights
+    )
+    np.testing.assert_array_equal(sched.sim_time, ref.sim_time)
+    # every round's protocol cost is bounded by the deadline, dead workers
+    # included (they simply never arrive)
+    assert (sched.sim_time <= 1.0 + 1e-9).all()
+
+
 def test_elastic_restart_continues_training():
     """train_elastic: full-W phase until the earliest death, re-shard onto
     survivors, optimizer state carries over, loss curve stays continuous

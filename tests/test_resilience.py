@@ -1,0 +1,507 @@
+"""Resilient sweep runner: journaled resume, cohort OOM bisection,
+divergence quarantine, checkpoint-corruption fallback, chaos hook.
+
+The sweep engine produces the paper's central artifact; these tests pin
+the contract that no single failure — preemption, cohort OOM, transient
+runtime error, diverging trajectory, torn checkpoint — can destroy it:
+
+  - a sweep interrupted by the chaos hook after trajectory N, then resumed
+    from its journal, produces summary rows IDENTICAL (labels, simulated
+    clocks, losses bitwise-equal, decode-error columns) to the
+    uninterrupted sweep, across batch-trajectories on/off/auto;
+  - a forced cohort dispatch failure degrades through bisection to
+    sequential without losing any trajectory, with the cohort.split /
+    cohort.retry counters and warning events asserting the path taken;
+  - a seeded diverging trajectory yields a status=diverged row while every
+    other row matches the sweep run without it.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from erasurehead_tpu.data.synthetic import generate_gmm
+from erasurehead_tpu.obs import events as events_lib
+from erasurehead_tpu.obs.metrics import REGISTRY
+from erasurehead_tpu.train import experiments, trainer
+from erasurehead_tpu.train import journal as journal_lib
+from erasurehead_tpu.utils import chaos
+from erasurehead_tpu.utils.config import RunConfig
+
+W = 4
+R = 6
+
+
+@pytest.fixture(scope="module")
+def gmm():
+    return generate_gmm(64, 8, n_partitions=W, seed=0)
+
+
+def _base(**kw):
+    # deduped: the partition-major stack is scheme-independent, so all
+    # four schemes form ONE cohort under batch-trajectories — the shape
+    # the bisection and kill->resume invariance contracts are about
+    d = dict(
+        scheme="naive", n_workers=W, n_stragglers=1, rounds=R,
+        n_rows=64, n_cols=8, update_rule="AGD", lr_schedule=1.0,
+        add_delay=True, seed=0, compute_mode="deduped",
+    )
+    d.update(kw)
+    return RunConfig(**d)
+
+
+def _configs():
+    return {
+        "naive": _base(),
+        "avoid_s1": _base(scheme="avoidstragg"),
+        "agc": _base(scheme="approx", num_collect=3),
+        "cyc": _base(scheme="cyccoded"),
+    }
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean(monkeypatch):
+    """Every test starts and ends with the chaos hook unarmed and its
+    invocation counters zeroed."""
+    monkeypatch.delenv(chaos.CHAOS_ENV, raising=False)
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _counter(name):
+    return REGISTRY.counter(name).value
+
+
+def _science(rows):
+    return [journal_lib.science_row(s.row()) for s in rows]
+
+
+# ---------------------------------------------------------------------------
+# chaos hook
+
+
+def test_chaos_spec_parsing():
+    s = chaos.parse_spec("kill:trajectory:2")
+    assert (s.mode, s.site, s.count, s.sticky) == (
+        "kill", "trajectory", 2, False,
+    )
+    s = chaos.parse_spec("raise:cohort:1+:UNAVAILABLE")
+    assert s.sticky and s.message == "UNAVAILABLE"
+    for bad in ("boom", "kill:nowhere:1", "raise:cohort:x", "raise:cohort:0"):
+        with pytest.raises(ValueError):
+            chaos.parse_spec(bad)
+
+
+def test_chaos_hook_fires_at_count(monkeypatch):
+    monkeypatch.setenv(chaos.CHAOS_ENV, "raise:trajectory:2:BOOM")
+    chaos.reset()
+    chaos.maybe_fire("trajectory")  # invocation 1: below count
+    chaos.maybe_fire("cohort")  # other site: never fires
+    with pytest.raises(chaos.ChaosInjection, match="BOOM"):
+        chaos.maybe_fire("trajectory")  # invocation 2
+    chaos.maybe_fire("trajectory")  # invocation 3: non-sticky, done
+
+
+# ---------------------------------------------------------------------------
+# sweep journal + kill->resume invariance (the tentpole contract)
+
+
+@pytest.mark.parametrize("batch", ["off", "auto", "on"])
+def test_kill_resume_rows_identical(gmm, tmp_path, monkeypatch, batch):
+    """A sweep interrupted after its 2nd journaled trajectory, resumed
+    from the journal, yields rows row-for-row identical (losses bitwise)
+    to the uninterrupted sweep — across all dispatch modes."""
+    baseline = experiments.compare(_configs(), gmm, batch=batch)
+
+    jdir = str(tmp_path / f"journal_{batch}")
+    monkeypatch.setenv(chaos.CHAOS_ENV, "raise:trajectory:2")
+    chaos.reset()
+    j = journal_lib.SweepJournal(jdir, resume=False)
+    with pytest.raises(chaos.ChaosInjection):
+        experiments.compare(_configs(), gmm, batch=batch, journal=j)
+    j.close()
+    monkeypatch.delenv(chaos.CHAOS_ENV)
+    chaos.reset()
+
+    j2 = journal_lib.SweepJournal(jdir, resume=True)
+    assert len(j2) == 2  # exactly the pre-kill trajectories persisted
+    resumed_before = _counter("sweep_journal.resumed")
+    resumed = experiments.compare(_configs(), gmm, batch=batch, journal=j2)
+    j2.close()
+    assert _counter("sweep_journal.resumed") - resumed_before == 2
+
+    assert _science(baseline) == _science(resumed)
+    for a, b in zip(baseline, resumed):
+        assert np.array_equal(
+            np.asarray(a.training_loss), np.asarray(b.training_loss)
+        )
+        assert a.training_loss.dtype == b.training_loss.dtype
+        np.testing.assert_array_equal(a.timeset, b.timeset)
+    # the journal is a valid events.jsonl (same validator as every log)
+    errors = events_lib.validate_file(j2.path)
+    assert errors == [], errors
+
+
+def test_resume_key_rejects_changed_inputs(gmm, tmp_path):
+    """The journal key pins config + data + arrivals: change the arrival
+    schedule and NOTHING resumes — stale rows must never leak into a
+    different experiment."""
+    from erasurehead_tpu.parallel import straggler
+
+    configs = {"naive": _base(), "avoid": _base(scheme="avoidstragg")}
+    arr1 = straggler.arrival_schedule(R, W, add_delay=True, mean=0.5)
+    arr2 = straggler.arrival_schedule(R, W, add_delay=True, mean=0.9)
+    jdir = str(tmp_path / "j")
+    j = journal_lib.SweepJournal(jdir, resume=False)
+    experiments.compare(dict(configs), gmm, arrivals=arr1, journal=j)
+    j.close()
+    j2 = journal_lib.SweepJournal(jdir, resume=True)
+    before = _counter("sweep_journal.resumed")
+    experiments.compare(dict(configs), gmm, arrivals=arr2, journal=j2)
+    assert _counter("sweep_journal.resumed") == before
+    # identical inputs DO resume
+    j3 = journal_lib.SweepJournal(jdir, resume=True)
+    experiments.compare(dict(configs), gmm, arrivals=arr1, journal=j3)
+    assert _counter("sweep_journal.resumed") == before + 2
+    j3.close()
+
+
+def test_ambient_env_journal(gmm, tmp_path, monkeypatch):
+    """ERASUREHEAD_SWEEP_JOURNAL enables journaling with no plumbing —
+    any compare() call picks up the ambient journal."""
+    from erasurehead_tpu.utils.config import (
+        RESUME_SWEEP_ENV,
+        SWEEP_JOURNAL_ENV,
+    )
+
+    jdir = str(tmp_path / "ambient")
+    monkeypatch.setenv(SWEEP_JOURNAL_ENV, jdir)
+    journal_lib.reset_env_journal()
+    try:
+        first = experiments.compare({"naive": _base()}, gmm)
+        assert os.path.exists(os.path.join(jdir, "sweep_journal.jsonl"))
+        monkeypatch.setenv(RESUME_SWEEP_ENV, "1")
+        journal_lib.reset_env_journal()
+        before = _counter("sweep_journal.resumed")
+        again = experiments.compare({"naive": _base()}, gmm)
+        assert _counter("sweep_journal.resumed") == before + 1
+        assert _science(first) == _science(again)
+    finally:
+        journal_lib.reset_env_journal()
+
+
+# ---------------------------------------------------------------------------
+# graceful cohort degradation
+
+
+def test_cohort_oom_bisects_once(gmm, tmp_path, monkeypatch):
+    """First cohort dispatch OOMs -> one bisection, both halves succeed,
+    no trajectory lost; the warning events name the path taken."""
+    monkeypatch.setenv(chaos.CHAOS_ENV, "raise:cohort:1")
+    chaos.reset()
+    split0, fall0 = _counter("cohort.split"), _counter(
+        "cohort.sequential_fallback"
+    )
+    epath = str(tmp_path / "events.jsonl")
+    with events_lib.capture(epath):
+        rows = experiments.compare(_configs(), gmm, batch="on")
+    assert [s.label for s in rows] == list(_configs())
+    assert _counter("cohort.split") - split0 == 1
+    assert _counter("cohort.sequential_fallback") - fall0 == 0
+    kinds = [
+        rec["kind"]
+        for rec in map(json.loads, open(epath))
+        if rec["type"] == "warning"
+    ]
+    assert "cohort_dispatch" in kinds and "cohort_split" in kinds
+    msgs = " ".join(
+        rec["message"]
+        for rec in map(json.loads, open(epath))
+        if rec["type"] == "warning"
+    )
+    # the warning names the failed cohort composition
+    assert "naive" in msgs and "cyc" in msgs
+
+
+def test_cohort_sticky_failure_degrades_to_sequential(gmm, monkeypatch):
+    """Every cohort dispatch fails -> full bisection down to sequential
+    train(); rows are bitwise identical to batch='off' (sequential IS the
+    off path), and the counters record 3 splits + 4 fallbacks for a
+    4-trajectory cohort."""
+    off_rows = experiments.compare(_configs(), gmm, batch="off")
+    monkeypatch.setenv(chaos.CHAOS_ENV, "raise:cohort:1+")
+    chaos.reset()
+    split0, fall0 = _counter("cohort.split"), _counter(
+        "cohort.sequential_fallback"
+    )
+    rows = experiments.compare(_configs(), gmm, batch="on")
+    assert _counter("cohort.split") - split0 == 3  # 4 -> 2+2 -> 1+1+1+1
+    assert _counter("cohort.sequential_fallback") - fall0 == 4
+    assert _science(off_rows) == _science(rows)
+    for a, b in zip(off_rows, rows):
+        assert np.array_equal(
+            np.asarray(a.training_loss), np.asarray(b.training_loss)
+        )
+
+
+def test_cohort_transient_retries_with_backoff(gmm, monkeypatch):
+    """A transient (UNAVAILABLE) dispatch failure retries the SAME cohort
+    with backoff instead of bisecting."""
+    monkeypatch.setattr(experiments, "COHORT_BACKOFF_S", 0.001)
+    monkeypatch.setenv(chaos.CHAOS_ENV, "raise:cohort:1:UNAVAILABLE")
+    chaos.reset()
+    retry0, split0 = _counter("cohort.retry"), _counter("cohort.split")
+    rows = experiments.compare(_configs(), gmm, batch="on")
+    assert len(rows) == 4
+    assert _counter("cohort.retry") - retry0 == 1
+    assert _counter("cohort.split") - split0 == 0
+
+
+def test_guard_ignores_non_runtime_errors(gmm):
+    """The guard only classifies runtime/OOM/transient failures; a config
+    error from validation propagates untouched (no retry, no bisect)."""
+    bad = {"m": _base(arrival_mode="measured", compute_mode="faithful")}
+    with pytest.raises(ValueError, match="measured"):
+        experiments._dispatch_cohort(["m"], bad, gmm, None)
+
+
+# ---------------------------------------------------------------------------
+# divergence quarantine
+
+
+def test_divergence_quarantine(gmm, tmp_path):
+    """A diverging trajectory (lr blown up) yields a status=diverged row;
+    the sweep completes, downstream aggregation survives, and every other
+    row matches the sweep run without it."""
+    without = experiments.compare(_configs(), gmm, batch="off")
+    configs = _configs()
+    configs["boom"] = _base(scheme="avoidstragg", lr_schedule=1e12)
+    div0 = _counter("sweep.diverged")
+    epath = str(tmp_path / "events.jsonl")
+    with events_lib.capture(epath):
+        rows = experiments.compare(configs, gmm, batch="off")
+    assert _counter("sweep.diverged") - div0 == 1
+    by = {s.label: s for s in rows}
+    assert by["boom"].status == "diverged"
+    assert by["boom"].time_to_target is None
+    # diverged row renders distinctly and serializes as STRICT json
+    assert "diverged" in experiments.format_table(rows)
+    path = str(tmp_path / "rows.json")
+    experiments.save_summaries(rows, path)
+
+    def _no_nan(tok):
+        raise AssertionError(f"non-strict JSON token {tok!r}")
+
+    loaded = json.load(open(path), parse_constant=_no_nan)
+    boom_row = [r for r in loaded if r["label"] == "boom"][0]
+    assert boom_row["status"] == "diverged"
+    assert boom_row["final_train_loss"] is None
+    # quarantine: every other row identical to the sweep without boom
+    base_by = {s.label: s for s in without}
+    for label in base_by:
+        assert journal_lib.science_row(
+            base_by[label].row()
+        ) == journal_lib.science_row(by[label].row())
+    # the divergence was announced on the warning channel
+    kinds = [
+        rec["kind"]
+        for rec in map(json.loads, open(epath))
+        if rec["type"] == "warning"
+    ]
+    assert "divergence" in kinds
+
+
+def test_diverged_rows_resume_as_diverged(gmm, tmp_path):
+    """Divergence is deterministic under the journal key: a resumed sweep
+    rehydrates the diverged row instead of re-burning the rounds."""
+    configs = {"boom": _base(scheme="avoidstragg", lr_schedule=1e12),
+               "naive": _base()}
+    jdir = str(tmp_path / "j")
+    j = journal_lib.SweepJournal(jdir, resume=False)
+    first = experiments.compare(dict(configs), gmm, batch="off", journal=j)
+    j.close()
+    j2 = journal_lib.SweepJournal(jdir, resume=True)
+    before = _counter("sweep_journal.resumed")
+    again = experiments.compare(dict(configs), gmm, batch="off", journal=j2)
+    assert _counter("sweep_journal.resumed") == before + 2
+    assert [s.status for s in again] == [s.status for s in first]
+    assert _science(first) == _science(again)
+    j2.close()
+
+
+def test_baseline_suite_target_survives_divergence():
+    """The suite-4 shared-target min() must quarantine diverged rows
+    instead of propagating NaN into every time_to_target (and must not
+    crash when rows diverge)."""
+    s_ok = experiments.RunSummary(
+        label="a", config=_base(), sim_total_time=1.0,
+        sim_steps_per_sec=1.0, real_steps_per_sec=1.0,
+        final_train_loss=0.5, final_test_loss=0.5, final_auc=0.9,
+        time_to_target=None, training_loss=np.array([1.0, 0.5]),
+        timeset=np.array([1.0, 1.0]),
+    )
+    s_bad = experiments.RunSummary(
+        label="b", config=_base(), sim_total_time=1.0,
+        sim_steps_per_sec=1.0, real_steps_per_sec=1.0,
+        final_train_loss=float("nan"), final_test_loss=float("nan"),
+        final_auc=float("nan"), time_to_target=None,
+        training_loss=np.array([1.0, np.nan]),
+        timeset=np.array([1.0, 1.0]), status="diverged",
+    )
+    target = experiments._default_target_loss({"a": s_ok, "b": s_bad})
+    assert target is not None and np.isfinite(target)
+    assert experiments._default_target_loss({"b": s_bad}) is None
+
+
+# ---------------------------------------------------------------------------
+# compare() shape validation (satellite: asserts vanish under python -O)
+
+
+def test_compare_shape_mismatch_names_labels(gmm):
+    configs = {"a": _base(rounds=6), "b": _base(rounds=9)}
+    with pytest.raises(ValueError) as ei:
+        experiments.compare(configs, gmm)
+    msg = str(ei.value)
+    assert "'a'" in msg and "'b'" in msg
+    assert "rounds=6" in msg and "rounds=9" in msg
+    with pytest.raises(ValueError, match="at least one config"):
+        experiments.compare({}, gmm)
+    with pytest.raises(ValueError, match="at least one"):
+        experiments.straggler_sweep(_base(), gmm, {})
+
+
+# ---------------------------------------------------------------------------
+# checkpoint hardening (satellite: torn round_N directories)
+
+
+def test_truncated_checkpoint_falls_back(gmm, tmp_path):
+    """A corrupt newest checkpoint (truncated mid-save) must not kill the
+    resume: restore_latest falls back to the next-older valid checkpoint,
+    with a warning event and a checkpoint.invalid count."""
+    from erasurehead_tpu.train import checkpoint
+
+    cfg = _base(rounds=12, n_stragglers=0, compute_mode="faithful")
+    full = trainer.train(cfg, gmm)
+    ckdir = str(tmp_path / "ck")
+    trainer.train(cfg, gmm, checkpoint_dir=ckdir, checkpoint_every=4)
+    assert checkpoint.latest(ckdir).endswith("round_8")
+    # torn DATA: the layout is committed but the manifest is truncated
+    for p in glob.glob(os.path.join(ckdir, "round_8", "manifest.ocdbt")):
+        with open(p, "r+b") as f:
+            f.truncate(3)
+    inv0 = _counter("checkpoint.invalid")
+    epath = str(tmp_path / "events.jsonl")
+    with events_lib.capture(epath):
+        resumed = trainer.train(
+            cfg, gmm, checkpoint_dir=ckdir, checkpoint_every=4, resume=True
+        )
+    assert resumed.start_round == 4
+    assert _counter("checkpoint.invalid") > inv0
+    kinds = [
+        rec["kind"]
+        for rec in map(json.loads, open(epath))
+        if rec["type"] == "warning"
+    ]
+    assert "checkpoint_invalid" in kinds
+    # the fallback resume reproduces the uninterrupted run's tail
+    np.testing.assert_allclose(
+        np.asarray(resumed.params_history),
+        np.asarray(full.params_history)[4:],
+        atol=1e-5,
+    )
+    # structural tear: no commit marker -> latest() skips it entirely
+    os.remove(os.path.join(ckdir, "round_8", "_CHECKPOINT_METADATA"))
+    assert checkpoint.latest(ckdir).endswith("round_4")
+
+
+# ---------------------------------------------------------------------------
+# telemetry must not fail silently (satellite: trainer._memory_analysis)
+
+
+def test_memory_analysis_failure_counted_and_warned_once(capsys):
+    from erasurehead_tpu.obs import metrics as metrics_lib
+
+    class RaisingSink:
+        def memory_analysis(self):
+            raise RuntimeError("backend says no")
+
+    metrics_lib.reset_warnings()
+    before = _counter("telemetry.emit_errors")
+    assert trainer._memory_analysis(RaisingSink()) is None
+    assert trainer._memory_analysis(RaisingSink()) is None
+    assert _counter("telemetry.emit_errors") - before == 2
+    err = capsys.readouterr().err
+    assert err.count("memory_analysis unavailable") == 1
+
+
+# ---------------------------------------------------------------------------
+# journal file <-> obs tooling
+
+
+def test_journal_validator_catches_bad_records(tmp_path):
+    path = str(tmp_path / "sweep_journal.jsonl")
+    good = {
+        "type": "sweep_trajectory", "seq": 0, "t": 0.0, "key": "abc",
+        "label": "x", "status": "ok", "row": {"final_train_loss": 0.1},
+    }
+    bad_status = dict(good, seq=1, status="exploded")
+    bad_row = dict(good, seq=2, row=[1, 2])
+    bad_key = dict(good, seq=3, key="")
+    with open(path, "w") as f:
+        for rec in (good, bad_status, bad_row, bad_key):
+            f.write(json.dumps(rec) + "\n")
+    errors = events_lib.validate_file(path)
+    assert len(errors) == 3
+    assert any("status" in e for e in errors)
+    assert any("row" in e for e in errors)
+    assert any("key" in e for e in errors)
+
+
+def test_report_renders_journal_rows(gmm, tmp_path, capsys):
+    from erasurehead_tpu.obs import report
+
+    configs = {"naive": _base(),
+               "boom": _base(scheme="avoidstragg", lr_schedule=1e12)}
+    jdir = str(tmp_path / "j")
+    j = journal_lib.SweepJournal(jdir, resume=False)
+    experiments.compare(configs, gmm, batch="off", journal=j)
+    j.close()
+    out = report.render([j.path])
+    assert "sweep journal: 2 trajectory record(s), 1 DIVERGED" in out
+    assert "boom" in out and "diverged" in out
+
+
+def test_cli_sweep_subcommand_dispatches(monkeypatch):
+    from erasurehead_tpu import cli
+    from erasurehead_tpu.train import experiments as experiments_mod
+
+    seen = {}
+
+    def fake_main(argv):
+        seen["argv"] = list(argv)
+        return 0
+
+    monkeypatch.setattr(experiments_mod, "main", fake_main)
+    assert cli.main(["sweep", "--rounds", "3"]) == 0
+    assert seen["argv"] == ["--rounds", "3"]
+
+
+@pytest.mark.slow
+def test_chaos_smoke_subprocess():
+    """The full kill->resume cycle with REAL process deaths (what `make
+    chaos-smoke` runs); slow-marked — three jax subprocess boots."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "chaos_sweep.py")],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=600,
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert '"status": "PASS"' in p.stdout

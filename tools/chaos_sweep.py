@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Chaos harness: kill→resume and cohort-degradation cycles for the sweep
+runner (``make chaos-smoke``).
+
+Drives the resilience contract end-to-end with REAL process deaths, which
+the in-process tests cannot do:
+
+  1. **baseline** — a small straggler sweep (journaled) runs to completion;
+  2. **kill** — the same sweep with ``ERASUREHEAD_CHAOS=kill:trajectory:2``
+     armed: the child process dies (os._exit, preemption semantics) right
+     after its 2nd trajectory row hits the journal;
+  3. **resume** — the same command with ``--resume`` picks the journal up,
+     skips the 2 completed trajectories, trains the rest, and must produce
+     summary rows IDENTICAL to the baseline (labels, simulated clocks,
+     losses bitwise-equal, decode-error columns — train/journal.science_row
+     drops only the run-local wall-clock/cache telemetry);
+  4. **degrade** — ``ERASUREHEAD_CHAOS=raise:cohort:1+`` fails every
+     trajectory-batched cohort dispatch, forcing bisection down to
+     sequential train(); the sweep must still complete with rows identical
+     to the baseline.
+
+The journal file is schema-checked with the same validator as every other
+event log. Exit 0 = all invariants held.
+
+Usage: python tools/chaos_sweep.py [--rounds 4] [--workers 4]
+       (the --child form is the harness's internal sweep runner)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+KILL_EXIT = 43  # erasurehead_tpu.utils.chaos.KILL_EXIT (no jax import here)
+
+
+def child(ns) -> int:
+    """One journaled sweep run: the unit the orchestrator kills/resumes."""
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.train import experiments
+    from erasurehead_tpu.train import journal as journal_lib
+    from erasurehead_tpu.utils.config import RunConfig
+
+    W = ns.workers
+    rows = W * 16
+    base = RunConfig(
+        scheme="naive", n_workers=W, n_stragglers=0, num_collect=W // 2,
+        rounds=ns.rounds, n_rows=rows, n_cols=8, lr_schedule=1.0,
+        update_rule="AGD", add_delay=True, seed=0, compute_mode="deduped",
+    )
+    data = generate_gmm(rows, 8, n_partitions=W, seed=0)
+    sweep = {
+        "naive": [0],
+        "avoidstragg": [1, 2],
+        "approx": [1],
+        "cyccoded": [1],
+    }
+    journal = journal_lib.SweepJournal(ns.journal, resume=ns.resume)
+    try:
+        summaries = experiments.straggler_sweep(
+            base, data, sweep, batch=ns.batch, journal=journal
+        )
+    finally:
+        journal.close()
+    with open(ns.out, "w") as f:
+        json.dump(
+            [journal_lib.science_row(s.row()) for s in summaries],
+            f, indent=1,
+        )
+    return 0
+
+
+def _run_child(workdir, ns, leg, journal_dir, out, resume=False,
+               chaos=None, batch="auto") -> subprocess.CompletedProcess:
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--child",
+        "--journal", journal_dir, "--out", out,
+        "--rounds", str(ns.rounds), "--workers", str(ns.workers),
+        "--batch", batch,
+    ]
+    if resume:
+        cmd.append("--resume")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("ERASUREHEAD_CHAOS", None)
+    if chaos:
+        env["ERASUREHEAD_CHAOS"] = chaos
+    print(f"[chaos-sweep] {leg}: {' '.join(cmd[2:])}"
+          + (f"  ERASUREHEAD_CHAOS={chaos}" if chaos else ""),
+          file=sys.stderr)
+    return subprocess.run(cmd, env=env, cwd=workdir)
+
+
+def _load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _assert_rows_equal(a, b, leg: str) -> None:
+    if a == b:
+        return
+    for ra, rb in zip(a, b):
+        if ra != rb:
+            diff = {
+                k: (ra.get(k), rb.get(k))
+                for k in set(ra) | set(rb)
+                if ra.get(k) != rb.get(k)
+            }
+            raise SystemExit(
+                f"[chaos-sweep] FAIL ({leg}): row {ra.get('label')!r} "
+                f"differs from baseline: {diff}"
+            )
+    raise SystemExit(f"[chaos-sweep] FAIL ({leg}): row sets differ")
+
+
+def orchestrate(ns) -> int:
+    import tempfile
+
+    from erasurehead_tpu.obs import events as events_lib
+
+    work = tempfile.mkdtemp(prefix="eh-chaos-")
+    base_out = os.path.join(work, "rows_base.json")
+    res_out = os.path.join(work, "rows_resumed.json")
+    deg_out = os.path.join(work, "rows_degraded.json")
+    jdir_base = os.path.join(work, "journal_base")
+    jdir_kill = os.path.join(work, "journal_kill")
+    jdir_deg = os.path.join(work, "journal_degrade")
+
+    # 1. baseline (journaled, uninterrupted)
+    p = _run_child(work, ns, "baseline", jdir_base, base_out)
+    if p.returncode != 0:
+        raise SystemExit(f"[chaos-sweep] FAIL: baseline rc={p.returncode}")
+    rows_base = _load(base_out)
+
+    # 2. kill after the 2nd journaled trajectory (preemption semantics)
+    p = _run_child(
+        work, ns, "kill", jdir_kill, os.path.join(work, "unused.json"),
+        chaos="kill:trajectory:2",
+    )
+    if p.returncode != KILL_EXIT:
+        raise SystemExit(
+            f"[chaos-sweep] FAIL: kill leg rc={p.returncode}, "
+            f"expected {KILL_EXIT}"
+        )
+    jpath = os.path.join(jdir_kill, "sweep_journal.jsonl")
+    n_recs = sum(
+        1 for line in open(jpath)
+        if line.strip() and json.loads(line)["type"] == "sweep_trajectory"
+    )
+    if n_recs != 2:
+        raise SystemExit(
+            f"[chaos-sweep] FAIL: journal has {n_recs} rows after "
+            f"kill:trajectory:2, expected 2"
+        )
+    errors = events_lib.validate_file(jpath)
+    if errors:
+        raise SystemExit(f"[chaos-sweep] FAIL: journal invalid: {errors}")
+
+    # 3. resume: skip the 2 journaled rows, finish, match the baseline
+    p = _run_child(work, ns, "resume", jdir_kill, res_out, resume=True)
+    if p.returncode != 0:
+        raise SystemExit(f"[chaos-sweep] FAIL: resume rc={p.returncode}")
+    _assert_rows_equal(rows_base, _load(res_out), "kill->resume")
+    print("[chaos-sweep] kill->resume invariance: OK", file=sys.stderr)
+
+    # 4. every cohort dispatch fails -> bisect to sequential, same rows
+    p = _run_child(
+        work, ns, "degrade", jdir_deg, deg_out, chaos="raise:cohort:1+",
+        batch="on",
+    )
+    if p.returncode != 0:
+        raise SystemExit(f"[chaos-sweep] FAIL: degrade rc={p.returncode}")
+    _assert_rows_equal(rows_base, _load(deg_out), "cohort-degradation")
+    print("[chaos-sweep] cohort-degradation invariance: OK",
+          file=sys.stderr)
+
+    print(json.dumps({
+        "status": "PASS",
+        "rows": len(rows_base),
+        "workdir": work,
+    }))
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--journal", default=None)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--batch", default="auto",
+                    choices=["on", "off", "auto"])
+    ns = ap.parse_args()
+    if ns.child:
+        if not ns.journal or not ns.out:
+            ap.error("--child needs --journal and --out")
+        return child(ns)
+    return orchestrate(ns)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

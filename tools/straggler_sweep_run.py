@@ -44,13 +44,40 @@ def main() -> int:
                     help="deduped stacks partition-major (scheme-"
                          "independent), letting --batch-trajectories "
                          "collapse the whole sweep into a few dispatches")
+    ap.add_argument("--sweep-journal", default=None, metavar="DIR",
+                    help="journal each trajectory's summary row into "
+                         "DIR/sweep_journal.jsonl as it finishes — a "
+                         "preempted sweep keeps everything already done. "
+                         "Default: ERASUREHEAD_SWEEP_JOURNAL env, else "
+                         "off")
+    ap.add_argument("--resume-sweep", action="store_true",
+                    help="skip trajectories the journal already completed "
+                         "(rehydrated rows are identical to a fresh run's; "
+                         "requires --sweep-journal or the env var). "
+                         "ERASUREHEAD_RESUME_SWEEP=1 does the same")
     ns = ap.parse_args()
     W = ns.workers
     collect = ns.num_collect or W // 2
 
     from erasurehead_tpu.data.synthetic import generate_gmm
     from erasurehead_tpu.train import experiments, plots
-    from erasurehead_tpu.utils.config import RunConfig
+    from erasurehead_tpu.train import journal as journal_lib
+    from erasurehead_tpu.utils.config import (
+        RunConfig,
+        resolve_resume_sweep,
+        resolve_sweep_journal,
+    )
+
+    journal_dir = resolve_sweep_journal(ns.sweep_journal)
+    resume = resolve_resume_sweep(True if ns.resume_sweep else None)
+    if resume and journal_dir is None:
+        ap.error("--resume-sweep requires --sweep-journal DIR (or "
+                 "ERASUREHEAD_SWEEP_JOURNAL)")
+    journal = (
+        journal_lib.SweepJournal(journal_dir, resume=resume)
+        if journal_dir
+        else None
+    )
 
     rows = W * max(1, round(ns.rows / W))
     base = RunConfig(
@@ -79,16 +106,24 @@ def main() -> int:
     else:
         epath, sink = None, None
     t0 = time.time()
-    if sink is not None:
-        with sink:
+    try:
+        if sink is not None:
+            with sink:
+                summaries = experiments.straggler_sweep(
+                    base, data, sweep, batch=ns.batch_trajectories,
+                    journal=journal,
+                )
+            print(f"events -> {epath}", file=sys.stderr)
+        else:
             summaries = experiments.straggler_sweep(
-                base, data, sweep, batch=ns.batch_trajectories
+                base, data, sweep, batch=ns.batch_trajectories,
+                journal=journal,
             )
-        print(f"events -> {epath}", file=sys.stderr)
-    else:
-        summaries = experiments.straggler_sweep(
-            base, data, sweep, batch=ns.batch_trajectories
-        )
+    finally:
+        if journal is not None:
+            journal.close()
+    if journal is not None:
+        print(f"sweep journal -> {journal.path}", file=sys.stderr)
     print(f"sweep: {len(summaries)} runs in {time.time() - t0:.0f}s",
           file=sys.stderr)
     jpath = os.path.join(out_dir, f"straggler_sweep_w{W}.json")

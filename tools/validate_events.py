@@ -5,8 +5,11 @@ Thin CLI over erasurehead_tpu.obs.events.validate_file — the validation
 logic lives in the package so the tests, `make telemetry-smoke`, and this
 tool can never drift. Checks: every line parses, record types are known,
 required keys are present, seq is monotonic per logger, chunked
-rounds/decode records have strictly increasing round indices per run, and
-every run_start has a matching run_end.
+rounds/decode records have strictly increasing round indices per run,
+sweep_trajectory journal records (train/journal.py) carry a known status
+("ok"/"diverged"), a non-empty key and an object row, and every run_start
+has a matching run_end. Sweep journals are events.jsonl files too — point
+this tool at DIR/sweep_journal.jsonl to check one.
 
 Usage: python tools/validate_events.py events.jsonl [more.jsonl ...]
 Exit 0 = all files valid; 1 = errors (printed, one per line).
