@@ -42,7 +42,7 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 	partialrepcoded partialcyccoded randreg deadline \
 	generate_random_data arrange_real_data \
 	test tier1 bench sweep rehearse watch compare real_data dryrun \
-	telemetry-smoke sweep-batch-smoke chaos-smoke clean
+	telemetry-smoke sweep-batch-smoke chaos-smoke roofline-smoke clean
 
 naive:            ## uncoded wait-for-all baseline (src/naive.py)
 	$(RUN) --scheme naive
@@ -115,6 +115,9 @@ sweep-batch-smoke:  ## CPU 7-scheme x 2-seed cohort compare; asserts dispatches 
 
 chaos-smoke:      ## CPU kill->resume + cohort-degradation cycle: chaos-killed sweep resumes from its journal with identical rows (tools/chaos_sweep.py)
 	JAX_PLATFORMS=cpu $(PY) tools/chaos_sweep.py
+
+roofline-smoke:   ## CPU ring+pipelined+int8 sweep: asserts bytes accounting, dispatch counts, and the f32 bitwise pins (tools/roofline_smoke.py)
+	JAX_PLATFORMS=cpu $(PY) tools/roofline_smoke.py
 
 sweep:            ## the full on-TPU measurement program (resumable, tagged)
 	bash tools/tpu_measurements.sh

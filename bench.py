@@ -70,8 +70,36 @@ ROUNDS = 100
 W, S, COLLECT = 30, 2, 15
 N_COLS = 128
 
-# v5e HBM peak bandwidth, GB/s (public spec: 819 GB/s per chip)
-HBM_PEAK_GBPS = {"tpu": 819.0, "axon": 819.0}
+# Per-chip HBM peak bandwidths in GB/s (public specs), matched by
+# substring against ``jax.devices()[0].device_kind`` — ordered so the
+# more specific marker wins ("v5p" before the v5e/v5-lite catch-all).
+# Unrecognized kinds fall back to the v5e figure WITH a peak_source field
+# saying so, so pct_roofline is never silently computed against the wrong
+# roof on non-v5e silicon.
+DEVICE_KIND_PEAKS = (
+    ("v6", 1640.0),  # v6e / Trillium
+    ("v5p", 2765.0),
+    ("v5", 819.0),  # v5e ("v5 lite" / "v5litepod" kinds)
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+FALLBACK_PEAK_GBPS = 819.0  # v5e — the fleet this repo's captures ran on
+
+
+def _hbm_peak(platform: str, device_kind: str):
+    """(peak_gbps, peak_source) for this accelerator, or (None, None) on
+    hosts — a host's memory roofline is not the claim (module docstring)."""
+    if platform not in ("tpu", "axon"):
+        return None, None
+    dk = (device_kind or "").lower()
+    for marker, peak in DEVICE_KIND_PEAKS:
+        if marker in dk:
+            return peak, f"device_kind:{device_kind}"
+    return (
+        FALLBACK_PEAK_GBPS,
+        f"fallback:v5e (unrecognized device_kind {device_kind!r})",
+    )
 
 PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
 RUN_TIMEOUT = int(os.environ.get("BENCH_RUN_TIMEOUT", "900"))
@@ -118,6 +146,31 @@ if COMPUTE_MODE == "deduped":
 STACK_MODE = os.environ.get("BENCH_STACK", "materialized")
 if STACK_MODE == "ring":
     METRIC_SUFFIX += "_ring"
+# ring transport scheduling (utils/config.ring_pipeline): "on" double-
+# buffers the hops (ppermute for hop t+1 in flight under hop t's fill —
+# bitwise-identical trajectories, same bytes on the wire); "off" forces
+# the sequential transport. Unset = cfg default ("auto").
+RING_PIPELINE = os.environ.get("BENCH_RING_PIPELINE", "")
+if RING_PIPELINE and RING_PIPELINE in ("on", "off"):
+    METRIC_SUFFIX += f"_ringpipe{RING_PIPELINE}"
+# compressed-stack knob (utils/config.stack_dtype): "int8" streams a
+# quantized stack (per-partition scale tables, dequantized in the device
+# grad body) — ~4x fewer bytes on the bandwidth-bound pass, LOSSY; the
+# fidelity extra below reports the eval-loss delta vs the f32 stack.
+# Unset = cfg default ("auto" = follow BENCH_DTYPE).
+STACK_DTYPE = os.environ.get("BENCH_STACK_DTYPE", "")
+_STACK_ITEMSIZE = {"float32": 4, "bfloat16": 2, "int8": 1}
+if STACK_DTYPE and STACK_DTYPE in _STACK_ITEMSIZE:
+    if STACK_DTYPE == "int8":
+        METRIC_SUFFIX += "_int8"
+    elif STACK_DTYPE != DATA_DTYPE:
+        METRIC_SUFFIX += f"_stack{STACK_DTYPE}"
+# buffer-donation knob (utils/config.donate): "off" disables donation of
+# the scan carry + weight tables — the before/after lever for the
+# donation BASELINE rows. Unset = cfg default ("auto" = on).
+DONATE = os.environ.get("BENCH_DONATE", "")
+if DONATE == "off":
+    METRIC_SUFFIX += "_nodonate"
 # flat-stack lowering knob (parallel/step.make_flat_grad_fn): "on"/"off"
 # force the flat vs per-slot closed-form lowering; unset = cfg default
 # ("auto", step.resolve_flat_grad's per-stack-kind rules). Tagged so sweep entries
@@ -255,6 +308,9 @@ def _record_or_annotate(payload: dict) -> dict:
         and STACK_MODE == "materialized"
         and not FLAT_GRAD
         and not MARGIN_FLAT
+        and not RING_PIPELINE
+        and not STACK_DTYPE
+        and not DONATE
     )
     try:
         if on_tpu and canonical:
@@ -315,6 +371,7 @@ def _sweep7_extra(data, n_rows: int, peak) -> dict:
         n_workers=W, n_stragglers=S, rounds=SWEEP7_ROUNDS, n_rows=n_rows,
         n_cols=N_COLS, update_rule="AGD", lr_schedule=1.0, add_delay=True,
         dtype=DATA_DTYPE, compute_mode="deduped", seed=0,
+        stack_dtype=STACK_DTYPE or "auto", donate=DONATE or "auto",
     )
     schemes = [
         ("naive", {}),
@@ -344,8 +401,12 @@ def _sweep7_extra(data, n_rows: int, peak) -> dict:
 
     # cohort-correct roofline: the partition-major X streams ONCE per
     # cohort pass (2x for margin + transpose) and serves all B
-    # trajectories; per-trajectory numbers are the per-stream share
-    x_bytes = (n_rows // W) * W * N_COLS * _DTYPE_ITEMSIZE[DATA_DTYPE]
+    # trajectories; per-trajectory numbers are the per-stream share.
+    # Bytes at the stack's STORAGE dtype (int8 adds its scale tables).
+    stack_dtype = (STACK_DTYPE or DATA_DTYPE)
+    x_bytes = (n_rows // W) * W * N_COLS * _STACK_ITEMSIZE[stack_dtype]
+    if stack_dtype == "int8":
+        x_bytes += W * N_COLS * 4  # per-partition scale tables
     cohort_bytes_per_step = 2 * x_bytes
     cohort_flops_per_step = 4 * B * (n_rows // W) * W * N_COLS
     agg_rate = B * SWEEP7_ROUNDS / cohort_wall if cohort_wall > 0 else 0.0
@@ -386,10 +447,67 @@ def _sweep7_extra(data, n_rows: int, peak) -> dict:
     }
 
 
+def _fidelity_extra(cfg, data, result) -> dict:
+    """Fidelity evidence for a lossy/compressed stack: final train/test
+    loss of this run vs an f32-stack reference run of the IDENTICAL
+    config and schedule (exec/data caches make the reference cheap on
+    repeat captures). The eval replays on the full-precision host data,
+    so the deltas measure what the compressed gradient pass actually cost
+    the science — the knob ships with numbers, not vibes."""
+    import dataclasses
+
+    import jax
+
+    from erasurehead_tpu.train import evaluate, trainer
+
+    ref = trainer.train(
+        dataclasses.replace(cfg, stack_dtype="float32", dtype="float32"),
+        data,
+    )
+    model = trainer.build_model(cfg)
+
+    def final_losses(res):
+        last = jax.tree.map(lambda l: l[-1:], res.params_history)
+        n = res.n_train
+        ev = evaluate.replay(
+            model, cfg.model, last, data.X_train[:n], data.y_train[:n],
+            data.X_test, data.y_test,
+        )
+        return float(ev.training_loss[-1]), float(ev.testing_loss[-1])
+
+    train_loss, test_loss = final_losses(result)
+    ref_train, ref_test = final_losses(ref)
+    return {
+        "fidelity": {
+            "stack_dtype": cfg.resolve_stack_dtype(),
+            "final_train_loss": round(train_loss, 8),
+            "f32_final_train_loss": round(ref_train, 8),
+            "eval_loss_delta": round(train_loss - ref_train, 8),
+            "final_test_loss": round(test_loss, 8),
+            "f32_final_test_loss": round(ref_test, 8),
+            "eval_test_loss_delta": round(test_loss - ref_test, 8),
+            "mean_decode_error": (
+                round(
+                    float(sum(result.decode_error))
+                    / max(len(result.decode_error), 1),
+                    8,
+                )
+                if result.decode_error is not None
+                else None
+            ),
+        }
+    }
+
+
 def child() -> None:
     import jax
 
     platform = jax.devices()[0].platform
+    # device-kind-aware roofline: v5e's 819 GB/s was hard-coded for every
+    # TPU before; now the kind picks its own public peak and peak_source
+    # records how it was chosen, so pct_roofline is honest off-v5e
+    device_kind = str(getattr(jax.devices()[0], "device_kind", ""))
+    peak, peak_source = _hbm_peak(platform, device_kind)
     # size the problem to the platform: full canonical rows on an
     # accelerator, a light slice on CPU fallback so the bench terminates
     on_accel = platform not in ("cpu",)
@@ -418,6 +536,12 @@ def child() -> None:
         compute_mode=COMPUTE_MODE,
         # BENCH_STACK=ring: partition-major stack + ppermute hop transport
         stack_mode=STACK_MODE,
+        # BENCH_RING_PIPELINE: double-buffered vs sequential hop schedule
+        ring_pipeline=RING_PIPELINE or "auto",
+        # BENCH_STACK_DTYPE=int8: quantized stack, dequantized in-body
+        stack_dtype=STACK_DTYPE or "auto",
+        # BENCH_DONATE=off: keep the duplicate carry/weight-table HBM
+        donate=DONATE or "auto",
         # BENCH_FLAT: force the flat-stack closed-form lowering on/off
         # (unset = "auto", step.resolve_flat_grad decides per stack kind)
         flat_grad=FLAT_GRAD or "auto",
@@ -479,11 +603,20 @@ def child() -> None:
         # sequential cached path, with X counted once per cohort pass
         sweep7_extra = {}
         try:
-            sweep7_extra = _sweep7_extra(
-                data, n_rows, HBM_PEAK_GBPS.get(platform)
-            )
+            sweep7_extra = _sweep7_extra(data, n_rows, peak)
         except Exception as e:  # noqa: BLE001 — extras must never kill bench
             print(f"bench: sweep7 cohort extra failed: {e}", file=sys.stderr)
+
+        # ---- fidelity extra: the compressed-stack knob ships with evidence
+        # (eval-loss delta vs an f32-stack reference run of the same
+        # schedule), not vibes — only measured when a lossy/compressed
+        # stack dtype is actually in play
+        fidelity_extra = {}
+        try:
+            if cfg.resolve_stack_dtype() != "float32":
+                fidelity_extra = _fidelity_extra(cfg, data, result)
+        except Exception as e:  # noqa: BLE001 — extras must never kill bench
+            print(f"bench: fidelity extra failed: {e}", file=sys.stderr)
 
     # ---- telemetry extra: the same fields the event log carries -----------
     telemetry_extra = {}
@@ -533,14 +666,22 @@ def child() -> None:
 
     # ---- hardware roofline (see module docstring + BASELINE.md) ----------
     # faithful mode streams the [W, s+1, rows/W, F] slot stack twice/step;
-    # deduped streams the [P, rows/W, F] partition stack (1/(s+1) of it)
+    # deduped streams the [P, rows/W, F] partition stack (1/(s+1) of it).
+    # Bytes are counted at the stack's STORAGE dtype (stack_dtype): the
+    # whole point of bf16/int8 stacks is fewer bytes per step at the same
+    # FLOPs — so the flops/byte intensity rises and achieved_gbps is the
+    # bytes actually streamed. int8 adds its per-partition scale tables
+    # ([blocks, F] f32, read alongside the payload in both passes).
     slot_rows = n_rows // W
     replicas = (S + 1) if COMPUTE_MODE == "faithful" else 1
-    x_bytes = W * replicas * slot_rows * N_COLS * _DTYPE_ITEMSIZE[DATA_DTYPE]
+    stack_dtype = cfg.resolve_stack_dtype()
+    stack_itemsize = _STACK_ITEMSIZE[stack_dtype]
+    x_bytes = W * replicas * slot_rows * N_COLS * stack_itemsize
+    if stack_dtype == "int8":
+        x_bytes += W * replicas * N_COLS * 4  # scale tables
     bytes_per_step = 2 * x_bytes
     flops_per_step = 4 * W * replicas * slot_rows * N_COLS
     achieved_gbps = bytes_per_step * steps_per_sec / 1e9
-    peak = HBM_PEAK_GBPS.get(platform)
     pct_roofline = (
         round(100.0 * achieved_gbps / peak, 2) if peak else None
     )
@@ -564,6 +705,7 @@ def child() -> None:
                 "vs_baseline": round(float(steps_per_sec / ref_steps_per_sec), 3),
                 "platform": platform,
                 "dtype": DATA_DTYPE,
+                "stack_dtype": stack_dtype,
                 "mode": COMPUTE_MODE,
                 "n_rows": n_rows,
                 "wall_time_s": round(float(result.wall_time), 4),
@@ -571,9 +713,12 @@ def child() -> None:
                 "bytes_per_step": bytes_per_step,
                 "achieved_gbps": round(float(achieved_gbps), 2),
                 "pct_roofline": pct_roofline,
+                "hbm_peak_gbps": peak,
+                "peak_source": peak_source,
                 **mem_extra,
                 **sweep_extra,
                 **sweep7_extra,
+                **fidelity_extra,
                 **telemetry_extra,
             }
         )
@@ -627,6 +772,35 @@ if __name__ == "__main__":
                 _failure_record(
                     "BENCH_STACK=ring streams the faithful stack; it does "
                     "not compose with BENCH_MODE=deduped"
+                )
+            )
+        )
+        sys.exit(0 if "--child" not in sys.argv else 1)
+    if RING_PIPELINE not in ("", "on", "off"):
+        print(
+            json.dumps(
+                _failure_record(
+                    f"BENCH_RING_PIPELINE must be on or off, "
+                    f"got {RING_PIPELINE!r}"
+                )
+            )
+        )
+        sys.exit(0 if "--child" not in sys.argv else 1)
+    if STACK_DTYPE not in ("",) + tuple(_STACK_ITEMSIZE):
+        print(
+            json.dumps(
+                _failure_record(
+                    f"BENCH_STACK_DTYPE must be one of "
+                    f"{sorted(_STACK_ITEMSIZE)}, got {STACK_DTYPE!r}"
+                )
+            )
+        )
+        sys.exit(0 if "--child" not in sys.argv else 1)
+    if DONATE not in ("", "on", "off"):
+        print(
+            json.dumps(
+                _failure_record(
+                    f"BENCH_DONATE must be on or off, got {DONATE!r}"
                 )
             )
         )

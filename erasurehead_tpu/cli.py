@@ -148,6 +148,31 @@ def _flags_parser() -> argparse.ArgumentParser:
                         "per step (bitwise-identical trajectories, (s+1)x "
                         "less device data); 'auto' switches to ring past a "
                         "footprint estimate")
+    p.add_argument("--ring-pipeline", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="ring-transport scheduling under stack-mode ring: "
+                        "'on' double-buffers the hops (the ppermute for "
+                        "hop t+1 is issued while hop t's block fills, so "
+                        "ICI transfers overlap on-chip fills; same hops, "
+                        "same bytes, bitwise-identical trajectories); "
+                        "'off' keeps the sequential transport; 'auto' = "
+                        "the measurement-pinned default (off pending the "
+                        "dense_f32_ringpipe race)")
+    p.add_argument("--stack-dtype", default="auto",
+                   choices=["auto", "float32", "bfloat16", "int8"],
+                   help="feature-stack STORAGE dtype: int8 quantizes the "
+                        "partition-major stack at upload (per-partition "
+                        "scale tables, dequantized inside the device grad "
+                        "body) — ~4x fewer streamed bytes, LOSSY (the "
+                        "fidelity cost is measured per scheme: bench.py "
+                        "fidelity extra, decode-error columns); auto "
+                        "follows --dtype")
+    p.add_argument("--donate", default="auto", choices=["auto", "on", "off"],
+                   help="buffer donation for the training scan's carry "
+                        "(params + optimizer state) and per-round weight "
+                        "tables: frees the duplicate HBM copy per "
+                        "dispatch; bitwise-identical math, cached data "
+                        "stacks are never donated. auto = on")
     p.add_argument("--use-pallas", default="auto", choices=["auto", "on", "off"],
                    help="fused pallas gradient kernel (ops/kernels.py). "
                         "A correctness/reference path, NOT a performance "
@@ -288,6 +313,9 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         partitions_per_worker=ns.partitions_per_worker,
         compute_mode=ns.compute_mode,
         stack_mode=ns.stack_mode,
+        ring_pipeline=ns.ring_pipeline,
+        stack_dtype=ns.stack_dtype,
+        donate=ns.donate,
         use_pallas=ns.use_pallas,
         dtype=ns.dtype,
         arrival_mode=ns.arrival_mode,

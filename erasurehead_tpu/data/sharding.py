@@ -38,6 +38,7 @@ from erasurehead_tpu.ops.features import (
     Features,
     FieldOnehot,
     PaddedRows,
+    QuantizedStack,
     infer_field_sizes,
 )
 from erasurehead_tpu.parallel import mesh as mesh_lib
@@ -113,10 +114,15 @@ def _padded_host(csr, nnz):
 
 
 def worker_stack(layout: CodingLayout, Xp, yp):
-    """Gather the redundant worker-major stacks through the assignment."""
+    """Gather the redundant worker-major stacks through the assignment.
+
+    Container stacks (PaddedRows, FieldOnehot, QuantizedStack) gather
+    leaf-wise: every leaf leads with the partition axis, so one indexed
+    take per leaf — a QuantizedStack's scale table rides the same gather
+    as its payload."""
     take = lambda A: (
         jax.tree.map(lambda leaf: leaf[layout.assignment], A)
-        if isinstance(A, (PaddedRows, FieldOnehot))
+        if isinstance(A, (PaddedRows, FieldOnehot, QuantizedStack))
         else A[layout.assignment]
     )
     return take(Xp), yp[layout.assignment]
@@ -362,6 +368,7 @@ def shard_run_data(
     dtype=np.float32,
     sparse_format: str = "padded",
     ring: bool = False,
+    quantize: bool = False,
 ) -> ShardedData:
     """Build and device_put the stack the compute mode needs.
 
@@ -377,12 +384,31 @@ def shard_run_data(
     traffic on the bandwidth-bound gradient pass (params and optimizer
     state stay float32 — trainer-side mixed precision). Integer leaves
     (PaddedRows indices) are never cast.
+
+    ``quantize=True`` (stack_dtype="int8") compresses the feature stack to
+    a :class:`~erasurehead_tpu.ops.features.QuantizedStack` — int8 payload
+    plus per-partition-per-feature f32 scale tables, quantized once per
+    partition BEFORE any worker-major gather so materialized faithful,
+    ring, and deduped stacks all carry the identical quantized values
+    (their trajectories stay bitwise-comparable to each other). Dense
+    stacks only; labels keep the ``dtype`` cast. The scale leaves are
+    never down-cast (precision of the reconstruction, not traffic —
+    they are O(P*F)).
     """
     Xp_h, yp_h = partition_stack(
         dataset, layout.n_partitions, sparse_format=sparse_format
     )
     sharding = mesh_lib.worker_sharding(mesh)
     dtype = np.dtype(dtype) if not hasattr(dtype, "itemsize") else dtype
+    if quantize:
+        if not isinstance(Xp_h, np.ndarray):
+            raise ValueError(
+                "stack_dtype='int8' quantizes dense stacks only; this "
+                f"dataset builds a {type(Xp_h).__name__} sparse stack — "
+                "use stack_dtype float32/bfloat16 (or auto) with sparse "
+                "features"
+            )
+        Xp_h = QuantizedStack.quantize(Xp_h)
 
     def _cast(leaf):
         import jax.numpy as jnp
@@ -392,8 +418,12 @@ def shard_run_data(
             return arr.astype(jnp.dtype(dtype))
         return arr
 
+    # quantized stacks skip the float cast: the int8 payload is already
+    # final and the f32 scale table must not be down-cast to a bf16 DATA
+    # dtype (it scales every reconstructed value)
+    _x_leaf = (lambda leaf: np.asarray(leaf)) if quantize else _cast
     put = lambda A: jax.tree.map(
-        lambda leaf: put_global(_cast(leaf), sharding), A
+        lambda leaf: put_global(_x_leaf(leaf), sharding), A
     )
     rows = yp_h.shape[1]
 

@@ -315,7 +315,84 @@ def infer_field_sizes(csr) -> Optional[tuple]:
     return tuple(int(b) for b in np.diff(bounds))
 
 
-Features = Union[jnp.ndarray, PaddedRows, FieldOnehot]
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantizedStack:
+    """int8-compressed dense feature stack with per-partition scale tables
+    (``stack_dtype="int8"``, utils/config.RunConfig).
+
+    ``q[..., r, f]`` stores ``round(X[..., r, f] / scale[..., f])`` clipped
+    to [-127, 127]; ``scale`` is the per-(leading-block, feature) symmetric
+    absmax/127 table. The leading axes are the stack's partition axes
+    ([P] partition-major, [W, S] worker-major after the assignment
+    gather), so the scale table rides the same shardings, gathers, and
+    ring ``ppermute`` hops as the payload (both leaves lead with the
+    block axis).
+
+    The compression is *storage-side*: HBM residency, upload bytes, and
+    the per-step stream shrink ~4x vs f32 (the scale table is O(P*F),
+    noise next to the O(P*rows*F) payload); :meth:`dequantize` runs inside
+    the per-device grad body (parallel/step._dq), so the f32 values exist
+    only as an on-chip temporary. Lossy by construction — the fidelity
+    cost per scheme is measured, not assumed (bench.py fidelity extra,
+    tools/roofline_smoke.py).
+    """
+
+    q: jnp.ndarray  # [..., rows, F] int8
+    scale: jnp.ndarray  # [..., F] float32
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1])
+
+    def dequantize(self) -> jnp.ndarray:
+        """[..., rows, F] float reconstruction — q * scale, broadcast over
+        the rows axis. Exact for the values the quantizer produced; the
+        loss happened at :meth:`quantize` time."""
+        return self.q.astype(self.scale.dtype) * self.scale[..., None, :]
+
+    @classmethod
+    def quantize(cls, X) -> "QuantizedStack":
+        """Symmetric per-(block, feature) int8 quantization of a dense
+        [..., rows, F] stack (host numpy in, host numpy leaves out —
+        quantization happens before upload, like the dtype cast it
+        replaces). All-zero (block, feature) columns get scale 1.0 so the
+        division is defined and they reconstruct to exact zeros."""
+        X = np.asarray(X)
+        if not np.issubdtype(X.dtype, np.floating):
+            raise ValueError(
+                f"stack_dtype='int8' quantizes float stacks; got {X.dtype}"
+            )
+        absmax = np.abs(X).max(axis=-2)  # [..., F]
+        scale = (np.where(absmax > 0, absmax, 1.0) / 127.0).astype(
+            np.float32
+        )
+        q = np.clip(
+            np.rint(X / scale[..., None, :]), -127, 127
+        ).astype(np.int8)
+        return cls(q, scale)
+
+
+def maybe_dequantize(X):
+    """Identity for ordinary stacks; f32 reconstruction for a
+    :class:`QuantizedStack`. The per-device grad bodies call this first
+    (parallel/step._dq), so every lowering downstream sees the same dense
+    array it would for an uncompressed run."""
+    return X.dequantize() if isinstance(X, QuantizedStack) else X
+
+
+Features = Union[jnp.ndarray, PaddedRows, FieldOnehot, QuantizedStack]
 
 # Sparse margin-gather lane width. TPU scalar gather/scatter throughput is
 # ~7 ns/element (measured, tools/profile_sparse.py) — each of the nnz

@@ -41,6 +41,23 @@ from erasurehead_tpu.utils.tracing import annotate
 GradFn = Callable[..., Any]  # (params, X, y, weights) -> gradient pytree
 
 
+def _dq(local_body: GradFn) -> GradFn:
+    """Dequantize a compressed stack (ops/features.QuantizedStack) at the
+    top of a per-device grad body: the int8 payload + scale table stream
+    from HBM, the f32 reconstruction is an on-chip temporary, and every
+    local lowering downstream (per-slot vmap, flat, margin-flat, cohort
+    matmul) sees the same dense array an uncompressed run would. Identity
+    (and free) for ordinary stacks — every shard_map factory wraps its
+    body exactly once, so compressed stacks compose with all transports
+    and lowerings without per-path plumbing."""
+    from erasurehead_tpu.ops import features as features_lib
+
+    def local(params, Xs, ys, ws):
+        return local_body(params, features_lib.maybe_dequantize(Xs), ys, ws)
+
+    return local
+
+
 def _weighted_tree_sum(weights: jnp.ndarray, grads: Any, contract: str) -> Any:
     """sum_i weights[i...] * grads[i...] over the leading axes of each leaf."""
     return jax.tree.map(
@@ -125,11 +142,15 @@ MARGIN_FLAT_DEFAULT = False
 def supports_margin_flat(model, X) -> bool:
     """The hybrid needs a closed-form GLM on a DENSE stack: the margin
     lowers as one flat 2-D matmul while the transpose stays the batched
-    per-slot contraction (sparse stacks have their own margin paths)."""
+    per-slot contraction (sparse stacks have their own margin paths).
+    A QuantizedStack is a dense stack in int8 clothing — the body
+    dequantizes first (_dq), so the dense lowerings apply."""
+    from erasurehead_tpu.ops import features as features_lib
+
     return (
         hasattr(model, "margin_residual")
         and not _grads_via_loss(model)
-        and isinstance(X, jax.Array)
+        and isinstance(X, (jax.Array, features_lib.QuantizedStack))
     )
 
 
@@ -193,7 +214,7 @@ def make_margin_flat_grad_fn(model, mesh: Mesh) -> GradFn:
     """
 
     return shard_map(
-        _margin_flat_local_body(model),
+        _dq(_margin_flat_local_body(model)),
         mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
         out_specs=P(),
@@ -240,7 +261,7 @@ def make_faithful_grad_fn(model, mesh: Mesh) -> GradFn:
     """
 
     return shard_map(
-        _faithful_local_body(model, mesh),
+        _dq(_faithful_local_body(model, mesh)),
         mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
         out_specs=P(),
@@ -248,7 +269,7 @@ def make_faithful_grad_fn(model, mesh: Mesh) -> GradFn:
     )
 
 
-def _ring_fill(plan, Xp, yp):
+def _ring_fill(plan, Xp, yp, pipeline: bool = False):
     """Inside the shard_map body: reconstruct this device's worker-major
     slot buffer [Wl, S, rows, ...] from the partition-major local shard
     [Pl, rows, ...] via ``plan.n_hops - 1`` lax.ppermute neighbor hops.
@@ -258,15 +279,34 @@ def _ring_fill(plan, Xp, yp):
     device d+1's block, the direction the cyclic codes' w..w+s supports
     point) and scatters whatever slots that block owns into the buffer.
     The buffer is a per-step temporary — the (s+1)x redundancy never
-    becomes persistent HBM, and the hops run under lax.scan so XLA can
-    overlap each transfer with the previous hop's fills (the
-    parallel/ring.py pattern). Values are moved, never transformed, so
-    the downstream slot-gradient contraction sees bit-identical inputs to
-    the materialized stack's.
+    becomes persistent HBM. Values are moved, never transformed, and the
+    fill order is identical in both modes, so the downstream
+    slot-gradient contraction sees bit-identical inputs to the
+    materialized stack's.
+
+    Transport scheduling, per ``pipeline`` (cfg.ring_pipeline):
+
+    - ``False`` (sequential): each scan step runs ``ppermute -> fill`` in
+      order — the fill CONSUMES the ppermute's output, so the data
+      dependence serializes every ICI transfer behind the previous fill
+      and XLA cannot overlap them. This is the original transport; it
+      issues exactly ``n_hops - 1`` ppermutes.
+    - ``True`` (double-buffered): the ppermute for hop t+1 is issued in
+      the scan carry BEFORE hop t's block is filled — the fill reads the
+      block that already arrived, the next transfer has no consumer
+      inside this step, and XLA is free to fly hop t+1's ICI traffic
+      under hop t's fill/scatter. A prologue issues hop 1 before hop 0's
+      (communication-free) own-block fill, and an epilogue fills the last
+      block without issuing a dead transfer — still exactly
+      ``n_hops - 1`` ppermutes, same bytes on the wire.
     """
     D, H = plan.n_devices, plan.n_hops
     idx = lax.axis_index(WORKER_AXIS)
     sel_dev = jnp.asarray(plan.sel)[idx]  # [H, Wl, S], this device's plan
+    perm = [(i, (i - 1) % D) for i in range(D)]
+    ppermute = lambda blk: jax.tree.map(
+        lambda l: lax.ppermute(l, WORKER_AXIS, perm), blk
+    )
 
     def fill(buf, blk, sel_h):
         take = jnp.where(sel_h >= 0, sel_h, 0)  # [Wl, S] safe gather index
@@ -289,23 +329,63 @@ def _ring_fill(plan, Xp, yp):
 
     with annotate("eh_step/ring_fill"):
         blk = (Xp, yp)
+        if pipeline and H > 1:
+            # software-pipelined: hop 1's transfer departs before hop 0's
+            # own-block fill; each scan step fills the block in hand while
+            # the next is in flight; the epilogue fill issues no transfer
+            blk_next = ppermute(blk)
+            buf = fill(None, blk, sel_dev[0])
+            if H > 2:
+
+                def hop(carry, sel_h):
+                    buf, blk_cur = carry
+                    blk_nxt = ppermute(blk_cur)
+                    return (fill(buf, blk_cur, sel_h), blk_nxt), None
+
+                (buf, blk_next), _ = lax.scan(
+                    hop, (buf, blk_next), sel_dev[1:-1]
+                )
+            return fill(buf, blk_next, sel_dev[H - 1])
         buf = fill(None, blk, sel_dev[0])
         if H > 1:
-            perm = [(i, (i - 1) % D) for i in range(D)]
 
             def hop(carry, sel_h):
                 buf, blk = carry
-                blk = jax.tree.map(
-                    lambda l: lax.ppermute(l, WORKER_AXIS, perm), blk
-                )
+                blk = ppermute(blk)
                 return (fill(buf, blk, sel_h), blk), None
 
             (buf, _), _ = lax.scan(hop, (buf, blk), sel_dev[1:])
         return buf
 
 
+# Whether ring_pipeline="auto" resolves to the double-buffered transport.
+# False pending its end-to-end race (dense_f32_ringpipe / dense_int8_ringpipe,
+# tools/tpu_measurements_rep2.sh): the pipelined schedule moves the same
+# bytes over the same hops in the same fill order (bitwise-pinned either
+# way, tests/test_ring_stack.py), so the only question is whether XLA
+# actually flies hop t+1's ICI transfer under hop t's fill on real
+# silicon — a question this repo answers with a tagged measurement, not a
+# default flip on faith (the FLAT_GRAD_DEFAULT precedent: profile-favored
+# lowerings have lost end-to-end races here before).
+RING_PIPELINE_DEFAULT = False
+
+
+def resolve_ring_pipeline(ring_pipeline: str) -> bool:
+    """Should a ring-transport run use the double-buffered schedule?
+    "on"/"off" force; "auto" defers to :data:`RING_PIPELINE_DEFAULT`
+    (measurement-pinned module state, keyed into the executable cache via
+    the trainer's resolved ring signature so a default flip can never
+    serve a stale program)."""
+    if ring_pipeline == "on":
+        return True
+    if ring_pipeline == "off":
+        return False
+    return RING_PIPELINE_DEFAULT
+
+
 def make_ring_faithful_grad_fn(
-    model, mesh: Mesh, plan, local_body: GradFn = None, check_vma=None
+    model, mesh: Mesh, plan, local_body: GradFn = None, check_vma=None,
+    pipeline: bool = False,
 ) -> GradFn:
     """Faithful-mode decoded gradient from the PARTITION-major stack
     (stack_mode="ring"): per-step ring transport (:func:`_ring_fill`)
@@ -320,12 +400,15 @@ def make_ring_faithful_grad_fn(
       slot_weights: [W, S] decode x coding weight per slot message.
     ``local_body`` swaps in an alternative per-device grad body (the flat /
     margin-flat lowerings) — it receives the reconstructed worker-major
-    buffer exactly as the materialized fn would.
+    buffer exactly as the materialized fn would. ``pipeline`` picks the
+    double-buffered transport schedule (see :func:`_ring_fill`); the fill
+    order and values are identical either way, so the choice is a pure
+    lowering knob (resolve_ring_pipeline).
     """
-    body = local_body or _faithful_local_body(model, mesh)
+    body = _dq(local_body or _faithful_local_body(model, mesh))
 
     def local(params, Xp, yp, slot_weights):
-        Xw, yw = _ring_fill(plan, Xp, yp)
+        Xw, yw = _ring_fill(plan, Xp, yp, pipeline=pipeline)
         return body(params, Xw, yw, slot_weights)
 
     return shard_map(
@@ -374,7 +457,7 @@ def make_deduped_grad_fn(model, mesh: Mesh) -> GradFn:
     """
 
     return shard_map(
-        _deduped_local_body(model, mesh),
+        _dq(_deduped_local_body(model, mesh)),
         mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
         out_specs=P(),
@@ -469,7 +552,7 @@ def _cohort_matmul_local_body(model) -> GradFn:
 
 def make_cohort_grad_fn(
     model, mesh: Mesh, *, faithful: bool, ring_plan=None,
-    local_body: GradFn = None,
+    local_body: GradFn = None, ring_pipeline: bool = False,
 ) -> GradFn:
     """Trajectory-cohort decoded gradients: one shard_map step whose
     params/weights lead with a [B] trajectory axis while the data stack is
@@ -488,7 +571,9 @@ def make_cohort_grad_fn(
     or ``_batched_local_body(...)``); None picks the vmapped default body
     of the compute mode. ``ring_plan`` composes the ring transport exactly
     as make_ring_faithful_grad_fn does — the reconstructed worker buffer
-    is shared across the cohort too.
+    is shared across the cohort too, with ``ring_pipeline`` picking the
+    double-buffered transport schedule. Compressed stacks dequantize once
+    per step for the whole cohort (_dq wraps the batched body).
     """
     if local_body is None:
         local_body = _batched_local_body(
@@ -496,11 +581,12 @@ def make_cohort_grad_fn(
             if faithful
             else _deduped_local_body(model, mesh)
         )
+    local_body = _dq(local_body)
     if faithful and ring_plan is not None:
         inner = local_body
 
         def body(params_B, Xp, yp, ws_B):
-            Xw, yw = _ring_fill(ring_plan, Xp, yp)
+            Xw, yw = _ring_fill(ring_plan, Xp, yp, pipeline=ring_pipeline)
             return inner(params_B, Xw, yw, ws_B)
 
     else:
@@ -529,14 +615,21 @@ FLAT_GRAD_DEFAULT = False
 
 def supports_flat_grad(model, X) -> bool:
     """make_flat_grad_fn needs a closed-form GLM (margin_residual) on any
-    Features stack (dense, PaddedRows, FieldOnehot); autodiff families
-    take ONE jax.grad per device instead (see _grads_via_loss)."""
+    Features stack (dense, PaddedRows, FieldOnehot, or a dense
+    QuantizedStack — dequantized first by _dq); autodiff families take
+    ONE jax.grad per device instead (see _grads_via_loss)."""
     from erasurehead_tpu.ops import features as features_lib
 
     return hasattr(model, "margin_residual") and not _grads_via_loss(
         model
     ) and isinstance(
-        X, (jax.Array, features_lib.PaddedRows, features_lib.FieldOnehot)
+        X,
+        (
+            jax.Array,
+            features_lib.PaddedRows,
+            features_lib.FieldOnehot,
+            features_lib.QuantizedStack,
+        ),
     )
 
 
@@ -595,7 +688,7 @@ def make_flat_grad_fn(model, mesh: Mesh) -> GradFn:
     """
 
     return shard_map(
-        _flat_local_body(model),
+        _dq(_flat_local_body(model)),
         mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
         out_specs=P(),

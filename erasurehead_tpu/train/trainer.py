@@ -22,6 +22,7 @@ import dataclasses
 import os
 import sys
 import time
+from contextlib import contextmanager
 from functools import partial, wraps
 from typing import Any, Optional, Sequence
 
@@ -180,6 +181,10 @@ class _RunSetup:
     # = only the partition-major stack is resident and the step rebuilds
     # worker slot buffers over ppermute ring hops
     ring: bool = False
+    # RESOLVED feature-stack storage dtype (cfg.resolve_stack_dtype):
+    # "float32" / "bfloat16" / "int8" — int8 means the device stacks are
+    # QuantizedStack containers (payload + scale tables)
+    stack_dtype: str = "float32"
 
 
 def _with_run_sparse_lanes(fn):
@@ -267,12 +272,22 @@ def _setup_run(
     # ppermute hops instead of materializing it (paths with no ring body —
     # measured mode — pass ring_ok=False; use_pallas='on' forces the fused
     # body, so auto pins to materialized there)
+    # resolved stack storage dtype (cfg.stack_dtype; "auto" follows the
+    # data dtype): int8 builds QuantizedStack containers at upload, and
+    # the footprint gate below sees the COMPRESSED itemsize — a stack
+    # that only crosses the ring-auto threshold uncompressed stays
+    # materialized once int8 shrinks it under it
+    stack_dtype = cfg.resolve_stack_dtype()
+    stack_np_dtype = (
+        np.dtype(np.int8) if stack_dtype == "int8"
+        else jnp.dtype(stack_dtype)
+    )
     use_ring = faithful and resolve_ring_stack(
         cfg.stack_mode,
         layout,
         dataset,
         _worker_axis_size(mesh),
-        jnp.dtype(cfg.dtype),
+        stack_np_dtype,
         supported=ring_ok and cfg.use_pallas != "on",
     )
     # device-data cache: repeated runs of the same (dataset, layout
@@ -289,12 +304,17 @@ def _setup_run(
     stack_sig = cache_lib.layout_stack_signature(
         layout, worker_major=faithful and not use_ring
     )
+    # the key's dtype token is the RESOLVED stack dtype (plus the label
+    # dtype): an int8 run and an f32 run of the same content must never
+    # share an upload — re-key on (content, stack_dtype) per ISSUE 6.
+    # stack_dtype="auto" resolves to cfg.dtype, so pre-existing keys are
+    # byte-for-byte what they were.
     data_key = (
         "stacks",
         cache_lib.dataset_token(dataset),
         stack_sig,
         layout.n_partitions,
-        str(jnp.dtype(cfg.dtype)),
+        (stack_dtype, str(jnp.dtype(cfg.dtype))),
         cfg.sparse_format,
         cache_lib.mesh_signature(mesh),
     )
@@ -302,8 +322,13 @@ def _setup_run(
         data_key,
         lambda: shard_run_data(
             dataset, layout, mesh, faithful=faithful,
-            dtype=jnp.dtype(cfg.dtype), sparse_format=cfg.sparse_format,
+            dtype=(
+                jnp.dtype(cfg.dtype) if stack_dtype == "int8"
+                else jnp.dtype(stack_dtype)
+            ),
+            sparse_format=cfg.sparse_format,
             ring=use_ring,
+            quantize=stack_dtype == "int8",
         ),
     )
     params0 = _init_params_f32(cfg, model, dataset.n_features)
@@ -320,6 +345,7 @@ def _setup_run(
         n_train=data.n_train,
         data_cache_hit=data_hit,
         ring=use_ring,
+        stack_dtype=stack_dtype,
     )
 
 
@@ -354,13 +380,65 @@ def _hard_sync(x) -> None:
             np.asarray(leaves[0])
 
 
-def _ring_signature(ring_plan) -> tuple:
+def _ring_signature(ring_plan, pipeline: bool = False) -> tuple:
     """Executable-cache key component for the ring transport: the hop
     tables are compiled into the program as constants, so their CONTENT
-    (not just shape) distinguishes executables."""
+    (not just shape) distinguishes executables — as does the RESOLVED
+    transport schedule (pipelined vs sequential structure the scan
+    differently; ring_pipeline="auto" resolves through module state a
+    future race may flip)."""
     if ring_plan is None:
         return ("materialized",)
-    return ("ring", ring_plan.n_hops, ring_plan.sel.tobytes())
+    return (
+        "ring",
+        ring_plan.n_hops,
+        ring_plan.sel.tobytes(),
+        "pipelined" if pipeline else "sequential",
+    )
+
+
+# Whether donate="auto" resolves to donating the scan carry + per-round
+# weight tables (jax donate_argnums). On: donation frees the duplicate HBM
+# copy of the optimizer state and weight tables across the dispatch —
+# bitwise-identical math, and the device-data cache's stacks are never in
+# the donated argnums (the use-after-donate hazard is test-pinned in
+# tests/test_donation.py), so there is no correctness price to wait on a
+# race for. "off" remains forceable for debugging and before/after rows.
+DONATE_DEFAULT = True
+
+
+def _resolve_donate(cfg: RunConfig) -> bool:
+    if cfg.donate == "on":
+        return True
+    if cfg.donate == "off":
+        return False
+    return DONATE_DEFAULT
+
+
+def _donate_copy(tree):
+    """Fresh device buffers for a warm-up execution of a donating
+    executable: the warm-up consumes (deletes) its donated arguments, and
+    the real run still needs the originals. Copy cost is one transient
+    the size of the carry/weights — never the data stacks, which are not
+    donated."""
+    return jax.tree.map(lambda l: l.copy(), tree)
+
+
+@contextmanager
+def _quiet_donation_warnings():
+    """Scope out jax's "Some donated buffers were not usable" warning
+    around lowering a donating executable: the per-round weight tables
+    have no matching output to alias into (and some backends implement no
+    donation at all), so the warning is expected — the donation is still
+    correct (unusable donations are simply dropped) and the state carry's
+    aliasing is the part that pays."""
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        yield
 
 
 def _history_update_norms(history) -> np.ndarray:
@@ -385,14 +463,16 @@ def _history_update_norms(history) -> np.ndarray:
 
 def _exec_signature_fields(
     kind, platform, cfg, model, X, y, use_fused, ring_plan, weights_shape,
-    mesh, state0, alpha, n_train, **extra
+    mesh, state0, alpha, n_train, ring_pipeline=False, **extra
 ):
     """LABELED executable-cache signature: field name -> value, same
     content as the flat cache key (``tuple(fields.values())``). The names
     feed the recompile detector (obs/detect.py), which must be able to
     say WHICH field made two compiles differ. Anything that changes the
     compiled program must appear here — the single home replacing the
-    hand-built exec_sig tuples."""
+    hand-built exec_sig tuples. (The resolved stack dtype needs no field
+    of its own: an int8 stack changes the data_tree leaf dtypes, and the
+    raw knob rides in via static_signature_fields.)"""
     from erasurehead_tpu.train import cache as cache_lib
 
     fields = {
@@ -401,7 +481,7 @@ def _exec_signature_fields(
         **cfg.static_signature_fields(),
         "lowering": step_lib.lowering_signature(cfg, model, X),
         "fused": use_fused,
-        "ring": _ring_signature(ring_plan),
+        "ring": _ring_signature(ring_plan, ring_pipeline),
         "weights_shape": tuple(weights_shape),
         "mesh": cache_lib.mesh_signature(mesh),
         "state_tree": cache_lib.tree_signature(state0),
@@ -438,6 +518,7 @@ def _emit_run_start(run_id, cfg, setup, platform, lowering, faithful) -> None:
             else ("materialized" if faithful else "deduped")
         ),
         dtype=cfg.dtype,
+        stack_dtype=setup.stack_dtype,
     )
     obs_events.emit(
         "data_upload",
@@ -605,9 +686,14 @@ def train(
         )
     )  # [R, W, S]
     ring_plan = None
+    ring_pipe = setup.ring and step_lib.resolve_ring_pipeline(
+        cfg.ring_pipeline
+    )
     if faithful and setup.ring:
         ring_plan = plan_ring_transport(layout, _worker_axis_size(mesh))
-        grad_fn = step_lib.make_ring_faithful_grad_fn(model, mesh, ring_plan)
+        grad_fn = step_lib.make_ring_faithful_grad_fn(
+            model, mesh, ring_plan, pipeline=ring_pipe
+        )
         weights_seq, X, y = jnp.asarray(slot_w, dtype), data.Xp, data.yp
     elif faithful:
         grad_fn = step_lib.make_faithful_grad_fn(model, mesh)
@@ -617,8 +703,12 @@ def train(
         pw = layout.fold_slot_weights(slot_w)
         weights_seq, X, y = jnp.asarray(pw, dtype), data.Xp, data.yp
 
-    grad_fn = _apply_margin_flat(cfg, model, mesh, X, grad_fn, ring_plan)
-    grad_fn = _apply_flat_grad(cfg, model, mesh, X, grad_fn, ring_plan)
+    grad_fn = _apply_margin_flat(
+        cfg, model, mesh, X, grad_fn, ring_plan, ring_pipe
+    )
+    grad_fn = _apply_flat_grad(
+        cfg, model, mesh, X, grad_fn, ring_plan, ring_pipe
+    )
 
     # fused single-HBM-pass pallas kernel for dense GLM stacks
     from erasurehead_tpu.ops import kernels as kernels_lib
@@ -691,12 +781,21 @@ def train(
             new_state = update_fn(state, g, eta, alpha, n_train, i)
         return new_state, new_state.params
 
-    @jax.jit
-    def run(state, Xa, ya, lr_c, w_c, it_c):
+    def _run(state, Xa, ya, lr_c, w_c, it_c):
         return jax.lax.scan(
             partial(body, Xa, ya), state, (lr_c, w_c, it_c),
             unroll=cfg.scan_unroll,
         )
+
+    # buffer donation (cfg.donate): the scan carry (params + optimizer
+    # state, argnum 0) aliases straight into the final-state output, and
+    # the per-round weight table (argnum 4) becomes reusable scratch —
+    # the duplicate HBM copies go away. The DATA stacks (argnums 1-2) are
+    # deliberately NOT donated: they may be the device-data cache's
+    # pinned arrays, and a donated cached stack would poison every later
+    # cache hit (tests/test_donation.py pins this).
+    donate = _resolve_donate(cfg)
+    run = jax.jit(_run, donate_argnums=(0, 4) if donate else ())
 
     start_round = 0
     if initial_state is not None:
@@ -763,6 +862,7 @@ def train(
         sig_fields = _exec_signature_fields(
             "scan", platform, cfg, model, X, y, use_fused, ring_plan,
             weights_seq.shape, mesh, state0, alpha, n_train,
+            ring_pipeline=ring_pipe, donation=donate,
         )
         exec_sig = tuple(sig_fields.values())
 
@@ -784,9 +884,21 @@ def train(
 
                 def _compile(lo=lo, hi=hi):
                     t0 = time.perf_counter()
-                    ex = run.lower(state0, X, y, *slices(lo, hi)).compile()
+                    with _quiet_donation_warnings():
+                        ex = run.lower(
+                            state0, X, y, *slices(lo, hi)
+                        ).compile()
                     if measure:
-                        _hard_sync(ex(state0, X, y, *slices(lo, hi))[0])
+                        lr_c, w_c, it_c = slices(lo, hi)
+                        if donate:
+                            # the warm-up consumes its donated args; the
+                            # real run still needs state0 (and a full-
+                            # range weight slice aliases weights_seq)
+                            lr_c2, w_c2 = lr_c, _donate_copy(w_c)
+                            st = _donate_copy(state0)
+                        else:
+                            lr_c2, w_c2, st = lr_c, w_c, state0
+                        _hard_sync(ex(st, X, y, lr_c2, w_c2, it_c)[0])
                     return ex, time.perf_counter() - t0
 
                 t_cmp = time.perf_counter()
@@ -906,6 +1018,16 @@ def train(
                 if setup.ring
                 else ("materialized" if faithful else "deduped")
             ),
+            # memory-system levers (resolved): stack storage dtype, ring
+            # transport schedule (None off the ring path), and whether
+            # this dispatch donated its carry/weight buffers
+            "stack_dtype": setup.stack_dtype,
+            "ring_pipeline": (
+                ("pipelined" if ring_pipe else "sequential")
+                if setup.ring
+                else None
+            ),
+            "donation": donate,
             "stack_bytes": cache_lib.device_nbytes(data),
             "memory_analysis": mem_info,
         },
@@ -1094,6 +1216,9 @@ def _train_cohort_impl(cfg, dataset, cfgs, mesh, arrivals, measure):
     ]  # each [R, W, S] (S may differ only across stacks, refused above)
 
     ring_plan = None
+    ring_pipe = setup.ring and step_lib.resolve_ring_pipeline(
+        cfg.ring_pipeline
+    )
     if faithful and setup.ring:
         ring_plan = plan_ring_transport(layout, _worker_axis_size(mesh))
         weights_seq = jnp.asarray(np.stack(slot_ws, axis=1), dtype)
@@ -1132,7 +1257,7 @@ def _train_cohort_impl(cfg, dataset, cfgs, mesh, arrivals, measure):
         cohort_lowering = "per_slot_vmap"
     grad_fn = step_lib.make_cohort_grad_fn(
         model, mesh, faithful=faithful, ring_plan=ring_plan,
-        local_body=local_body,
+        local_body=local_body, ring_pipeline=ring_pipe,
     )
 
     # per-trajectory init + optimizer state, stacked on a leading [B] axis
@@ -1166,12 +1291,19 @@ def _train_cohort_impl(cfg, dataset, cfgs, mesh, arrivals, measure):
             new_state = b_update(state, g, eta_t, alphas, n_train, i)
         return new_state, new_state.params
 
-    @jax.jit
-    def run(state, Xa, ya, alphas, lr_c, w_c, it_c):
+    def _run(state, Xa, ya, alphas, lr_c, w_c, it_c):
         return jax.lax.scan(
             partial(body, Xa, ya, alphas), state, (lr_c, w_c, it_c),
             unroll=cfg.scan_unroll,
         )
+
+    # buffer donation, cohort form: the [B]-stacked carry (argnum 0) and
+    # the [R, B, ...] per-trajectory weight tables (argnum 5) are the
+    # B-fold duplicated buffers that cap cohort width — donating them
+    # frees that HBM for the dispatch. The shared data stack is never
+    # donated (it may be the data cache's pinned upload).
+    donate = _resolve_donate(cfg)
+    run = jax.jit(_run, donate_argnums=(0, 5) if donate else ())
 
     platform = jax.devices()[0].platform
     from erasurehead_tpu.obs import decode as obs_decode
@@ -1208,6 +1340,7 @@ def _train_cohort_impl(cfg, dataset, cfgs, mesh, arrivals, measure):
     sig_fields = _exec_signature_fields(
         "cohort_scan", platform, cfg, model, X, y, False, ring_plan,
         weights_seq.shape, mesh, state0, 0.0, n_train,
+        ring_pipeline=ring_pipe, donation=donate,
         batch_size=B, chunk_rounds=cfg.rounds,
         cohort_lowering=cohort_lowering,
     )
@@ -1215,13 +1348,16 @@ def _train_cohort_impl(cfg, dataset, cfgs, mesh, arrivals, measure):
 
     def _compile():
         t0 = time.perf_counter()
-        ex = run.lower(
-            state0, X, y, alpha_B, lr_seq, weights_seq, iters
-        ).compile()
+        with _quiet_donation_warnings():
+            ex = run.lower(
+                state0, X, y, alpha_B, lr_seq, weights_seq, iters
+            ).compile()
         if measure:
-            _hard_sync(
-                ex(state0, X, y, alpha_B, lr_seq, weights_seq, iters)[0]
-            )
+            # the warm-up consumes its donated args (carry + weight
+            # table); the timed dispatch below still needs the originals
+            st = _donate_copy(state0) if donate else state0
+            ws = _donate_copy(weights_seq) if donate else weights_seq
+            _hard_sync(ex(st, X, y, alpha_B, lr_seq, ws, iters)[0])
         return ex, time.perf_counter() - t0
 
     t_cmp = time.perf_counter()
@@ -1271,6 +1407,13 @@ def _train_cohort_impl(cfg, dataset, cfgs, mesh, arrivals, measure):
             if setup.ring
             else ("materialized" if faithful else "deduped")
         ),
+        "stack_dtype": setup.stack_dtype,
+        "ring_pipeline": (
+            ("pipelined" if ring_pipe else "sequential")
+            if setup.ring
+            else None
+        ),
+        "donation": donate,
         "stack_bytes": cache_lib.device_nbytes(data),
         "memory_analysis": _memory_analysis(ex),
     }
@@ -1933,14 +2076,17 @@ def _train_measured_cluster(cfg, dataset, setup, mult, dtype, mesh=None):
     )
 
 
-def _apply_margin_flat(cfg, model, mesh, X, grad_fn, ring_plan=None):
+def _apply_margin_flat(
+    cfg, model, mesh, X, grad_fn, ring_plan=None, ring_pipeline=False
+):
     """Swap in the hybrid dense lowering (step.make_margin_flat_grad_fn)
     per cfg.margin_flat: flat 2-D margin matmul + batched per-slot
     transpose. "on" forces (raising off the dense closed-form path);
     "auto" defers to step.resolve_margin_flat (MARGIN_FLAT_DEFAULT,
     pending the dense_f32_marginflat race). With ``ring_plan`` set (the
     ring stack mode), the same per-device body runs behind the ring
-    transport — the lowering choice composes with either transport."""
+    transport — the lowering choice composes with either transport (and
+    with either transport schedule, ``ring_pipeline``)."""
     if cfg.margin_flat == "on" and not step_lib.supports_margin_flat(model, X):
         raise ValueError(
             "margin_flat='on' needs a closed-form GLM on a dense stack; "
@@ -1952,12 +2098,15 @@ def _apply_margin_flat(cfg, model, mesh, X, grad_fn, ring_plan=None):
             return step_lib.make_ring_faithful_grad_fn(
                 model, mesh, ring_plan,
                 local_body=step_lib._margin_flat_local_body(model),
+                pipeline=ring_pipeline,
             )
         return step_lib.make_margin_flat_grad_fn(model, mesh)
     return grad_fn
 
 
-def _apply_flat_grad(cfg, model, mesh, X, grad_fn, ring_plan=None):
+def _apply_flat_grad(
+    cfg, model, mesh, X, grad_fn, ring_plan=None, ring_pipeline=False
+):
     """Swap in the flat-stack closed-form lowering (step.make_flat_grad_fn)
     per cfg.flat_grad: one matvec/rmatvec pair instead of the batched
     per-slot contraction. "on" forces (raising off the closed-form path),
@@ -1975,6 +2124,7 @@ def _apply_flat_grad(cfg, model, mesh, X, grad_fn, ring_plan=None):
             return step_lib.make_ring_faithful_grad_fn(
                 model, mesh, ring_plan,
                 local_body=step_lib._flat_local_body(model),
+                pipeline=ring_pipeline,
             )
         return step_lib.make_flat_grad_fn(model, mesh)
     return grad_fn
@@ -2021,9 +2171,14 @@ def train_dynamic(
         cfg.scheme, layout, cfg.num_collect, cfg.delay_mean, cfg.add_delay,
         deadline=cfg.deadline,
     )
+    ring_pipe = setup.ring and step_lib.resolve_ring_pipeline(
+        cfg.ring_pipeline
+    )
     if setup.ring:
         ring_plan = plan_ring_transport(layout, _worker_axis_size(mesh))
-        base_fn = step_lib.make_ring_faithful_grad_fn(model, mesh, ring_plan)
+        base_fn = step_lib.make_ring_faithful_grad_fn(
+            model, mesh, ring_plan, pipeline=ring_pipe
+        )
         X, y = data.Xp, data.yp
     else:
         ring_plan = None
@@ -2031,8 +2186,11 @@ def train_dynamic(
         X, y = data.Xw, data.yw
     grad_fn = _apply_flat_grad(
         cfg, model, mesh, X,
-        _apply_margin_flat(cfg, model, mesh, X, base_fn, ring_plan),
+        _apply_margin_flat(
+            cfg, model, mesh, X, base_fn, ring_plan, ring_pipe
+        ),
         ring_plan,
+        ring_pipe,
     )
     update_fn = setup.update_fn
     dtype = jnp.float32  # param/update dtype (cfg.dtype is the data dtype)

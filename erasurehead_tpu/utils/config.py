@@ -148,6 +148,42 @@ class RunConfig:
     #                    estimate crosses sharding.RING_AUTO_MIN_BYTES.
     # Deduped mode has no redundancy to stream and ignores/refuses it.
     stack_mode: str = "materialized"
+    # ring-transport scheduling (parallel/step._ring_fill): how the per-step
+    # ppermute hops interleave with the slot-buffer fills under
+    # stack_mode="ring"/"auto"->ring:
+    #   "off"  — sequential: each hop's fill consumes that hop's transfer,
+    #            serializing ICI behind compute (the original transport);
+    #   "on"   — double-buffered: the hop t+1 ppermute is issued in the
+    #            scan carry while hop t's block fills, so XLA can overlap
+    #            the transfer with the fill. Same hop count, same bytes,
+    #            same fill order — trajectories are BITWISE identical;
+    #   "auto" — step.RING_PIPELINE_DEFAULT (off pending the
+    #            dense_f32_ringpipe race).
+    # Ignored (harmless) when the run doesn't resolve to ring transport.
+    ring_pipeline: str = "auto"
+    # feature-stack STORAGE dtype (data/sharding.shard_run_data):
+    #   "auto"     — follow `dtype` (today's behavior);
+    #   "float32"/"bfloat16" — force the stored float dtype; for the
+    #            training stacks this is equivalent to setting `dtype`
+    #            (labels ride along), kept explicit so sweeps can tag
+    #            the stack lever independently;
+    #   "int8"     — quantize the partition-major stack at upload to an
+    #            int8 payload + per-partition-per-feature f32 scale table
+    #            (ops/features.QuantizedStack), dequantized inside the
+    #            per-device grad body — ~4x fewer streamed bytes on the
+    #            bandwidth-bound pass, LOSSY (fidelity measured, not
+    #            assumed: bench.py fidelity extra, decode-error columns).
+    #            Dense stacks only; composes with stack_mode=ring and the
+    #            cohort dispatch.
+    stack_dtype: str = "auto"
+    # buffer donation (jax donate_argnums) for the training scan's carry
+    # (params + optimizer state) and per-round weight tables: the donated
+    # HBM is reused in place instead of held as a duplicate across the
+    # dispatch. "auto" = on (trainer.DONATE_DEFAULT — bitwise-identical
+    # math; the cached device DATA stacks are never donated, test-pinned
+    # in tests/test_donation.py); "off" for debugging / before-after
+    # measurement.
+    donate: str = "auto"
     seed: int = 0  # model init + generator matrix (reference: unseeded)
     # DATA dtype: bfloat16 halves HBM traffic on the gradient pass; model
     # params and optimizer updates always run in float32 (mixed precision)
@@ -273,6 +309,36 @@ class RunConfig:
                 f"stack_mode must be materialized/ring/auto, got "
                 f"{self.stack_mode!r}"
             )
+        if self.ring_pipeline not in ("auto", "on", "off"):
+            raise ValueError(
+                f"ring_pipeline must be auto/on/off, got "
+                f"{self.ring_pipeline!r}"
+            )
+        if self.stack_dtype not in ("auto", "float32", "bfloat16", "int8"):
+            raise ValueError(
+                f"stack_dtype must be auto/float32/bfloat16/int8, got "
+                f"{self.stack_dtype!r}"
+            )
+        if self.donate not in ("auto", "on", "off"):
+            raise ValueError(
+                f"donate must be auto/on/off, got {self.donate!r}"
+            )
+        if self.stack_dtype == "int8":
+            if self.arrival_mode == "measured":
+                raise ValueError(
+                    "arrival_mode='measured' dispatches each worker's own "
+                    "grad_sum on its resident slot stack; the int8 "
+                    "compressed stack only dequantizes inside the SPMD "
+                    "step body — use stack_dtype float32/bfloat16 (or "
+                    "auto) with measured mode"
+                )
+            if self.use_pallas == "on":
+                raise ValueError(
+                    "use_pallas='on' forces the fused kernel, which "
+                    "streams a plain dense float stack and has no "
+                    "dequantizing body; force at most one of "
+                    "stack_dtype='int8' / use_pallas='on'"
+                )
         if self.stack_mode == "ring":
             if self.compute_mode != ComputeMode.FAITHFUL:
                 raise ValueError(
@@ -449,6 +515,14 @@ class RunConfig:
             # (auto depends on a footprint estimate cfg alone cannot see);
             # the raw knob here keeps explicit/auto requests distinct
             "stack_mode": self.stack_mode,
+            # memory-system knobs (PR 6): the raw knobs here name the
+            # differing field in recompile-detector warnings; the trainer
+            # keys the RESOLVED values too (ring signature carries the
+            # pipeline schedule, data_tree carries the stack dtype, and
+            # the donation field carries the resolved aliasing)
+            "ring_pipeline": self.ring_pipeline,
+            "stack_dtype": self.stack_dtype,
+            "donate": self.donate,
             "update_rule": self.update_rule.value,
             "dtype": self.dtype,
             "scan_unroll": self.scan_unroll,
@@ -477,6 +551,17 @@ class RunConfig:
         whole point. When adding a lowering knob to RunConfig, add it to
         :meth:`static_signature_fields` (this derives from it)."""
         return tuple(self.static_signature_fields().values())
+
+    def resolve_stack_dtype(self) -> str:
+        """The feature stack's RESOLVED storage dtype: "float32",
+        "bfloat16", or "int8". ``stack_dtype="auto"`` follows the DATA
+        dtype (the pre-knob behavior, so existing configs and cache keys
+        are unchanged); explicit float values override it (labels ride
+        along — equivalent to setting ``dtype``); "int8" quantizes the
+        feature stack while labels keep the ``dtype`` cast."""
+        if self.stack_dtype == "auto":
+            return self.dtype
+        return self.stack_dtype
 
     @property
     def effective_alpha(self) -> float:

@@ -1,0 +1,224 @@
+"""Compressed feature stacks (cfg.stack_dtype): storage-side compression
+with measured — never assumed — fidelity.
+
+stack_dtype="int8" quantizes the partition-major stack at upload
+(ops/features.QuantizedStack: int8 payload + per-partition-per-feature
+f32 scale tables) and dequantizes inside the per-device grad body
+(parallel/step._dq). Pinned here:
+
+  - the quantizer's error bound and exact-zero reconstruction;
+  - bytes accounting: the resident int8 stack is ~4x smaller than f32
+    (payload exactly 4x; scale tables are the small remainder);
+  - transport invariance: int8 materialized == int8 ring == int8
+    ring-pipelined BITWISE (all three consume the identical quantized
+    values — the loss happened once, at upload);
+  - the data cache re-keys on (content, stack_dtype) — an int8 and an
+    f32 run never share an upload; int8 reruns hit;
+  - cohort dispatches and lowering swaps compose; sparse stacks and
+    measured mode refuse loudly.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from erasurehead_tpu.data.synthetic import generate_gmm, generate_onehot
+from erasurehead_tpu.ops.features import QuantizedStack, maybe_dequantize
+from erasurehead_tpu.train import cache as cache_lib, trainer
+from erasurehead_tpu.utils.config import RunConfig
+
+W = 12
+
+
+@pytest.fixture(scope="module")
+def gmm():
+    return generate_gmm(W * 8, 16, n_partitions=W, seed=0)
+
+
+def _cfg(**kw):
+    base = dict(
+        scheme="approx", n_workers=W, n_stragglers=2, num_collect=6,
+        rounds=3, n_rows=W * 8, n_cols=16, lr_schedule=0.5,
+        update_rule="AGD", add_delay=True, seed=0,
+    )
+    base.update(kw)
+    return RunConfig(**base)
+
+
+def _bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+# ---------------------------------------------------------------------------
+# quantizer
+
+
+def test_quantize_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(5, 32, 7)).astype(np.float32) * rng.uniform(
+        0.1, 10.0, size=(5, 1, 7)
+    ).astype(np.float32)
+    qs = QuantizedStack.quantize(X)
+    assert qs.q.dtype == np.int8 and qs.scale.dtype == np.float32
+    assert qs.q.shape == X.shape and qs.scale.shape == (5, 7)
+    rec = np.asarray(qs.q, dtype=np.float64) * qs.scale[:, None, :]
+    # symmetric rounding: |err| <= scale/2 = absmax/254 per (block, col)
+    bound = np.abs(X).max(axis=1, keepdims=True) / 254.0 + 1e-12
+    assert (np.abs(rec - X) <= bound).all()
+
+
+def test_quantize_zero_columns_and_dequantize_helper():
+    X = np.zeros((2, 4, 3), dtype=np.float32)
+    X[0, :, 1] = 2.0
+    qs = QuantizedStack.quantize(X)
+    # all-zero columns reconstruct to exact zeros (scale pinned to 1)
+    rec = np.asarray(maybe_dequantize(qs))
+    assert np.array_equal(rec[:, :, 0], np.zeros((2, 4)))
+    assert np.allclose(rec[0, :, 1], 2.0)
+    # identity for plain arrays
+    arr = np.ones((3, 3), np.float32)
+    assert maybe_dequantize(arr) is arr
+    with pytest.raises(ValueError, match="float"):
+        QuantizedStack.quantize(np.ones((2, 3, 4), dtype=np.int32))
+
+
+# ---------------------------------------------------------------------------
+# training: bytes, fidelity, transport invariance
+
+
+def test_int8_stack_bytes_and_fidelity(gmm):
+    """The resident int8 stack is ~4x smaller than f32 (payload exactly
+    4x; y and the scale tables make up the remainder), and the trained
+    params stay close to the f32 run — lossy, but bounded."""
+    cache_lib.clear()
+    f32 = trainer.train(_cfg(), gmm)
+    q = trainer.train(_cfg(stack_dtype="int8"), gmm)
+    assert q.cache_info["stack_dtype"] == "int8"
+    assert f32.cache_info["stack_dtype"] == "float32"
+    rows, F, S = 8, 16, 3  # rows/partition, features, slots (s+1)
+    x_f32 = W * S * rows * F * 4
+    x_q = W * S * rows * F * 1
+    scale = W * S * F * 4
+    y_b = W * S * rows * 4
+    assert f32.cache_info["stack_bytes"] == x_f32 + y_b
+    assert q.cache_info["stack_bytes"] == x_q + scale + y_b
+    assert f32.cache_info["stack_bytes"] > 2 * q.cache_info["stack_bytes"]
+    pf = np.asarray(jax.tree.leaves(f32.final_params)[0], np.float64)
+    pq = np.asarray(jax.tree.leaves(q.final_params)[0], np.float64)
+    assert np.isfinite(pq).all()
+    rel = np.linalg.norm(pq - pf) / np.linalg.norm(pf)
+    assert rel < 0.05, rel  # ~2e-3 measured; generous CI headroom
+
+
+def test_int8_transport_invariance(gmm):
+    """Materialized, ring, and ring-pipelined int8 runs consume the same
+    quantized values — bitwise-identical trajectories (quantization
+    happens once, per partition, BEFORE any worker-major gather)."""
+    m = trainer.train(_cfg(stack_dtype="int8"), gmm)
+    r = trainer.train(
+        _cfg(stack_dtype="int8", stack_mode="ring"), gmm
+    )
+    p = trainer.train(
+        _cfg(stack_dtype="int8", stack_mode="ring", ring_pipeline="on"),
+        gmm,
+    )
+    assert _bitwise(m.params_history, r.params_history)
+    assert _bitwise(m.params_history, p.params_history)
+    # ring telemetry: the int8 ring stack is the compressed partition stack
+    assert r.cache_info["stack_mode"] == "ring"
+    assert r.cache_info["stack_bytes"] < m.cache_info["stack_bytes"]
+
+
+def test_int8_composes_with_lowerings_and_deduped(gmm):
+    """The dequantizing body sits under every lowering swap: forced flat
+    and margin-flat runs train on the identical dequantized values as the
+    per-slot body (allclose — reduction order differs), and deduped mode
+    compresses its partition stack too."""
+    base = trainer.train(_cfg(stack_dtype="int8"), gmm)
+    for tag, extra in (
+        ("flat", dict(flat_grad="on")),
+        ("marginflat", dict(margin_flat="on")),
+        ("deduped", dict(compute_mode="deduped")),
+    ):
+        res = trainer.train(_cfg(stack_dtype="int8", **extra), gmm)
+        a = np.asarray(jax.tree.leaves(base.final_params)[0])
+        b = np.asarray(jax.tree.leaves(res.final_params)[0])
+        assert np.allclose(a, b, rtol=1e-4, atol=1e-5), tag
+
+
+def test_int8_cohort_matches_sequential(gmm):
+    cfgs = [
+        _cfg(stack_dtype="int8", compute_mode="deduped", seed=s)
+        for s in (0, 1)
+    ]
+    cohort = trainer.train_cohort(cfgs, gmm)
+    assert cohort[0].cache_info["stack_dtype"] == "int8"
+    for c, res in zip(cfgs, cohort):
+        seq = trainer.train(c, gmm)
+        a = np.asarray(jax.tree.leaves(seq.final_params)[0])
+        b = np.asarray(jax.tree.leaves(res.final_params)[0])
+        assert np.allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_data_cache_rekeys_on_stack_dtype(gmm):
+    """(content, stack_dtype) keys the upload: f32 -> int8 misses, int8
+    rerun hits, and the exec cache never serves an f32 program to an int8
+    run (leaf dtypes differ in the data_tree signature)."""
+    cache_lib.clear()
+    f32 = trainer.train(_cfg(), gmm)
+    assert not f32.cache_info["data_hit"]
+    q = trainer.train(_cfg(stack_dtype="int8"), gmm)
+    assert not q.cache_info["data_hit"]
+    assert q.cache_info["exec_misses"] >= 1
+    q2 = trainer.train(_cfg(stack_dtype="int8"), gmm)
+    assert q2.cache_info["data_hit"]
+    assert q2.cache_info["exec_hits"] >= 1
+    assert _bitwise(q.params_history, q2.params_history)
+
+
+def test_stack_dtype_bfloat16_equals_data_dtype_bf16(gmm):
+    """Explicit stack_dtype='bfloat16' is the same lever as
+    dtype='bfloat16' for the training stacks — bitwise."""
+    a = trainer.train(_cfg(dtype="bfloat16"), gmm)
+    b = trainer.train(_cfg(stack_dtype="bfloat16"), gmm)
+    assert b.cache_info["stack_dtype"] == "bfloat16"
+    assert _bitwise(a.params_history, b.params_history)
+
+
+# ---------------------------------------------------------------------------
+# refusals and validation
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="stack_dtype"):
+        _cfg(stack_dtype="int4")
+    with pytest.raises(ValueError, match="ring_pipeline"):
+        _cfg(ring_pipeline="banana")
+    with pytest.raises(ValueError, match="donate"):
+        _cfg(donate="maybe")
+    with pytest.raises(ValueError, match="measured"):
+        _cfg(stack_dtype="int8", arrival_mode="measured")
+    with pytest.raises(ValueError, match="use_pallas"):
+        _cfg(stack_dtype="int8", use_pallas="on")
+    # resolution: auto follows the data dtype
+    assert _cfg().resolve_stack_dtype() == "float32"
+    assert _cfg(dtype="bfloat16").resolve_stack_dtype() == "bfloat16"
+    assert _cfg(stack_dtype="int8").resolve_stack_dtype() == "int8"
+    assert (
+        _cfg(dtype="bfloat16", stack_dtype="float32").resolve_stack_dtype()
+        == "float32"
+    )
+
+
+def test_int8_refuses_sparse_stacks():
+    data = generate_onehot(96, 16, n_partitions=12, n_fields=4, seed=0)
+    with pytest.raises(ValueError, match="dense"):
+        trainer.train(
+            _cfg(stack_dtype="int8", sparse_format="padded"), data
+        )

@@ -1,0 +1,188 @@
+"""Buffer donation (cfg.donate): the memory lever must never touch the
+science or the caches.
+
+Donation aliases the scan carry (params + optimizer state) and the
+per-round weight tables into the dispatch (jax donate_argnums), freeing
+the duplicate HBM copies. The hazards this file pins:
+
+  - use-after-donate against the device-data cache: a donating run must
+    never donate a cached stack, and a cache-hit rerun after a donating
+    run must be bitwise identical (ISSUE 6 acceptance);
+  - the warm-up execution consumes donated buffers — the real run must
+    still see live originals (the _donate_copy discipline), including on
+    the checkpoint-chunked path where a full-range weight slice ALIASES
+    the run's weight table;
+  - donation is observation-free math: on/off trajectories are bitwise
+    identical, sequential and cohort alike;
+  - the OOM-bisection path (experiments._dispatch_cohort +
+    cache.drop_data_cache) still works mid-sweep with donation on.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from erasurehead_tpu.data.synthetic import generate_gmm
+from erasurehead_tpu.train import cache as cache_lib
+from erasurehead_tpu.train import experiments, trainer
+from erasurehead_tpu.train import journal as journal_lib
+from erasurehead_tpu.utils import chaos
+from erasurehead_tpu.utils.config import RunConfig
+
+W = 8
+
+
+@pytest.fixture(scope="module")
+def gmm():
+    return generate_gmm(W * 8, 16, n_partitions=W, seed=0)
+
+
+def _cfg(**kw):
+    base = dict(
+        scheme="approx", n_workers=W, n_stragglers=1, num_collect=4,
+        rounds=3, n_rows=W * 8, n_cols=16, lr_schedule=0.5,
+        update_rule="AGD", add_delay=True, seed=0,
+    )
+    base.update(kw)
+    return RunConfig(**base)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean(monkeypatch):
+    monkeypatch.delenv(chaos.CHAOS_ENV, raising=False)
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _bitwise(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+def _cached_stack_leaves():
+    """Every jax Array currently pinned by the device-data cache."""
+    leaves = []
+    for data, _nbytes in cache_lib._data_cache.values():
+        for leaf in jax.tree.leaves((data.Xp, data.yp, data.Xw, data.yw)):
+            if isinstance(leaf, jax.Array):
+                leaves.append(leaf)
+    return leaves
+
+
+def test_donating_run_never_donates_cached_stacks(gmm):
+    """After a donating run, every data-cache array is still alive (no
+    donated buffer is a cached device array), and a cache-hit rerun is
+    bitwise identical — the ISSUE 6 donation regression."""
+    cache_lib.clear()
+    cfg = _cfg(donate="on")
+    first = trainer.train(cfg, gmm)
+    assert first.cache_info["donation"] is True
+    leaves = _cached_stack_leaves()
+    assert leaves, "expected the data cache to hold this run's stacks"
+    assert all(not leaf.is_deleted() for leaf in leaves)
+    second = trainer.train(cfg, gmm)
+    assert second.cache_info["data_hit"]
+    assert second.cache_info["exec_hits"] >= 1
+    assert _bitwise(first.params_history, second.params_history)
+    assert _bitwise(first.final_params, second.final_params)
+    # and the cache pins are STILL alive after the second donating run
+    assert all(not leaf.is_deleted() for leaf in _cached_stack_leaves())
+
+
+def test_donation_is_bitwise_invisible(gmm):
+    """donate on vs off: identical trajectories (donation is aliasing,
+    not math), for the default measure=True warm-up path too."""
+    on = trainer.train(_cfg(donate="on"), gmm)
+    off = trainer.train(_cfg(donate="off"), gmm)
+    assert on.cache_info["donation"] is True
+    assert off.cache_info["donation"] is False
+    assert _bitwise(on.params_history, off.params_history)
+    # donation resolution: auto = DONATE_DEFAULT
+    auto = trainer.train(_cfg(), gmm)
+    assert auto.cache_info["donation"] is trainer.DONATE_DEFAULT
+
+
+def test_donation_checkpoint_chunked_path(gmm, tmp_path):
+    """The chunked scan (checkpoint_every) re-slices the weight table per
+    chunk; with donation on, consumed chunk slices must never strand a
+    later chunk or the saved state. Bitwise vs the non-donating run with
+    identical chunking."""
+    kw = dict(
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+    )
+    on = trainer.train(_cfg(rounds=6, donate="on"), gmm, **kw)
+    off = trainer.train(
+        _cfg(rounds=6, donate="off"), gmm,
+        checkpoint_dir=str(tmp_path / "ck2"), checkpoint_every=2,
+    )
+    assert _bitwise(on.params_history, off.params_history)
+    assert _bitwise(on.final_params, off.final_params)
+
+
+def test_donation_cohort_bitwise(gmm):
+    """Cohort dispatches donate the [B]-stacked carry and the [R, B, ...]
+    weight tables; trajectories match the non-donating cohort bitwise and
+    the shared data stack survives in the cache."""
+    cache_lib.clear()
+    cfgs = [
+        _cfg(compute_mode="deduped", donate="on", seed=s) for s in (0, 1)
+    ]
+    on = trainer.train_cohort(cfgs, gmm)
+    off = trainer.train_cohort(
+        [dataclasses.replace(c, donate="off") for c in cfgs], gmm
+    )
+    assert on[0].cache_info["donation"] is True
+    for a, b in zip(on, off):
+        assert _bitwise(a.params_history, b.params_history)
+    assert all(not leaf.is_deleted() for leaf in _cached_stack_leaves())
+    # a donating cohort rerun off the caches is bitwise identical too
+    rerun = trainer.train_cohort(cfgs, gmm)
+    assert rerun[0].cache_info["data_hit"]
+    for a, b in zip(on, rerun):
+        assert _bitwise(a.params_history, b.params_history)
+
+
+def test_donation_survives_oom_bisection_and_cache_drop(gmm, monkeypatch):
+    """Donating sweep + injected cohort OOM: _dispatch_cohort drops the
+    data cache's HBM pins (cache.drop_data_cache) and bisects; the
+    re-uploaded stacks feed donating retries and every row matches the
+    sequential (batch='off') sweep — drop_data_cache still works
+    mid-sweep with donation on."""
+    configs = {
+        f"{s}_d": _cfg(scheme=s, compute_mode="deduped", donate="on",
+                       **extra)
+        for s, extra in (
+            ("naive", {}),
+            ("avoidstragg", {}),
+            ("approx", {"num_collect": 4}),
+            ("cyccoded", {}),
+        )
+    }
+    off_rows = experiments.compare(dict(configs), gmm, batch="off")
+    dropped0 = cache_lib._METRICS.counter(
+        "sweep_cache.data_dropped_bytes"
+    ).value
+    monkeypatch.setenv(chaos.CHAOS_ENV, "raise:cohort:1")
+    chaos.reset()
+    rows = experiments.compare(dict(configs), gmm, batch="on")
+    monkeypatch.delenv(chaos.CHAOS_ENV)
+    assert (
+        cache_lib._METRICS.counter(
+            "sweep_cache.data_dropped_bytes"
+        ).value
+        > dropped0
+    ), "the OOM path must have dropped the data cache's pins"
+    science = lambda rs: [journal_lib.science_row(s.row()) for s in rs]
+    assert science(off_rows) == science(rows)
+    # and the post-drop rebuilt cache is healthy: a fresh donating run hits
+    again = trainer.train(configs["naive_d"], gmm)
+    assert _bitwise(
+        again.final_params,
+        trainer.train(configs["naive_d"], gmm).final_params,
+    )

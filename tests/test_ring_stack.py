@@ -78,14 +78,28 @@ def gmm30():
 )
 def test_ring_bitwise_identical_w30(gmm30, scheme, extra):
     """All seven reference schemes at the canonical W=30 shape: the ring
-    transport must reproduce the materialized trajectory bit for bit."""
+    transport must reproduce the materialized trajectory bit for bit —
+    under BOTH transport schedules (ring_pipeline off and on; the
+    double-buffered form moves the same blocks in the same fill order,
+    so pipelining is a pure lowering knob)."""
     cfg = _cfg(scheme=scheme, n_workers=W30, n_rows=ROWS30, rounds=2, **extra)
     m = trainer.train(cfg, gmm30)
-    r = trainer.train(dataclasses.replace(cfg, stack_mode="ring"), gmm30)
+    r = trainer.train(
+        dataclasses.replace(cfg, stack_mode="ring", ring_pipeline="off"),
+        gmm30,
+    )
+    p = trainer.train(
+        dataclasses.replace(cfg, stack_mode="ring", ring_pipeline="on"),
+        gmm30,
+    )
     assert m.cache_info["stack_mode"] == "materialized"
     assert r.cache_info["stack_mode"] == "ring"
+    assert r.cache_info["ring_pipeline"] == "sequential"
+    assert p.cache_info["ring_pipeline"] == "pipelined"
     assert _bitwise_equal(m.params_history, r.params_history), scheme
     assert _bitwise_equal(m.final_params, r.final_params), scheme
+    assert _bitwise_equal(m.params_history, p.params_history), scheme
+    assert _bitwise_equal(m.final_params, p.final_params), scheme
 
 
 def test_ring_bitwise_beyond_reference_schemes(gmm30):
@@ -328,6 +342,59 @@ def test_config_validation():
     # auto composes with everything (resolution backs off where needed)
     _cfg(stack_mode="auto", use_pallas="on")
     _cfg(stack_mode="auto", compute_mode="deduped")
+
+
+def test_ring_pipeline_resolution_and_exec_key():
+    """resolve_ring_pipeline: on/off force, auto follows the
+    measurement-pinned default; a pipelined and a sequential ring run of
+    otherwise identical configs never share a compiled executable (the
+    scan structure differs — the resolved schedule is in the ring
+    signature)."""
+    from erasurehead_tpu.parallel import step as step_lib
+
+    assert step_lib.resolve_ring_pipeline("on") is True
+    assert step_lib.resolve_ring_pipeline("off") is False
+    assert (
+        step_lib.resolve_ring_pipeline("auto")
+        is step_lib.RING_PIPELINE_DEFAULT
+    )
+    W = 12
+    data = generate_gmm(W * 8, 16, n_partitions=W, seed=0)
+    cache_lib.clear()
+    base = _cfg(
+        scheme="approx", n_workers=W, n_stragglers=2, num_collect=6,
+        n_rows=W * 8, stack_mode="ring",
+    )
+    trainer.train(dataclasses.replace(base, ring_pipeline="off"), data)
+    p = trainer.train(dataclasses.replace(base, ring_pipeline="on"), data)
+    assert p.cache_info["exec_misses"] >= 1  # no false hit
+    assert p.cache_info["data_hit"]  # same upload serves both schedules
+
+
+def test_ring_pipeline_cohort_and_dynamic_bitwise():
+    """The double-buffered transport composes with the trajectory-cohort
+    dispatch and the on-device dynamic trainer without breaking bit
+    identity against the sequential schedule."""
+    data = gmm12()
+    cfg = _cfg(
+        scheme="repcoded", n_workers=12, n_stragglers=2, n_rows=96,
+        rounds=2, stack_mode="ring",
+    )
+    seq = trainer.train_batch(cfg, data, seeds=[0, 1])
+    pipe = trainer.train_batch(
+        dataclasses.replace(cfg, ring_pipeline="on"), data, seeds=[0, 1]
+    )
+    for s, p in zip(seq, pipe):
+        assert _bitwise_equal(s.params_history, p.params_history)
+    dcfg = _cfg(
+        scheme="approx", n_workers=12, n_stragglers=2, num_collect=6,
+        n_rows=96, rounds=2, stack_mode="ring",
+    )
+    d_seq = trainer.train_dynamic(dcfg, data)
+    d_pipe = trainer.train_dynamic(
+        dataclasses.replace(dcfg, ring_pipeline="on"), data
+    )
+    assert _bitwise_equal(d_seq.params_history, d_pipe.params_history)
 
 
 def test_exec_cache_keys_on_resolved_ring():

@@ -340,6 +340,46 @@ def test_recompile_warning_event_from_trainer(tmp_path):
     assert obs_events.validate_file(path) == []
 
 
+def test_recompile_detector_names_memory_system_knobs(tmp_path):
+    """The PR-6 memory-system knobs are named signature fields
+    (RunConfig.static_signature_fields): a cache miss caused by flipping
+    stack_dtype, ring_pipeline, or donate produces a recompile warning
+    that NAMES the differing knob, not just "something changed"."""
+    ds = _dataset()
+
+    def changed_fields(cfg_a, cfg_b, tag):
+        cache.clear()
+        path = str(tmp_path / f"events_{tag}.jsonl")
+        with obs_events.capture(path):
+            trainer.train(cfg_a, ds)
+            trainer.train(cfg_b, ds)
+        warnings = [
+            r for r in _events(path)
+            if r["type"] == "warning" and r["kind"] == "recompile"
+        ]
+        assert warnings, f"expected a recompile warning for {tag}"
+        assert obs_events.validate_file(path) == []
+        return warnings[-1]["changed"]
+
+    base = dict(num_collect=2)
+    assert "stack_dtype" in changed_fields(
+        _cfg("approx", **base),
+        _cfg("approx", stack_dtype="int8", **base),
+        "stack_dtype",
+    )
+    ring = dict(num_collect=2, compute_mode="faithful", stack_mode="ring")
+    assert "ring_pipeline" in changed_fields(
+        _cfg("approx", ring_pipeline="off", **ring),
+        _cfg("approx", ring_pipeline="on", **ring),
+        "ring_pipeline",
+    )
+    assert "donate" in changed_fields(
+        _cfg("approx", donate="on", **base),
+        _cfg("approx", donate="off", **base),
+        "donate",
+    )
+
+
 # ---------------------------------------------------------------------------
 # metrics registry (tentpole: cache_info plumbing now reports through it)
 

@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Smoke-check the memory-system roofline levers on CPU
+(`make roofline-smoke`).
+
+Runs a small ring + pipelined + int8 sweep and asserts the MECHANISM of
+each ISSUE-6 lever (the TPU step-time numbers come from the tagged
+measurement program; this asserts what must hold on any backend):
+
+  - f32 bitwise pins: materialized == ring == ring+pipelined trajectories
+    for the flagship scheme shape, with donation on;
+  - bytes accounting, exactly: the ring stack is 1/(s+1) of the
+    materialized stack, and the int8 ring stack's payload is 1/4 of the
+    f32 ring stack's (plus the scale table + labels, computed here to the
+    byte);
+  - dispatch counts: the int8+ring+pipelined 2-scheme x 2-seed cohort is
+    ONE dispatch (cohort.dispatches counter), and a rerun of every
+    variant is pure cache hits (no recompiles, no re-uploads);
+  - no donated buffer is a cached device array (the data cache's pins
+    are alive after every donating dispatch).
+
+Exit 0 = all assertions hold; 1 = failure (printed).
+"""
+
+import os
+import sys
+
+# runnable from anywhere without an install (the tools/ convention)
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU relay
+
+
+def main() -> int:
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.obs.metrics import REGISTRY
+    from erasurehead_tpu.train import cache, trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    failures = []
+
+    def check(ok, msg):
+        if not ok:
+            failures.append(msg)
+
+    def bitwise(a, b):
+        la, lb = jax.tree.leaves(a.params_history), jax.tree.leaves(
+            b.params_history
+        )
+        return len(la) == len(lb) and all(
+            np.array_equal(np.asarray(x), np.asarray(y))
+            for x, y in zip(la, lb)
+        )
+
+    # FRC shape with real (s+1)=3x redundancy — the ring lever's subject
+    W, s, rows_per, F, R = 12, 2, 16, 24, 4
+    data = generate_gmm(W * rows_per, F, n_partitions=W, seed=0)
+    base = RunConfig(
+        scheme="repcoded", n_workers=W, n_stragglers=s, rounds=R,
+        n_rows=W * rows_per, n_cols=F, lr_schedule=0.5,
+        update_rule="AGD", add_delay=True, seed=0, donate="on",
+    )
+    cache.clear()
+
+    # ---- lever 1+2: ring + pipelined transport, donation on ------------
+    m = trainer.train(base, data)
+    r = trainer.train(dataclasses.replace(base, stack_mode="ring"), data)
+    p = trainer.train(
+        dataclasses.replace(
+            base, stack_mode="ring", ring_pipeline="on"
+        ),
+        data,
+    )
+    check(bitwise(m, r), "f32 ring != materialized (bitwise pin broken)")
+    check(bitwise(m, p), "f32 ring+pipelined != materialized")
+    check(
+        p.cache_info["ring_pipeline"] == "pipelined",
+        f"expected pipelined transport, got {p.cache_info['ring_pipeline']}",
+    )
+    check(
+        m.cache_info["donation"] is True,
+        "donation did not resolve on",
+    )
+
+    # bytes accounting, to the byte: materialized = (s+1) x ring
+    x_ring = W * rows_per * F * 4
+    y_ring = W * rows_per * 4
+    check(
+        r.cache_info["stack_bytes"] == x_ring + y_ring,
+        f"ring f32 stack bytes {r.cache_info['stack_bytes']} != "
+        f"{x_ring + y_ring}",
+    )
+    check(
+        m.cache_info["stack_bytes"] == (s + 1) * r.cache_info["stack_bytes"],
+        f"materialized {m.cache_info['stack_bytes']} != "
+        f"{s + 1}x ring {r.cache_info['stack_bytes']}",
+    )
+
+    # ---- lever 3: int8 compressed stack over the pipelined ring --------
+    q = trainer.train(
+        dataclasses.replace(
+            base, stack_mode="ring", ring_pipeline="on", stack_dtype="int8"
+        ),
+        data,
+    )
+    x_q = W * rows_per * F * 1
+    scale_q = W * F * 4
+    check(
+        q.cache_info["stack_bytes"] == x_q + scale_q + y_ring,
+        f"int8 ring stack bytes {q.cache_info['stack_bytes']} != "
+        f"{x_q + scale_q + y_ring}",
+    )
+    check(
+        q.cache_info["stack_dtype"] == "int8",
+        f"stack_dtype telemetry {q.cache_info['stack_dtype']!r}",
+    )
+    # int8 transports agree bitwise (quantized once, per partition)
+    q_mat = trainer.train(
+        dataclasses.replace(base, stack_dtype="int8"), data
+    )
+    check(bitwise(q, q_mat), "int8 ring+pipelined != int8 materialized")
+    qp = np.concatenate(
+        [np.asarray(l).ravel() for l in jax.tree.leaves(q.final_params)]
+    )
+    check(np.isfinite(qp).all(), "int8 run produced non-finite params")
+
+    # ---- dispatch counts: one cohort dispatch for the int8 ring sweep --
+    d0 = REGISTRY.counter("cohort.dispatches").value
+    cfgs = [
+        dataclasses.replace(
+            base, scheme=sch, stack_mode="ring", ring_pipeline="on",
+            stack_dtype="int8", seed=sd,
+        )
+        # repcoded and approx share the FRC assignment -> one ring cohort
+        for sch in ("repcoded", "approx")
+        for sd in (0, 1)
+    ]
+    cfgs = [
+        dataclasses.replace(c, num_collect=6)
+        if c.scheme.value == "approx" else c
+        for c in cfgs
+    ]
+    cohort = trainer.train_cohort(cfgs, data)
+    check(
+        REGISTRY.counter("cohort.dispatches").value - d0 == 1,
+        "int8 ring cohort did not run as ONE dispatch",
+    )
+    check(
+        cohort[0].cache_info["cohort_size"] == len(cfgs),
+        f"cohort size {cohort[0].cache_info['cohort_size']} != {len(cfgs)}",
+    )
+
+    # ---- cache hygiene: reruns are pure hits; pins alive post-donation --
+    stats0 = cache.stats().snapshot()
+    for cfg in (
+        base,
+        dataclasses.replace(base, stack_mode="ring", ring_pipeline="on"),
+        dataclasses.replace(
+            base, stack_mode="ring", ring_pipeline="on", stack_dtype="int8"
+        ),
+    ):
+        rerun = trainer.train(cfg, data)
+        check(
+            rerun.cache_info["data_hit"] and rerun.cache_info["exec_hits"],
+            f"rerun of {cfg.stack_mode}/{cfg.stack_dtype} missed the caches",
+        )
+    stats1 = cache.stats().snapshot()
+    check(
+        stats1["exec_misses"] == stats0["exec_misses"],
+        "reruns recompiled (donation or keys broke executable reuse)",
+    )
+    for d, _nbytes in cache._data_cache.values():
+        for leaf in jax.tree.leaves((d.Xp, d.yp, d.Xw, d.yw)):
+            if isinstance(leaf, jax.Array) and leaf.is_deleted():
+                check(False, "a cached device array was donated (deleted)")
+
+    print(
+        f"roofline-smoke: f32 pins ok; stack bytes materialized="
+        f"{m.cache_info['stack_bytes']} ring={r.cache_info['stack_bytes']} "
+        f"int8_ring={q.cache_info['stack_bytes']}; "
+        f"{len(cfgs)}-trajectory int8 ring cohort = 1 dispatch; "
+        f"reruns all cache hits"
+    )
+    for f in failures:
+        print(f"FAIL: {f}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

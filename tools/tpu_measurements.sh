@@ -78,6 +78,15 @@ run dense_bf16     1800 env BENCH_DTYPE=bfloat16 python bench.py
 # memory_analysis/stack_bytes telemetry on real silicon
 run dense_f32_ring  1800 env BENCH_STACK=ring python bench.py
 run dense_bf16_ring 1800 env BENCH_STACK=ring BENCH_DTYPE=bfloat16 python bench.py
+# PR-6 memory-system levers (ISSUE 6): double-buffered ring transport
+# (bitwise-identical; decides RING_PIPELINE_DEFAULT), the int8 compressed
+# stack (4x fewer streamed bytes; fidelity extra rides in the payload),
+# and the donation before-row (the canonical run now donates by default)
+run dense_f32_ringpipe   1800 env BENCH_STACK=ring BENCH_RING_PIPELINE=on python bench.py
+run dense_int8_ring      1800 env BENCH_STACK=ring BENCH_STACK_DTYPE=int8 python bench.py
+run dense_int8_ringpipe  1800 env BENCH_STACK=ring BENCH_RING_PIPELINE=on BENCH_STACK_DTYPE=int8 python bench.py
+run dense_int8           1800 env BENCH_STACK_DTYPE=int8 python bench.py
+run dense_f32_nodonate   1800 env BENCH_DONATE=off python bench.py
 # deduped compute mode on the dense flagship: bit-compatible gradients at
 # 1/(s+1) the HBM traffic — the framework's structural win over the
 # faithful reference protocol, never yet TPU-measured for dense

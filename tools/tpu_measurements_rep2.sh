@@ -29,9 +29,19 @@ run dense_bf16_rep2            1800 env BENCH_DTYPE=bfloat16 python bench.py
 run dense_bf16_flat_rep2       1800 env BENCH_FLAT=on BENCH_DTYPE=bfloat16 python bench.py
 run dense_bf16_marginflat_rep2 1800 env BENCH_MARGIN_FLAT=on BENCH_DTYPE=bfloat16 python bench.py
 
-# --- ring stack mode (new this round; the memory-side candidate) --------
+# --- ring stack mode (the memory-side candidate) -------------------------
 run dense_f32_ring_rep2        1800 env BENCH_STACK=ring python bench.py
 run dense_bf16_ring_rep2       1800 env BENCH_STACK=ring BENCH_DTYPE=bfloat16 python bench.py
+
+# --- PR-6 memory-system levers (BASELINE.md queued-measurement note) -----
+# double-buffered transport gates RING_PIPELINE_DEFAULT; the int8 rows
+# carry the fidelity extra (eval-loss delta vs the f32 stack); nodonate
+# is the donation before-row now that the canonical run donates
+run dense_f32_ringpipe_rep2    1800 env BENCH_STACK=ring BENCH_RING_PIPELINE=on python bench.py
+run dense_int8_ring_rep2       1800 env BENCH_STACK=ring BENCH_STACK_DTYPE=int8 python bench.py
+run dense_int8_ringpipe_rep2   1800 env BENCH_STACK=ring BENCH_RING_PIPELINE=on BENCH_STACK_DTYPE=int8 python bench.py
+run dense_int8_rep2            1800 env BENCH_STACK_DTYPE=int8 python bench.py
+run dense_f32_nodonate_rep2    1800 env BENCH_DONATE=off python bench.py
 
 # --- fields constellation (per-shape default gates) ----------------------
 for shape in covtype amazon; do
