@@ -42,7 +42,8 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 	partialrepcoded partialcyccoded randreg deadline \
 	generate_random_data arrange_real_data \
 	test tier1 bench sweep rehearse watch compare real_data dryrun \
-	telemetry-smoke sweep-batch-smoke chaos-smoke roofline-smoke clean
+	telemetry-smoke sweep-batch-smoke chaos-smoke roofline-smoke \
+	serve-smoke clean
 
 naive:            ## uncoded wait-for-all baseline (src/naive.py)
 	$(RUN) --scheme naive
@@ -118,6 +119,9 @@ chaos-smoke:      ## CPU kill->resume + cohort-degradation cycle: chaos-killed s
 
 roofline-smoke:   ## CPU ring+pipelined+int8 sweep: asserts bytes accounting, dispatch counts, and the f32 bitwise pins (tools/roofline_smoke.py)
 	JAX_PLATFORMS=cpu $(PY) tools/roofline_smoke.py
+
+serve-smoke:      ## CPU serve daemon race: 4 clients pack into shared dispatches, rows bitwise vs sequential (tools/serve_smoke.py)
+	JAX_PLATFORMS=cpu $(PY) tools/serve_smoke.py
 
 sweep:            ## the full on-TPU measurement program (resumable, tagged)
 	bash tools/tpu_measurements.sh

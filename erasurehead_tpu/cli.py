@@ -35,6 +35,13 @@ A fourth runs the comparison-suite sweep (train/experiments.py) behind the
 same console entry, with the resilient-sweep flags::
 
        erasurehead-tpu sweep --rounds 30 --sweep-journal DIR --resume-sweep
+
+A fifth runs the multi-tenant sweep-as-a-service daemon
+(erasurehead_tpu/serve/): concurrent clients' compatible requests bin-pack
+into shared cohort dispatches under an HBM admission budget::
+
+       erasurehead-tpu serve --socket /tmp/eh.sock --budget 2g \\
+           --journal-dir /var/lib/eh-serve --events serve_events.jsonl
 """
 
 from __future__ import annotations
@@ -584,6 +591,14 @@ def main(argv: list[str] | None = None) -> int:
         from erasurehead_tpu.train import experiments as experiments_lib
 
         return experiments_lib.main(argv[1:])
+    if argv and argv[0] == "serve":
+        # `erasurehead-tpu serve ...` — the multi-tenant sweep-as-a-service
+        # daemon (erasurehead_tpu/serve/): packs concurrent clients'
+        # compatible requests into shared cohort dispatches behind a unix
+        # socket, under an HBM admission budget
+        from erasurehead_tpu.serve import server as serve_lib
+
+        return serve_lib.main(argv[1:])
     if len(argv) == 13 and not argv[0].startswith("-"):
         cfg = _legacy_to_config(argv)
         run(cfg)

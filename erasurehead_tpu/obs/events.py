@@ -27,6 +27,7 @@ import hashlib
 import itertools
 import json
 import os
+import threading
 import time
 from typing import IO, Iterable, Optional
 
@@ -69,6 +70,21 @@ SCHEMA: dict[str, tuple] = {
     # row without re-training. The journal file is an events.jsonl like any
     # other (same envelope, same validator).
     "sweep_trajectory": ("key", "label", "status", "row"),
+    # serve daemon (erasurehead_tpu/serve/): one per accepted client
+    # request — which tenant asked for which trajectory
+    "request": ("tenant", "request_id", "label"),
+    # one per packed cohort the packer hands to the dispatch engine:
+    # how many pending trajectories (across how many tenants) share this
+    # dispatch — the record behind report's packed-dispatch ratio
+    "pack": ("n_trajectories", "labels", "tenants"),
+    # one per admission decision: the cohort's estimated device footprint
+    # against the serve budget ("admitted" rides along as an optional
+    # field; admitted=false = the request QUEUES instead of joining)
+    "admit": ("est_bytes", "budget_bytes"),
+    # one per admission-pressure eviction: the controller dropped the
+    # sweep data cache's HBM pins (or timed a request out of the packing
+    # window) to make room — "reason" says which
+    "evict": ("reason",),
 }
 
 #: sweep_trajectory completion statuses (train/journal.py); "diverged"
@@ -99,19 +115,39 @@ def _jsonable(v):
 
 class EventLogger:
     """Append-only JSONL writer with per-line flush (a crashed run keeps
-    every event emitted before the crash)."""
+    every event emitted before the crash).
+
+    Concurrency contract (the serve daemon and the sweep journal depend on
+    it): ``emit`` is safe under concurrent WRITERS.
+
+      - threads sharing one logger: a lock makes the seq draw + write one
+        atomic step, so ``seq`` stays strictly monotonic per logger;
+      - processes appending to one FILE (``mode="a"``): the file is opened
+        with O_APPEND and every record is ONE unbuffered ``write()`` of a
+        complete line, so concurrent appenders' lines land whole — never
+        interleaved mid-line (each writer restarts seq at 0, which the
+        validator accepts as a new logger run).
+
+    ``mode="w"`` (single-writer run logs) keeps buffered text io with a
+    per-line flush.
+    """
 
     def __init__(self, path: str, mode: str = "w"):
         self.path = path
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        self._f: Optional[IO[str]] = open(path, mode)
+        self._append = "a" in mode
+        # append mode: unbuffered binary fd with O_APPEND semantics — one
+        # os-level write per record is what makes multi-process journal
+        # appends corruption-free (train/journal.py)
+        self._f: Optional[IO] = open(
+            path, mode + "b", buffering=0
+        ) if self._append else open(path, mode)
         self._seq = itertools.count()
+        self._lock = threading.Lock()
 
     def emit(self, type: str, **fields) -> None:
-        if self._f is None:
-            raise ValueError(f"event logger {self.path!r} is closed")
         required = SCHEMA.get(type)
         if required is None:
             raise ValueError(
@@ -120,15 +156,27 @@ class EventLogger:
         missing = [k for k in required if k not in fields]
         if missing:
             raise ValueError(f"event {type!r} missing required {missing}")
-        rec = {"type": type, "seq": next(self._seq), "t": round(time.time(), 3)}
-        rec.update({k: _jsonable(v) for k, v in fields.items()})
-        self._f.write(json.dumps(rec) + "\n")
-        self._f.flush()
+        payload = {k: _jsonable(v) for k, v in fields.items()}
+        with self._lock:
+            if self._f is None:
+                raise ValueError(f"event logger {self.path!r} is closed")
+            rec = {
+                "type": type, "seq": next(self._seq),
+                "t": round(time.time(), 3),
+            }
+            rec.update(payload)
+            line = json.dumps(rec) + "\n"
+            if self._append:
+                self._f.write(line.encode())  # one write(2); O_APPEND
+            else:
+                self._f.write(line)
+                self._f.flush()
 
     def close(self) -> None:
-        if self._f is not None:
-            self._f.close()
-            self._f = None
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
 
 
 # --------------------------------------------------------------------------
@@ -292,10 +340,19 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
     trajectory); ``cohort`` records are internally consistent
     (n_trajectories matches the seeds list, dispatches >= 1);
     ``sweep_trajectory`` journal records carry a known status, a non-empty
-    key, and an object row; every ``run_start`` has a matching later
-    ``run_end``."""
+    key, and an object row; serve records are internally consistent
+    (``request`` names tenant/request_id/label, ``pack``'s trajectory
+    count matches its label list, ``admit`` carries non-negative byte
+    figures, ``evict`` names its reason); every ``run_start`` has a
+    matching later ``run_end``."""
     errors: list[str] = []
-    last_seq: Optional[int] = None
+    # seq checking is MULTI-STREAM: a file may interleave several
+    # append-mode loggers (concurrent journal writers, the serve daemon
+    # next to a local sweep). Each stream is append-only from 0, so every
+    # record's seq must either open a stream (0) or continue one; the
+    # multiset maps "next expected seq" -> number of streams expecting it.
+    seq_streams: dict = {}
+    seen_seq = False
     last_round: dict = {}  # (run_id, type) -> last first_round
     started: set = set()
     ended: set = set()
@@ -322,13 +379,22 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
         if not isinstance(seq, int):
             errors.append(f"line {i}: missing/invalid seq")
         else:
-            # seq restarts at 0 when another logger appended to the file;
-            # within a logger's run it must strictly increase
-            if last_seq is not None and seq != 0 and seq <= last_seq:
+            if seq == 0 or not seen_seq:
+                # a new logger run (seq restarts at 0); the file's very
+                # first record may also be the tail of a rotated stream
+                seq_streams[seq + 1] = seq_streams.get(seq + 1, 0) + 1
+            elif seq_streams.get(seq):
+                seq_streams[seq] -= 1
+                if not seq_streams[seq]:
+                    del seq_streams[seq]
+                seq_streams[seq + 1] = seq_streams.get(seq + 1, 0) + 1
+            else:
                 errors.append(
-                    f"line {i}: non-monotonic seq {seq} after {last_seq}"
+                    f"line {i}: non-monotonic seq {seq} (continues no "
+                    f"logger stream; expected one of "
+                    f"{sorted(seq_streams) or [0]})"
                 )
-            last_seq = seq
+            seen_seq = True
         if rtype in ("rounds", "decode"):
             key = (rec.get("run_id"), rtype, rec.get("trajectory"))
             fr = rec.get("first_round")
@@ -375,6 +441,47 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
                 errors.append(
                     f"line {i}: sweep_trajectory key must be a non-empty "
                     f"string"
+                )
+        if rtype == "request":
+            for field in ("tenant", "request_id", "label"):
+                v = rec.get(field)
+                if not isinstance(v, str) or not v:
+                    errors.append(
+                        f"line {i}: request {field} must be a non-empty "
+                        f"string, got {v!r}"
+                    )
+        if rtype == "pack":
+            n = rec.get("n_trajectories")
+            labels = rec.get("labels")
+            tenants = rec.get("tenants")
+            if not isinstance(labels, list):
+                errors.append(f"line {i}: pack labels must be a list")
+            elif isinstance(n, int) and len(labels) != n:
+                errors.append(
+                    f"line {i}: pack n_trajectories {n} != "
+                    f"{len(labels)} labels"
+                )
+            if not isinstance(tenants, list) or not tenants:
+                errors.append(
+                    f"line {i}: pack tenants must be a non-empty list"
+                )
+        if rtype == "admit":
+            for field in ("est_bytes", "budget_bytes"):
+                v = rec.get(field)
+                # budget_bytes None = unbounded (no budget configured)
+                if v is None and field == "budget_bytes":
+                    continue
+                if not isinstance(v, (int, float)) or v < 0:
+                    errors.append(
+                        f"line {i}: admit {field} must be a non-negative "
+                        f"number, got {v!r}"
+                    )
+        if rtype == "evict":
+            reason = rec.get("reason")
+            if not isinstance(reason, str) or not reason:
+                errors.append(
+                    f"line {i}: evict reason must be a non-empty string, "
+                    f"got {reason!r}"
                 )
         if rtype == "run_start":
             started.add(rec.get("run_id"))

@@ -19,18 +19,24 @@ from typing import Optional
 
 
 class Counter:
-    """Monotonically increasing value (int or float increments)."""
+    """Monotonically increasing value (int or float increments).
 
-    __slots__ = ("name", "_value")
+    Increments are lock-guarded: the serve daemon (erasurehead_tpu/serve/)
+    bumps counters from its dispatch pool threads, and ``+=`` alone is not
+    atomic under free-threaded interleavings."""
+
+    __slots__ = ("name", "_value", "_lock")
 
     def __init__(self, name: str):
         self.name = name
         self._value = 0
+        self._lock = threading.Lock()
 
     def inc(self, n=1):
         if n < 0:
             raise ValueError(f"counter {self.name}: negative increment {n}")
-        self._value += n
+        with self._lock:
+            self._value += n
 
     @property
     def value(self):

@@ -19,15 +19,18 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
     "end": run_end|None, "compiles": [...], "uploads": [...],
     "rounds": [...], "decode": [...], "cohort": cohort|None,
     "warnings": [...]}. A trailing run_id=None entry carries stray
-    warnings and any ``sweep_trajectory`` journal records (a sweep
+    warnings, any ``sweep_trajectory`` journal records (a sweep
     journal is an events.jsonl like any other — `report` renders its
-    rows, diverged ones flagged).
+    rows, diverged ones flagged), and the serve daemon's
+    request/pack/admit/evict stream (rendered as the per-tenant serving
+    section).
     Unparseable lines are skipped (the validator's job is strictness;
     the report renders what it can)."""
     runs: dict = {}
     order: list = []
     warnings: list = []
     trajectories: list = []
+    serve: dict = {"requests": [], "packs": [], "admits": [], "evicts": []}
 
     def run(rid):
         if rid not in runs:
@@ -69,13 +72,85 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
                     (run(rid)["warnings"] if rid else warnings).append(rec)
                 elif rtype == "sweep_trajectory":
                     trajectories.append(rec)
+                elif rtype == "request":
+                    serve["requests"].append(rec)
+                elif rtype == "pack":
+                    serve["packs"].append(rec)
+                elif rtype == "admit":
+                    serve["admits"].append(rec)
+                elif rtype == "evict":
+                    serve["evicts"].append(rec)
     out = [runs[rid] for rid in order]
-    if warnings or trajectories:
+    if warnings or trajectories or any(serve.values()):
         out.append({
             "run_id": None, "warnings": warnings,
-            "trajectories": trajectories,
+            "trajectories": trajectories, "serve": serve,
         })
     return out
+
+
+def _serve_section(stray: list) -> list[str]:
+    """The per-tenant serving section: requests, packed-dispatch ratio,
+    admission pressure, and quarantined/diverged rows, from the serve
+    daemon's request/pack/admit/evict + sweep_trajectory records."""
+    serve = {"requests": [], "packs": [], "admits": [], "evicts": []}
+    trajectories: list = []
+    for g in stray:
+        for k in serve:
+            serve[k].extend((g.get("serve") or {}).get(k, []))
+        trajectories.extend(g.get("trajectories", []))
+    if not serve["requests"] and not serve["packs"]:
+        return []
+    packs = serve["packs"]
+    n_packed_traj = sum(p.get("n_trajectories", 0) for p in packs)
+    ratio = n_packed_traj / len(packs) if packs else 0.0
+    deferred = sum(
+        1 for a in serve["admits"] if a.get("admitted") is False
+    )
+    lines = [
+        f"\nserve (multi-tenant cohort packing): "
+        f"{len(serve['requests'])} request(s) -> {len(packs)} "
+        f"dispatch(es), {ratio:.1f} trajectories/dispatch"
+        + (f", {deferred} deferred by admission" if deferred else "")
+        + (f", {len(serve['evicts'])} eviction(s)" if serve["evicts"]
+           else "")
+    ]
+    by_tenant: dict = {}
+    for r in serve["requests"]:
+        t = by_tenant.setdefault(
+            r.get("tenant", "?"),
+            {"requests": 0, "rows": 0, "diverged": 0, "errors": 0},
+        )
+        t["requests"] += 1
+    for rec in trajectories:
+        tenant = rec.get("tenant")
+        if tenant is None:
+            continue  # a local sweep journal row, not a serve row
+        t = by_tenant.setdefault(
+            tenant, {"requests": 0, "rows": 0, "diverged": 0, "errors": 0}
+        )
+        t["rows"] += 1
+        if rec.get("status") == "diverged":
+            t["diverged"] += 1
+    for w in (g2 for g in stray for g2 in g.get("warnings", [])):
+        if w.get("kind") != "serve_error":
+            continue
+        msg = w.get("message", "")
+        for tenant, t in by_tenant.items():
+            if f"(tenant '{tenant}')" in msg:
+                t["errors"] += 1
+    header = (
+        f"  {'tenant':16s} {'requests':>9s} {'rows':>6s} "
+        f"{'diverged':>9s} {'errors':>7s}"
+    )
+    lines += [header, "  " + "-" * (len(header) - 2)]
+    for tenant in sorted(by_tenant):
+        t = by_tenant[tenant]
+        lines.append(
+            f"  {tenant[:16]:16s} {t['requests']:>9d} {t['rows']:>6d} "
+            f"{t['diverged']:>9d} {t['errors']:>7d}"
+        )
+    return lines
 
 
 def _fmt(v, spec: str, none: str = "-") -> str:
@@ -151,8 +226,14 @@ def render(paths: Sequence[str]) -> str:
                 f"{c.get('n_trajectories', len(seeds))} trajectories in "
                 f"{disp} dispatch(es) [{c.get('lowering', '?')}]"
             )
+    lines.extend(_serve_section(stray))
+    # serve rows (tenant-tagged) render in the serving section above; the
+    # journal listing keeps the local-sweep rows
     trajectories = [
-        t for g in stray for t in g.get("trajectories", [])
+        t
+        for g in stray
+        for t in g.get("trajectories", [])
+        if t.get("tenant") is None
     ]
     if trajectories:
         n_div = sum(1 for t in trajectories if t.get("status") == "diverged")

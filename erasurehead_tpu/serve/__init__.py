@@ -1,0 +1,27 @@
+"""Sweep-as-a-service: multi-tenant cohort packing with admission control.
+
+The serve daemon generalizes the cohort engine's batch dimension from "one
+user's sweep" (train/trainer.train_cohort, PR 4) to "many concurrent
+clients": compatible requests from different tenants bin-pack into shared
+compiled dispatches, an admission controller bounds in-flight HBM, and
+results stream back per tenant with journal-backed resume and the sweep
+guard's full degradation ladder as fault isolation.
+
+    serve/queue.py      request/result model + in-process handles
+    serve/packer.py     signature bin-packing (cohort_signature + dataset)
+    serve/admission.py  HBM budget: estimates, measured refinement, evict
+    serve/server.py     the SweepServer loop + the unix-socket front
+    serve/client.py     socket client for `erasurehead-tpu serve`
+"""
+
+from erasurehead_tpu.serve.queue import (  # noqa: F401
+    RequestHandle,
+    RunRequest,
+    ServeResult,
+    config_from_payload,
+)
+from erasurehead_tpu.serve.server import (  # noqa: F401
+    SocketFront,
+    SweepServer,
+    serving,
+)

@@ -1,0 +1,188 @@
+"""Admission controller: bound the serve daemon's in-flight HBM.
+
+A cohort dispatch pins device memory three ways: the shared data stack it
+uploads (or reuses from the sweep data cache), the per-round weight tables
+that scale with cohort width, and the compiled executable's own working
+set. The controller charges each candidate cohort an ESTIMATE of that
+footprint against a byte budget before it may dispatch:
+
+  - the estimate starts from the host-side stack arithmetic the
+    ``stack_mode="auto"`` gate already uses (trainer.estimate_stack_bytes,
+    data/sharding.RING_AUTO_MIN_BYTES machinery) plus the weight-table
+    bytes the cohort's width implies;
+  - once a signature has actually dispatched, its compiled
+    ``memory_analysis`` byte accounting (argument/temp/output) REFINES the
+    estimate — later admissions of the same signature charge the measured
+    peak when it is larger (estimates may undercount XLA temps);
+  - an over-footprint cohort QUEUES: it stays pending and is retried next
+    loop, after in-flight dispatches release their charge. It never joins
+    a running cohort's HBM — that is the whole point (an admission-control
+    OOM would take innocent tenants' dispatches down with it);
+  - when the blocker is the sweep data cache's pins rather than live
+    dispatches, the controller EVICTS the cache (cache.drop_data_cache —
+    the same pressure valve the OOM-bisection ladder uses) and admits;
+  - a cohort too big for the budget even on an idle daemon admits alone
+    with a warning (refusing forever would deadlock the tenant; alone, an
+    OOM hurts only itself and the bisection ladder still degrades it).
+
+Every decision is observable: ``admit`` events carry the estimate vs the
+budget and the verdict, ``evict`` events name what was dropped, and the
+``serve.admitted`` / ``serve.deferred`` / ``serve.evictions`` counters
+aggregate them.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from erasurehead_tpu.obs import events as events_lib
+from erasurehead_tpu.obs.metrics import REGISTRY as _METRICS
+from erasurehead_tpu.train import cache as cache_lib
+from erasurehead_tpu.train import trainer
+
+#: per-trajectory fixed overhead charged on top of the weight tables —
+#: params history [R, F], optimizer state, host<->device staging slack
+TRAJECTORY_SLACK_BYTES = 1 << 20
+
+
+def estimate_cohort_bytes(cohort, width: Optional[int] = None) -> int:
+    """Estimated device footprint of one packed cohort: ONE shared data
+    stack (the pack key guarantees the cohort shares it) + width-scaled
+    per-round weight tables + per-trajectory slack. ``width`` overrides
+    the trajectory count (the server's fixed-width padded dispatch really
+    allocates ``max_cohort`` table columns)."""
+    first = cohort.requests[0]
+    cfg = first.config
+    stack = trainer.estimate_stack_bytes(cfg, first.dataset)
+    layout = trainer.build_layout(cfg)
+    B = width if width is not None else len(cohort.requests)
+    from erasurehead_tpu.utils.config import ComputeMode
+
+    if cfg.compute_mode == ComputeMode.FAITHFUL:
+        table_cols = layout.n_workers * layout.n_slots
+    else:
+        table_cols = layout.n_partitions
+    tables = cfg.rounds * B * table_cols * 4  # f32 weight tables [R, B, ...]
+    return int(stack + tables + B * TRAJECTORY_SLACK_BYTES)
+
+
+class AdmissionController:
+    """Byte-budgeted admission over concurrent cohort dispatches.
+
+    ``budget_bytes=None`` = unbounded (every cohort admits; events still
+    record the estimates, so a budget can be sized from a dry run)."""
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        if budget_bytes is not None and budget_bytes <= 0:
+            raise ValueError(
+                f"budget_bytes must be positive (or None for unbounded), "
+                f"got {budget_bytes}"
+            )
+        self.budget_bytes = budget_bytes
+        self._lock = threading.Lock()
+        self._in_flight: dict[str, int] = {}  # key digest -> charged bytes
+        self._measured: dict[str, int] = {}  # key digest -> measured bytes
+
+    @property
+    def in_flight_bytes(self) -> int:
+        with self._lock:
+            return sum(self._in_flight.values())
+
+    def charge_for(self, cohort, width: Optional[int] = None) -> int:
+        """The bytes this cohort would be charged: the estimate, raised to
+        the signature's measured compiled footprint when known & larger."""
+        est = estimate_cohort_bytes(cohort, width=width)
+        with self._lock:
+            measured = self._measured.get(cohort.key_digest)
+        if measured is not None:
+            est = max(est, measured)
+        return est
+
+    def try_admit(
+        self, cohort, dispatch_id: str, width: Optional[int] = None
+    ) -> bool:
+        """Admit ``cohort`` (charging its footprint until
+        :meth:`release`), or defer it. Emits one ``admit`` event either
+        way; eviction of data-cache pins happens here when it is what
+        stands between the cohort and the budget."""
+        est = self.charge_for(cohort, width=width)
+        with self._lock:
+            in_flight = sum(self._in_flight.values())
+            budget = self.budget_bytes
+            admitted = budget is None or in_flight + est <= budget
+            evict_would_help = False
+            if not admitted:
+                cached = cache_lib.data_cache_bytes()
+                # the data cache's pins are idle capital: dropping them
+                # frees real HBM without touching any live dispatch
+                evict_would_help = (
+                    cached > 0 and in_flight + est - cached <= budget
+                )
+                if not evict_would_help and in_flight == 0:
+                    # nothing to wait for and nothing to evict: admitting
+                    # alone is the only non-deadlocking move
+                    admitted = True
+            if admitted:
+                self._in_flight[dispatch_id] = est
+        if not admitted and evict_would_help:
+            released = cache_lib.drop_data_cache()
+            _METRICS.counter("serve.evictions").inc()
+            events_lib.emit(
+                "evict",
+                reason="data_cache_pressure",
+                cohort=cohort.key_digest,
+                released_bytes=released,
+            )
+            with self._lock:
+                in_flight = sum(self._in_flight.values())
+                admitted = in_flight + est <= self.budget_bytes
+                if admitted:
+                    self._in_flight[dispatch_id] = est
+        if admitted and self.budget_bytes is not None and (
+            est > self.budget_bytes
+        ):
+            from erasurehead_tpu.obs.metrics import warn_once
+
+            warn_once(
+                f"serve_overbudget_{cohort.key_digest}",
+                f"serve: cohort {cohort.key_digest} estimate {est}B "
+                f"exceeds the whole budget {self.budget_bytes}B; admitted "
+                f"ALONE (refusing forever would deadlock the tenant) — "
+                f"the OOM-bisection ladder is its safety net",
+            )
+        _METRICS.counter(
+            "serve.admitted" if admitted else "serve.deferred"
+        ).inc()
+        events_lib.emit(
+            "admit",
+            est_bytes=est,
+            budget_bytes=self.budget_bytes,
+            in_flight_bytes=self.in_flight_bytes,
+            admitted=admitted,
+            cohort=cohort.key_digest,
+            n_trajectories=len(cohort.requests),
+        )
+        return admitted
+
+    def release(self, dispatch_id: str) -> None:
+        """Return a finished (or failed) dispatch's charge to the budget."""
+        with self._lock:
+            self._in_flight.pop(dispatch_id, None)
+
+    def observe(self, cohort, cache_info: Optional[dict]) -> None:
+        """Refine the signature's footprint with the dispatch's compiled
+        ``memory_analysis`` accounting (argument + output + temp bytes ~
+        the executable's live working set). Estimates only ever RATCHET UP
+        — a measured undercount must not talk admission into optimism."""
+        ma = (cache_info or {}).get("memory_analysis") or {}
+        measured = sum(
+            int(ma.get(k) or 0)
+            for k in ("argument_bytes", "output_bytes", "temp_bytes")
+        )
+        if measured <= 0:
+            return
+        with self._lock:
+            prev = self._measured.get(cohort.key_digest, 0)
+            if measured > prev:
+                self._measured[cohort.key_digest] = measured

@@ -1,0 +1,108 @@
+"""Socket client for the serve daemon's unix-socket front.
+
+The wire protocol is newline-delimited JSON (see server.SocketFront): one
+``submit`` line per request, streamed ``result`` lines back as the
+daemon's packed dispatches land. A reader thread demultiplexes the
+responses, so any number of submissions may be in flight on one
+connection; results arrive in COMPLETION order — match them up by
+``request_id`` (or ``label``).
+
+    client = ServeClient("/tmp/eh-serve.sock")
+    rid = client.submit("alice", "agc_s2", {"scheme": "approx",
+                        "n_workers": 8, "num_collect": 4, "rounds": 20})
+    res = client.result(timeout=300)   # {"request_id": rid, "row": ...}
+    client.close()
+"""
+
+from __future__ import annotations
+
+import json
+import queue as queue_lib
+import socket
+import threading
+from typing import Optional
+
+
+class ServeClient:
+    """One connection to a serve daemon's unix socket."""
+
+    def __init__(self, path: str, timeout: Optional[float] = None):
+        self.path = path
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        self._sock.connect(path)
+        self._wlock = threading.Lock()
+        self._accepted: "queue_lib.Queue[dict]" = queue_lib.Queue()
+        self._results: "queue_lib.Queue[dict]" = queue_lib.Queue()
+        self._reader = threading.Thread(
+            target=self._read_loop, name="eh-serve-client", daemon=True
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        buf = b""
+        while True:
+            try:
+                chunk = self._sock.recv(1 << 16)
+            except OSError:
+                return
+            if not chunk:
+                return
+            buf += chunk
+            while b"\n" in buf:
+                raw, buf = buf.split(b"\n", 1)
+                if not raw.strip():
+                    continue
+                try:
+                    msg = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                if msg.get("type") == "result":
+                    self._results.put(msg)
+                else:  # accepted / error — answers to submit, in order
+                    self._accepted.put(msg)
+
+    def submit(
+        self,
+        tenant: str,
+        label: str,
+        config: dict,
+        target_loss: Optional[float] = None,
+        data_seed: int = 0,
+        timeout: Optional[float] = 30.0,
+    ) -> str:
+        """Submit one trajectory request; returns its request_id. Raises
+        RuntimeError when the daemon refuses the payload."""
+        line = json.dumps(
+            {
+                "op": "submit",
+                "tenant": tenant,
+                "label": label,
+                "config": config,
+                "target_loss": target_loss,
+                "data_seed": data_seed,
+            }
+        ) + "\n"
+        with self._wlock:
+            self._sock.sendall(line.encode())
+        reply = self._accepted.get(timeout=timeout)
+        if reply.get("type") != "accepted":
+            raise RuntimeError(
+                f"serve daemon refused the request: "
+                f"{reply.get('message', reply)}"
+            )
+        return reply["request_id"]
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        """The next finished trajectory (completion order, any of this
+        connection's requests): {"request_id", "tenant", "label",
+        "status", "row", "error", "resumed"}. Raises ``queue.Empty`` on
+        timeout."""
+        return self._results.get(timeout=timeout)
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()

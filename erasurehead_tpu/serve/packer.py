@@ -1,0 +1,114 @@
+"""Cohort packer: bin-pack compatible pending requests into shared dispatches.
+
+The multiplier the serve daemon adds over PR 4's per-sweep batching: the
+cohort engine (trainer.train_cohort) doesn't care WHOSE trajectories share
+a dispatch, only that they share a device data stack and a compiled-scan
+lowering. The packing key is therefore exactly the cohort grouping key the
+sweep planner uses — ``trainer.cohort_signature`` (static lowering
+signature + rounds + workers + ``cache.layout_stack_signature``) — plus
+the dataset's identity token: requests from different tenants that agree
+on all of it ride ONE compiled scan.
+
+What must NOT pack, packs not: the static signature carries the
+memory-system knobs (``stack_dtype``, ``stack_mode``, ``ring_pipeline``,
+``donate``...), so e.g. an int8-stack request and an f32-stack request key
+DIFFERENT data caches and land in different cohorts (pinned in
+tests/test_cohort.py's negative-packing test). Arrival schedules are NOT
+in the key — train_cohort takes them per trajectory, so tenants keep their
+own straggler streams inside a shared dispatch.
+
+Packing changes throughput, never bits: a cohort dispatch's per-trajectory
+results are bitwise independent of the cohort's width (a packed request
+and the same request dispatched alone produce identical rows — pinned in
+tests/test_serve.py), so the packer needs no fairness/correctness
+tradeoff, only a size cap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Optional
+
+from erasurehead_tpu.serve.queue import RunRequest
+from erasurehead_tpu.train import cache as cache_lib
+from erasurehead_tpu.train import trainer
+
+
+def pack_key(request: RunRequest) -> Optional[tuple]:
+    """The bin-packing key for one request: ``(cohort_signature, dataset
+    token)``, or None when the config is cohort-ineligible (measured mode,
+    forced pallas — dispatched as its own sequential singleton). The
+    request's dataset must already be resolved (server._resolve_dataset)."""
+    sig = trainer.cohort_signature(request.config)
+    if sig is None:
+        return None
+    return (sig, cache_lib.dataset_token(request.dataset))
+
+
+def key_digest(key: Optional[tuple]) -> str:
+    """Short stable digest of a pack key for event payloads/logs (the raw
+    key embeds assignment bytes — not something to put in a JSON line)."""
+    if key is None:
+        return "sequential"
+    return hashlib.sha256(repr(key).encode()).hexdigest()[:12]
+
+
+@dataclasses.dataclass
+class PackedCohort:
+    """One planned dispatch: the requests riding it and their shared key."""
+
+    key: Optional[tuple]
+    requests: list  # list[RunRequest], first-submitted first
+    batchable: bool  # False = cohort-ineligible singleton
+
+    @property
+    def key_digest(self) -> str:
+        return key_digest(self.key)
+
+    @property
+    def tenants(self) -> list:
+        return sorted({r.tenant for r in self.requests})
+
+    @property
+    def labels(self) -> list:
+        return [r.label for r in self.requests]
+
+
+def plan_packs(
+    pending: list, max_cohort: int = 64
+) -> list[PackedCohort]:
+    """Group pending requests into dispatch cohorts, first-seen key order
+    (arrival order within a key is preserved — FIFO per signature).
+    Cohorts larger than ``max_cohort`` split into chunks: the per-round
+    weight tables scale with cohort width, so an unbounded pack would let
+    one burst of traffic balloon a single dispatch's footprint past what
+    the admission controller (serve/admission.py) can usefully reason
+    about. Cohort-ineligible requests come back as their own
+    ``batchable=False`` singletons."""
+    if max_cohort < 1:
+        raise ValueError(f"max_cohort must be >= 1, got {max_cohort}")
+    groups: dict = {}
+    order: list = []
+    for req in pending:
+        key = pack_key(req)
+        gk = ("__sequential__", req.request_id) if key is None else key
+        if gk not in groups:
+            groups[gk] = (key, [])
+            order.append(gk)
+        groups[gk][1].append(req)
+    out: list[PackedCohort] = []
+    for gk in order:
+        key, reqs = groups[gk]
+        if key is None:
+            out.append(PackedCohort(key=None, requests=reqs, batchable=False))
+            continue
+        for lo in range(0, len(reqs), max_cohort):
+            out.append(
+                PackedCohort(
+                    key=key,
+                    requests=reqs[lo:lo + max_cohort],
+                    batchable=True,
+                )
+            )
+    return out

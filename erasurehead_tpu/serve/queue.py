@@ -1,0 +1,161 @@
+"""Serve request/result model: what a client submits and what it gets back.
+
+The serve daemon's unit of work is one trajectory request — a labeled
+RunConfig from some tenant, optionally carrying its own dataset, arrival
+schedule and loss target. The in-process API hands the submitter a
+:class:`RequestHandle`; results stream back onto it as the packed cohort
+dispatches land (one :class:`ServeResult` per request, in completion
+order, not submission order).
+
+The thin socket front (serve/client.py, ``erasurehead-tpu serve``) carries
+the same model as JSON lines; :func:`config_from_payload` is the single
+place a wire payload becomes a RunConfig, so the socket surface can never
+accept a field the in-process surface would refuse.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue as queue_lib
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from erasurehead_tpu.utils.config import RunConfig
+
+_request_ids = itertools.count(1)
+_id_lock = threading.Lock()
+
+
+def new_request_id(tenant: str) -> str:
+    """Process-unique request id, tenant-prefixed for readable logs."""
+    with _id_lock:
+        n = next(_request_ids)
+    return f"{tenant}-req-{n:04d}"
+
+
+@dataclasses.dataclass
+class RunRequest:
+    """One tenant's trajectory request.
+
+    ``dataset`` is optional: in-process clients may pass a real Dataset
+    (requests sharing one OBJECT share a device stack and can pack);
+    config-only requests (the socket front) are resolved by the server's
+    memoized dataset pool, keyed on the config's data-defining fields plus
+    ``data_seed`` — so same-shape requests from different tenants resolve
+    to the SAME dataset object and pack, while the trajectory ``seed``
+    stays free to differ per request. ``arrivals`` is optional the same
+    way (None = the deterministic per-config default schedule,
+    trainer.default_arrivals)."""
+
+    tenant: str
+    label: str
+    config: RunConfig
+    dataset: Optional[Any] = None
+    arrivals: Optional[np.ndarray] = None
+    target_loss: Optional[float] = None
+    data_seed: int = 0
+    request_id: str = ""
+
+    def __post_init__(self):
+        if not self.tenant or not isinstance(self.tenant, str):
+            raise ValueError(
+                f"request tenant must be a non-empty string, got "
+                f"{self.tenant!r}"
+            )
+        if not self.label or not isinstance(self.label, str):
+            raise ValueError(
+                f"request label must be a non-empty string, got "
+                f"{self.label!r}"
+            )
+        if not self.request_id:
+            self.request_id = new_request_id(self.tenant)
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """One finished trajectory, delivered back to its submitter.
+
+    ``status``: ``"ok"`` / ``"diverged"`` (quarantined row — the science
+    columns are NaN-free Nones downstream, the sweep/serve loop continued)
+    / ``"error"`` (the dispatch failed beyond the degradation ladder;
+    ``error`` carries the head of the exception). ``row`` is the UNROUNDED
+    journal payload (train/journal.summary_payload) — the form the bitwise
+    packed-vs-sequential contract is checked in; ``summary`` is the full
+    RunSummary for in-process consumers (None over the wire)."""
+
+    request_id: str
+    tenant: str
+    label: str
+    status: str
+    row: Optional[dict] = None
+    summary: Optional[Any] = None
+    error: Optional[str] = None
+    resumed: bool = False  # rehydrated from the tenant's journal, no dispatch
+
+
+class RequestHandle:
+    """The submitter's view of one in-flight request."""
+
+    def __init__(self, request: RunRequest):
+        self.request = request
+        self._q: "queue_lib.Queue[ServeResult]" = queue_lib.Queue()
+        self._result: Optional[ServeResult] = None
+        # the tenant-journal identity key, assigned at server intake once
+        # the dataset/arrivals are resolved (None = journaling off)
+        self.journal_key: Optional[str] = None
+
+    @property
+    def request_id(self) -> str:
+        return self.request.request_id
+
+    def _deliver(self, result: ServeResult) -> None:
+        self._q.put(result)
+
+    def result(self, timeout: Optional[float] = None) -> ServeResult:
+        """Block until this request's result lands (memoized after the
+        first call). Raises ``queue.Empty`` on timeout."""
+        if self._result is None:
+            self._result = self._q.get(timeout=timeout)
+        return self._result
+
+    def done(self) -> bool:
+        return self._result is not None or not self._q.empty()
+
+
+#: RunConfig fields a wire payload may set — the plain-JSON subset (enums
+#: accept their string values; lr_schedule accepts a number or list).
+#: Deliberately absent: input_dir/is_real_data (a remote client must not
+#: point the daemon at arbitrary host paths).
+CONFIG_PAYLOAD_FIELDS = frozenset(
+    {
+        "scheme", "model", "n_workers", "n_stragglers", "rounds",
+        "num_collect", "add_delay", "delay_mean", "compute_time",
+        "worker_speed_spread", "update_rule", "alpha", "lr_schedule",
+        "dataset", "n_rows", "n_cols", "partitions_per_worker",
+        "compute_mode", "stack_mode", "ring_pipeline", "stack_dtype",
+        "donate", "seed", "dtype", "use_pallas", "sparse_lanes",
+        "dense_margin_cols", "flat_grad", "margin_flat", "deadline",
+        "scan_unroll", "sparse_format", "fields_scatter", "fields_margin",
+    }
+)
+
+
+def config_from_payload(payload: dict) -> RunConfig:
+    """Wire JSON -> RunConfig, refusing unknown/unserveable fields loudly
+    (a typo'd knob must fail the request, not silently train the default).
+    RunConfig.__post_init__ does the semantic validation."""
+    if not isinstance(payload, dict):
+        raise ValueError(
+            f"config payload must be a JSON object, got "
+            f"{type(payload).__name__}"
+        )
+    unknown = sorted(set(payload) - CONFIG_PAYLOAD_FIELDS)
+    if unknown:
+        raise ValueError(
+            f"config payload has unserveable field(s) {unknown}; "
+            f"accepted: {sorted(CONFIG_PAYLOAD_FIELDS)}"
+        )
+    return RunConfig(**payload)

@@ -1,0 +1,784 @@
+"""The serve daemon: multi-tenant sweep-as-a-service over the cohort engine.
+
+``SweepServer`` is a long-running loop that turns CONCURRENT CLIENTS into
+the batch dimension the cohort engine already exploits per-sweep (PR 4):
+clients submit labeled trajectory requests (in-process ``submit()``, or
+the unix-socket front in serve/client.py behind ``erasurehead-tpu
+serve``); a packer bin-packs compatible pending requests into shared
+cohort dispatches (serve/packer.py — key = cohort signature + dataset
+identity); an admission controller bounds the in-flight HBM footprint
+(serve/admission.py); and each request's summary row streams back to its
+submitter as the dispatch lands, journaled per tenant (train/journal.py)
+so PR 5's resume/quarantine machinery becomes per-tenant fault isolation.
+
+Contracts:
+
+  - packing is a pure throughput lever: every request dispatches through
+    the cohort engine (singletons included), and a cohort's per-trajectory
+    results are bitwise independent of its width — a packed request and
+    the same request dispatched alone produce IDENTICAL journal rows
+    (pinned in tests/test_serve.py; raced in bench.py's serve_pack extra);
+  - fault isolation: a dispatch failure degrades through the sweep
+    guard's ladder (retry / bisect / sequential, experiments.
+    _dispatch_cohort) and, beyond it, fails only ITS cohort's requests
+    (status="error") — the daemon and every other tenant's work continue;
+    divergence quarantines the single row (status="diverged"), exactly as
+    in a local sweep;
+  - per-tenant resume: each tenant's rows journal to
+    ``<journal_dir>/<tenant>/sweep_journal.jsonl``; a resubmitted request
+    whose (label, config, data, arrivals) key is already journaled is
+    REHYDRATED bitwise without a dispatch.
+
+All dispatching happens on a small thread pool; everything JAX-side is
+per-dispatch-independent, and the admission controller is what keeps the
+concurrency from overcommitting device memory.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import itertools
+import os
+import queue as queue_lib
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import numpy as np
+
+from erasurehead_tpu.obs import events as events_lib
+from erasurehead_tpu.obs.metrics import REGISTRY as _METRICS
+from erasurehead_tpu.serve import admission as admission_lib
+from erasurehead_tpu.serve import packer as packer_lib
+from erasurehead_tpu.serve.queue import (
+    RequestHandle,
+    RunRequest,
+    ServeResult,
+)
+from erasurehead_tpu.train import experiments, trainer
+from erasurehead_tpu.train import journal as journal_lib
+from erasurehead_tpu.utils.config import RunConfig
+
+#: how long the packing window stays open once a request arrives: the
+#: daemon trades this much latency for whatever packs in behind it
+DEFAULT_WINDOW_S = 0.02
+
+#: inbox sentinel that wakes the loop for shutdown (None would be
+#: indistinguishable from a get() timeout)
+_STOP = object()
+
+#: default packed-dispatch width (requests per compiled cohort scan)
+DEFAULT_MAX_COHORT = 32
+#: concurrent dispatch threads (the admission controller is the real
+#: bound; 2 keeps a second cohort compiling/uploading while one runs)
+DEFAULT_DISPATCH_WORKERS = 2
+
+
+def _summarize(
+    request: RunRequest, result
+) -> "experiments.RunSummary":
+    """One dispatch result -> the request's RunSummary row, mirroring
+    experiments.compare's per-trajectory completion (eval replay,
+    divergence quarantine, request-local time_to_target)."""
+    from erasurehead_tpu.train import evaluate
+
+    cfg = request.config
+    dataset = request.dataset
+    model = trainer.build_model(cfg)
+    n = result.n_train
+    ev = evaluate.replay(
+        model,
+        cfg.model,
+        result.params_history,
+        dataset.X_train[:n],
+        dataset.y_train[:n],
+        dataset.X_test,
+        dataset.y_test,
+    )
+    diverged = experiments._diverged(result, ev)
+    if diverged:
+        _METRICS.counter("sweep.diverged").inc()
+        events_lib.emit(
+            "warning",
+            kind="divergence",
+            message=(
+                f"serve: request {request.request_id!r} (tenant "
+                f"{request.tenant!r}, scheme {cfg.scheme.value}) diverged; "
+                "row quarantined as status=diverged, daemon continues"
+            ),
+        )
+    summary = experiments.RunSummary(
+        label=request.label,
+        config=result.config,
+        sim_total_time=result.sim_total_time,
+        sim_steps_per_sec=(
+            result.config.rounds / result.sim_total_time
+            if result.sim_total_time > 0
+            else float("inf")
+        ),
+        real_steps_per_sec=result.steps_per_sec,
+        final_train_loss=float(ev.training_loss[-1]),
+        final_test_loss=float(ev.testing_loss[-1]),
+        final_auc=float(ev.auc[-1]),
+        time_to_target=None,
+        training_loss=ev.training_loss,
+        timeset=result.timeset,
+        cache=result.cache_info,
+        decode_error_mean=(
+            float(np.mean(result.decode_error))
+            if result.decode_error is not None and len(result.decode_error)
+            else None
+        ),
+        status="diverged" if diverged else "ok",
+    )
+    if request.target_loss is not None and summary.status == "ok":
+        summary.time_to_target = experiments.time_to_target_loss(
+            summary.training_loss, summary.timeset, request.target_loss
+        )
+    return summary
+
+
+class SweepServer:
+    """In-process serve daemon (see module docstring).
+
+    Use as a context manager, or ``start()``/``stop()`` explicitly::
+
+        with SweepServer(budget_bytes=2 << 30) as srv:
+            h = srv.submit(tenant="alice", label="agc", config=cfg,
+                           dataset=data)
+            row = h.result(timeout=120)
+    """
+
+    def __init__(
+        self,
+        budget_bytes: Optional[int] = None,
+        max_cohort: int = DEFAULT_MAX_COHORT,
+        window_s: float = DEFAULT_WINDOW_S,
+        journal_dir: Optional[str] = None,
+        resume: bool = True,
+        dispatch_workers: int = DEFAULT_DISPATCH_WORKERS,
+        pad_cohorts: bool = True,
+    ):
+        self.admission = admission_lib.AdmissionController(budget_bytes)
+        self.max_cohort = int(max_cohort)
+        # fixed-width dispatch: pad every batchable cohort to exactly
+        # max_cohort trajectories (replicating the first request's config;
+        # pad results are discarded). Two daemon-grade properties follow:
+        # (1) ONE compiled executable per signature — without it, every
+        # distinct pack width would trace and compile its own scan, and a
+        # long-lived daemon's compile cache would bloat with traffic-shape
+        # noise; (2) packing-invariant numerics — XLA's reduction order
+        # inside the cohort matmul depends on the batch WIDTH (not on the
+        # other columns' values), so with the width pinned, a request's
+        # row is bitwise identical whether it dispatched alone or packed
+        # with 31 strangers. The cost is padded-column compute on light
+        # traffic — near-free on the bandwidth-bound TPU path (the padded
+        # columns ride the same X stream), real on CPU; pad_cohorts=False
+        # trades both properties back for it.
+        self.pad_cohorts = bool(pad_cohorts)
+        self.window_s = float(window_s)
+        self.journal_dir = journal_dir
+        self.resume = bool(resume)
+        self._inbox: "queue_lib.Queue[Optional[RequestHandle]]" = (
+            queue_lib.Queue()
+        )
+        self._pending: list[RequestHandle] = []
+        self._handles: dict[str, RequestHandle] = {}
+        self._journals: dict[str, journal_lib.SweepJournal] = {}
+        self._datasets: dict[tuple, object] = {}
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(1, int(dispatch_workers)),
+            thread_name_prefix="eh-serve-dispatch",
+        )
+        self._dispatch_ids = itertools.count(1)
+        self._in_flight = 0
+        self._gen = 0  # bumped on arrivals/completions; gates re-packing
+        self._state_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+        self._drain = True
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> "SweepServer":
+        if self._thread is not None:
+            raise RuntimeError("serve loop already started")
+        self._thread = threading.Thread(
+            target=self._loop, name="eh-serve-loop", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop the loop. ``drain=True`` (default) finishes every pending
+        and in-flight request first; ``drain=False`` fails pending
+        requests with status="error" and returns as soon as in-flight
+        dispatches land."""
+        if self._thread is None:
+            return
+        self._drain = drain
+        self._stopping = True
+        self._inbox.put(_STOP)
+        self._thread.join(timeout=timeout)
+        self._thread = None
+        self._executor.shutdown(wait=True)
+        for j in self._journals.values():
+            j.close()
+        self._journals.clear()
+
+    def __enter__(self) -> "SweepServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- client surface --------------------------------------------------
+
+    def submit(
+        self,
+        request: Optional[RunRequest] = None,
+        *,
+        tenant: Optional[str] = None,
+        label: Optional[str] = None,
+        config: Optional[RunConfig] = None,
+        dataset=None,
+        arrivals=None,
+        target_loss: Optional[float] = None,
+        data_seed: int = 0,
+    ) -> RequestHandle:
+        """Submit one trajectory request; returns immediately with the
+        handle its result will land on. Thread-safe (any number of client
+        threads may submit concurrently)."""
+        if request is None:
+            request = RunRequest(
+                tenant=tenant, label=label, config=config, dataset=dataset,
+                arrivals=arrivals, target_loss=target_loss,
+                data_seed=data_seed,
+            )
+        if self._thread is None or self._stopping:
+            raise RuntimeError("serve loop is not running")
+        handle = RequestHandle(request)
+        self._handles[request.request_id] = handle
+        _METRICS.counter("serve.requests").inc()
+        self._inbox.put(handle)
+        return handle
+
+    # ---- loop internals --------------------------------------------------
+
+    def _journal_for(self, tenant: str) -> Optional[journal_lib.SweepJournal]:
+        if self.journal_dir is None:
+            return None
+        j = self._journals.get(tenant)
+        if j is None:
+            j = journal_lib.SweepJournal(
+                os.path.join(self.journal_dir, tenant), resume=self.resume
+            )
+            self._journals[tenant] = j
+        return j
+
+    def _resolve_dataset(self, request: RunRequest):
+        """The request's dataset: as submitted, or from the daemon's
+        memoized pool. The pool key is the config's data-defining fields
+        plus ``data_seed`` — NOT the trajectory seed — so same-shape
+        requests from different tenants resolve to the same object and
+        can pack into one dispatch (packer.pack_key keys on object
+        identity via cache.dataset_token)."""
+        if request.dataset is not None:
+            return request.dataset
+        cfg = request.config
+        key = (
+            cfg.dataset, cfg.n_rows, cfg.n_cols, cfg.n_workers,
+            cfg.n_stragglers, cfg.partitions_per_worker, cfg.model.value,
+            cfg.is_real_data, cfg.input_dir, request.data_seed,
+        )
+        ds = self._datasets.get(key)
+        if ds is None:
+            from erasurehead_tpu.cli import load_dataset
+
+            ds = load_dataset(
+                dataclasses.replace(cfg, seed=request.data_seed)
+            )
+            self._datasets[key] = ds
+        return ds
+
+    def _fail(self, handle: RequestHandle, error: str) -> None:
+        _METRICS.counter("serve.errors").inc()
+        req = handle.request
+        events_lib.emit(
+            "warning",
+            kind="serve_error",
+            message=(
+                f"serve: request {req.request_id!r} (tenant "
+                f"{req.tenant!r}) failed: {error.splitlines()[0][:200]}"
+            ),
+        )
+        handle._deliver(
+            ServeResult(
+                request_id=req.request_id, tenant=req.tenant,
+                label=req.label, status="error", error=error,
+            )
+        )
+
+    def _intake(self, handle: RequestHandle) -> None:
+        """Admit one arriving request into the pending set: emit its
+        ``request`` event, resolve its dataset and arrivals, and serve it
+        straight from the tenant's journal when resumable."""
+        req = handle.request
+        events_lib.emit(
+            "request",
+            tenant=req.tenant,
+            request_id=req.request_id,
+            label=req.label,
+            scheme=req.config.scheme.value,
+        )
+        try:
+            req.dataset = self._resolve_dataset(req)
+            if req.arrivals is None:
+                req.arrivals = trainer.default_arrivals(req.config)
+        except Exception as e:  # noqa: BLE001 — isolate to this request
+            self._fail(handle, f"{type(e).__name__}: {e}")
+            return
+        journal = self._journal_for(req.tenant)
+        if journal is not None:
+            key = journal_lib.trajectory_key(
+                req.label, req.config, req.dataset, req.arrivals
+            )
+            handle.journal_key = key
+            rec = journal.lookup(key)
+            if rec is not None:
+                _METRICS.counter("serve.resumed").inc()
+                summary = journal_lib.rehydrate_summary(
+                    rec["row"], req.config
+                )
+                handle._deliver(
+                    ServeResult(
+                        request_id=req.request_id, tenant=req.tenant,
+                        label=req.label, status=rec.get("status", "ok"),
+                        row=rec["row"], summary=summary, resumed=True,
+                    )
+                )
+                _METRICS.counter("serve.results").inc()
+                return
+        else:
+            handle.journal_key = None
+        self._pending.append(handle)
+
+    def _loop(self) -> None:
+        last_packed_gen = -1
+        stop_seen = False
+        while True:
+            # ---- gather: block briefly, then hold the packing window
+            # open so a burst of concurrent submissions packs together
+            arrivals: list[RequestHandle] = []
+            try:
+                item = self._inbox.get(timeout=0.05)
+                if item is _STOP:
+                    stop_seen = True
+                else:
+                    arrivals.append(item)
+            except queue_lib.Empty:
+                pass
+            if arrivals:
+                deadline = time.monotonic() + self.window_s
+                while time.monotonic() < deadline:
+                    try:
+                        nxt = self._inbox.get_nowait()
+                    except queue_lib.Empty:
+                        time.sleep(self.window_s / 10)
+                        continue
+                    if nxt is _STOP:
+                        stop_seen = True
+                    else:
+                        arrivals.append(nxt)
+            for h in arrivals:
+                self._intake(h)
+            if arrivals:
+                with self._state_lock:
+                    self._gen += 1
+
+            # ---- pack + admit whatever the budget allows; deferred
+            # cohorts retry when the generation moves (new arrivals, or a
+            # dispatch completed and released its admission charge)
+            with self._state_lock:
+                gen = self._gen
+            if self._pending and gen != last_packed_gen:
+                last_packed_gen = gen
+                self._try_dispatch()
+
+            # ---- exit once stopping and (drained or drain=False)
+            if stop_seen:
+                if not self._drain and self._pending:
+                    for h in self._pending:
+                        self._fail(h, "server stopped before dispatch")
+                    self._pending.clear()
+                with self._state_lock:
+                    in_flight = self._in_flight
+                if (
+                    not self._pending
+                    and in_flight == 0
+                    and self._inbox.empty()
+                ):
+                    return
+                # else keep looping: in-flight dispatches still land, and
+                # drain mode keeps packing the remaining pending set
+
+    def _try_dispatch(self) -> None:
+        """One packing pass over the pending set: dispatch every cohort
+        the admission controller lets through, keep the rest pending."""
+        by_id = {h.request.request_id: h for h in self._pending}
+        packs = packer_lib.plan_packs(
+            [h.request for h in self._pending], max_cohort=self.max_cohort
+        )
+        dispatched: set[str] = set()
+        for cohort in packs:
+            dispatch_id = f"disp-{next(self._dispatch_ids):04d}"
+            width = (
+                self.max_cohort
+                if (cohort.batchable and self.pad_cohorts)
+                else len(cohort.requests)
+            )
+            if not self.admission.try_admit(cohort, dispatch_id, width=width):
+                continue  # stays pending; retried on the next generation
+            for req in cohort.requests:
+                dispatched.add(req.request_id)
+            handles = [by_id[r.request_id] for r in cohort.requests]
+            events_lib.emit(
+                "pack",
+                n_trajectories=len(cohort.requests),
+                labels=cohort.labels,
+                tenants=cohort.tenants,
+                cohort=cohort.key_digest,
+                dispatch_id=dispatch_id,
+                batchable=cohort.batchable,
+            )
+            _METRICS.counter("serve.dispatches").inc()
+            if len(cohort.requests) > 1:
+                _METRICS.counter("serve.packed_trajectories").inc(
+                    len(cohort.requests)
+                )
+            with self._state_lock:
+                self._in_flight += 1
+            self._executor.submit(
+                self._run_cohort, cohort, handles, dispatch_id
+            )
+        if dispatched:
+            self._pending = [
+                h for h in self._pending
+                if h.request.request_id not in dispatched
+            ]
+
+    def _run_cohort(self, cohort, handles, dispatch_id: str) -> None:
+        """Dispatch one admitted cohort (executor thread) and deliver each
+        request's result as it is summarized. Failures here are isolated:
+        this cohort's requests get status="error", the daemon lives on."""
+        try:
+            ids = [h.request.request_id for h in handles]
+            configs = {h.request.request_id: h.request.config for h in handles}
+            arrivals = {
+                h.request.request_id: h.request.arrivals for h in handles
+            }
+            dataset = handles[0].request.dataset
+            if cohort.batchable:
+                dispatch_ids = list(ids)
+                if self.pad_cohorts and len(ids) < self.max_cohort:
+                    # fixed-width dispatch (see __init__): fill the empty
+                    # seats with the first request's trajectory; the pad
+                    # columns' results are computed and dropped
+                    first = handles[0].request
+                    for i in range(self.max_cohort - len(ids)):
+                        pid = f"_pad{i}_{dispatch_id}"
+                        configs[pid] = first.config
+                        arrivals[pid] = first.arrivals
+                        dispatch_ids.append(pid)
+                results = experiments._dispatch_cohort(
+                    dispatch_ids, configs, dataset, arrivals
+                )
+            else:
+                results = {
+                    rid: experiments._train_one_guarded(
+                        rid, configs[rid], dataset, arrivals
+                    )
+                    for rid in ids
+                }
+            first_info = next(iter(results.values())).cache_info
+            self.admission.observe(cohort, first_info)
+            for h in handles:
+                req = h.request
+                summary = _summarize(req, results[req.request_id])
+                payload = journal_lib.summary_payload(summary)
+                journal = self._journal_for(req.tenant)
+                if journal is not None and h.journal_key is not None:
+                    journal.record(h.journal_key, req.label, summary)
+                events_lib.emit(
+                    "sweep_trajectory",
+                    key=h.journal_key or req.request_id,
+                    label=req.label,
+                    status=summary.status,
+                    row=payload,
+                    tenant=req.tenant,
+                    request_id=req.request_id,
+                )
+                h._deliver(
+                    ServeResult(
+                        request_id=req.request_id, tenant=req.tenant,
+                        label=req.label, status=summary.status,
+                        row=payload, summary=summary,
+                    )
+                )
+                _METRICS.counter("serve.results").inc()
+        except Exception as e:  # noqa: BLE001 — tenant isolation boundary
+            err = f"{type(e).__name__}: {e}"
+            for h in handles:
+                self._fail(h, err)
+        finally:
+            self.admission.release(dispatch_id)
+            with self._state_lock:
+                self._in_flight -= 1
+                self._gen += 1
+
+
+@contextlib.contextmanager
+def serving(**kw):
+    """``with serving(...) as srv:`` — a started SweepServer that stops
+    (draining) on exit."""
+    srv = SweepServer(**kw)
+    srv.start()
+    try:
+        yield srv
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# thin unix-socket front: newline-delimited JSON over AF_UNIX. One line in:
+#   {"op": "submit", "tenant": ..., "label": ..., "config": {...},
+#    "target_loss"?: float, "data_seed"?: int}
+# lines out (interleaved, tagged by request_id):
+#   {"type": "accepted", "request_id": ...}
+#   {"type": "result", "request_id", "tenant", "label", "status",
+#    "row"?: {...}, "error"?: ..., "resumed": bool}
+#   {"type": "error", "message": ...}        (malformed request line)
+# The protocol is the queue model verbatim (serve/queue.py); RunConfig
+# payloads go through config_from_payload, so the socket surface can never
+# accept a config the in-process surface would refuse.
+
+
+def main(argv=None) -> int:
+    """``erasurehead-tpu serve``: run the daemon behind a unix socket
+    until interrupted. Clients: serve/client.ServeClient, or any tool
+    that can write JSON lines to an AF_UNIX stream."""
+    import argparse
+
+    from erasurehead_tpu.utils.config import (
+        resolve_serve_budget,
+        resolve_serve_max_cohort,
+    )
+
+    p = argparse.ArgumentParser(
+        prog="erasurehead-tpu serve",
+        description=(
+            "Multi-tenant sweep-as-a-service daemon: packs concurrent "
+            "clients' compatible run requests into shared cohort "
+            "dispatches under an HBM admission budget"
+        ),
+    )
+    p.add_argument("--socket", default="/tmp/erasurehead-serve.sock",
+                   help="unix socket path for the client front")
+    p.add_argument("--budget", default=None,
+                   help="in-flight HBM admission budget: bytes with an "
+                        "optional k/m/g/t suffix (e.g. 2g). Default: "
+                        "ERASUREHEAD_SERVE_BUDGET env, else unbounded")
+    p.add_argument("--max-cohort", type=int, default=None,
+                   help="packed dispatch width. Default: "
+                        "ERASUREHEAD_SERVE_MAX_COHORT env, else "
+                        f"{DEFAULT_MAX_COHORT}")
+    p.add_argument("--no-pad", action="store_true",
+                   help="dispatch cohorts at their natural width instead "
+                        "of padding to --max-cohort (saves compute on "
+                        "light CPU traffic; costs one compiled executable "
+                        "per distinct width and makes a row's bits depend "
+                        "on how it happened to pack)")
+    p.add_argument("--window-ms", type=float, default=20.0,
+                   help="packing window: how long a request waits for "
+                        "compatible traffic to pack in behind it")
+    p.add_argument("--journal-dir", default=None,
+                   help="per-tenant sweep journals land under "
+                        "DIR/<tenant>/ (train/journal.py); resubmitted "
+                        "identical requests rehydrate without a dispatch")
+    p.add_argument("--no-resume", action="store_true",
+                   help="journal without serving rows back from it")
+    p.add_argument("--dispatch-workers", type=int,
+                   default=DEFAULT_DISPATCH_WORKERS,
+                   help="concurrent dispatch threads (admission bounds "
+                        "the memory, this bounds the overlap)")
+    p.add_argument("--events", default=None,
+                   help="write the daemon's serve/run event log here "
+                        "(request/pack/admit/evict records; render with "
+                        "`erasurehead-tpu report`)")
+    ns = p.parse_args(argv)
+    budget = resolve_serve_budget(ns.budget)
+    max_cohort = resolve_serve_max_cohort(
+        ns.max_cohort, default=DEFAULT_MAX_COHORT
+    )
+
+    from erasurehead_tpu.parallel.backend import initialize_distributed
+
+    initialize_distributed()
+    capture = (
+        events_lib.capture(ns.events)
+        if ns.events
+        else contextlib.nullcontext()
+    )
+    with capture:
+        srv = SweepServer(
+            budget_bytes=budget,
+            max_cohort=max_cohort,
+            window_s=ns.window_ms / 1000.0,
+            journal_dir=ns.journal_dir,
+            resume=not ns.no_resume,
+            dispatch_workers=ns.dispatch_workers,
+            pad_cohorts=not ns.no_pad,
+        )
+        srv.start()
+        front = SocketFront(srv, ns.socket)
+        budget_str = f"{budget} bytes" if budget is not None else "unbounded"
+        print(
+            f"serve: listening on {ns.socket} (budget {budget_str}, "
+            f"max cohort {max_cohort}, window {ns.window_ms:g} ms)",
+            flush=True,
+        )
+        try:
+            while True:
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            print("serve: draining and shutting down", flush=True)
+        finally:
+            front.close()
+            srv.stop()
+    return 0
+
+
+class SocketFront:
+    """AF_UNIX listener bridging socket clients onto a SweepServer."""
+
+    def __init__(self, server: SweepServer, path: str):
+        import socket as socket_lib
+
+        self.server = server
+        self.path = path
+        if os.path.exists(path):
+            os.unlink(path)
+        self._sock = socket_lib.socket(
+            socket_lib.AF_UNIX, socket_lib.SOCK_STREAM
+        )
+        self._sock.bind(path)
+        self._sock.listen(16)
+        self._sock.settimeout(0.2)
+        self._closing = False
+        self._threads: list[threading.Thread] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="eh-serve-socket", daemon=True
+        )
+        self._accept_thread.start()
+
+    def close(self) -> None:
+        self._closing = True
+        self._accept_thread.join(timeout=5)
+        self._sock.close()
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+
+    def _accept_loop(self) -> None:
+        import socket as socket_lib
+
+        while not self._closing:
+            try:
+                conn, _ = self._sock.accept()
+            except socket_lib.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn) -> None:
+        import json as json_lib
+
+        from erasurehead_tpu.serve.queue import config_from_payload
+
+        wlock = threading.Lock()
+
+        def send(obj: dict) -> None:
+            line = (json_lib.dumps(obj) + "\n").encode()
+            with wlock:
+                try:
+                    conn.sendall(line)
+                except OSError:
+                    pass  # client went away; results are still journaled
+
+        def relay(handle: RequestHandle) -> None:
+            res = handle.result()
+            send(
+                {
+                    "type": "result",
+                    "request_id": res.request_id,
+                    "tenant": res.tenant,
+                    "label": res.label,
+                    "status": res.status,
+                    "row": res.row,
+                    "error": res.error,
+                    "resumed": res.resumed,
+                }
+            )
+
+        buf = b""
+        with conn:
+            while not self._closing:
+                try:
+                    chunk = conn.recv(1 << 16)
+                except OSError:
+                    return
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    raw, buf = buf.split(b"\n", 1)
+                    if not raw.strip():
+                        continue
+                    try:
+                        msg = json_lib.loads(raw)
+                        if msg.get("op") != "submit":
+                            raise ValueError(
+                                f"unknown op {msg.get('op')!r} "
+                                "(only 'submit')"
+                            )
+                        cfg = config_from_payload(msg.get("config") or {})
+                        handle = self.server.submit(
+                            tenant=msg["tenant"],
+                            label=msg["label"],
+                            config=cfg,
+                            target_loss=msg.get("target_loss"),
+                            data_seed=int(msg.get("data_seed", 0)),
+                        )
+                    except Exception as e:  # noqa: BLE001 — per-line fault
+                        send(
+                            {
+                                "type": "error",
+                                "message": f"{type(e).__name__}: {e}",
+                            }
+                        )
+                        continue
+                    send(
+                        {
+                            "type": "accepted",
+                            "request_id": handle.request_id,
+                        }
+                    )
+                    threading.Thread(
+                        target=relay, args=(handle,), daemon=True
+                    ).start()

@@ -126,6 +126,14 @@ def stats() -> CacheStats:
     return _stats
 
 
+def data_cache_bytes() -> int:
+    """Device bytes currently pinned by the data cache's entries — what a
+    long-lived process (the serve daemon's admission controller,
+    serve/admission.py) counts against its HBM budget alongside in-flight
+    dispatches, and what :func:`drop_data_cache` would release."""
+    return sum(nbytes for _, nbytes in _data_cache.values())
+
+
 def drop_data_cache() -> int:
     """Release the data cache's references to device-resident stacks;
     returns the device bytes whose cache pin was dropped (counted in

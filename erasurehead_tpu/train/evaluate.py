@@ -34,6 +34,52 @@ class EvalResult:
     auc: np.ndarray  # [rounds]; NaN for regression (reference prints none)
 
 
+#: (model identity, is_regression) -> the jitted replay scan. The jit
+#: used to live in a per-call closure, so EVERY replay re-traced and
+#: re-compiled (~0.3 s each) — for a 28-trajectory sweep or a serve
+#:  daemon summarizing every request, the replay recompiles dominated the
+#: wall-clock. Models are stateless value objects (trainer.build_model
+#: constructs a fresh instance per call), so the cache keys on the model's
+#: TYPE + constructor attrs and passes data/history as traced arguments;
+#: jit's own cache then handles shape polymorphism.
+_replay_fns: dict = {}
+
+
+def _replay_fn(model, is_regression: bool):
+    key = (
+        type(model),
+        repr(sorted(getattr(model, "__dict__", {}).items())),
+        is_regression,
+    )
+    fn = _replay_fns.get(key)
+    if fn is None:
+
+        def one(carry, params, X_train, y_train, X_test, y_test):
+            train_loss = model.loss_mean(params, X_train, y_train)
+            pred_test = model.predict(params, X_test)
+            test_loss = (
+                metrics.mse_mean(y_test, pred_test)
+                if is_regression
+                else metrics.log_loss_mean(y_test, pred_test)
+            )
+            auc_val = (
+                jnp.nan if is_regression else metrics.auc(y_test, pred_test)
+            )
+            return carry, (train_loss, test_loss, auc_val)
+
+        @jax.jit
+        def run(history, X_train, y_train, X_test, y_test):
+            _, out = jax.lax.scan(
+                lambda c, p: one(c, p, X_train, y_train, X_test, y_test),
+                0,
+                history,
+            )
+            return out
+
+        _replay_fns[key] = fn = run
+    return fn
+
+
 def replay(
     model,
     model_kind: ModelKind,
@@ -47,7 +93,8 @@ def replay(
 
     Accepts dense ndarrays or scipy sparse matrices; the latter are converted
     to the TPU-native PaddedRows format here so callers can pass a Dataset's
-    matrices straight through.
+    matrices straight through. Repeat replays of the same model family and
+    shapes reuse one compiled scan (see :data:`_replay_fns`).
     """
     import scipy.sparse as sps
 
@@ -61,25 +108,10 @@ def replay(
     y_test = jnp.asarray(np.asarray(y_test, np.float32))
     is_regression = ModelKind(model_kind) == ModelKind.LINEAR
 
-    def one(carry, params):
-        train_loss = model.loss_mean(params, X_train, y_train)
-        pred_test = model.predict(params, X_test)
-        test_loss = (
-            metrics.mse_mean(y_test, pred_test)
-            if is_regression
-            else metrics.log_loss_mean(y_test, pred_test)
-        )
-        auc_val = (
-            jnp.nan if is_regression else metrics.auc(y_test, pred_test)
-        )
-        return carry, (train_loss, test_loss, auc_val)
-
-    @jax.jit
-    def run(history):
-        _, out = jax.lax.scan(one, 0, history)
-        return out
-
-    train_l, test_l, auc_l = run(params_history)
+    run = _replay_fn(model, is_regression)
+    train_l, test_l, auc_l = run(
+        params_history, X_train, y_train, X_test, y_test
+    )
     return EvalResult(
         training_loss=np.asarray(train_l),
         testing_loss=np.asarray(test_l),

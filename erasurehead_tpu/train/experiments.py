@@ -197,6 +197,24 @@ def _backoff(attempt: int) -> float:
     return min(COHORT_BACKOFF_S * (2 ** (attempt - 1)), COHORT_BACKOFF_CAP_S)
 
 
+def _arrivals_for(arrivals, label):
+    """One trajectory's arrival matrix when ``arrivals`` may be a per-label
+    dict (the serve daemon packs requests carrying their own schedules into
+    one cohort); a shared matrix / None passes through untouched."""
+    if isinstance(arrivals, dict):
+        return arrivals[label]
+    return arrivals
+
+
+def _arrivals_arg(arrivals, labels):
+    """The ``arrivals`` argument for a ``train_cohort`` dispatch of
+    ``labels``: a per-label dict becomes the per-trajectory list
+    train_cohort expects (in label order); anything else passes through."""
+    if isinstance(arrivals, dict):
+        return [arrivals[l] for l in labels]
+    return arrivals
+
+
 def _train_one_guarded(
     label: str, cfg: RunConfig, dataset: Dataset, arrivals
 ) -> "trainer.TrainResult":
@@ -209,7 +227,9 @@ def _train_one_guarded(
     attempts = 0
     while True:
         try:
-            return trainer.train(cfg, dataset, arrivals=arrivals)
+            return trainer.train(
+                cfg, dataset, arrivals=_arrivals_for(arrivals, label)
+            )
         except _guarded_error_types() as e:
             if (
                 _dispatch_error_kind(e) != "transient"
@@ -239,7 +259,11 @@ def _dispatch_cohort(
     at sequential train(). Every degradation step increments a counter
     (``cohort.retry`` / ``cohort.split`` / ``cohort.sequential_fallback``)
     and emits a ``warning`` event naming the failed cohort composition, so
-    a degraded sweep is diagnosable from its event log."""
+    a degraded sweep is diagnosable from its event log.
+
+    ``arrivals`` is a shared matrix, None, or a per-label dict (the serve
+    daemon packs requests carrying their own schedules); the dict form
+    threads correctly through bisection halves and sequential fallback."""
     from erasurehead_tpu.obs import events as obs_events
     from erasurehead_tpu.obs.metrics import REGISTRY as _metrics, warn_once
 
@@ -247,7 +271,8 @@ def _dispatch_cohort(
     while True:
         try:
             results = trainer.train_cohort(
-                [configs[l] for l in labels], dataset, arrivals=arrivals
+                [configs[l] for l in labels], dataset,
+                arrivals=_arrivals_arg(arrivals, labels),
             )
             return dict(zip(labels, results))
         except _guarded_error_types() as e:

@@ -37,6 +37,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 from typing import Optional
 
 import numpy as np
@@ -185,7 +186,20 @@ class SweepJournal:
     with ``resume=False`` the journal only records (a restart that wants a
     fresh measurement of everything can journal without skipping). The
     writer opens lazily in append mode, so constructing a journal never
-    clobbers an interrupted run's records."""
+    clobbers an interrupted run's records.
+
+    Safe under CONCURRENT WRITERS — the serve daemon
+    (erasurehead_tpu/serve/) journals per-tenant rows from its dispatch
+    pool threads, and several processes may share one journal file:
+
+      - within a process, a lock serializes the lazy logger open, the
+        append, and the completed-map update;
+      - across processes, the append-mode EventLogger (obs/events.py)
+        emits each record as ONE ``write()`` on an O_APPEND fd, so
+        interleaved writers produce interleaved whole LINES, never torn
+        ones — every record any writer flushed survives, and a resuming
+        reader sees the union (last record per key wins, as before).
+    """
 
     def __init__(self, directory: str, resume: bool = False):
         self.directory = directory
@@ -193,6 +207,7 @@ class SweepJournal:
         self.resume = bool(resume)
         self._logger: Optional[events_lib.EventLogger] = None
         self._completed: dict[str, dict] = {}
+        self._lock = threading.Lock()
         if os.path.exists(self.path):
             self._load()
 
@@ -227,28 +242,31 @@ class SweepJournal:
 
     def record(self, key: str, label: str, summary) -> None:
         """Append one finished trajectory. Flushed before returning — a
-        kill any time after this call preserves the row."""
-        if self._logger is None:
-            self._logger = events_lib.EventLogger(self.path, mode="a")
+        kill any time after this call preserves the row. Thread-safe (see
+        class docstring)."""
         payload = summary_payload(summary)
-        self._logger.emit(
-            "sweep_trajectory",
-            key=key,
-            label=label,
-            status=summary.status,
-            scheme=summary.config.scheme.value,
-            row=payload,
-        )
-        self._completed[key] = {
-            "type": "sweep_trajectory", "key": key, "label": label,
-            "status": summary.status, "row": payload,
-        }
+        with self._lock:
+            if self._logger is None:
+                self._logger = events_lib.EventLogger(self.path, mode="a")
+            self._logger.emit(
+                "sweep_trajectory",
+                key=key,
+                label=label,
+                status=summary.status,
+                scheme=summary.config.scheme.value,
+                row=payload,
+            )
+            self._completed[key] = {
+                "type": "sweep_trajectory", "key": key, "label": label,
+                "status": summary.status, "row": payload,
+            }
         _METRICS.counter("sweep_journal.records").inc()
 
     def close(self) -> None:
-        if self._logger is not None:
-            self._logger.close()
-            self._logger = None
+        with self._lock:
+            if self._logger is not None:
+                self._logger.close()
+                self._logger = None
 
 
 # ---------------------------------------------------------------------------

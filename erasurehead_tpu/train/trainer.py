@@ -1042,6 +1042,49 @@ def cohort_eligible(cfg: RunConfig) -> bool:
     return cfg.arrival_mode == "simulated" and cfg.use_pallas != "on"
 
 
+def estimate_stack_bytes(cfg: RunConfig, dataset: Dataset) -> int:
+    """Host-side estimate of the device data-stack footprint a dispatch of
+    ``cfg`` pins while in flight — the serve admission controller's charge
+    unit (serve/admission.py), built on the same machinery as the
+    stack_mode="auto" footprint gate (data/sharding.
+    estimate_worker_stack_bytes / RING_AUTO_MIN_BYTES).
+
+    Deduped runs and explicitly ring-streamed faithful runs keep only the
+    partition-major stack; materialized faithful pays the (s+1)x
+    worker-major stack. ``stack_mode="auto"`` is charged at the
+    MATERIALIZED estimate (the auto gate needs the mesh to resolve;
+    admission is a bound, so over-charging the undecided case is the safe
+    direction). int8 stacks add their per-block f32 scale tables. An
+    estimate, not an accounting — refined per signature by the compiled
+    ``memory_analysis`` once a dispatch has run (serve/admission.py).
+    """
+    layout = build_layout(cfg)
+    dtype_name = cfg.resolve_stack_dtype()
+    est_dtype = {
+        "float32": np.float32, "bfloat16": jnp.bfloat16, "int8": np.int8,
+    }[dtype_name]
+    from erasurehead_tpu.data import sharding as sharding_lib
+
+    worker_stack_est = sharding_lib.estimate_worker_stack_bytes(
+        dataset, layout, est_dtype
+    )
+    per_block = worker_stack_est / max(
+        1, layout.n_workers * layout.n_slots
+    )
+    partition_major = (
+        cfg.compute_mode != ComputeMode.FAITHFUL or cfg.stack_mode == "ring"
+    )
+    if partition_major:
+        est = per_block * layout.n_partitions
+        blocks = layout.n_partitions
+    else:
+        est = worker_stack_est
+        blocks = layout.n_workers * layout.n_slots
+    if dtype_name == "int8":
+        est += blocks * dataset.X_train.shape[1] * 4  # f32 scale tables
+    return int(est)
+
+
 def cohort_signature(cfg: RunConfig) -> Optional[tuple]:
     """Grouping key for trajectory-batched dispatch (experiments.
     plan_cohorts): configs mapping to the same key share a device data

@@ -325,6 +325,41 @@ class TestCompareBatched:
         assert plan[0] == (["a", "b"], True)
         assert plan[1] == (["m"], False)
 
+    def test_plan_cohorts_memory_knobs_never_pack(self, gmm):
+        """Negative packing: trajectories differing in stack_dtype,
+        stack_mode, or ring_pipeline key DIFFERENT data caches / compiled
+        scans (PR 6 grew the signature) and must land in different
+        cohorts — a serve daemon packing them together would train an
+        int8 client's request on an f32 stack (or vice versa)."""
+        base = dict(scheme="approx", compute_mode="deduped")
+        variants = {
+            "f32": _cfg(**base),
+            "int8": _cfg(**base, stack_dtype="int8"),
+            "bf16_stack": _cfg(**base, stack_dtype="bfloat16"),
+        }
+        ring_base = dict(scheme="cyccoded", compute_mode="faithful")
+        variants.update(
+            {
+                "mat": _cfg(**ring_base),
+                "ring": _cfg(**ring_base, stack_mode="ring"),
+                "ring_pipe": _cfg(
+                    **ring_base, stack_mode="ring", ring_pipeline="on"
+                ),
+            }
+        )
+        plan = experiments.plan_cohorts(variants)
+        # every variant is its own cohort: no two of these may share a
+        # dispatch, even though schemes/shapes agree within each family
+        assert sorted(labels for labels, _ in plan) == sorted(
+            [[v] for v in variants]
+        )
+        # and the sanity inverse: agreeing knobs DO pack
+        same = {
+            "a": _cfg(**base, seed=0),
+            "b": _cfg(**base, seed=1),
+        }
+        assert experiments.plan_cohorts(same)[0] == (["a", "b"], True)
+
     def test_batch_off_never_dispatches_cohorts(self, gmm):
         configs = {
             s: _cfg(scheme=s, compute_mode="deduped", **SCHEME_EXTRAS[s])

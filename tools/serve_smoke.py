@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Smoke-check the multi-tenant serve daemon on CPU (`make serve-smoke`).
+
+Starts the daemon in-process, races 4 client threads whose requests share
+a cohort signature (overlapping shapes, per-client seeds), then asserts
+the serving contract:
+
+  - packing happened: serve.dispatches < serve.requests (the clients'
+    trajectories shared compiled dispatches instead of going one-by-one),
+    and cohort.dispatches agrees;
+  - bitwise row equality: the same requests run SEQUENTIALLY through the
+    daemon (one at a time, same fixed dispatch width) produce science
+    rows identical byte-for-byte, tolerating only completion order —
+    packing is a throughput lever, never a numerics knob;
+  - per-tenant journals landed (one sweep_journal.jsonl per tenant) and
+    pass the schema check, as does the daemon's own event log
+    (request/pack/admit records included);
+  - `erasurehead-tpu report` renders the serve section without error.
+
+Exit 0 = all assertions hold; 1 = failure (printed).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+
+# runnable from anywhere without an install (the tools/ convention)
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU relay
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.obs import events as events_lib
+    from erasurehead_tpu.obs import report as report_lib
+    from erasurehead_tpu.obs.metrics import REGISTRY
+    from erasurehead_tpu.serve import server as serve_server
+    from erasurehead_tpu.train import journal as journal_lib
+    from erasurehead_tpu.utils.config import RunConfig
+
+    W, rounds, n_clients = 8, 4, 4
+    data = generate_gmm(W * 16, 24, n_partitions=W, seed=0)
+    common = dict(
+        n_workers=W, n_stragglers=1, rounds=rounds, n_rows=W * 16,
+        n_cols=24, update_rule="AGD", lr_schedule=0.5, add_delay=True,
+        compute_mode="deduped",
+    )
+    schemes = [
+        ("naive", {}),
+        ("cyccoded", {}),
+        ("approx", {"num_collect": 6}),
+        ("deadline", {"deadline": 1.0}),
+    ]
+    requests = [
+        (
+            f"tenant{k}",
+            f"{s}_c{k}",
+            RunConfig(**{**common, **extra, "scheme": s, "seed": k}),
+        )
+        for k in range(n_clients)
+        for s, extra in schemes
+    ]
+    n_requests = len(requests)
+    width = 16  # fixed dispatch width shared by both runs
+
+    def science(summary):
+        return json.dumps(
+            journal_lib.science_row(journal_lib.summary_payload(summary)),
+            sort_keys=True,
+        )
+
+    workdir = tempfile.mkdtemp(prefix="eh-serve-smoke-")
+    events_path = os.path.join(workdir, "serve_events.jsonl")
+    journal_dir = os.path.join(workdir, "journal")
+
+    for c in ("serve.requests", "serve.dispatches", "serve.results",
+              "cohort.dispatches"):
+        REGISTRY.counter(c).reset()
+
+    # ---- packed: 4 concurrent clients, shared dispatches -----------------
+    with events_lib.capture(events_path):
+        with serve_server.serving(
+            window_s=0.2, max_cohort=width, journal_dir=journal_dir
+        ) as srv:
+            handles, hlock = [], threading.Lock()
+
+            def client(tenant: str) -> None:
+                for tn, label, cfg in requests:
+                    if tn != tenant:
+                        continue
+                    h = srv.submit(
+                        tenant=tn, label=label, config=cfg, dataset=data
+                    )
+                    with hlock:
+                        handles.append(h)
+
+            threads = [
+                threading.Thread(target=client, args=(f"tenant{k}",))
+                for k in range(n_clients)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            packed = [h.result(timeout=300) for h in handles]
+
+    dispatches = REGISTRY.counter("serve.dispatches").value
+    n_req_counter = REGISTRY.counter("serve.requests").value
+    cohort_dispatches = REGISTRY.counter("cohort.dispatches").value
+    packed_rows = sorted(science(r.summary) for r in packed)
+
+    # ---- sequential: same requests, one at a time, fresh journal ---------
+    with serve_server.serving(
+        window_s=0.001, max_cohort=width,
+        journal_dir=os.path.join(workdir, "journal-seq"),
+    ) as srv:
+        seq_rows = sorted(
+            science(
+                srv.submit(
+                    tenant=tn, label=label, config=cfg, dataset=data
+                ).result(timeout=300).summary
+            )
+            for tn, label, cfg in requests
+        )
+
+    failures = []
+    statuses = {r.status for r in packed}
+    if statuses != {"ok"}:
+        failures.append(f"expected all-ok results, got statuses {statuses}")
+    if n_req_counter != n_requests:
+        failures.append(
+            f"serve.requests={n_req_counter} != {n_requests} submitted"
+        )
+    if dispatches >= n_requests:
+        failures.append(
+            f"serve.dispatches={dispatches} not < {n_requests} requests: "
+            "the daemon did not pack"
+        )
+    if cohort_dispatches > dispatches:
+        failures.append(
+            f"cohort.dispatches={cohort_dispatches} exceeds "
+            f"serve.dispatches={dispatches}"
+        )
+    if packed_rows != seq_rows:
+        n_diff = sum(1 for a, b in zip(packed_rows, seq_rows) if a != b)
+        failures.append(
+            f"packed vs sequential science rows differ ({n_diff} of "
+            f"{n_requests}): packing changed the numbers"
+        )
+    schema_errors = events_lib.validate_file(events_path)
+    failures.extend(f"serve events schema: {e}" for e in schema_errors)
+    for k in range(n_clients):
+        jpath = os.path.join(
+            journal_dir, f"tenant{k}", journal_lib.JOURNAL_NAME
+        )
+        if not os.path.exists(jpath):
+            failures.append(f"missing per-tenant journal {jpath}")
+            continue
+        errs = events_lib.validate_file(jpath)
+        failures.extend(f"journal tenant{k}: {e}" for e in errs)
+    rendered = report_lib.render([events_path])
+    if "serve (multi-tenant cohort packing)" not in rendered:
+        failures.append("report did not render the serve section")
+
+    print(
+        f"serve-smoke: {n_requests} requests from {n_clients} tenants -> "
+        f"{dispatches} dispatch(es); rows bitwise vs sequential: "
+        f"{packed_rows == seq_rows}"
+    )
+    print(f"events -> {events_path}")
+    print(rendered.split("serve (multi-tenant")[-1] if failures == [] else "")
+    for f in failures:
+        print(f"FAIL: {f}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,9 +7,13 @@ tool can never drift. Checks: every line parses, record types are known,
 required keys are present, seq is monotonic per logger, chunked
 rounds/decode records have strictly increasing round indices per run,
 sweep_trajectory journal records (train/journal.py) carry a known status
-("ok"/"diverged"), a non-empty key and an object row, and every run_start
-has a matching run_end. Sweep journals are events.jsonl files too — point
-this tool at DIR/sweep_journal.jsonl to check one.
+("ok"/"diverged"), a non-empty key and an object row, serve-daemon
+records (erasurehead_tpu/serve/) are internally consistent (`request`
+names its tenant/request_id/label, `pack`'s trajectory count matches its
+label list, `admit` carries non-negative byte figures, `evict` names its
+reason), and every run_start has a matching run_end. Sweep journals and
+serve event logs are events.jsonl files too — point this tool at
+DIR/sweep_journal.jsonl or the daemon's --events log to check them.
 
 Usage: python tools/validate_events.py events.jsonl [more.jsonl ...]
 Exit 0 = all files valid; 1 = errors (printed, one per line).
