@@ -14,13 +14,17 @@ footprint against a byte budget before it may dispatch:
     ``memory_analysis`` byte accounting (argument/temp/output) REFINES the
     estimate — later admissions of the same signature charge the measured
     peak when it is larger (estimates may undercount XLA temps);
+  - the sweep data cache's device pins (cache.data_cache_bytes) count
+    against the budget alongside in-flight charges — they are real HBM;
   - an over-footprint cohort QUEUES: it stays pending and is retried next
     loop, after in-flight dispatches release their charge. It never joins
     a running cohort's HBM — that is the whole point (an admission-control
     OOM would take innocent tenants' dispatches down with it);
-  - when the blocker is the sweep data cache's pins rather than live
-    dispatches, the controller EVICTS the cache (cache.drop_data_cache —
-    the same pressure valve the OOM-bisection ladder uses) and admits;
+  - when dropping the data cache's pins would change the verdict, the
+    controller EVICTS the cache (cache.drop_data_cache — the same
+    pressure valve the OOM-bisection ladder uses) and re-runs the FULL
+    decision, so eviction can admit in the same call and an idle daemon
+    can never strand a pending cohort;
   - a cohort too big for the budget even on an idle daemon admits alone
     with a warning (refusing forever would deadlock the tenant; alone, an
     OOM hurts only itself and the bisection ladder still degrades it).
@@ -99,6 +103,32 @@ class AdmissionController:
             est = max(est, measured)
         return est
 
+    def _decide_locked(self, est: int) -> str:
+        """The admission verdict for ``est`` charged bytes (caller holds
+        ``self._lock``): ``"admit"``, ``"evict"`` (dropping the data
+        cache's pins would change the verdict — re-decide after), or
+        ``"defer"``. The data cache's device pins count against the
+        budget alongside in-flight charges, so evicting them genuinely
+        moves the inequality."""
+        budget = self.budget_bytes
+        if budget is None:
+            return "admit"
+        in_flight = sum(self._in_flight.values())
+        cached = cache_lib.data_cache_bytes()
+        if in_flight + cached + est <= budget:
+            return "admit"
+        if cached > 0 and (in_flight + est <= budget or in_flight == 0):
+            # the data cache's pins are idle capital: dropping them frees
+            # real HBM without touching any live dispatch. Evict when
+            # that alone closes the gap, or when the daemon is otherwise
+            # idle (the admit-alone fallback below wants every byte)
+            return "evict"
+        if in_flight == 0:
+            # nothing to wait for and nothing to evict: admitting alone
+            # is the only non-deadlocking move
+            return "admit"
+        return "defer"
+
     def try_admit(
         self, cohort, dispatch_id: str, width: Optional[int] = None
     ) -> bool:
@@ -108,24 +138,10 @@ class AdmissionController:
         stands between the cohort and the budget."""
         est = self.charge_for(cohort, width=width)
         with self._lock:
-            in_flight = sum(self._in_flight.values())
-            budget = self.budget_bytes
-            admitted = budget is None or in_flight + est <= budget
-            evict_would_help = False
-            if not admitted:
-                cached = cache_lib.data_cache_bytes()
-                # the data cache's pins are idle capital: dropping them
-                # frees real HBM without touching any live dispatch
-                evict_would_help = (
-                    cached > 0 and in_flight + est - cached <= budget
-                )
-                if not evict_would_help and in_flight == 0:
-                    # nothing to wait for and nothing to evict: admitting
-                    # alone is the only non-deadlocking move
-                    admitted = True
-            if admitted:
+            verdict = self._decide_locked(est)
+            if verdict == "admit":
                 self._in_flight[dispatch_id] = est
-        if not admitted and evict_would_help:
+        if verdict == "evict":
             released = cache_lib.drop_data_cache()
             _METRICS.counter("serve.evictions").inc()
             events_lib.emit(
@@ -135,10 +151,17 @@ class AdmissionController:
                 released_bytes=released,
             )
             with self._lock:
-                in_flight = sum(self._in_flight.values())
-                admitted = in_flight + est <= self.budget_bytes
-                if admitted:
+                # full re-decision with the pins gone, INCLUDING the idle
+                # admit-alone fallback — an idle daemon must never strand
+                # a pending cohort after dropping its cache for it
+                verdict = self._decide_locked(est)
+                if verdict == "evict":
+                    # a concurrent dispatch repopulated the cache between
+                    # the drop and this lock; defer rather than thrash
+                    verdict = "defer"
+                if verdict == "admit":
                     self._in_flight[dispatch_id] = est
+        admitted = verdict == "admit"
         if admitted and self.budget_bytes is not None and (
             est > self.budget_bytes
         ):

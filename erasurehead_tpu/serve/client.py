@@ -5,7 +5,10 @@ The wire protocol is newline-delimited JSON (see server.SocketFront): one
 daemon's packed dispatches land. A reader thread demultiplexes the
 responses, so any number of submissions may be in flight on one
 connection; results arrive in COMPLETION order — match them up by
-``request_id`` (or ``label``).
+``request_id`` (or ``label``). Submissions themselves serialize briefly:
+the daemon answers ``accepted`` lines in submit order with no correlation
+tag, so :meth:`submit` holds a lock across its send + reply to keep
+concurrent submitters from swapping request_ids.
 
     client = ServeClient("/tmp/eh-serve.sock")
     rid = client.submit("alice", "agc_s2", {"scheme": "approx",
@@ -72,7 +75,10 @@ class ServeClient:
         timeout: Optional[float] = 30.0,
     ) -> str:
         """Submit one trajectory request; returns its request_id. Raises
-        RuntimeError when the daemon refuses the payload."""
+        RuntimeError when the daemon refuses the payload. Thread-safe:
+        the accepted reply is correlated purely by submit order, so the
+        lock spans the send AND the reply — two concurrent submitters
+        must not each read the other's request_id."""
         line = json.dumps(
             {
                 "op": "submit",
@@ -85,7 +91,7 @@ class ServeClient:
         ) + "\n"
         with self._wlock:
             self._sock.sendall(line.encode())
-        reply = self._accepted.get(timeout=timeout)
+            reply = self._accepted.get(timeout=timeout)
         if reply.get("type") != "accepted":
             raise RuntimeError(
                 f"serve daemon refused the request: "

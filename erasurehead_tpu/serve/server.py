@@ -185,8 +185,8 @@ class SweepServer:
             queue_lib.Queue()
         )
         self._pending: list[RequestHandle] = []
-        self._handles: dict[str, RequestHandle] = {}
         self._journals: dict[str, journal_lib.SweepJournal] = {}
+        self._journal_lock = threading.Lock()
         self._datasets: dict[tuple, object] = {}
         self._executor = ThreadPoolExecutor(
             max_workers=max(1, int(dispatch_workers)),
@@ -260,7 +260,6 @@ class SweepServer:
         if self._thread is None or self._stopping:
             raise RuntimeError("serve loop is not running")
         handle = RequestHandle(request)
-        self._handles[request.request_id] = handle
         _METRICS.counter("serve.requests").inc()
         self._inbox.put(handle)
         return handle
@@ -270,12 +269,18 @@ class SweepServer:
     def _journal_for(self, tenant: str) -> Optional[journal_lib.SweepJournal]:
         if self.journal_dir is None:
             return None
-        j = self._journals.get(tenant)
-        if j is None:
-            j = journal_lib.SweepJournal(
-                os.path.join(self.journal_dir, tenant), resume=self.resume
-            )
-            self._journals[tenant] = j
+        # called from the intake loop AND dispatch executor threads:
+        # check-then-insert under a lock, or two concurrent dispatches
+        # for a new tenant each open a journal (fd leak + the loser's
+        # in-memory resume map silently diverging from the winner's)
+        with self._journal_lock:
+            j = self._journals.get(tenant)
+            if j is None:
+                j = journal_lib.SweepJournal(
+                    os.path.join(self.journal_dir, tenant),
+                    resume=self.resume,
+                )
+                self._journals[tenant] = j
         return j
 
     def _resolve_dataset(self, request: RunRequest):
@@ -678,15 +683,35 @@ class SocketFront:
         self._sock.settimeout(0.2)
         self._closing = False
         self._threads: list[threading.Thread] = []
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="eh-serve-socket", daemon=True
         )
         self._accept_thread.start()
 
     def close(self) -> None:
+        import socket as socket_lib
+
         self._closing = True
         self._accept_thread.join(timeout=5)
         self._sock.close()
+        # shut down accepted connections so their recv() unblocks and the
+        # per-connection threads see _closing and exit
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket_lib.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2)
+        self._threads.clear()
         if os.path.exists(self.path):
             os.unlink(self.path)
 
@@ -700,6 +725,12 @@ class SocketFront:
                 continue
             except OSError:
                 return
+            # a finite recv timeout is what lets _serve_conn honor
+            # _closing between lines instead of blocking forever
+            conn.settimeout(0.5)
+            with self._conns_lock:
+                self._conns.add(conn)
+            self._threads = [t for t in self._threads if t.is_alive()]
             t = threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             )
@@ -708,6 +739,7 @@ class SocketFront:
 
     def _serve_conn(self, conn) -> None:
         import json as json_lib
+        import socket as socket_lib
 
         from erasurehead_tpu.serve.queue import config_from_payload
 
@@ -722,7 +754,15 @@ class SocketFront:
                     pass  # client went away; results are still journaled
 
         def relay(handle: RequestHandle) -> None:
-            res = handle.result()
+            # poll rather than block forever: a close() mid-dispatch must
+            # be able to retire this thread (the row is still journaled)
+            while True:
+                try:
+                    res = handle.result(timeout=0.5)
+                    break
+                except queue_lib.Empty:
+                    if self._closing:
+                        return
             send(
                 {
                     "type": "result",
@@ -737,48 +777,56 @@ class SocketFront:
             )
 
         buf = b""
-        with conn:
-            while not self._closing:
-                try:
-                    chunk = conn.recv(1 << 16)
-                except OSError:
-                    return
-                if not chunk:
-                    return
-                buf += chunk
-                while b"\n" in buf:
-                    raw, buf = buf.split(b"\n", 1)
-                    if not raw.strip():
-                        continue
+        try:
+            with conn:
+                while not self._closing:
                     try:
-                        msg = json_lib.loads(raw)
-                        if msg.get("op") != "submit":
-                            raise ValueError(
-                                f"unknown op {msg.get('op')!r} "
-                                "(only 'submit')"
+                        chunk = conn.recv(1 << 16)
+                    except socket_lib.timeout:
+                        continue  # idle; re-check _closing
+                    except OSError:
+                        return
+                    if not chunk:
+                        return
+                    buf += chunk
+                    while b"\n" in buf:
+                        raw, buf = buf.split(b"\n", 1)
+                        if not raw.strip():
+                            continue
+                        try:
+                            msg = json_lib.loads(raw)
+                            if msg.get("op") != "submit":
+                                raise ValueError(
+                                    f"unknown op {msg.get('op')!r} "
+                                    "(only 'submit')"
+                                )
+                            cfg = config_from_payload(
+                                msg.get("config") or {}
                             )
-                        cfg = config_from_payload(msg.get("config") or {})
-                        handle = self.server.submit(
-                            tenant=msg["tenant"],
-                            label=msg["label"],
-                            config=cfg,
-                            target_loss=msg.get("target_loss"),
-                            data_seed=int(msg.get("data_seed", 0)),
-                        )
-                    except Exception as e:  # noqa: BLE001 — per-line fault
+                            handle = self.server.submit(
+                                tenant=msg["tenant"],
+                                label=msg["label"],
+                                config=cfg,
+                                target_loss=msg.get("target_loss"),
+                                data_seed=int(msg.get("data_seed", 0)),
+                            )
+                        except Exception as e:  # noqa: BLE001 — per-line
+                            send(
+                                {
+                                    "type": "error",
+                                    "message": f"{type(e).__name__}: {e}",
+                                }
+                            )
+                            continue
                         send(
                             {
-                                "type": "error",
-                                "message": f"{type(e).__name__}: {e}",
+                                "type": "accepted",
+                                "request_id": handle.request_id,
                             }
                         )
-                        continue
-                    send(
-                        {
-                            "type": "accepted",
-                            "request_id": handle.request_id,
-                        }
-                    )
-                    threading.Thread(
-                        target=relay, args=(handle,), daemon=True
-                    ).start()
+                        threading.Thread(
+                            target=relay, args=(handle,), daemon=True
+                        ).start()
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)

@@ -159,6 +159,46 @@ class TestAdmission:
         ctl = admission_lib.AdmissionController(budget_bytes=1)
         assert ctl.try_admit(cohort, "d1")  # idle daemon: admit + warn
 
+    def test_eviction_admits_in_the_same_call(self, gmm):
+        """Data-cache pins count in the admission inequality, so dropping
+        them genuinely changes the post-evict recheck: an idle daemon
+        whose cache is the only blocker must evict AND admit in one
+        try_admit call — never drop the cache and then strand the cohort
+        (nothing else would ever bump the serve loop's generation)."""
+        cohort = packer_lib.plan_packs([_req(gmm)])[0]
+        est = admission_lib.estimate_cohort_bytes(cohort)
+        ctl = admission_lib.AdmissionController(budget_bytes=est)
+        cache._data_cache["pin"] = (None, 123)  # est fits; est + pins won't
+        e0 = _counter("serve.evictions")
+        assert ctl.try_admit(cohort, "d1")
+        assert _counter("serve.evictions") == e0 + 1
+        assert cache.data_cache_bytes() == 0
+        ctl.release("d1")
+
+    def test_idle_evicts_then_admits_alone_when_still_over(self, gmm):
+        """Over-budget even after eviction, on an idle daemon: evict (the
+        oversized dispatch wants every byte) and fall through to the
+        admit-alone path in the same call."""
+        cohort = packer_lib.plan_packs([_req(gmm)])[0]
+        ctl = admission_lib.AdmissionController(budget_bytes=1)
+        cache._data_cache["pin"] = (None, 999)
+        assert ctl.try_admit(cohort, "d1")
+        assert cache.data_cache_bytes() == 0
+        ctl.release("d1")
+
+    def test_busy_daemon_defers_without_pointless_eviction(self, gmm):
+        """When live dispatches (not the cache) are the blocker, defer
+        WITHOUT dropping the cache: eviction that cannot change the
+        verdict just burns a warm cache for nothing."""
+        cohort = packer_lib.plan_packs([_req(gmm)])[0]
+        est = admission_lib.estimate_cohort_bytes(cohort)
+        ctl = admission_lib.AdmissionController(budget_bytes=est)
+        assert ctl.try_admit(cohort, "d1")
+        cache._data_cache["pin"] = (None, 7)
+        assert not ctl.try_admit(cohort, "d2")
+        assert cache.data_cache_bytes() == 7  # cache kept warm
+        ctl.release("d1")
+
     def test_admit_events_and_measured_ratchet(self, gmm, tmp_path):
         cohort = packer_lib.plan_packs([_req(gmm)])[0]
         est = admission_lib.estimate_cohort_bytes(cohort)
