@@ -21,6 +21,12 @@ Layout:
   data/      synthetic GMM + real-dataset preprocessing, partitioning, disk IO
   train/     GD/AGD optimizer, scan-based trainer, post-hoc evaluation replay,
              result artifacts, checkpointing
+  schemes/   the declarative scheme registry: a scheme = layout builder +
+             collection rules + capability flags, entry-point-discoverable
+             for third-party codes (group "erasurehead_tpu.schemes")
+  adapt/     online straggler-adaptive collection: a seeded bandit over
+             registry-compatible (scheme, collect, deadline) arms reading
+             the run's own decode-error + arrival telemetry
   utils/     typed config, determinism audit, profiler tracing
 """
 
@@ -64,3 +70,11 @@ def train_elastic(cfg, dataset, deaths, **kw):
     from erasurehead_tpu.parallel import failures
 
     return failures.train_elastic(cfg, dataset, deaths, **kw)
+
+
+def train_adaptive(cfg, dataset, **kw):
+    """Convenience re-export of adapt.train_adaptive (chunk-boundary
+    bandit over registry-compatible collection policies)."""
+    from erasurehead_tpu import adapt
+
+    return adapt.train_adaptive(cfg, dataset, **kw)

@@ -1,0 +1,31 @@
+"""Online straggler-adaptive collection (ISSUE 8 / ROADMAP item 5).
+
+``train_adaptive`` runs the scan trainer in chunks and lets a seeded
+discounted-reward bandit (:class:`AdaptiveController`) re-choose the
+collection policy — a registry-compatible :class:`Arm` of (scheme,
+collect count, deadline) — at every chunk boundary, reading the run's own
+decode-error and arrival telemetry. Decisions are journaled as typed
+``adapt`` events; see README "Schemes & adaptive collection".
+"""
+
+from erasurehead_tpu.adapt.controller import (
+    AdaptiveController,
+    Arm,
+    ChunkStats,
+    ControllerConfig,
+)
+from erasurehead_tpu.adapt.driver import (
+    AdaptiveResult,
+    default_arms,
+    train_adaptive,
+)
+
+__all__ = [
+    "AdaptiveController",
+    "AdaptiveResult",
+    "Arm",
+    "ChunkStats",
+    "ControllerConfig",
+    "default_arms",
+    "train_adaptive",
+]

@@ -1,0 +1,324 @@
+"""train_adaptive: run the scan in chunks under the bandit's chosen arms.
+
+The driver is deliberately a THIN composition of existing machinery:
+
+  - each chunk is a plain ``trainer.train`` call covering rounds
+    [lo, hi) via the ``initial_state``/``initial_round`` mid-schedule
+    restart contract (the elastic-recovery hook) — so every chunk's math,
+    caching, telemetry and decode-error accounting are exactly the
+    single-run trainer's;
+  - the arrival matrix is drawn ONCE for the whole horizon
+    (trainer.default_arrivals — the ``ERASUREHEAD_REGIME`` shift applies
+    here) and every arm sees the same stream, the paired-comparison
+    contract compare() uses;
+  - arm switches are weight-table switches: arms must share the base
+    config's layout-stack signature (validated up front), so no data
+    re-upload ever happens mid-run, and in deduped mode all arms share
+    one compiled executable (the weight table is a traced argument).
+
+Between chunks the controller reads the chunk's own telemetry quantities
+(sim seconds, decode-error mean, masked arrival stats) and decides the
+next arm; each decision is journaled as a typed ``adapt`` event
+(obs/events.py). Decisions are deterministic given (controller seed,
+arrival schedule), so kill→resume — or simply rerunning — replays the
+same sequence bitwise (tests/test_adapt.py; chaos site "adapt" arms a
+mid-adaptation fault).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from erasurehead_tpu.adapt.controller import (
+    AdaptiveController,
+    Arm,
+    ChunkStats,
+    ControllerConfig,
+)
+from erasurehead_tpu.utils.config import RunConfig
+
+
+@dataclasses.dataclass
+class AdaptiveResult:
+    """A merged TrainResult plus the controller's decision record."""
+
+    result: object  # trainer.TrainResult over the full horizon
+    decisions: list[dict]  # one per chunk (controller.decisions)
+    arms: list[Arm]
+    #: per-chunk (arm label, ChunkStats) pairs, decision order
+    chunk_stats: list[tuple]
+    #: the controller's own cost: wall seconds spent in choose/observe/
+    #: event emission across all chunks (the bench `adapt` extra's <2%%
+    #: bar divides this by total_wall_s)
+    decision_overhead_s: float
+    #: everything outside the chunk train() calls (schedule refits,
+    #: cache lookups, history stitching) — the chunked-dispatch fixed
+    #: cost, reported separately from the controller's own math
+    driver_overhead_s: float
+    #: sum of the chunks' device wall seconds
+    train_wall_s: float
+    #: whole-run wall seconds (train + driver + decisions)
+    total_wall_s: float
+
+
+def default_arms(cfg: RunConfig) -> list[Arm]:
+    """A reasonable registry-compatible arm set for ``cfg``: the config's
+    own policy plus the uncoded-layout alternatives every straggler
+    regime ranks differently (wait-for-all, ignore-stragglers, and — when
+    the config carries a deadline — deadline collection). All share the
+    deduped partition-major stack; in faithful mode only stack-compatible
+    arms survive the driver's validation."""
+    arms = [Arm(cfg.scheme.value, cfg.num_collect, cfg.deadline)]
+
+    def add(arm: Arm):
+        if all(a.label != arm.label for a in arms):
+            arms.append(arm)
+
+    add(Arm("naive"))
+    add(Arm("avoidstragg"))
+    if cfg.deadline is not None:
+        add(Arm("deadline", deadline=cfg.deadline))
+    return arms
+
+
+def _arm_config(cfg: RunConfig, arm: Arm, rounds: int) -> RunConfig:
+    return dataclasses.replace(
+        cfg, rounds=rounds, lr_schedule=cfg.resolve_lr_schedule()[:rounds],
+        **arm.overrides(),
+    )
+
+
+def _validate_arms(cfg: RunConfig, arms: Sequence[Arm]):
+    """Every arm must (a) validate as a config and (b) build the SAME
+    device data stack as the base config — the no-re-upload contract that
+    makes arm switches cheap. Returns the arms' layouts."""
+    from erasurehead_tpu import schemes
+    from erasurehead_tpu.train import cache as cache_lib
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import ComputeMode
+
+    faithful = cfg.compute_mode == ComputeMode.FAITHFUL
+    base_layout = trainer.build_layout(cfg)
+    base_sig = cache_lib.layout_stack_signature(
+        base_layout, worker_major=faithful
+    )
+    layouts = []
+    for arm in arms:
+        desc = schemes.get(arm.scheme)
+        if desc.partial:
+            raise ValueError(
+                f"arm {arm.label!r}: partial two-part schemes change the "
+                "partition count and cannot share the base data stack"
+            )
+        arm_cfg = _arm_config(cfg, arm, cfg.rounds)
+        lay = trainer.build_layout(arm_cfg)
+        sig = cache_lib.layout_stack_signature(lay, worker_major=faithful)
+        if sig != base_sig:
+            raise ValueError(
+                f"arm {arm.label!r} builds a different device data stack "
+                "than the base config (layout-stack signatures differ); "
+                "adaptive arm switches must be weight-table-only — use "
+                "compute_mode='deduped' (partition-major stacks are "
+                "scheme-independent) or stack-compatible schemes"
+            )
+        layouts.append(lay)
+    return layouts
+
+
+def train_adaptive(
+    cfg: RunConfig,
+    dataset,
+    arms: Optional[Sequence[Arm]] = None,
+    controller: Optional[ControllerConfig] = None,
+    mesh=None,
+    arrivals: Optional[np.ndarray] = None,
+) -> AdaptiveResult:
+    """Train ``cfg.rounds`` rounds, re-choosing the collection policy at
+    every ``controller.chunk_rounds`` boundary (module docstring).
+
+    ``cfg`` provides everything but the per-chunk policy: model, data
+    shape, update rule, decode mode, memory knobs. ``arms`` defaults to
+    :func:`default_arms`. Returns an :class:`AdaptiveResult` whose
+    ``result`` quacks like a single ``trainer.train`` result over the
+    full horizon (history, clocks with the -1 sentinel, decode-error
+    series stitched from the chunks).
+    """
+    import jax
+
+    from erasurehead_tpu.obs import events as obs_events
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils import chaos as chaos_lib
+
+    if cfg.arrival_mode != "simulated":
+        raise ValueError(
+            "train_adaptive drives the scan trainer in chunks; "
+            "arrival_mode='measured' has no chunked implementation"
+        )
+    arms = list(arms) if arms is not None else default_arms(cfg)
+    ctl_cfg = controller or ControllerConfig()
+    _validate_arms(cfg, arms)
+    ctl = AdaptiveController(arms, ctl_cfg)
+
+    # chunk-boundary loss probe (reward_mode="progress"): one-snapshot
+    # eval replays on the full host training set — evaluate.replay caches
+    # its jitted scan per model identity, so each probe is one tiny
+    # program execution, counted into decision_overhead_s
+    from erasurehead_tpu.train import evaluate as evaluate_lib
+    from erasurehead_tpu.train import trainer as trainer_lib
+
+    probe_model = trainer_lib.build_model(cfg)
+
+    def _loss_of(params) -> float:
+        import jax as _jax
+
+        hist = _jax.tree.map(lambda l: np.asarray(l)[None], params)
+        ev = evaluate_lib.replay(
+            probe_model, cfg.model, hist,
+            dataset.X_train, dataset.y_train,
+            dataset.X_test, dataset.y_test,
+        )
+        return float(ev.training_loss[-1])
+
+    if arrivals is None:
+        arrivals = trainer.default_arrivals(cfg)
+    arrivals = np.asarray(arrivals, dtype=np.float64)
+    if arrivals.shape != (cfg.rounds, cfg.n_workers):
+        raise ValueError(
+            f"arrivals shape {arrivals.shape} != "
+            f"({cfg.rounds}, {cfg.n_workers})"
+        )
+
+    R, W = cfg.rounds, cfg.n_workers
+    run_id = obs_events.new_run_id() if obs_events.current() else None
+    state = None
+    pieces = []  # per-chunk params_history trees
+    timeset = np.zeros(R)
+    worker_times = np.full((R, W), -1.0)
+    collected = np.zeros((R, W), dtype=bool)
+    decode_err = np.zeros(R)
+    chunk_stats: list[tuple] = []
+    train_wall = 0.0
+    decision_wall = 0.0
+    last_res = None
+    t_total0 = time.perf_counter()
+    loss_prev: Optional[float] = None
+    if ctl_cfg.reward_mode == "progress":
+        p0 = trainer_lib._init_params_f32(
+            cfg, probe_model, dataset.n_features
+        )
+        # warm the probe's jitted replay scan outside the timed region
+        # (same contract as the trainers' executable warm-up: one-time
+        # compile cost is not a property of the per-chunk decision)
+        _loss_of(p0)
+        t_dec0 = time.perf_counter()
+        loss_prev = _loss_of(p0)
+        decision_wall += time.perf_counter() - t_dec0
+    lo = 0
+    while lo < R:
+        hi = min(lo + ctl_cfg.chunk_rounds, R)
+        # chaos site "adapt": a kill here is a preemption mid-adaptation;
+        # rerunning replays the decision prefix bitwise (determinism)
+        chaos_lib.maybe_fire("adapt")
+        t_dec = time.perf_counter()
+        idx, reason = ctl.choose()
+        decision_wall += time.perf_counter() - t_dec
+        arm = arms[idx]
+        arm_cfg = _arm_config(cfg, arm, hi)
+        res = trainer.train(
+            arm_cfg, dataset, mesh=mesh, arrivals=arrivals[:hi],
+            initial_state=state, initial_round=lo if state is not None else 0,
+            measure=False,
+        )
+        state = res.final_state
+        last_res = res
+        train_wall += res.wall_time
+        # the chunk's own telemetry: clocks + decode errors for [lo, hi)
+        timeset[lo:hi] = res.timeset[lo:hi]
+        worker_times[lo:hi] = res.worker_times[lo:hi]
+        collected[lo:hi] = res.collected[lo:hi]
+        decode_err[lo:hi] = res.decode_error[lo:hi]
+        pieces.append(res.params_history)
+        t_dec = time.perf_counter()
+        # arrival stats for SHIFT DETECTION come from the raw schedule
+        # window, not the collected-masked worker_times: masked stats are
+        # policy-dependent (avoidstragg never stamps the straggler it
+        # skipped), and a policy-dependent detector would read every arm
+        # switch as a regime change
+        raw = arrivals[lo:hi]
+        raw = raw[np.isfinite(raw)]
+        loss_delta = None
+        if loss_prev is not None:
+            loss_now = _loss_of(res.final_params)
+            loss_delta = loss_prev - loss_now
+            loss_prev = loss_now
+        stats = ChunkStats(
+            n_rounds=hi - lo,
+            sim_time=float(res.timeset[lo:hi].sum()),
+            decode_error_mean=float(res.decode_error[lo:hi].mean()),
+            arrival_mean=float(raw.mean()) if raw.size else None,
+            arrival_p90=(
+                float(np.quantile(raw, 0.9)) if raw.size else None
+            ),
+            loss_delta=loss_delta,
+        )
+        shift = ctl.observe(idx, stats)
+        chunk_stats.append((arm.label, stats))
+        obs_events.emit(
+            "adapt",
+            run_id=run_id,
+            round=lo,
+            n_rounds=hi - lo,
+            arm=arm.label,
+            scheme=arm.scheme,
+            num_collect=arm.num_collect,
+            deadline=arm.deadline,
+            reason=reason,
+            reward=round(ctl.reward(stats), 8),
+            sim_per_round=round(stats.sim_per_round, 8),
+            decode_error_mean=round(stats.decode_error_mean, 10),
+            regime_shift=bool(shift),
+            values=ctl.snapshot()["values"],
+        )
+        decision_wall += time.perf_counter() - t_dec
+        lo = hi
+
+    history = (
+        pieces[0]
+        if len(pieces) == 1
+        else jax.tree.map(
+            lambda *xs: np.concatenate([np.asarray(x) for x in xs]), *pieces
+        )
+    )
+    total_wall = time.perf_counter() - t_total0
+    driver_overhead = max(total_wall - train_wall - decision_wall, 0.0)
+    merged = trainer.TrainResult(
+        params_history=history,
+        final_params=state.params,
+        final_state=state,
+        timeset=timeset,
+        worker_times=worker_times,
+        collected=collected,
+        sim_total_time=float(timeset.sum()),
+        wall_time=train_wall,
+        steps_per_sec=R / train_wall if train_wall > 0 else 0.0,
+        n_train=last_res.n_train,
+        config=cfg,
+        layout=last_res.layout,
+        decode_error=decode_err,
+        run_id=run_id,
+        cache_info=last_res.cache_info,
+    )
+    return AdaptiveResult(
+        result=merged,
+        decisions=list(ctl.decisions),
+        arms=arms,
+        chunk_stats=chunk_stats,
+        decision_overhead_s=decision_wall,
+        driver_overhead_s=driver_overhead,
+        train_wall_s=train_wall,
+        total_wall_s=total_wall,
+    )

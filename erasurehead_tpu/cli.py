@@ -118,11 +118,16 @@ def _legacy_to_config(argv: list[str]) -> RunConfig:
 
 
 def _flags_parser() -> argparse.ArgumentParser:
+    from erasurehead_tpu import schemes as schemes_lib
+
     p = argparse.ArgumentParser(
         prog="erasurehead-tpu",
         description="Straggler-tolerant coded gradient descent on TPU",
     )
-    p.add_argument("--scheme", default="naive", choices=[s.value for s in Scheme])
+    # --scheme choices come from the registry (erasurehead_tpu/schemes/),
+    # so entry-point-registered third-party schemes appear here without
+    # touching this file
+    p.add_argument("--scheme", default="naive", choices=schemes_lib.names())
     p.add_argument("--model", default=None, choices=[m.value for m in ModelKind])
     p.add_argument("--workers", type=int, default=8)
     p.add_argument("--stragglers", type=int, default=1)
@@ -130,6 +135,29 @@ def _flags_parser() -> argparse.ArgumentParser:
     p.add_argument("--deadline", type=float, default=None,
                    help="per-round collection deadline in simulated "
                         "seconds (scheme=deadline)")
+    p.add_argument("--decode", default="fixed", choices=["fixed", "optimal"],
+                   help="decode-weight policy: 'optimal' refits the "
+                        "collection weights per round to the ACTUAL "
+                        "arrival set (least-squares over the layout's "
+                        "effective coding matrix, arXiv:2006.09638) — "
+                        "error <= the scheme's fixed weights round for "
+                        "round (obs/decode.py proves it); 'fixed' keeps "
+                        "the reference behavior")
+    p.add_argument("--adapt", default="off", choices=["off", "on"],
+                   help="online straggler-adaptive collection (adapt/): "
+                        "a seeded bandit re-chooses the (scheme, collect, "
+                        "deadline) policy at every --adapt-chunk boundary "
+                        "from the run's own decode-error and arrival "
+                        "telemetry, switching when the straggler regime "
+                        "shifts; decisions are journaled as typed `adapt` "
+                        "events")
+    p.add_argument("--adapt-chunk", type=int, default=10,
+                   help="rounds per adaptive decision window")
+    p.add_argument("--adapt-arms", default=None, metavar="SPEC",
+                   help="comma-separated arms 'scheme[:cN][:dSECS]', e.g. "
+                        "'naive,approx:c4,deadline:d1.5'; default: the "
+                        "run's own policy plus the uncoded-layout "
+                        "alternatives (adapt.default_arms)")
     p.add_argument("--rounds", type=int, default=100)
     p.add_argument("--dataset", default="artificial")
     p.add_argument("--rows", type=int, default=4096)
@@ -304,6 +332,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         n_stragglers=ns.stragglers,
         num_collect=ns.num_collect,
         deadline=ns.deadline,
+        decode=ns.decode,
         rounds=ns.rounds,
         add_delay=ns.add_delay,
         delay_mean=ns.delay_mean,
@@ -418,6 +447,19 @@ def _validate_checkpoint_flags(parser, ns) -> None:
         parser.error("--kill-workers does not compose with checkpointing")
     if ns.kill_workers and ns.arrival_mode == "measured":
         parser.error("--kill-workers needs the simulated-arrival trainer")
+    # adaptive collection: the driver owns the chunking, so the static
+    # checkpoint/fault paths don't compose with it
+    if ns.adapt == "on":
+        if ns.arrival_mode == "measured":
+            parser.error("--adapt needs the simulated-arrival trainer")
+        if ns.checkpoint_dir or ns.resume:
+            parser.error("--adapt does not compose with checkpointing")
+        if ns.kill_workers:
+            parser.error("--adapt does not compose with --kill-workers")
+    if ns.adapt_chunk < 1:
+        parser.error("--adapt-chunk must be >= 1")
+    if ns.adapt_arms is not None and ns.adapt != "on":
+        parser.error("--adapt-arms requires --adapt on")
 
 
 def _parse_deaths(spec: str) -> dict[int, int]:
@@ -440,6 +482,34 @@ def _parse_deaths(spec: str) -> dict[int, int]:
     return out
 
 
+def _parse_arms(spec: str):
+    """'naive,approx:c4,deadline:d1.5' -> [Arm, ...] (cN = num_collect,
+    dSECS = deadline; order-free within one arm)."""
+    from erasurehead_tpu.adapt import Arm
+
+    arms = []
+    for part in spec.split(","):
+        fields = part.strip().split(":")
+        if not fields or not fields[0]:
+            raise ValueError(f"bad --adapt-arms entry {part!r}")
+        scheme, num_collect, deadline = fields[0], None, None
+        for f in fields[1:]:
+            try:
+                if f.startswith("c"):
+                    num_collect = int(f[1:])
+                elif f.startswith("d"):
+                    deadline = float(f[1:])
+                else:
+                    raise ValueError
+            except ValueError:
+                raise ValueError(
+                    f"bad --adapt-arms field {f!r} in {part!r}; want cN "
+                    "(collect count) or dSECS (deadline)"
+                ) from None
+        arms.append(Arm(scheme, num_collect=num_collect, deadline=deadline))
+    return arms
+
+
 def run(
     cfg: RunConfig,
     output_dir: str | None = None,
@@ -452,6 +522,9 @@ def run(
     on_death: str = "error",
     death_timeout: float | None = None,
     telemetry: str | None = None,
+    adapt: str = "off",
+    adapt_chunk: int = 10,
+    adapt_arms: str | None = None,
 ):
     # argument-only checks: fail before backend init / dataset load
     if (checkpoint_dir or resume) and cfg.arrival_mode == "measured":
@@ -502,7 +575,35 @@ def run(
         else contextlib.nullcontext()
     )
     with capture, device_trace(trace_dir):
-        if cfg.arrival_mode == "measured":
+        if adapt == "on":
+            from erasurehead_tpu import adapt as adapt_lib
+
+            arms = _parse_arms(adapt_arms) if adapt_arms else None
+            ares = adapt_lib.train_adaptive(
+                cfg, dataset, arms=arms,
+                controller=adapt_lib.ControllerConfig(
+                    chunk_rounds=adapt_chunk, seed=cfg.seed
+                ),
+            )
+            result = ares.result
+            if not quiet:
+                switches = sum(
+                    1
+                    for a, b in zip(ares.decisions, ares.decisions[1:])
+                    if a["arm"] != b["arm"]
+                )
+                print(
+                    f"adaptive collection: {len(ares.decisions)} "
+                    f"decision(s), {switches} arm switch(es), "
+                    f"{1000 * ares.decision_overhead_s:.2f} ms controller "
+                    "overhead"
+                )
+                for d in ares.decisions:
+                    print(
+                        f"  chunk {d['chunk']:>3} -> {d['arm']:24s} "
+                        f"[{d['reason']}]"
+                    )
+        elif cfg.arrival_mode == "measured":
             result = trainer.train_measured(cfg, dataset)
         elif deaths and on_death == "elastic":
             result, report = failures.train_elastic(cfg, dataset, deaths)
@@ -623,6 +724,9 @@ def main(argv: list[str] | None = None) -> int:
         on_death=ns.on_death,
         death_timeout=ns.death_timeout,
         telemetry=ns.telemetry,
+        adapt=ns.adapt,
+        adapt_chunk=ns.adapt_chunk,
+        adapt_arms=ns.adapt_arms,
     )
     return 0
 

@@ -85,7 +85,16 @@ SCHEMA: dict[str, tuple] = {
     # sweep data cache's HBM pins (or timed a request out of the packing
     # window) to make room — "reason" says which
     "evict": ("reason",),
+    # one per adaptive-controller decision (adapt/driver.py): which
+    # (scheme, collect, deadline) arm ran the chunk starting at "round",
+    # and why (warmup / exploit / explore / regime_shift). Seeded and
+    # telemetry-driven, so a resumed run replays the identical sequence —
+    # the event log is the decision journal.
+    "adapt": ("round", "arm", "reason"),
 }
+
+#: adapt decision reasons (adapt/controller.AdaptiveController.choose)
+ADAPT_REASONS = ("warmup", "exploit", "explore", "regime_shift")
 
 #: sweep_trajectory completion statuses (train/journal.py); "diverged"
 #: rows are quarantined, not retried — divergence is deterministic under
@@ -482,6 +491,25 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
                 errors.append(
                     f"line {i}: evict reason must be a non-empty string, "
                     f"got {reason!r}"
+                )
+        if rtype == "adapt":
+            rnd = rec.get("round")
+            if not isinstance(rnd, int) or rnd < 0:
+                errors.append(
+                    f"line {i}: adapt round must be a non-negative int, "
+                    f"got {rnd!r}"
+                )
+            arm = rec.get("arm")
+            if not isinstance(arm, str) or not arm:
+                errors.append(
+                    f"line {i}: adapt arm must be a non-empty string, "
+                    f"got {arm!r}"
+                )
+            reason = rec.get("reason")
+            if reason not in ADAPT_REASONS:
+                errors.append(
+                    f"line {i}: adapt reason must be one of "
+                    f"{ADAPT_REASONS}, got {reason!r}"
                 )
         if rtype == "run_start":
             started.add(rec.get("run_id"))

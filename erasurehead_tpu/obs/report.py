@@ -30,6 +30,7 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
     order: list = []
     warnings: list = []
     trajectories: list = []
+    adapt: list = []
     serve: dict = {"requests": [], "packs": [], "admits": [], "evicts": []}
 
     def run(rid):
@@ -72,6 +73,8 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
                     (run(rid)["warnings"] if rid else warnings).append(rec)
                 elif rtype == "sweep_trajectory":
                     trajectories.append(rec)
+                elif rtype == "adapt":
+                    adapt.append(rec)
                 elif rtype == "request":
                     serve["requests"].append(rec)
                 elif rtype == "pack":
@@ -81,12 +84,45 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
                 elif rtype == "evict":
                     serve["evicts"].append(rec)
     out = [runs[rid] for rid in order]
-    if warnings or trajectories or any(serve.values()):
+    if warnings or trajectories or adapt or any(serve.values()):
         out.append({
             "run_id": None, "warnings": warnings,
             "trajectories": trajectories, "serve": serve,
+            "adapt": adapt,
         })
     return out
+
+
+def _adapt_section(stray: list) -> list[str]:
+    """The adaptive-controller section: one line per decision (chunk
+    start round, chosen arm, reason), plus a switch/shift summary — a
+    run's policy trajectory, reconstructed from its `adapt` events."""
+    decisions: list = []
+    for g in stray:
+        decisions.extend(g.get("adapt", []))
+    if not decisions:
+        return []
+    switches = sum(
+        1
+        for a, b in zip(decisions, decisions[1:])
+        if a.get("arm") != b.get("arm")
+    )
+    shifts = sum(1 for d in decisions if d.get("regime_shift"))
+    lines = [
+        f"\nadaptive controller: {len(decisions)} decision(s), "
+        f"{switches} arm switch(es)"
+        + (f", {shifts} regime shift(s) detected" if shifts else "")
+    ]
+    for d in decisions:
+        err = d.get("decode_error_mean")
+        lines.append(
+            f"  round {d.get('round', '?'):>5} -> "
+            f"{str(d.get('arm', '?'))[:24]:24s} [{d.get('reason', '?')}]"
+            f"  sim/round={_fmt(d.get('sim_per_round'), '.4f')}"
+            f"  decode_err={_fmt(err, '.6f')}"
+            + ("  REGIME SHIFT" if d.get("regime_shift") else "")
+        )
+    return lines
 
 
 def _serve_section(stray: list) -> list[str]:
@@ -227,6 +263,7 @@ def render(paths: Sequence[str]) -> str:
                 f"{disp} dispatch(es) [{c.get('lowering', '?')}]"
             )
     lines.extend(_serve_section(stray))
+    lines.extend(_adapt_section(stray))
     # serve rows (tenant-tagged) render in the serving section above; the
     # journal listing keeps the local-sweep rows
     trajectories = [

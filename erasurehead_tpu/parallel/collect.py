@@ -299,37 +299,76 @@ def collect_partial(
     )
 
 
+def optimal_decode_weights_host(E: np.ndarray, masks: np.ndarray) -> np.ndarray:
+    """Least-squares collection weights fit to the ACTUAL arrival sets —
+    the optimal decoder of "Approximate Gradient Coding with Optimal
+    Decoding" (arXiv:2006.09638).
+
+    ``E`` is the layout's [W, P] effective coding matrix
+    (CodingLayout.effective_matrix: message_w = E[w] @ partition_grads);
+    for each round's completion mask the returned row minimizes
+    ``||w^T E - 1||_2`` over weights supported on the collected workers —
+    exactly the weight-space decode error obs/decode.py surfaces, so
+    per round this decode is the minimum-error linear combination of
+    whatever actually arrived (vs e.g. AGC's all-or-nothing group
+    erasures or the avoidstragg/deadline uniform rescales).
+
+    Host float64, batched over rounds; like the MDS solver above, each
+    DISTINCT mask is solved once (a cohort's [R, W] mask batch shares the
+    handful of patterns the straggler regime produces — the "tiny [k, P]
+    solve, batchable across a cohort" of ROADMAP item 1/5).
+    """
+    E = np.asarray(E, dtype=np.float64)
+    masks = np.asarray(masks, dtype=bool)
+    ones = np.ones(E.shape[1])
+    uniq, inverse = np.unique(masks, axis=0, return_inverse=True)
+    out = np.zeros((uniq.shape[0], E.shape[0]))
+    for k in range(uniq.shape[0]):
+        live = np.flatnonzero(uniq[k])
+        if live.size:
+            out[k, live] = np.linalg.lstsq(E[live, :].T, ones, rcond=None)[0]
+    return out[inverse.reshape(-1)]
+
+
+def optimal_decode_schedule(
+    schedule: CollectionSchedule, layout: CodingLayout
+) -> CollectionSchedule:
+    """``decode="optimal"``: keep the schedule's stop condition — who was
+    collected, when the master exited — and refit only the decode weights
+    to each round's actual arrival set (:func:`optimal_decode_weights_host`).
+    Timing artifacts (sim_time, worker_times, collected) are untouched:
+    the optimal decoder changes what the master does WITH the messages,
+    never how long it waits for them."""
+    weights = optimal_decode_weights_host(
+        layout.effective_matrix(), schedule.collected
+    )
+    return dataclasses.replace(schedule, message_weights=weights)
+
+
 def build_schedule(
     scheme: Scheme,
     t: np.ndarray,
     layout: CodingLayout,
     num_collect: int | None = None,
     deadline: float | None = None,
+    decode: str = "fixed",
 ) -> CollectionSchedule:
-    """Dispatch to the scheme's collection rule (the reference's dispatch is
-    main.py:62-92)."""
-    if scheme == Scheme.DEADLINE:
-        if deadline is None:
-            raise ValueError("deadline scheme needs a deadline")
-        return collect_deadline(t, deadline)
-    if scheme == Scheme.NAIVE:
-        return collect_all(t)
-    if scheme == Scheme.CYCLIC_MDS:
-        return collect_first_k_mds(t, layout.B, layout.n_stragglers)
-    if scheme == Scheme.FRC:
-        return collect_frc(t, layout.groups)
-    if scheme == Scheme.APPROX:
-        if num_collect is None:
-            raise ValueError("AGC needs num_collect")
-        return collect_agc(t, layout.groups, num_collect)
-    if scheme == Scheme.RANDOM_REGULAR:
-        if num_collect is None:
-            raise ValueError("randreg needs num_collect")
-        return collect_first_k_optimal(t, layout.B, num_collect)
-    if scheme == Scheme.AVOID_STRAGGLERS:
-        return collect_avoidstragg(t, layout.n_stragglers)
-    if scheme == Scheme.PARTIAL_CYCLIC:
-        return collect_partial(t, layout, "mds")
-    if scheme == Scheme.PARTIAL_FRC:
-        return collect_partial(t, layout, "frc")
-    raise ValueError(f"unknown scheme {scheme}")
+    """Build the scheme's collection schedule via its registry descriptor
+    (erasurehead_tpu/schemes/; the reference's dispatch was main.py:62-92).
+
+    ``decode="optimal"`` refits the decode weights per round to the
+    actual arrival pattern (:func:`optimal_decode_schedule`) on schemes
+    whose descriptor carries an ``optimal_decode`` hook; schemes without
+    one (the partial two-part layouts) keep their fixed weights.
+    """
+    from erasurehead_tpu import schemes
+
+    desc = schemes.get(scheme)
+    sched = desc.build_schedule(
+        t, layout, num_collect=num_collect, deadline=deadline
+    )
+    if decode == "optimal" and desc.optimal_decode is not None:
+        sched = desc.optimal_decode(sched, layout)
+    elif decode not in ("fixed", "optimal"):
+        raise ValueError(f"decode must be fixed/optimal, got {decode!r}")
+    return sched

@@ -218,92 +218,30 @@ def make_round_schedule_fn(
     The arrival model matches straggler.jax_delay_schedule (threefry
     exponential draws; not bit-matched to the reference's MT19937 — use the
     host control plane for run-for-run numeric parity with the reference).
-    """
-    scheme = Scheme(scheme)
-    W = layout.n_workers
-    B = None if layout.B is None else jnp.asarray(layout.B, jnp.float32)
-    onehot = (
-        None if layout.groups is None
-        else jnp.asarray(_group_onehot(np.asarray(layout.groups)))
-    )
-    # MDS schemes: precompute f64 decode weights for every <=s straggler
-    # pattern so the in-scan decode is a table gather, immune to the fp32
-    # conditioning hazard at canonical W=30 (ops/codes.MdsDecodeTable).
-    decode_table = None
-    if scheme == Scheme.CYCLIC_MDS:
-        decode_table = codes.build_decode_table(
-            np.asarray(layout.B), layout.n_stragglers, exact_only=True
-        )
-    elif scheme == Scheme.PARTIAL_CYCLIC:
-        # completed sets can exceed W-s here -> full 0..s pattern range
-        decode_table = codes.build_decode_table(
-            np.asarray(layout.B), layout.n_stragglers
-        )
-    elif scheme == Scheme.RANDOM_REGULAR and num_collect is not None:
-        decode_table = codes.build_decode_table(
-            np.asarray(layout.B), W - num_collect, exact_only=True
-        )
-    if (
-        decode_table is None
-        and scheme in (Scheme.CYCLIC_MDS, Scheme.PARTIAL_CYCLIC,
-                       Scheme.RANDOM_REGULAR)
-        and W > 16
-    ):
-        import warnings
 
-        warnings.warn(
-            f"{scheme.value}: C(W, s) too large for a decode table at W={W};"
-            " falling back to the on-device fp32 solve, which is UNRELIABLE"
-            " for ill-conditioned straggler patterns at this scale (see"
-            " ops/codes.mds_decode_weights_host). Prefer trainer.train()"
-            " (host f64 control plane) for science runs.",
-            stacklevel=2,
+    The per-scheme rule comes from the scheme's registry descriptor
+    (``dynamic_rule``, erasurehead_tpu/schemes/builtin.py): each factory
+    closes over its layout tables and — for the MDS family — precomputes
+    the f64 decode table so the in-scan decode is a table gather, immune
+    to the fp32 conditioning hazard at canonical W=30
+    (ops/codes.MdsDecodeTable; falls back with a warning past the table
+    cap).
+    """
+    from erasurehead_tpu import schemes
+
+    desc = schemes.get(scheme)
+    W = layout.n_workers
+    if desc.dynamic_rule is None:
+        raise ValueError(
+            f"scheme {desc.name!r} has no dynamic (on-device) collection "
+            "rule; use the host control plane (trainer.train)"
         )
+    rule = desc.dynamic_rule(layout, num_collect=num_collect, deadline=deadline)
 
     def draw(key):
         if not add_delay:
             return jnp.zeros(W)
         return delay_mean * jax.random.exponential(key, (W,))
-
-    if scheme == Scheme.DEADLINE:
-        if deadline is None:
-            raise ValueError("deadline scheme needs a deadline")
-        rule = lambda t: collect_deadline_jnp(t, deadline)
-    elif scheme == Scheme.NAIVE:
-        rule = collect_all_jnp
-    elif scheme == Scheme.CYCLIC_MDS:
-        rule = lambda t: collect_first_k_mds_jnp(
-            t, B, layout.n_stragglers, decode_table=decode_table
-        )
-    elif scheme == Scheme.AVOID_STRAGGLERS:
-        rule = lambda t: collect_avoidstragg_jnp(t, layout.n_stragglers)
-    elif scheme == Scheme.FRC:
-        rule = lambda t: collect_frc_jnp(t, onehot)
-    elif scheme == Scheme.APPROX:
-        if num_collect is None:
-            raise ValueError("AGC needs num_collect")
-        rule = lambda t: collect_agc_jnp(t, onehot, num_collect)
-    elif scheme == Scheme.RANDOM_REGULAR:
-        if num_collect is None:
-            raise ValueError("randreg needs num_collect")
-        rule = lambda t: _first_k_lstsq_jnp(
-            t, B, num_collect, decode_table=decode_table
-        )
-    elif scheme in (Scheme.PARTIAL_CYCLIC, Scheme.PARTIAL_FRC):
-        frac = layout.uncoded_frac
-        if scheme == Scheme.PARTIAL_CYCLIC:
-            rule = lambda t: collect_partial_jnp(
-                t, variant="mds", frac=frac,
-                n_stragglers=layout.n_stragglers, B=B,
-                decode_table=decode_table,
-            )
-        else:
-            gids = jnp.asarray(np.asarray(layout.groups))
-            rule = lambda t: collect_partial_jnp(
-                t, variant="frc", frac=frac, onehot=onehot, group_ids=gids,
-            )
-    else:
-        raise ValueError(f"unknown scheme {scheme}")
 
     def schedule(key: jax.Array) -> RoundSchedule:
         t = draw(key)

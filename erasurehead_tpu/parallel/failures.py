@@ -107,45 +107,18 @@ def analyze(
     num_collect: int | None = None,
     timeout: float = np.inf,
 ) -> FeasibilityReport:
-    """Per-round feasibility of the scheme's stop condition (table above)."""
-    scheme = Scheme(scheme)
+    """Per-round feasibility of the scheme's stop condition (table above).
+
+    The per-scheme core lives on the scheme's registry descriptor
+    (``feasibility``, erasurehead_tpu/schemes/builtin.py); this wraps it
+    with the shared death detection and report plumbing."""
+    from erasurehead_tpu import schemes
+    from erasurehead_tpu.utils.config import as_scheme
+
+    scheme = as_scheme(scheme)
+    desc = schemes.get(scheme)
     dead = detect_dead(arrivals, timeout)
-    alive_cnt = (~dead).sum(axis=1)
-    W = arrivals.shape[1]
-    s = layout.n_stragglers
-    if layout.groups is not None:
-        n_groups = layout.n_groups
-        group_alive = np.stack(
-            [(~dead[:, layout.groups == g]).any(axis=1) for g in range(n_groups)],
-            axis=1,
-        )  # [R, G]
-        all_groups_alive = group_alive.all(axis=1)
-    if scheme == Scheme.DEADLINE:
-        # the master always exits at the deadline; zero-arrival rounds
-        # apply a zero gradient rather than blocking
-        feasible = np.ones(arrivals.shape[0], dtype=bool)
-        reason = "deadline collection always completes"
-    elif scheme == Scheme.NAIVE:
-        feasible, reason = alive_cnt == W, "needs all W workers"
-    elif scheme in (Scheme.CYCLIC_MDS, Scheme.AVOID_STRAGGLERS):
-        feasible, reason = alive_cnt >= W - s, f"needs first {W - s} arrivals"
-    elif scheme == Scheme.FRC:
-        feasible, reason = all_groups_alive, "needs one arrival per group"
-    elif scheme == Scheme.APPROX:
-        if num_collect is None:
-            raise ValueError("AGC needs num_collect")
-        feasible = (alive_cnt >= num_collect) | all_groups_alive
-        reason = f"needs {num_collect} arrivals or full group coverage"
-    elif scheme == Scheme.RANDOM_REGULAR:
-        if num_collect is None:
-            raise ValueError("randreg needs num_collect")
-        feasible = alive_cnt >= num_collect
-        reason = f"needs first {num_collect} arrivals"
-    elif scheme in (Scheme.PARTIAL_CYCLIC, Scheme.PARTIAL_FRC):
-        feasible = alive_cnt == W
-        reason = "needs every worker's uncoded first-part"
-    else:
-        raise ValueError(f"unknown scheme {scheme}")
+    feasible, reason = desc.feasibility(layout, dead, num_collect=num_collect)
     return FeasibilityReport(
         feasible=np.asarray(feasible), dead=dead, scheme=scheme, reason=reason
     )
@@ -215,6 +188,7 @@ def plan_run(
     timeout: float = np.inf,
     on_infeasible: str = "error",  # "error" | "failover"
     deadline: float | None = None,
+    decode: str = "fixed",
 ) -> tuple[collect.CollectionSchedule, FeasibilityReport]:
     """Build the run's collection schedule with failure handling.
 
@@ -231,8 +205,8 @@ def plan_run(
         )
     report = analyze(scheme, layout, arrivals, num_collect, timeout)
     schedule = collect.build_schedule(
-        Scheme(scheme), arrivals, layout, num_collect=num_collect,
-        deadline=deadline,
+        scheme, arrivals, layout, num_collect=num_collect,
+        deadline=deadline, decode=decode,
     )
     if report.all_feasible:
         return schedule, report

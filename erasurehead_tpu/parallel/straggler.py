@@ -100,23 +100,103 @@ def model_from_config(cfg) -> "ArrivalModel | None":
     return ArrivalModel(compute_time=cfg.compute_time, worker_speed=speed)
 
 
+@dataclasses.dataclass(frozen=True)
+class RegimeShift:
+    """A deterministic mid-run change of the straggler regime.
+
+    The reference's delay model is stationary (the same Exponential(0.5)
+    stream every round); the worst-case analyses the retrieved papers run
+    are not — "Fundamental Limits of Approximate Gradient Coding"
+    (arXiv:1901.08166) shows the cost of straggling concentrates in
+    adversarial/non-stationary patterns. Two kinds:
+
+      - ``"heavytail"``: Exponential(mean) delays through round
+        ``round``-1, then Pareto(``alpha``)-tailed delays (seeded per
+        round like the reference's own stream, so the whole matrix stays
+        deterministic and shared across schemes). Small ``alpha`` =
+        heavier tail; alpha <= 1 has infinite mean — every round pays
+        some worker's catastrophic delay.
+      - ``"adversary"``: from round ``round`` on, worker ``worker`` turns
+        adversarially slow (+``slowdown`` simulated seconds on top of its
+        drawn delay) — the fixed-straggler worst case of 1901.08166,
+        where any scheme that must hear from that worker stalls every
+        round.
+
+    This is what the adapt/ controller reacts to: a policy tuned to the
+    pre-shift regime stops being the best arm at ``round``.
+    """
+
+    kind: str  # "heavytail" | "adversary"
+    round: int  # first round of the new regime
+    alpha: float = 1.2  # heavytail: Pareto tail index
+    worker: int = 0  # adversary: which worker turns slow
+    slowdown: float = 5.0  # adversary: extra seconds per round
+
+    def __post_init__(self):
+        if self.kind not in ("heavytail", "adversary"):
+            raise ValueError(
+                f"regime kind must be heavytail/adversary, got {self.kind!r}"
+            )
+        if self.round < 0:
+            raise ValueError(f"regime round must be >= 0, got {self.round}")
+        if self.kind == "heavytail" and self.alpha <= 0:
+            raise ValueError(f"heavytail alpha must be > 0, got {self.alpha}")
+        if self.kind == "adversary" and self.slowdown < 0:
+            raise ValueError(
+                f"adversary slowdown must be >= 0, got {self.slowdown}"
+            )
+
+
+#: seed offset separating the post-shift heavy-tail stream from the
+#: reference's own exponential stream (which seeds RandomState(i))
+_REGIME_SEED_BASE = 104_729
+
+
+def apply_regime_shift(
+    delays: np.ndarray, shift: RegimeShift, mean: float = 0.5
+) -> np.ndarray:
+    """Rewrite a [R, W] delay matrix's rounds >= shift.round per the shift
+    (deterministic: heavy-tail rounds re-seed per round exactly like
+    :func:`reference_delay_schedule`, so every scheme in a paired sweep
+    sees the identical shifted stream)."""
+    out = np.array(delays, dtype=np.float64, copy=True)
+    R, W = out.shape
+    r0 = min(max(int(shift.round), 0), R)
+    if shift.kind == "heavytail":
+        for i in range(r0, R):
+            rs = np.random.RandomState(_REGIME_SEED_BASE + i)
+            # Pareto(alpha) - shifted to start at 0, scaled so the
+            # pre-shift mean survives as the scale unit; alpha near 1
+            # makes the per-round max routinely 10-100x the mean
+            out[i] = mean * rs.pareto(shift.alpha, W)
+    elif shift.kind == "adversary":
+        out[r0:, shift.worker % W] += shift.slowdown
+    return out
+
+
 def arrival_schedule(
     rounds: int,
     n_workers: int,
     add_delay: bool,
     mean: float = 0.5,
     arrival_model: ArrivalModel | None = None,
+    regime: RegimeShift | None = None,
 ) -> np.ndarray:
     """The full [rounds, W] arrival-time matrix for a run.
 
     With ``add_delay=False`` the reference's workers reply in compute order
     with no injected sleep (main.py arg add_delay, src/naive.py:140); we model
     that as all-zero arrivals (ties broken by worker index in the collection
-    rules, documented there).
+    rules, documented there). ``regime`` applies a deterministic mid-run
+    straggler-regime change (:class:`RegimeShift`) on top of the drawn
+    delays — the adversary kind applies even with delays off (a slow
+    worker is slow whether or not the exponential stream is injected).
     """
     if add_delay:
         delays = reference_delay_schedule(rounds, n_workers, mean)
     else:
         delays = np.zeros((rounds, n_workers))
+    if regime is not None and (add_delay or regime.kind == "adversary"):
+        delays = apply_regime_shift(delays, regime, mean)
     model = arrival_model or ArrivalModel()
     return model.arrivals(delays)

@@ -1,0 +1,43 @@
+"""Declarative scheme registry (ISSUE 8 / ROADMAP item 5).
+
+A scheme is a frozen :class:`SchemeDescriptor` bundling its layout
+builder, collection rule (host + traced), failure feasibility, optimal-
+decode hook, capability flags, and config surface. The nine builtins
+register on import; third-party codes register via
+:func:`register` or the ``erasurehead_tpu.schemes`` entry-point group
+(:data:`ENTRY_POINT_GROUP`) — see README "Schemes & adaptive collection".
+
+All scheme dispatch in the package resolves through :func:`get`; a
+grep-enforced test (tests/test_schemes.py) pins that no ``if scheme ==``
+spine survives outside this package.
+"""
+
+from erasurehead_tpu.schemes.base import SchemeDescriptor
+from erasurehead_tpu.schemes.registry import (
+    ENTRY_POINT_GROUP,
+    descriptors,
+    get,
+    is_registered,
+    load_entry_points,
+    names,
+    register,
+    scheme_name,
+    unregister,
+)
+
+# importing the package declares the builtins (registration is idempotent
+# per interpreter: module import runs once)
+from erasurehead_tpu.schemes import builtin as _builtin  # noqa: F401,E402
+
+__all__ = [
+    "SchemeDescriptor",
+    "ENTRY_POINT_GROUP",
+    "descriptors",
+    "get",
+    "is_registered",
+    "load_entry_points",
+    "names",
+    "register",
+    "scheme_name",
+    "unregister",
+]

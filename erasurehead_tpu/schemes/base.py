@@ -1,0 +1,149 @@
+"""SchemeDescriptor: the declarative unit of the scheme registry.
+
+A *scheme* used to be an if/elif spine threaded through six files — layout
+construction in train/trainer.py, the host collection rule in
+parallel/collect.py, the on-device rule in parallel/dynamic.py, failure
+feasibility in parallel/failures.py, config validation in utils/config.py,
+and ad-hoc capability checks everywhere else. Each of those branches was
+one facet of the same object; this module gives that object a home.
+
+A :class:`SchemeDescriptor` bundles, per scheme:
+
+  - **layout builder** (``build_layout``): RunConfig -> ops/codes
+    CodingLayout — which partitions each worker holds, with which coding
+    coefficients (the reference's per-scheme data-assignment blocks).
+  - **host collection rule** (``build_schedule``): the stop condition +
+    decode weights as a pure function of the arrival matrix
+    (parallel/collect.py's rule functions; the reference's master
+    ``Waitany`` loop).
+  - **dynamic rule factory** (``dynamic_rule``): the fully on-device jnp
+    form of the same rule (parallel/dynamic.py), or None when the scheme
+    has no traced implementation.
+  - **failure feasibility** (``feasibility``): would the master's wait
+    loop ever exit under these deaths (parallel/failures.analyze)?
+  - **optimal-decode hook** (``optimal_decode``): the registry-level
+    ``decode="optimal"`` option (arXiv:2006.09638) — per-round
+    least-squares collection weights fit to the *actual* arrival pattern.
+    None = the scheme's fixed weights are kept (partial schemes).
+  - **capability flags**: exact vs approximate, partial (two-part)
+    layouts, measured-mode support, dynamic/on-device decode support,
+    cohort batchability (what the sweep planner and the serve packer key
+    compatibility on).
+  - **config/CLI surface**: which RunConfig knobs the scheme reads
+    (``config_fields``), plus a ``validate_config`` hook holding the
+    scheme's own config invariants (utils/config delegates to it).
+
+Descriptors are frozen: registration is declaration, not construction.
+Third-party codes ship one descriptor and register it — directly via
+:func:`erasurehead_tpu.schemes.register` or through the
+``erasurehead_tpu.schemes`` entry-point group (see registry.py) — and the
+CLI ``--scheme`` choices, ``utils.config`` validation, sweep planning and
+serve packing all pick it up without touching core.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+#: RunConfig fields every scheme shares (the descriptor's ``config_fields``
+#: lists scheme-SPECIFIC knobs beyond these)
+COMMON_CONFIG_FIELDS = ("scheme", "n_workers", "n_stragglers", "seed")
+
+
+@dataclasses.dataclass(frozen=True)
+class SchemeDescriptor:
+    """One collection/coding scheme, declaratively (module docstring)."""
+
+    #: the CLI / config name ("approx", "cyccoded", ...)
+    name: str
+    #: one-line human summary (CLI help, report rendering)
+    summary: str = ""
+
+    # ---- behavior --------------------------------------------------------
+    #: (cfg: RunConfig) -> ops.codes.CodingLayout
+    build_layout: Optional[Callable] = None
+    #: (t [R, W], layout, *, num_collect, deadline) ->
+    #: parallel.collect.CollectionSchedule — the host (float64) rule
+    build_schedule: Optional[Callable] = None
+    #: (layout, *, num_collect, deadline) -> (t [W] -> dynamic.RoundSchedule),
+    #: the traced on-device rule factory; None = no dynamic implementation
+    dynamic_rule: Optional[Callable] = None
+    #: (layout, dead [R, W] bool, *, num_collect) -> (feasible [R] bool,
+    #: reason str) — parallel.failures.analyze's per-scheme core
+    feasibility: Optional[Callable] = None
+    #: (schedule, layout) -> schedule with decode="optimal" weights
+    #: (least-squares fit to the actual arrival set); None = fixed weights
+    #: are already the scheme's only decode (partial schemes)
+    optimal_decode: Optional[Callable] = None
+
+    # ---- capabilities ----------------------------------------------------
+    #: decodes to the exact full gradient whenever its stop rule is
+    #: satisfiable (decode error snaps to 0.0)
+    exact: bool = False
+    #: two-part partial layout (uncoded slots + coded band)
+    partial: bool = False
+    #: the layout depends on cfg.seed (cyclic MDS / randreg generator draws)
+    seed_dependent_layout: bool = False
+    #: has a per-worker-timed measured-arrival implementation
+    #: (trainer.train_measured refuses schemes that don't)
+    supports_measured: bool = True
+    #: has a traced on-device rule (trainer.train_dynamic)
+    supports_dynamic: bool = True
+    #: may ride a trajectory-batched cohort dispatch (the sweep planner's
+    #: plan_cohorts and the serve packer both derive eligibility from this)
+    cohort_batchable: bool = True
+
+    # ---- config / CLI surface -------------------------------------------
+    #: scheme-specific RunConfig knobs (beyond COMMON_CONFIG_FIELDS)
+    config_fields: Tuple[str, ...] = ()
+    #: cfg.num_collect is required (AGC-family stop counts)
+    needs_num_collect: bool = False
+    #: cfg.deadline is required
+    needs_deadline: bool = False
+    #: (cfg) -> None, raising ValueError on scheme-specific config
+    #: violations (partial partition counts, positive deadlines, ...)
+    validate_config: Optional[Callable] = None
+    #: (n_workers) -> num_collect override for straggler sweeps whose base
+    #: config would collect everything (experiments.straggler_sweep's
+    #: "AGC's interesting regime collects fewer than all")
+    sweep_num_collect: Optional[Callable] = None
+
+    #: ships with erasurehead_tpu (entry-point/third-party schemes: False)
+    builtin: bool = False
+
+    def __post_init__(self):
+        if not self.name or not isinstance(self.name, str):
+            raise ValueError(f"scheme descriptor needs a name, got {self.name!r}")
+        for field in ("build_layout", "build_schedule"):
+            if getattr(self, field) is None:
+                raise ValueError(
+                    f"scheme {self.name!r}: descriptor field {field!r} is "
+                    "required (a scheme must at least build a layout and a "
+                    "collection schedule)"
+                )
+
+    def capabilities(self) -> dict:
+        """Flag dict (report rendering, third-party introspection)."""
+        return {
+            "exact": self.exact,
+            "partial": self.partial,
+            "seed_dependent_layout": self.seed_dependent_layout,
+            "supports_measured": self.supports_measured,
+            "supports_dynamic": self.supports_dynamic,
+            "cohort_batchable": self.cohort_batchable,
+            "supports_optimal_decode": self.optimal_decode is not None,
+            "needs_num_collect": self.needs_num_collect,
+            "needs_deadline": self.needs_deadline,
+        }
+
+    def validate(self, cfg) -> None:
+        """Scheme-specific config validation (utils.config delegates here
+        from RunConfig.__post_init__)."""
+        if self.needs_deadline and (cfg.deadline is None or cfg.deadline <= 0):
+            raise ValueError(
+                f"scheme={self.name!r} needs a positive deadline "
+                f"(got {cfg.deadline!r})"
+            )
+        if self.validate_config is not None:
+            self.validate_config(cfg)

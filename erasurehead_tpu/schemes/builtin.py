@@ -1,0 +1,473 @@
+"""The built-in scheme descriptors: the reference's seven plus randreg and
+deadline, declared as registry entries.
+
+Each descriptor wires the scheme's existing rule implementations together
+— layout factories from ops/codes.py, host collection rules from
+parallel/collect.py, traced rules from parallel/dynamic.py — so the
+registry refactor changes DISPATCH, never math: every builtin's layout and
+collection schedule are bitwise-identical to the old if/elif spines
+(pinned by tests/test_schemes.py's round-trip suite and by the existing
+equivalence suites running unchanged).
+
+Feasibility cores reproduce parallel/failures.analyze's per-scheme table
+(the "would the reference's master ever exit its wait loop" question);
+reasons keep the exact wording the failure reports always used.
+
+The ``optimal_decode`` hook is the registry-level ``decode="optimal"``
+option (arXiv:2006.09638): least-squares collection weights fit to the
+*actual* per-round arrival set over the layout's effective coding matrix.
+On exact schemes the fit reproduces the fixed weights' zero decode error;
+on approximate schemes it is the minimum-weight-space-error decode, which
+the obs/decode.py error norm proves ≤ the fixed weights round for round.
+Partial schemes keep ``optimal_decode=None``: their separate slots are
+unconditionally weighted 1.0 outside the message-weight system, so the
+fixed decode is the only one defined.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from erasurehead_tpu.ops import codes
+from erasurehead_tpu.schemes.base import SchemeDescriptor
+from erasurehead_tpu.schemes.registry import register
+
+
+# ---------------------------------------------------------------------------
+# shared feasibility helpers (parallel/failures.analyze's precomputations)
+# ---------------------------------------------------------------------------
+
+
+def _alive_cnt(dead: np.ndarray) -> np.ndarray:
+    return (~dead).sum(axis=1)
+
+
+def _all_groups_alive(layout, dead: np.ndarray) -> np.ndarray:
+    groups = np.asarray(layout.groups)
+    return np.stack(
+        [(~dead[:, groups == g]).any(axis=1) for g in range(layout.n_groups)],
+        axis=1,
+    ).all(axis=1)
+
+
+# ---------------------------------------------------------------------------
+# optimal decode (arXiv:2006.09638): the shared least-squares hook
+# ---------------------------------------------------------------------------
+
+
+def lstsq_optimal_decode(schedule, layout):
+    """decode="optimal": refit the schedule's message weights as the
+    least-squares solution over the ACTUAL collected set (delegates to
+    parallel.collect.optimal_decode_schedule — the solve lives beside the
+    other host collection math)."""
+    from erasurehead_tpu.parallel import collect
+
+    return collect.optimal_decode_schedule(schedule, layout)
+
+
+# ---------------------------------------------------------------------------
+# dynamic-rule factories (parallel/dynamic.py's per-scheme closures,
+# including each MDS-family scheme's f64 decode-table construction)
+# ---------------------------------------------------------------------------
+
+
+def _mds_table_or_warn(scheme_name, layout, max_stragglers, exact_only):
+    """Build the f64 decode table for an MDS-family dynamic rule, warning
+    (exactly as the old dispatch did) when C(W, s) exceeds the table cap
+    and the rule must fall back to the unreliable on-device fp32 solve."""
+    table = codes.build_decode_table(
+        np.asarray(layout.B), max_stragglers, exact_only=exact_only
+    )
+    if table is None and layout.n_workers > 16:
+        import warnings
+
+        warnings.warn(
+            f"{scheme_name}: C(W, s) too large for a decode table at "
+            f"W={layout.n_workers}; falling back to the on-device fp32 "
+            "solve, which is UNRELIABLE for ill-conditioned straggler "
+            "patterns at this scale (see ops/codes.mds_decode_weights_host)."
+            " Prefer trainer.train() (host f64 control plane) for science"
+            " runs.",
+            stacklevel=3,
+        )
+    return table
+
+
+def _dyn_naive(layout, *, num_collect=None, deadline=None):
+    from erasurehead_tpu.parallel import dynamic
+
+    return dynamic.collect_all_jnp
+
+
+def _dyn_cyclic_mds(layout, *, num_collect=None, deadline=None):
+    import jax.numpy as jnp
+
+    from erasurehead_tpu.parallel import dynamic
+
+    B = jnp.asarray(layout.B, jnp.float32)
+    table = _mds_table_or_warn(
+        "cyccoded", layout, layout.n_stragglers, exact_only=True
+    )
+    return lambda t: dynamic.collect_first_k_mds_jnp(
+        t, B, layout.n_stragglers, decode_table=table
+    )
+
+
+def _dyn_frc(layout, *, num_collect=None, deadline=None):
+    import jax.numpy as jnp
+
+    from erasurehead_tpu.parallel import dynamic
+
+    onehot = jnp.asarray(dynamic._group_onehot(np.asarray(layout.groups)))
+    return lambda t: dynamic.collect_frc_jnp(t, onehot)
+
+
+def _dyn_agc(layout, *, num_collect=None, deadline=None):
+    import jax.numpy as jnp
+
+    from erasurehead_tpu.parallel import dynamic
+
+    if num_collect is None:
+        raise ValueError("AGC needs num_collect")
+    onehot = jnp.asarray(dynamic._group_onehot(np.asarray(layout.groups)))
+    return lambda t: dynamic.collect_agc_jnp(t, onehot, num_collect)
+
+
+def _dyn_avoidstragg(layout, *, num_collect=None, deadline=None):
+    from erasurehead_tpu.parallel import dynamic
+
+    return lambda t: dynamic.collect_avoidstragg_jnp(t, layout.n_stragglers)
+
+
+def _dyn_randreg(layout, *, num_collect=None, deadline=None):
+    import jax.numpy as jnp
+
+    from erasurehead_tpu.parallel import dynamic
+
+    if num_collect is None:
+        raise ValueError("randreg needs num_collect")
+    B = jnp.asarray(layout.B, jnp.float32)
+    table = _mds_table_or_warn(
+        "randreg", layout, layout.n_workers - num_collect, exact_only=True
+    )
+    return lambda t: dynamic._first_k_lstsq_jnp(
+        t, B, num_collect, decode_table=table
+    )
+
+
+def _dyn_deadline(layout, *, num_collect=None, deadline=None):
+    from erasurehead_tpu.parallel import dynamic
+
+    if deadline is None:
+        raise ValueError("deadline scheme needs a deadline")
+    return lambda t: dynamic.collect_deadline_jnp(t, deadline)
+
+
+def _dyn_partial_cyclic(layout, *, num_collect=None, deadline=None):
+    import jax.numpy as jnp
+
+    from erasurehead_tpu.parallel import dynamic
+
+    B = jnp.asarray(layout.B, jnp.float32)
+    # completed sets can exceed W-s here -> full 0..s pattern range
+    table = _mds_table_or_warn(
+        "partialcyccoded", layout, layout.n_stragglers, exact_only=False
+    )
+    frac = layout.uncoded_frac
+    return lambda t: dynamic.collect_partial_jnp(
+        t, variant="mds", frac=frac, n_stragglers=layout.n_stragglers,
+        B=B, decode_table=table,
+    )
+
+
+def _dyn_partial_frc(layout, *, num_collect=None, deadline=None):
+    import jax.numpy as jnp
+
+    from erasurehead_tpu.parallel import dynamic
+
+    onehot = jnp.asarray(dynamic._group_onehot(np.asarray(layout.groups)))
+    gids = jnp.asarray(np.asarray(layout.groups))
+    frac = layout.uncoded_frac
+    return lambda t: dynamic.collect_partial_jnp(
+        t, variant="frc", frac=frac, onehot=onehot, group_ids=gids,
+    )
+
+
+# ---------------------------------------------------------------------------
+# config validation hooks
+# ---------------------------------------------------------------------------
+
+
+def _validate_partial(cfg) -> None:
+    if cfg.partitions_per_worker < cfg.n_stragglers + 2:
+        raise ValueError(
+            "partial schemes need partitions_per_worker >= n_stragglers+2"
+        )
+
+
+def _validate_deadline(cfg) -> None:
+    if cfg.deadline is None or cfg.deadline <= 0:
+        raise ValueError(
+            "scheme='deadline' needs a positive deadline "
+            f"(got {cfg.deadline!r})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# host collection rules needing argument guards (the old dispatch's checks)
+# ---------------------------------------------------------------------------
+
+
+def _sched_agc(t, layout, *, num_collect=None, deadline=None):
+    from erasurehead_tpu.parallel import collect
+
+    if num_collect is None:
+        raise ValueError("AGC needs num_collect")
+    return collect.collect_agc(t, layout.groups, num_collect)
+
+
+def _sched_randreg(t, layout, *, num_collect=None, deadline=None):
+    from erasurehead_tpu.parallel import collect
+
+    if num_collect is None:
+        raise ValueError("randreg needs num_collect")
+    return collect.collect_first_k_optimal(t, layout.B, num_collect)
+
+
+def _sched_deadline(t, layout, *, num_collect=None, deadline=None):
+    from erasurehead_tpu.parallel import collect
+
+    if deadline is None:
+        raise ValueError("deadline scheme needs a deadline")
+    return collect.collect_deadline(t, deadline)
+
+
+def _sched(fn_name):
+    """Host rule passthrough: resolve parallel.collect.<fn_name> lazily so
+    this module imports without pulling the jax-heavy stack."""
+
+    def rule(t, layout, *, num_collect=None, deadline=None, _n=fn_name):
+        from erasurehead_tpu.parallel import collect
+
+        fn = getattr(collect, _n)
+        if _n == "collect_all":
+            return fn(t)
+        if _n == "collect_first_k_mds":
+            return fn(t, layout.B, layout.n_stragglers)
+        if _n == "collect_frc":
+            return fn(t, layout.groups)
+        if _n == "collect_avoidstragg":
+            return fn(t, layout.n_stragglers)
+        raise AssertionError(_n)
+
+    return rule
+
+
+def _sched_partial(variant):
+    def rule(t, layout, *, num_collect=None, deadline=None):
+        from erasurehead_tpu.parallel import collect
+
+        return collect.collect_partial(t, layout, variant)
+
+    return rule
+
+
+# ---------------------------------------------------------------------------
+# the nine builtins
+# ---------------------------------------------------------------------------
+
+NAIVE = register(SchemeDescriptor(
+    name="naive",
+    summary="uncoded synchronous GD: wait for all W workers (src/naive.py)",
+    build_layout=lambda cfg: codes.uncoded_layout(cfg.n_workers),
+    build_schedule=_sched("collect_all"),
+    dynamic_rule=_dyn_naive,
+    feasibility=lambda layout, dead, *, num_collect=None: (
+        _alive_cnt(dead) == dead.shape[1], "needs all W workers"
+    ),
+    optimal_decode=lstsq_optimal_decode,
+    exact=True,
+    builtin=True,
+))
+
+CYCLIC_MDS = register(SchemeDescriptor(
+    name="cyccoded",
+    summary="exact gradient coding, cyclic MDS code (src/coded.py)",
+    build_layout=lambda cfg: codes.cyclic_mds_layout(
+        cfg.n_workers, cfg.n_stragglers, seed=cfg.seed
+    ),
+    build_schedule=_sched("collect_first_k_mds"),
+    dynamic_rule=_dyn_cyclic_mds,
+    feasibility=lambda layout, dead, *, num_collect=None: (
+        _alive_cnt(dead) >= dead.shape[1] - layout.n_stragglers,
+        f"needs first {layout.n_workers - layout.n_stragglers} arrivals",
+    ),
+    optimal_decode=lstsq_optimal_decode,
+    exact=True,
+    seed_dependent_layout=True,
+    builtin=True,
+))
+
+FRC = register(SchemeDescriptor(
+    name="repcoded",
+    summary="exact coding, fractional repetition groups (src/replication.py)",
+    build_layout=lambda cfg: codes.frc_layout(
+        cfg.n_workers, cfg.n_stragglers
+    ),
+    build_schedule=_sched("collect_frc"),
+    dynamic_rule=_dyn_frc,
+    feasibility=lambda layout, dead, *, num_collect=None: (
+        _all_groups_alive(layout, dead), "needs one arrival per group"
+    ),
+    optimal_decode=lstsq_optimal_decode,
+    exact=True,
+    builtin=True,
+))
+
+APPROX = register(SchemeDescriptor(
+    name="approx",
+    summary=(
+        "approximate gradient coding: first num_collect arrivals, group "
+        "erasures (src/approximate_coding.py)"
+    ),
+    build_layout=lambda cfg: codes.frc_layout(
+        cfg.n_workers, cfg.n_stragglers
+    ),
+    build_schedule=_sched_agc,
+    dynamic_rule=_dyn_agc,
+    feasibility=lambda layout, dead, *, num_collect=None: (
+        (_feas_agc(layout, dead, num_collect)),
+        f"needs {num_collect} arrivals or full group coverage",
+    ),
+    optimal_decode=lstsq_optimal_decode,
+    needs_num_collect=True,
+    config_fields=("num_collect",),
+    # the straggler sweep's "interesting regime collects fewer than all"
+    sweep_num_collect=lambda n_workers: n_workers // 2,
+    builtin=True,
+))
+
+
+def _feas_agc(layout, dead, num_collect):
+    if num_collect is None:
+        raise ValueError("AGC needs num_collect")
+    return (_alive_cnt(dead) >= num_collect) | _all_groups_alive(layout, dead)
+
+
+AVOID_STRAGGLERS = register(SchemeDescriptor(
+    name="avoidstragg",
+    summary=(
+        "ignore-stragglers baseline: first W-s uncoded gradients, W/(W-s) "
+        "rescale (src/avoidstragg.py)"
+    ),
+    build_layout=lambda cfg: codes.uncoded_layout(
+        cfg.n_workers, n_stragglers=cfg.n_stragglers
+    ),
+    build_schedule=_sched("collect_avoidstragg"),
+    dynamic_rule=_dyn_avoidstragg,
+    feasibility=lambda layout, dead, *, num_collect=None: (
+        _alive_cnt(dead) >= dead.shape[1] - layout.n_stragglers,
+        f"needs first {layout.n_workers - layout.n_stragglers} arrivals",
+    ),
+    optimal_decode=lstsq_optimal_decode,
+    builtin=True,
+))
+
+RANDOM_REGULAR = register(SchemeDescriptor(
+    name="randreg",
+    summary=(
+        "sparse random d-regular code with lstsq-optimal decoding "
+        "(arXiv:1711.06771 + 2006.09638)"
+    ),
+    build_layout=lambda cfg: codes.random_regular_layout(
+        cfg.n_workers, cfg.n_stragglers, seed=cfg.seed
+    ),
+    build_schedule=_sched_randreg,
+    dynamic_rule=_dyn_randreg,
+    feasibility=lambda layout, dead, *, num_collect=None: (
+        _feas_randreg(dead, num_collect),
+        f"needs first {num_collect} arrivals",
+    ),
+    optimal_decode=lstsq_optimal_decode,
+    needs_num_collect=True,
+    config_fields=("num_collect",),
+    seed_dependent_layout=True,
+    builtin=True,
+))
+
+
+def _feas_randreg(dead, num_collect):
+    if num_collect is None:
+        raise ValueError("randreg needs num_collect")
+    return _alive_cnt(dead) >= num_collect
+
+
+DEADLINE = register(SchemeDescriptor(
+    name="deadline",
+    summary=(
+        "deadline collection: whatever arrived by the cutoff, W/collected "
+        "rescale (beyond the reference)"
+    ),
+    build_layout=lambda cfg: codes.uncoded_layout(cfg.n_workers),
+    build_schedule=_sched_deadline,
+    dynamic_rule=_dyn_deadline,
+    feasibility=lambda layout, dead, *, num_collect=None: (
+        np.ones(dead.shape[0], dtype=bool),
+        "deadline collection always completes",
+    ),
+    optimal_decode=lstsq_optimal_decode,
+    needs_deadline=True,
+    config_fields=("deadline",),
+    validate_config=_validate_deadline,
+    builtin=True,
+))
+
+PARTIAL_CYCLIC = register(SchemeDescriptor(
+    name="partialcyccoded",
+    summary=(
+        "two-part partial MDS: unique uncoded slots + cyclic coded band "
+        "(src/partial_coded.py)"
+    ),
+    build_layout=lambda cfg: codes.partial_cyclic_layout(
+        cfg.n_workers, cfg.partitions_per_worker, cfg.n_stragglers,
+        seed=cfg.seed,
+    ),
+    build_schedule=_sched_partial("mds"),
+    dynamic_rule=_dyn_partial_cyclic,
+    feasibility=lambda layout, dead, *, num_collect=None: (
+        _alive_cnt(dead) == dead.shape[1],
+        "needs every worker's uncoded first-part",
+    ),
+    optimal_decode=None,  # separate slots sit outside the message weights
+    exact=True,
+    partial=True,
+    seed_dependent_layout=True,
+    supports_measured=False,  # two-part send has no single-message timing
+    config_fields=("partitions_per_worker",),
+    validate_config=_validate_partial,
+    builtin=True,
+))
+
+PARTIAL_FRC = register(SchemeDescriptor(
+    name="partialrepcoded",
+    summary=(
+        "two-part partial FRC: unique uncoded slots + replicated coded "
+        "band (src/partial_replication.py)"
+    ),
+    build_layout=lambda cfg: codes.partial_frc_layout(
+        cfg.n_workers, cfg.partitions_per_worker, cfg.n_stragglers
+    ),
+    build_schedule=_sched_partial("frc"),
+    dynamic_rule=_dyn_partial_frc,
+    feasibility=lambda layout, dead, *, num_collect=None: (
+        _alive_cnt(dead) == dead.shape[1],
+        "needs every worker's uncoded first-part",
+    ),
+    optimal_decode=None,
+    exact=True,
+    partial=True,
+    supports_measured=False,
+    config_fields=("partitions_per_worker",),
+    validate_config=_validate_partial,
+    builtin=True,
+))

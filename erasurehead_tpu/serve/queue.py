@@ -138,6 +138,7 @@ CONFIG_PAYLOAD_FIELDS = frozenset(
         "compute_mode", "stack_mode", "ring_pipeline", "stack_dtype",
         "donate", "seed", "dtype", "use_pallas", "sparse_lanes",
         "dense_margin_cols", "flat_grad", "margin_flat", "deadline",
+        "decode",
         "scan_unroll", "sparse_format", "fields_scatter", "fields_margin",
     }
 )

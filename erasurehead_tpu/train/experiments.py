@@ -596,13 +596,22 @@ def straggler_sweep(
             "straggler_sweep needs at least one (scheme, straggler-count) "
             f"entry; got {scheme_stragglers!r}"
         )
+    from erasurehead_tpu import schemes as schemes_lib
+
     configs = {}
     for scheme, s_values in scheme_stragglers.items():
         for s in s_values:
             cfg = dataclasses.replace(base, scheme=scheme, n_stragglers=s)
-            if scheme == "approx" and cfg.num_collect >= cfg.n_workers:
-                # AGC's interesting regime collects fewer than all
-                cfg = dataclasses.replace(cfg, num_collect=cfg.n_workers // 2)
+            collect_override = schemes_lib.get(cfg.scheme).sweep_num_collect
+            if (
+                collect_override is not None
+                and cfg.num_collect >= cfg.n_workers
+            ):
+                # e.g. AGC: its interesting regime collects fewer than all
+                # (the descriptor's sweep_num_collect hook says how many)
+                cfg = dataclasses.replace(
+                    cfg, num_collect=collect_override(cfg.n_workers)
+                )
             configs[f"{scheme}_s{s}"] = cfg
     return compare(configs, dataset, **compare_kw)
 

@@ -60,27 +60,11 @@ from erasurehead_tpu.utils.config import (
 
 
 def build_layout(cfg: RunConfig) -> codes.CodingLayout:
-    """Scheme -> layout dispatch (the reference's is main.py:62-92)."""
-    W, s = cfg.n_workers, cfg.n_stragglers
-    if cfg.scheme == Scheme.NAIVE:
-        return codes.uncoded_layout(W)  # waits for everyone: s plays no role
-    if cfg.scheme == Scheme.DEADLINE:
-        return codes.uncoded_layout(W)  # uncoded; the deadline does the work
-    if cfg.scheme == Scheme.AVOID_STRAGGLERS:
-        return codes.uncoded_layout(W, n_stragglers=s)
-    if cfg.scheme == Scheme.CYCLIC_MDS:
-        return codes.cyclic_mds_layout(W, s, seed=cfg.seed)
-    if cfg.scheme in (Scheme.FRC, Scheme.APPROX):
-        return codes.frc_layout(W, s)
-    if cfg.scheme == Scheme.RANDOM_REGULAR:
-        return codes.random_regular_layout(W, s, seed=cfg.seed)
-    if cfg.scheme == Scheme.PARTIAL_CYCLIC:
-        return codes.partial_cyclic_layout(
-            W, cfg.partitions_per_worker, s, seed=cfg.seed
-        )
-    if cfg.scheme == Scheme.PARTIAL_FRC:
-        return codes.partial_frc_layout(W, cfg.partitions_per_worker, s)
-    raise ValueError(f"unknown scheme {cfg.scheme}")
+    """Scheme -> layout via the registry descriptor (erasurehead_tpu/
+    schemes/; the reference's dispatch was main.py:62-92)."""
+    from erasurehead_tpu import schemes
+
+    return schemes.get(cfg.scheme).build_layout(cfg)
 
 
 def build_model(cfg: RunConfig):
@@ -352,10 +336,18 @@ def _setup_run(
 def default_arrivals(cfg: RunConfig) -> np.ndarray:
     """The run's default straggler arrival schedule — single home shared by
     train(), the CLI's fault-injection path, and the determinism audit, so
-    the arrival construction cannot drift between them."""
+    the arrival construction cannot drift between them.
+
+    ``ERASUREHEAD_REGIME`` (utils/chaos.py) arms a deterministic mid-run
+    straggler-regime shift (exp→heavy-tail, or one worker turning
+    adversarially slow) on top of the drawn delays; unset, the schedule is
+    byte-for-byte the stationary reference stream it always was."""
+    from erasurehead_tpu.utils import chaos as chaos_lib
+
     return straggler.arrival_schedule(
         cfg.rounds, cfg.n_workers, cfg.add_delay, cfg.delay_mean,
         arrival_model=straggler.model_from_config(cfg),
+        regime=chaos_lib.active_regime(),
     )
 
 
@@ -655,7 +647,7 @@ def train(
         # rewrite) overrides the scheme's plain collection rule
         schedule = collect.build_schedule(
             cfg.scheme, arrivals, layout, num_collect=cfg.num_collect,
-            deadline=cfg.deadline,
+            deadline=cfg.deadline, decode=cfg.decode,
         )
     # per-round decode-error norm (obs/decode.py): host float64 from the
     # weights the run decodes with — computed unconditionally (cheap, and
@@ -1038,8 +1030,18 @@ def cohort_eligible(cfg: RunConfig) -> bool:
     """Can this config run inside a trajectory-batched cohort dispatch?
     The cohort engine batches the scan trainer only: measured-arrival mode
     dispatches per worker, and the forced pallas kernel has no batched
-    body (it is a correctness/reference path, not a performance option)."""
-    return cfg.arrival_mode == "simulated" and cfg.use_pallas != "on"
+    body (it is a correctness/reference path, not a performance option).
+    The scheme's registry descriptor can also opt out
+    (``cohort_batchable=False``) — what the sweep planner
+    (experiments.plan_cohorts) and the serve packer (serve/packer.py)
+    both key third-party compatibility on."""
+    from erasurehead_tpu import schemes
+
+    return (
+        cfg.arrival_mode == "simulated"
+        and cfg.use_pallas != "on"
+        and schemes.get(cfg.scheme).cohort_batchable
+    )
 
 
 def estimate_stack_bytes(cfg: RunConfig, dataset: Dataset) -> int:
@@ -1245,7 +1247,8 @@ def _train_cohort_impl(cfg, dataset, cfgs, mesh, arrivals, measure):
         arr_list = [np.asarray(arrivals)] * B
     schedules = [
         collect.build_schedule(
-            c.scheme, a, lay, num_collect=c.num_collect, deadline=c.deadline
+            c.scheme, a, lay, num_collect=c.num_collect,
+            deadline=c.deadline, decode=c.decode,
         )
         for c, a, lay in zip(cfgs, arr_list, layouts)
     ]
@@ -1679,13 +1682,16 @@ def train_measured(
             "lax.scan to unroll); scan_unroll has no measured-mode "
             "implementation — leave it at 1"
         )
-    if cfg.scheme in (Scheme.PARTIAL_CYCLIC, Scheme.PARTIAL_FRC):
-        # the reference's partial worker really sends its uncoded first
-        # part BEFORE computing the coded second (src/partial_coded.py:
-        # 226-234); this mode times ONE combined message per worker, so it
-        # cannot observe the staggered two-part arrival it exists to
-        # measure — refuse rather than silently measure a different
-        # protocol (the refuse-unimplemented-knobs policy above)
+    from erasurehead_tpu import schemes as schemes_lib
+
+    if not schemes_lib.get(cfg.scheme).supports_measured:
+        # e.g. the partial schemes: the reference's partial worker really
+        # sends its uncoded first part BEFORE computing the coded second
+        # (src/partial_coded.py:226-234); this mode times ONE combined
+        # message per worker, so it cannot observe the staggered two-part
+        # arrival it exists to measure — refuse rather than silently
+        # measure a different protocol (the descriptor's supports_measured
+        # capability flag carries the same contract for extension schemes)
         raise ValueError(
             "arrival_mode='measured' has no two-part message timing: the "
             "partial schemes send their uncoded part before the coded part "
@@ -1857,7 +1863,7 @@ def train_measured(
         arrivals = (t_row + delays[r])[None, :]
         sched = collect.build_schedule(
             cfg.scheme, arrivals, layout, num_collect=cfg.num_collect,
-            deadline=cfg.deadline,
+            deadline=cfg.deadline, decode=cfg.decode,
         )
         slot_w = np.asarray(
             step_lib.expand_slot_weights(
@@ -2056,7 +2062,7 @@ def _train_measured_cluster(cfg, dataset, setup, mult, dtype, mesh=None):
         arrivals = (t_row + delays[r])[None, :]
         sched = collect.build_schedule(
             cfg.scheme, arrivals, layout, num_collect=cfg.num_collect,
-            deadline=cfg.deadline,
+            deadline=cfg.deadline, decode=cfg.decode,
         )
         slot_w = np.asarray(
             step_lib.expand_slot_weights(
@@ -2207,6 +2213,13 @@ def train_dynamic(
         raise ValueError(
             f"initial_round={initial_round} requires initial_state: a "
             "mid-schedule restart resumes from donor state"
+        )
+    if cfg.decode == "optimal":
+        raise ValueError(
+            "decode='optimal' refits collection weights on the host "
+            "control plane (a per-round float64 lstsq); train_dynamic's "
+            "weights are traced values inside the scan — use "
+            "trainer.train() for optimal decoding"
         )
     setup = _setup_run(cfg, dataset, mesh, faithful=True)
     layout, model, mesh, data = setup.layout, setup.model, setup.mesh, setup.data

@@ -50,8 +50,10 @@ CHAOS_ENV = "ERASUREHEAD_CHAOS"
 #: injected preemption from a genuine crash
 KILL_EXIT = 43
 
-#: instrumented call sites
-SITES = ("trajectory", "cohort", "checkpoint")
+#: instrumented call sites ("adapt" fires at the adaptive controller's
+#: chunk boundaries — adapt/driver.py — so kill→resume decision-replay
+#: invariance is testable mid-adaptation)
+SITES = ("trajectory", "cohort", "checkpoint", "adapt")
 
 
 class ChaosInjection(RuntimeError):
@@ -134,3 +136,57 @@ def maybe_fire(site: str) -> None:
         f"(invocation {n}, spec {spec.mode}:{spec.site}:"
         f"{spec.count}{'+' if spec.sticky else ''})"
     )
+
+
+# ---------------------------------------------------------------------------
+# straggler-regime injection (ISSUE 8 satellite): a deterministic mid-run
+# regime change, armed by env var like the fault spec above. Not a fault —
+# nothing crashes — but the same philosophy: the adaptive controller
+# (adapt/) exists to survive regime shifts that are awkward to produce on
+# demand, and this makes them reproducible for tests and bench.
+
+#: env var arming a straggler-regime shift
+#: (``kind:round[:param[:param2]]``): ``heavytail:50[:alpha]`` switches
+#: the delay stream from exponential to Pareto(alpha)-tailed at round 50;
+#: ``adversary:50[:worker[:slowdown]]`` turns one worker adversarially
+#: slow from round 50 (arXiv:1901.08166's fixed-straggler worst case).
+#: Consumed by trainer.default_arrivals — unset, arrival schedules are
+#: byte-for-byte what they always were.
+REGIME_ENV = "ERASUREHEAD_REGIME"
+
+
+def parse_regime(spec: str):
+    """Parse :data:`REGIME_ENV`; loud on malformed specs (a typo'd regime
+    run silently staying stationary would invalidate the experiment)."""
+    from erasurehead_tpu.parallel.straggler import RegimeShift
+
+    parts = spec.split(":")
+    if len(parts) < 2:
+        raise ValueError(
+            f"{REGIME_ENV}={spec!r}: want kind:round[:param[:param2]]"
+        )
+    kind = parts[0]
+    try:
+        rnd = int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"{REGIME_ENV}={spec!r}: round must be an int"
+        ) from None
+    if kind == "heavytail":
+        alpha = float(parts[2]) if len(parts) > 2 else 1.2
+        return RegimeShift(kind=kind, round=rnd, alpha=alpha)
+    if kind == "adversary":
+        worker = int(parts[2]) if len(parts) > 2 else 0
+        slowdown = float(parts[3]) if len(parts) > 3 else 5.0
+        return RegimeShift(
+            kind=kind, round=rnd, worker=worker, slowdown=slowdown
+        )
+    raise ValueError(
+        f"{REGIME_ENV}={spec!r}: kind must be heavytail|adversary"
+    )
+
+
+def active_regime():
+    """The armed RegimeShift, or None when the env var is unset."""
+    spec = os.environ.get(REGIME_ENV)
+    return parse_regime(spec) if spec else None

@@ -18,7 +18,16 @@ import numpy as np
 
 
 class Scheme(str, enum.Enum):
-    """The seven collection/coding strategies of the reference (SURVEY.md §2.1)."""
+    """The seven collection/coding strategies of the reference (SURVEY.md
+    §2.1) plus the two beyond-reference builtins.
+
+    The enum is the BUILTIN subset of the scheme registry
+    (erasurehead_tpu/schemes/): behavior — layout builder, collection
+    rule, capability flags — lives in each scheme's SchemeDescriptor, and
+    third-party schemes registered via the ``erasurehead_tpu.schemes``
+    entry-point group are equally valid ``RunConfig.scheme`` values (they
+    resolve to :class:`ExtensionScheme` tags instead of enum members).
+    """
 
     NAIVE = "naive"  # wait for all workers               (src/naive.py)
     CYCLIC_MDS = "cyccoded"  # exact coding, cyclic MDS code      (src/coded.py)
@@ -36,6 +45,46 @@ class Scheme(str, enum.Enum):
     # unbiasedness; inherently failure-tolerant (a dead worker just never
     # arrives) and the practical form async-SGD systems deploy
     DEADLINE = "deadline"
+
+
+class ExtensionScheme(str):
+    """A registry-registered scheme name outside the builtin enum.
+
+    Quacks like a :class:`Scheme` member everywhere the framework reads
+    one — ``.value`` returns the name, string equality/hashing follow the
+    name — so third-party schemes flow through configs, cache keys, event
+    payloads and journal hashes without special-casing. Constructed only
+    by :func:`as_scheme` after a registry membership check."""
+
+    __slots__ = ()
+
+    @property
+    def value(self) -> str:
+        return str(self)
+
+    def __repr__(self) -> str:  # mirrors the enum's debugging shape
+        return f"<ExtensionScheme {str(self)!r}>"
+
+
+def as_scheme(name) -> "Scheme | ExtensionScheme":
+    """Resolve a scheme value: builtin names map to :class:`Scheme`
+    members, registry-registered third-party names to
+    :class:`ExtensionScheme` tags; anything else raises a ValueError
+    naming the registered schemes (builtins AND entry-point extensions —
+    the registry is the single source of the valid set)."""
+    if isinstance(name, (Scheme, ExtensionScheme)):
+        return name
+    try:
+        return Scheme(name)
+    except ValueError:
+        pass
+    from erasurehead_tpu import schemes
+
+    if schemes.is_registered(str(name)):
+        return ExtensionScheme(name)
+    raise ValueError(
+        f"unknown scheme {name!r}; registered schemes: {schemes.names()}"
+    )
 
 
 class UpdateRule(str, enum.Enum):
@@ -223,6 +272,20 @@ class RunConfig:
     margin_flat: str = "auto"
     # per-round collection deadline in simulated seconds (scheme="deadline")
     deadline: Optional[float] = None
+    # decode-weight policy (schemes registry / arXiv:2006.09638):
+    #   "fixed"   — the scheme's own collection weights (the reference's
+    #               behavior; bitwise-unchanged default);
+    #   "optimal" — per-round least-squares weights refit to the ACTUAL
+    #               arrival set over the layout's effective coding matrix
+    #               (a tiny host-side [k, P] solve, batchable across a
+    #               cohort). On exact schemes the refit reproduces zero
+    #               decode error; on approximate schemes it is the
+    #               minimum-weight-space-error decode (obs/decode.py
+    #               proves the per-round improvement). Host control plane
+    #               only: train_dynamic refuses it (weights live on
+    #               device there). Schemes without an optimal_decode hook
+    #               (partial two-part layouts) keep their fixed weights.
+    decode: str = "fixed"
     # lax.scan unroll factor for the training scans (train/train_dynamic):
     # >1 lets XLA fuse and overlap consecutive rounds, amortizing the
     # per-iteration scan overhead the in-scan bandwidth probes showed
@@ -283,7 +346,7 @@ class RunConfig:
         return cls(**base)
 
     def __post_init__(self):
-        self.scheme = Scheme(self.scheme)
+        self.scheme = as_scheme(self.scheme)
         self.model = ModelKind(self.model)
         self.update_rule = UpdateRule(self.update_rule)
         self.compute_mode = ComputeMode(self.compute_mode)
@@ -482,23 +545,22 @@ class RunConfig:
             # composed fields x lanes lowering must be asked for explicitly
             # (sparse_format="fields") until its race flips this default
             self.sparse_format = "padded"
+        if self.decode not in ("fixed", "optimal"):
+            raise ValueError(
+                f"decode must be fixed/optimal, got {self.decode!r}"
+            )
         if self.num_collect is None:
             self.num_collect = self.n_workers
         if self.dataset not in DATASET_PRESETS:
             raise ValueError(
                 f"unknown dataset {self.dataset!r}; known: {sorted(DATASET_PRESETS)}"
             )
-        if self.scheme in (Scheme.PARTIAL_CYCLIC, Scheme.PARTIAL_FRC):
-            if self.partitions_per_worker < self.n_stragglers + 2:
-                raise ValueError(
-                    "partial schemes need partitions_per_worker >= n_stragglers+2"
-                )
-        if self.scheme == Scheme.DEADLINE:
-            if self.deadline is None or self.deadline <= 0:
-                raise ValueError(
-                    "scheme='deadline' needs a positive deadline "
-                    f"(got {self.deadline!r})"
-                )
+        # scheme-specific invariants (partial partition counts, positive
+        # deadlines, third-party knobs) live on the scheme's registry
+        # descriptor, not in an if/elif spine here
+        from erasurehead_tpu import schemes
+
+        schemes.get(self.scheme).validate(self)
 
     def static_signature_fields(self) -> dict:
         """LABELED form of :meth:`static_signature`: field name -> value.

@@ -1,0 +1,351 @@
+"""Adaptive-controller tests (ISSUE 8): regime-shift injection, bandit
+decision determinism and kill→resume replay invariance under
+ERASUREHEAD_CHAOS, adapt-event journaling + validation, and arm
+compatibility (no-re-upload) enforcement."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from erasurehead_tpu import adapt
+from erasurehead_tpu.adapt.controller import (
+    AdaptiveController,
+    Arm,
+    ChunkStats,
+    ControllerConfig,
+)
+from erasurehead_tpu.data.synthetic import generate_gmm
+from erasurehead_tpu.parallel import straggler
+from erasurehead_tpu.utils import chaos as chaos_lib
+from erasurehead_tpu.utils.config import RunConfig
+
+W, R = 6, 40
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    monkeypatch.delenv(chaos_lib.CHAOS_ENV, raising=False)
+    monkeypatch.delenv(chaos_lib.REGIME_ENV, raising=False)
+    chaos_lib.reset()
+    yield
+    chaos_lib.reset()
+
+
+def _cfg(**kw):
+    base = dict(
+        scheme="naive", n_workers=W, n_stragglers=1, rounds=R,
+        n_rows=96, n_cols=8, lr_schedule=1.0, add_delay=True,
+        compute_mode="deduped", update_rule="GD", seed=0,
+    )
+    base.update(kw)
+    return RunConfig(**base)
+
+
+def _arms():
+    return [
+        Arm("naive"),
+        Arm("avoidstragg"),
+        Arm("deadline", deadline=1.5),
+    ]
+
+
+def _shifted_arrivals(rounds=R, shift_round=R // 2, slowdown=8.0):
+    shift = straggler.RegimeShift(
+        kind="adversary", round=shift_round, worker=0, slowdown=slowdown
+    )
+    return straggler.arrival_schedule(
+        rounds, W, add_delay=True, regime=shift
+    )
+
+
+# ---------------------------------------------------------------------------
+# regime-shift injection (parallel/straggler.py + utils/chaos.py)
+# ---------------------------------------------------------------------------
+
+
+def test_regime_shift_deterministic_and_localized():
+    base = straggler.reference_delay_schedule(R, W)
+    shift = straggler.RegimeShift(kind="heavytail", round=20, alpha=1.2)
+    a = straggler.apply_regime_shift(base, shift)
+    b = straggler.apply_regime_shift(base, shift)
+    assert np.array_equal(a, b)  # seeded per round, fully deterministic
+    assert np.array_equal(a[:20], base[:20])  # pre-shift untouched
+    assert not np.array_equal(a[20:], base[20:])
+    # heavy tail: the post-shift max delay dwarfs the exponential stream's
+    assert a[20:].max() > 2 * base.max()
+
+
+def test_adversary_regime_applies_without_delays():
+    shift = straggler.RegimeShift(
+        kind="adversary", round=5, worker=2, slowdown=4.0
+    )
+    arr = straggler.arrival_schedule(10, W, add_delay=False, regime=shift)
+    assert (arr[:5] == 0).all()
+    assert (arr[5:, 2] == 4.0).all()
+    assert (np.delete(arr[5:], 2, axis=1) == 0).all()
+
+
+def test_regime_spec_parsing():
+    s = chaos_lib.parse_regime("heavytail:30:1.5")
+    assert (s.kind, s.round, s.alpha) == ("heavytail", 30, 1.5)
+    s = chaos_lib.parse_regime("adversary:10:3:2.5")
+    assert (s.kind, s.round, s.worker, s.slowdown) == ("adversary", 10, 3, 2.5)
+    for bad in ("heavytail", "nope:3", "adversary:x"):
+        with pytest.raises(ValueError):
+            chaos_lib.parse_regime(bad)
+    with pytest.raises(ValueError):
+        straggler.RegimeShift(kind="nope", round=1)
+
+
+def test_regime_env_threads_into_default_arrivals(monkeypatch):
+    from erasurehead_tpu.train import trainer
+
+    cfg = _cfg(rounds=10)
+    plain = trainer.default_arrivals(cfg)
+    monkeypatch.setenv(chaos_lib.REGIME_ENV, "adversary:4:1:9")
+    shifted = trainer.default_arrivals(cfg)
+    assert np.array_equal(shifted[:4], plain[:4])
+    assert np.allclose(shifted[4:, 1], plain[4:, 1] + 9.0)
+    monkeypatch.delenv(chaos_lib.REGIME_ENV)
+    # unset -> byte-for-byte the stationary reference stream
+    assert np.array_equal(trainer.default_arrivals(cfg), plain)
+
+
+# ---------------------------------------------------------------------------
+# controller unit behavior
+# ---------------------------------------------------------------------------
+
+
+def _stats(sim_per_round, err=0.0, mean=0.5):
+    return ChunkStats(
+        n_rounds=5, sim_time=5 * sim_per_round, decode_error_mean=err,
+        arrival_mean=mean, arrival_p90=mean * 2,
+    )
+
+
+def test_controller_warmup_then_exploit():
+    ctl = AdaptiveController(_arms(), ControllerConfig(epsilon=0.0, seed=0))
+    rewards = {0: 2.0, 1: 0.5, 2: 1.0}  # sim/round: arm 1 is fastest
+    for _ in range(6):
+        idx, _reason = ctl.choose()
+        ctl.observe(idx, _stats(rewards[idx]))
+    reasons = [d["reason"] for d in ctl.decisions]
+    assert reasons[:3] == ["warmup", "warmup", "warmup"]
+    assert all(r == "exploit" for r in reasons[3:])
+    assert all(d["arm"] == "avoidstragg" for d in ctl.decisions[3:])
+
+
+def test_controller_decisions_deterministic():
+    def run():
+        ctl = AdaptiveController(
+            _arms(), ControllerConfig(epsilon=0.3, seed=7)
+        )
+        for i in range(12):
+            idx, _ = ctl.choose()
+            ctl.observe(idx, _stats(1.0 + idx, err=0.01 * idx))
+        return ctl.decisions
+
+    assert run() == run()
+
+
+def test_controller_regime_shift_resets_values():
+    ctl = AdaptiveController(_arms(), ControllerConfig(epsilon=0.0, seed=0))
+    for _ in range(4):
+        idx, _ = ctl.choose()
+        ctl.observe(idx, _stats(1.0, mean=0.5))
+    idx, _ = ctl.choose()
+    shift = ctl.observe(idx, _stats(9.0, mean=5.0))  # 10x arrival jump
+    assert shift == "regime_shift"
+    snap = ctl.snapshot()
+    # all arms but the observed one restart from scratch
+    assert sum(1 for w in snap["weights"] if w > 0) == 1
+    # the next choices re-explore (warm-up pass tagged regime_shift)
+    idx2, reason2 = ctl.choose()
+    assert reasons_ok(reason2)
+
+
+def reasons_ok(reason):
+    from erasurehead_tpu.obs.events import ADAPT_REASONS
+
+    return reason in ADAPT_REASONS
+
+
+def test_controller_rejects_bad_config():
+    with pytest.raises(ValueError):
+        ControllerConfig(chunk_rounds=0)
+    with pytest.raises(ValueError):
+        ControllerConfig(discount=1.5)
+    with pytest.raises(ValueError):
+        ControllerConfig(shift_factor=1.0)
+    with pytest.raises(ValueError):
+        AdaptiveController([], ControllerConfig())
+    with pytest.raises(ValueError, match="duplicate"):
+        AdaptiveController([Arm("naive"), Arm("naive")], ControllerConfig())
+
+
+# ---------------------------------------------------------------------------
+# the driver: switching, events, replay invariance
+# ---------------------------------------------------------------------------
+
+
+def test_train_adaptive_switches_on_regime_shift(tmp_path):
+    from erasurehead_tpu.obs import events as obs_events
+
+    rounds = 60  # enough chunks for post-shift exploitation to settle
+    ds = generate_gmm(96, 8, W, seed=0)
+    arr = _shifted_arrivals(rounds=rounds, shift_round=30)
+    path = str(tmp_path / "events.jsonl")
+    with obs_events.capture(path):
+        res = adapt.train_adaptive(
+            _cfg(rounds=rounds), ds, arms=_arms(),
+            controller=ControllerConfig(chunk_rounds=5, seed=0),
+            arrivals=arr,
+        )
+    reasons = [d["reason"] for d in res.decisions]
+    assert "regime_shift" in reasons
+    # pre-shift the bandit exploits wait-for-all (cheap + exact); after
+    # the shift's re-exploration, exploit decisions abandon it (the
+    # adversary makes every naive round pay the slowdown)
+    shift_at = reasons.index("regime_shift")
+    pre_exploits = [
+        d["arm"] for d in res.decisions[:shift_at] if d["reason"] == "exploit"
+    ]
+    post_exploits = [
+        d["arm"] for d in res.decisions[shift_at:] if d["reason"] == "exploit"
+    ]
+    assert pre_exploits and set(pre_exploits) == {"naive"}
+    # the first post-shift exploitation abandons wait-for-all (later
+    # decisions may wander once every arm's progress floors at
+    # convergence — the reward signal is legitimately flat there)
+    assert post_exploits and post_exploits[0] != "naive"
+    # merged result covers the full horizon with stitched telemetry
+    assert res.result.timeset.shape == (rounds,)
+    assert res.result.decode_error.shape == (rounds,)
+    assert res.result.sim_total_time > 0
+    leaves = __import__("jax").tree.leaves(res.result.params_history)
+    assert int(leaves[0].shape[0]) == rounds
+    # every decision journaled as a typed, schema-valid adapt event
+    with open(path) as f:
+        lines = f.readlines()
+    errors = obs_events.validate_lines(lines)
+    assert errors == []
+    adapt_recs = [
+        json.loads(l) for l in lines if json.loads(l)["type"] == "adapt"
+    ]
+    assert len(adapt_recs) == len(res.decisions)
+    assert [a["arm"] for a in adapt_recs] == [
+        d["arm"] for d in res.decisions
+    ]
+    assert any(a["regime_shift"] for a in adapt_recs)
+
+
+def test_train_adaptive_decision_replay_bitwise():
+    """Rerunning the same (seed, arrivals) replays decisions AND the
+    trained parameters bitwise — the determinism that makes kill→resume
+    replay-invariant."""
+    import jax
+
+    ds = generate_gmm(96, 8, W, seed=0)
+    arr = _shifted_arrivals()
+
+    def go():
+        return adapt.train_adaptive(
+            _cfg(), ds, arms=_arms(),
+            controller=ControllerConfig(chunk_rounds=5, seed=0),
+            arrivals=arr,
+        )
+
+    a, b = go(), go()
+    assert a.decisions == b.decisions
+    for la, lb in zip(
+        jax.tree.leaves(a.result.final_params),
+        jax.tree.leaves(b.result.final_params),
+    ):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_train_adaptive_chaos_kill_resume_replays_decisions(
+    tmp_path, monkeypatch
+):
+    """ERASUREHEAD_CHAOS=raise:adapt:3 interrupts the run at the third
+    chunk boundary; the journaled decision prefix is bitwise the
+    uninterrupted baseline's, and the rerun (resume-from-scratch — the
+    decisions are a pure function of seed + telemetry) reproduces the
+    full sequence."""
+    from erasurehead_tpu.obs import events as obs_events
+
+    ds = generate_gmm(96, 8, W, seed=0)
+    arr = _shifted_arrivals()
+    kw = dict(
+        arms=_arms(), controller=ControllerConfig(chunk_rounds=5, seed=0),
+        arrivals=arr,
+    )
+    baseline = adapt.train_adaptive(_cfg(), ds, **kw)
+
+    killed_path = str(tmp_path / "killed.jsonl")
+    monkeypatch.setenv(chaos_lib.CHAOS_ENV, "raise:adapt:3:PREEMPTED")
+    chaos_lib.reset()
+    with pytest.raises(chaos_lib.ChaosInjection):
+        with obs_events.capture(killed_path):
+            adapt.train_adaptive(_cfg(), ds, **kw)
+    monkeypatch.delenv(chaos_lib.CHAOS_ENV)
+    chaos_lib.reset()
+    with open(killed_path) as f:
+        killed = [
+            json.loads(l) for l in f if json.loads(l)["type"] == "adapt"
+        ]
+    assert len(killed) == 2  # chunks 0 and 1 committed before the fault
+    for rec, d in zip(killed, baseline.decisions):
+        assert rec["arm"] == d["arm"]
+        assert rec["reason"] == d["reason"]
+        assert rec["round"] == d["chunk"] * 5
+
+    rerun = adapt.train_adaptive(_cfg(), ds, **kw)
+    assert rerun.decisions == baseline.decisions
+
+
+def test_train_adaptive_validates_arms():
+    ds = generate_gmm(96, 8, W, seed=0)
+    with pytest.raises(ValueError, match="partial"):
+        adapt.train_adaptive(
+            _cfg(rounds=4), ds,
+            arms=[Arm("naive"), Arm("partialrepcoded")],
+        )
+    # faithful mode: cyccoded's worker-major stack differs from naive's
+    with pytest.raises(ValueError, match="different device data stack"):
+        adapt.train_adaptive(
+            _cfg(rounds=4, compute_mode="faithful"), ds,
+            arms=[Arm("naive"), Arm("cyccoded")],
+        )
+    with pytest.raises(ValueError, match="measured"):
+        adapt.train_adaptive(
+            _cfg(rounds=4, arrival_mode="measured"), ds, arms=[Arm("naive")]
+        )
+
+
+def test_default_arms_cover_base_policy():
+    cfg = _cfg(scheme="approx", num_collect=4)
+    arms = adapt.default_arms(cfg)
+    labels = [a.label for a in arms]
+    assert labels[0] == "approx:c4"
+    assert "naive" in labels and "avoidstragg" in labels
+
+
+def test_adaptive_beats_static_naive_under_regime_shift():
+    """The headline property at test scale: under an adversarial mid-run
+    slowdown, the adaptive run's total simulated time beats the static
+    wait-for-all baseline (which pays the slow worker every post-shift
+    round)."""
+    from erasurehead_tpu.train import trainer
+
+    ds = generate_gmm(96, 8, W, seed=0)
+    arr = _shifted_arrivals()
+    ares = adapt.train_adaptive(
+        _cfg(), ds, arms=_arms(),
+        controller=ControllerConfig(chunk_rounds=5, seed=0),
+        arrivals=arr,
+    )
+    static = trainer.train(_cfg(), ds, arrivals=arr, measure=False)
+    assert ares.result.sim_total_time < static.sim_total_time

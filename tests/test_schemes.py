@@ -1,0 +1,400 @@
+"""Scheme-registry tests (ISSUE 8): round-trip equivalence of every
+builtin descriptor against the rule/factory functions the old if/elif
+spines called, the grep-enforced no-dispatch-outside-schemes/ contract,
+entry-point discovery of third-party schemes, and the registry-level
+optimal decoder (decode=fixed|optimal)."""
+
+import dataclasses
+import os
+import re
+
+import numpy as np
+import pytest
+
+from erasurehead_tpu import schemes
+from erasurehead_tpu.ops import codes
+from erasurehead_tpu.parallel import collect, failures, straggler
+from erasurehead_tpu.utils.config import ExtensionScheme, RunConfig, Scheme
+
+R, W, S = 12, 6, 1  # rounds, workers, stragglers ((S+1) | W for FRC)
+
+
+@pytest.fixture(scope="module")
+def arrivals():
+    return straggler.arrival_schedule(R, W, add_delay=True)
+
+
+def _cfg(scheme, **kw):
+    base = dict(
+        scheme=scheme, n_workers=W, n_stragglers=S, rounds=R,
+        n_rows=96, n_cols=8, lr_schedule=1.0, seed=0,
+    )
+    base.update(kw)
+    return RunConfig(**base)
+
+
+#: every builtin: (scheme name, config overrides, direct layout factory,
+#: direct collection rule) — the exact calls the pre-registry dispatch made
+def _builtin_cases():
+    return [
+        ("naive", {},
+         lambda c: codes.uncoded_layout(W),
+         lambda t, lay, c: collect.collect_all(t)),
+        ("cyccoded", {},
+         lambda c: codes.cyclic_mds_layout(W, S, seed=0),
+         lambda t, lay, c: collect.collect_first_k_mds(t, lay.B, S)),
+        ("repcoded", {},
+         lambda c: codes.frc_layout(W, S),
+         lambda t, lay, c: collect.collect_frc(t, lay.groups)),
+        ("approx", {"num_collect": 4},
+         lambda c: codes.frc_layout(W, S),
+         lambda t, lay, c: collect.collect_agc(t, lay.groups, 4)),
+        ("avoidstragg", {},
+         lambda c: codes.uncoded_layout(W, n_stragglers=S),
+         lambda t, lay, c: collect.collect_avoidstragg(t, S)),
+        ("randreg", {"num_collect": 4},
+         lambda c: codes.random_regular_layout(W, S, seed=0),
+         lambda t, lay, c: collect.collect_first_k_optimal(t, lay.B, 4)),
+        ("deadline", {"deadline": 0.8},
+         lambda c: codes.uncoded_layout(W),
+         lambda t, lay, c: collect.collect_deadline(t, 0.8)),
+        ("partialcyccoded", {"partitions_per_worker": S + 2},
+         lambda c: codes.partial_cyclic_layout(W, S + 2, S, seed=0),
+         lambda t, lay, c: collect.collect_partial(t, lay, "mds")),
+        ("partialrepcoded", {"partitions_per_worker": S + 2},
+         lambda c: codes.partial_frc_layout(W, S + 2, S),
+         lambda t, lay, c: collect.collect_partial(t, lay, "frc")),
+    ]
+
+
+@pytest.mark.parametrize(
+    "scheme,kw,layout_fn,rule_fn",
+    _builtin_cases(),
+    ids=[c[0] for c in _builtin_cases()],
+)
+def test_registry_round_trip_bitwise(scheme, kw, layout_fn, rule_fn, arrivals):
+    """Descriptor path == direct-call path, bitwise: layout arrays and the
+    full collection schedule (the old dispatch's exact outputs)."""
+    from erasurehead_tpu.train import trainer
+
+    cfg = _cfg(scheme, **kw)
+    lay_reg = trainer.build_layout(cfg)
+    lay_dir = layout_fn(cfg)
+    assert np.array_equal(lay_reg.assignment, lay_dir.assignment)
+    assert np.array_equal(lay_reg.coeffs, lay_dir.coeffs)
+    assert np.array_equal(lay_reg.slot_is_coded, lay_dir.slot_is_coded)
+    if lay_dir.B is not None:
+        assert np.array_equal(lay_reg.B, lay_dir.B)
+    if lay_dir.groups is not None:
+        assert np.array_equal(lay_reg.groups, lay_dir.groups)
+
+    sched_reg = collect.build_schedule(
+        cfg.scheme, arrivals, lay_reg, num_collect=cfg.num_collect,
+        deadline=cfg.deadline,
+    )
+    sched_dir = rule_fn(arrivals, lay_dir, cfg)
+    assert np.array_equal(sched_reg.message_weights, sched_dir.message_weights)
+    assert np.array_equal(sched_reg.sim_time, sched_dir.sim_time)
+    assert np.array_equal(sched_reg.worker_times, sched_dir.worker_times)
+    assert np.array_equal(sched_reg.collected, sched_dir.collected)
+
+
+def test_no_scheme_dispatch_outside_schemes_package():
+    """Grep-enforced acceptance criterion: zero `if scheme ==`/`elif
+    scheme` dispatch sites outside erasurehead_tpu/schemes/."""
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(schemes.__file__))
+    )
+    pattern = re.compile(
+        r"^\s*(?:el)?if\b.*\bscheme\b\s*(?:==|!=|\bin\b)"
+    )
+    offenders = []
+    for dirpath, _dirnames, filenames in os.walk(pkg_root):
+        if os.path.sep + "schemes" in dirpath or "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    if pattern.search(line):
+                        offenders.append(f"{path}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "scheme dispatch outside schemes/ (use the registry):\n"
+        + "\n".join(offenders)
+    )
+
+
+def test_every_builtin_registered_and_flagged():
+    names = schemes.names()
+    n_builtin = len(list(Scheme))
+    assert {s.value for s in Scheme} == set(names[:n_builtin])
+    for s in Scheme:
+        desc = schemes.get(s)
+        assert desc.builtin
+        assert desc.name == s.value
+        caps = desc.capabilities()
+        assert isinstance(caps["exact"], bool)
+    # capability spot checks the rest of the framework relies on
+    assert schemes.get("partialcyccoded").supports_measured is False
+    assert schemes.get("partialrepcoded").partial is True
+    assert schemes.get("approx").needs_num_collect is True
+    assert schemes.get("deadline").needs_deadline is True
+    assert schemes.get("cyccoded").exact is True
+    assert schemes.get("cyccoded").seed_dependent_layout is True
+    assert schemes.get("approx").optimal_decode is not None
+    assert schemes.get("partialcyccoded").optimal_decode is None
+
+
+def test_unknown_scheme_error_names_registry():
+    with pytest.raises(ValueError, match="registered schemes"):
+        RunConfig(scheme="definitely-not-a-scheme")
+    with pytest.raises(ValueError, match="registered schemes"):
+        schemes.get("definitely-not-a-scheme")
+
+
+def test_register_refuses_silent_shadowing():
+    with pytest.raises(ValueError, match="already registered"):
+        schemes.register(
+            schemes.SchemeDescriptor(
+                name="naive",
+                build_layout=lambda cfg: codes.uncoded_layout(cfg.n_workers),
+                build_schedule=lambda t, lay, **kw: collect.collect_all(t),
+            )
+        )
+    with pytest.raises(ValueError, match="builtin"):
+        schemes.unregister("naive")
+
+
+# ---------------------------------------------------------------------------
+# third-party schemes: direct registration + entry-point discovery
+# ---------------------------------------------------------------------------
+
+
+def _toy_descriptor(name):
+    """A minimal but complete third-party scheme: uncoded layout, collect
+    everyone (a registered alias of naive, structurally)."""
+    return schemes.SchemeDescriptor(
+        name=name,
+        summary="toy third-party scheme (tests)",
+        build_layout=lambda cfg: codes.uncoded_layout(cfg.n_workers),
+        build_schedule=lambda t, lay, **kw: collect.collect_all(t),
+        feasibility=lambda lay, dead, **kw: (
+            (~dead).all(axis=1), "needs all W workers"
+        ),
+        optimal_decode=collect.optimal_decode_schedule,
+        exact=True,
+    )
+
+
+def test_third_party_scheme_registers_and_trains():
+    name = "toyuniform"
+    schemes.register(_toy_descriptor(name))
+    try:
+        cfg = _cfg(name)
+        assert isinstance(cfg.scheme, ExtensionScheme)
+        assert cfg.scheme.value == name  # quacks like the enum
+        from erasurehead_tpu.data.synthetic import generate_gmm
+        from erasurehead_tpu.train import experiments, trainer
+
+        lay = trainer.build_layout(cfg)
+        assert lay.n_partitions == W
+        ds = generate_gmm(96, 8, W, seed=0)
+        rows = experiments.compare(
+            {"toy": _cfg(name, rounds=3), "naive": _cfg("naive", rounds=3)},
+            ds,
+        )
+        by_label = {s.label: s for s in rows}
+        # structurally identical to naive: identical losses under the
+        # shared arrival schedule
+        assert by_label["toy"].final_train_loss == pytest.approx(
+            by_label["naive"].final_train_loss
+        )
+    finally:
+        schemes.unregister(name)
+    with pytest.raises(ValueError, match="registered schemes"):
+        RunConfig(scheme=name)
+
+
+def test_entry_point_scheme_shows_up_in_cli_choices(monkeypatch):
+    """The satellite contract: a scheme published under the
+    erasurehead_tpu.schemes entry-point group appears in registry names,
+    CLI --scheme choices, and trains through compare()."""
+    import importlib.metadata as md
+
+    name = "toyep"
+
+    class FakeEP:
+        def load(self):
+            return lambda: _toy_descriptor(name)  # factory form
+
+    FakeEP.name = name
+
+    class FakeEPS:
+        def select(self, group=None):
+            return [FakeEP()] if group == schemes.ENTRY_POINT_GROUP else []
+
+    monkeypatch.setattr(md, "entry_points", lambda: FakeEPS())
+    added = schemes.load_entry_points(force=True)
+    try:
+        assert name in added
+        assert name in schemes.names()
+        from erasurehead_tpu import cli
+
+        parser = cli._flags_parser()
+        choices = next(
+            a.choices for a in parser._actions if a.dest == "scheme"
+        )
+        assert name in choices
+        from erasurehead_tpu.data.synthetic import generate_gmm
+        from erasurehead_tpu.train import experiments
+
+        ds = generate_gmm(96, 8, W, seed=0)
+        rows = experiments.compare({name: _cfg(name, rounds=3)}, ds)
+        assert rows[0].status == "ok"
+    finally:
+        schemes.unregister(name)
+
+
+def test_broken_entry_point_is_isolated(monkeypatch):
+    import importlib.metadata as md
+
+    class BadEP:
+        name = "broken"
+
+        def load(self):
+            raise RuntimeError("boom")
+
+    class FakeEPS:
+        def select(self, group=None):
+            return [BadEP()] if group == schemes.ENTRY_POINT_GROUP else []
+
+    monkeypatch.setattr(md, "entry_points", lambda: FakeEPS())
+    assert schemes.load_entry_points(force=True) == []
+    assert "broken" not in schemes.names()
+
+
+# ---------------------------------------------------------------------------
+# decode=optimal (arXiv:2006.09638)
+# ---------------------------------------------------------------------------
+
+
+def _decode_errors(scheme, kw, arrivals, decode):
+    from erasurehead_tpu.obs import decode as obs_decode
+    from erasurehead_tpu.train import trainer
+
+    cfg = _cfg(scheme, **kw)
+    lay = trainer.build_layout(cfg)
+    sched = collect.build_schedule(
+        cfg.scheme, arrivals, lay, num_collect=cfg.num_collect,
+        deadline=cfg.deadline, decode=decode,
+    )
+    return obs_decode.decode_error_series(lay, sched.message_weights)
+
+
+@pytest.mark.parametrize(
+    "scheme,kw",
+    [
+        ("approx", {"num_collect": 4}),
+        ("randreg", {"num_collect": 4}),
+        ("avoidstragg", {}),
+        ("deadline", {"deadline": 0.8}),
+    ],
+)
+def test_optimal_decode_error_leq_fixed_on_approximate(scheme, kw, arrivals):
+    fixed = _decode_errors(scheme, kw, arrivals, "fixed")
+    opt = _decode_errors(scheme, kw, arrivals, "optimal")
+    assert (opt <= fixed + 1e-9).all()
+
+
+def test_optimal_decode_strictly_improves_rescale_schemes(arrivals):
+    """avoidstragg/deadline decode with a uniform W/collected rescale; the
+    lstsq fit is strictly tighter whenever any worker is missing."""
+    for scheme, kw in (("avoidstragg", {}), ("deadline", {"deadline": 0.8})):
+        fixed = _decode_errors(scheme, kw, arrivals, "fixed")
+        opt = _decode_errors(scheme, kw, arrivals, "optimal")
+        straggling = fixed > 0
+        assert straggling.any()  # the schedule genuinely straggles
+        assert (opt[straggling] < fixed[straggling]).all()
+
+
+@pytest.mark.parametrize("scheme,kw", [
+    ("naive", {}),
+    ("cyccoded", {}),
+    ("repcoded", {}),
+])
+def test_optimal_decode_zero_delta_on_exact(scheme, kw, arrivals):
+    fixed = _decode_errors(scheme, kw, arrivals, "fixed")
+    opt = _decode_errors(scheme, kw, arrivals, "optimal")
+    assert (fixed == 0.0).all()
+    assert (opt == 0.0).all()
+
+
+def test_optimal_decode_noop_on_partial(arrivals):
+    """Partial schemes carry no optimal_decode hook: the schedule is
+    byte-for-byte the fixed one."""
+    cfg = _cfg("partialrepcoded", partitions_per_worker=S + 2)
+    from erasurehead_tpu.train import trainer
+
+    lay = trainer.build_layout(cfg)
+    f = collect.build_schedule(cfg.scheme, arrivals, lay)
+    o = collect.build_schedule(cfg.scheme, arrivals, lay, decode="optimal")
+    assert np.array_equal(f.message_weights, o.message_weights)
+
+
+def test_decode_field_validation():
+    with pytest.raises(ValueError, match="decode must be fixed/optimal"):
+        _cfg("naive", decode="bogus")
+    with pytest.raises(ValueError, match="decode must be fixed/optimal"):
+        collect.build_schedule(
+            "naive",
+            np.zeros((2, W)),
+            codes.uncoded_layout(W),
+            decode="bogus",
+        )
+
+
+def test_train_dynamic_refuses_optimal_decode():
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.train import trainer
+
+    ds = generate_gmm(96, 8, W, seed=0)
+    with pytest.raises(ValueError, match="decode='optimal'"):
+        trainer.train_dynamic(_cfg("naive", rounds=2, decode="optimal"), ds)
+
+
+def test_optimal_decode_improves_trained_decode_error_column():
+    """End-to-end: train() with decode=optimal reports a decode_error
+    series <= the fixed run's, round for round, on the same arrivals."""
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.train import trainer
+
+    ds = generate_gmm(96, 8, W, seed=0)
+    arr = straggler.arrival_schedule(4, W, add_delay=True)
+    res_f = trainer.train(
+        _cfg("avoidstragg", rounds=4), ds, arrivals=arr, measure=False
+    )
+    res_o = trainer.train(
+        _cfg("avoidstragg", rounds=4, decode="optimal"), ds, arrivals=arr,
+        measure=False,
+    )
+    assert (res_o.decode_error <= res_f.decode_error + 1e-9).all()
+    assert res_o.decode_error.sum() < res_f.decode_error.sum()
+    # the stop condition is untouched: identical clocks and collected sets
+    assert np.array_equal(res_o.timeset, res_f.timeset)
+    assert np.array_equal(res_o.collected, res_f.collected)
+
+
+def test_cohort_signature_consults_descriptor_batchability():
+    from erasurehead_tpu.train import trainer
+
+    cfg = _cfg("naive", compute_mode="deduped")
+    assert trainer.cohort_signature(cfg) is not None
+    name = "toyunbatchable"
+    desc = dataclasses.replace(_toy_descriptor(name), cohort_batchable=False)
+    schemes.register(desc)
+    try:
+        assert trainer.cohort_signature(_cfg(name)) is None
+    finally:
+        schemes.unregister(name)

@@ -11,7 +11,10 @@ sweep_trajectory journal records (train/journal.py) carry a known status
 records (erasurehead_tpu/serve/) are internally consistent (`request`
 names its tenant/request_id/label, `pack`'s trajectory count matches its
 label list, `admit` carries non-negative byte figures, `evict` names its
-reason), and every run_start has a matching run_end. Sweep journals and
+reason), adaptive-controller `adapt` records (erasurehead_tpu/adapt/)
+carry a non-negative chunk-start round, a non-empty arm label and a
+known reason (warmup/exploit/explore/regime_shift — obs/events.
+ADAPT_REASONS), and every run_start has a matching run_end. Sweep journals and
 serve event logs are events.jsonl files too — point this tool at
 DIR/sweep_journal.jsonl or the daemon's --events log to check them.
 
