@@ -55,6 +55,16 @@ run while retiring B trajectory-steps, so
 and the arithmetic intensity (flops/byte) rises B-fold — the roofline
 lever batching moves and kernel fusion could not (BASELINE.md).
 
+``deep_cohort`` repeats the cohort race off the convex GLMs: a 7-scheme
+x 4-seed DEEP-MODEL cohort (the autodiff margin families — one vmapped-
+forward dispatch with per-trajectory weight tables) against the
+sequential cached path, bar >= 3x aggregate trajectories/sec on CPU. It
+also emits a decode-error-vs-depth series: blockwise-coded deepmlp runs
+(layer_coding="on", ops/blocks.py) measure each layer block's
+gradient-space decode error against the model's own partition gradients
+(obs/decode.block_decode_error) and write layer-tagged decode chunk
+streams into the events capture.
+
 Serve extras (the multi-tenant layer over the same engine): ``serve_pack``
 races SERVE_CLIENTS concurrent clients submitting same-signature 7-scheme
 sweeps to the serve daemon (erasurehead_tpu/serve/ — bin-packed cohort
@@ -770,6 +780,146 @@ def _adapt_extra() -> dict:
     }
 
 
+#: deep_cohort extra: a 7-scheme x 4-seed DEEP-MODEL cohort at W=30
+#: racing the sequential cached path (the PR 4 amortization win, repeated
+#: off the convex GLMs), plus a decode-error-vs-depth series from
+#: blockwise-coded deepmlp runs (obs/decode.block_decode_error). Shapes
+#: are sweep-shaped on purpose: many small trajectories is the workload
+#: the cohort engine exists for, and per-run dispatch overhead is what
+#: the single dispatch amortizes away on CPU (BASELINE.md "Deep-model
+#: cohorts" carries the measured rows).
+DEEP_MODEL = "mlp"  # the autodiff margin family (grads_via_loss path)
+DEEP_ROUNDS = 4
+DEEP_SEEDS = (0, 1, 2, 3)
+DEEP_ROWS, DEEP_COLS = 60, 32
+DEEP_DEPTHS = (2, 4, 8)  # deepmlp hidden-layer counts for the err-vs-depth series
+
+
+def _deep_cohort_extra() -> dict:
+    """Deep-model trajectory-batched sweep vs the sequential cached path
+    (bar >= 3x, same shape as sweep7), plus the decode-error-vs-depth
+    series emitted through obs/decode + the events capture."""
+    import jax
+
+    from erasurehead_tpu.data.sharding import partition_stack
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.obs import decode as obs_decode
+    from erasurehead_tpu.obs import events as obs_events
+    from erasurehead_tpu.ops import blocks as blocks_lib
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    data = generate_gmm(DEEP_ROWS, DEEP_COLS, n_partitions=W, seed=0)
+    common = dict(
+        model=DEEP_MODEL, n_workers=W, n_stragglers=S, rounds=DEEP_ROUNDS,
+        n_rows=DEEP_ROWS, n_cols=DEEP_COLS, update_rule="GD",
+        lr_schedule=0.1, add_delay=True, compute_mode="deduped",
+    )
+    schemes = [
+        ("naive", {}),
+        ("cyccoded", {}),
+        ("repcoded", {}),
+        ("approx", {"num_collect": COLLECT}),
+        ("avoidstragg", {}),
+        ("randreg", {"num_collect": COLLECT}),
+        ("deadline", {"deadline": 1.0}),
+    ]
+    cfgs = [
+        RunConfig(**{**common, **extra, "scheme": s, "seed": sd})
+        for s, extra in schemes
+        for sd in DEEP_SEEDS
+    ]
+    B = len(cfgs)
+    # steady-state race: one warm pass per path (compile + program load),
+    # then min over repeats — walls are milliseconds here, so single-shot
+    # numbers would measure scheduler noise, not the dispatch structure
+    cohort = trainer.train_cohort(cfgs, data)
+    cohort_wall = min(
+        min(r.wall_time for r in trainer.train_cohort(cfgs, data))
+        for _ in range(3)
+    )
+    for c in cfgs:
+        trainer.train(c, data)
+    seq_wall = min(
+        sum(trainer.train(c, data).wall_time for c in cfgs)
+        for _ in range(2)
+    )
+    agg_rate = B * DEEP_ROUNDS / cohort_wall if cohort_wall > 0 else 0.0
+    seq_rate = B * DEEP_ROUNDS / seq_wall if seq_wall > 0 else 0.0
+
+    # ---- decode-error-vs-depth: blockwise-coded deepmlp under real
+    # straggling; per-layer gradient-space error from the model's own
+    # partition grad blocks at the trained params, emitted as
+    # layer-tagged decode chunk streams into the bench events capture
+    Wd = 8
+    depth_data = generate_gmm(128, 32, n_partitions=Wd, seed=1)
+    depth_rows = {}
+    for depth in DEEP_DEPTHS:
+        dcfg = RunConfig(
+            scheme="approx", model="deepmlp", deep_layers=depth,
+            layer_coding="on", n_workers=Wd, n_stragglers=1, num_collect=5,
+            rounds=6, n_rows=128, n_cols=32, update_rule="GD",
+            lr_schedule=0.1, add_delay=True, compute_mode="deduped",
+        )
+        res = trainer.train(dcfg, depth_data)
+        model = trainer.build_model(dcfg)
+        spec = blocks_lib.model_block_spec(
+            model, model.init_params(jax.random.key(0), 32)
+        )
+        Xp, yp = partition_stack(depth_data, res.layout.n_partitions)
+        table = blocks_lib.partition_block_table(
+            model, spec, res.final_params, Xp, yp
+        )
+        from erasurehead_tpu.parallel import collect
+
+        sched = collect.build_schedule(
+            dcfg.scheme, trainer.default_arrivals(dcfg), res.layout,
+            num_collect=dcfg.num_collect, deadline=dcfg.deadline,
+            decode=dcfg.decode,
+        )
+        errs = obs_decode.block_decode_error(
+            res.layout, sched.message_weights, table
+        )
+        run_id = res.run_id or obs_events.new_run_id()
+        obs_events.emit_layer_decode_chunks(
+            run_id, errs["per_block"], trajectory=f"depth{depth}"
+        )
+        depth_rows[str(depth)] = {
+            "n_blocks": int(errs["per_block"].shape[1]),
+            "mean_block_error": round(float(errs["per_block"].mean()), 8),
+            "max_cumulative_error": round(
+                float(errs["cumulative"][:, -1].max()), 8
+            ),
+        }
+    return {
+        "deep_cohort_speedup": (
+            round(seq_wall / cohort_wall, 3) if cohort_wall > 0 else 0.0
+        ),
+        "deep_cohort": {
+            "model": DEEP_MODEL,
+            "n_trajectories": B,
+            "n_schemes": len(schemes),
+            "n_seeds": len(DEEP_SEEDS),
+            "rounds": DEEP_ROUNDS,
+            "rows": DEEP_ROWS,
+            "cols": DEEP_COLS,
+            "dispatches": cohort[0].cache_info.get("cohort_dispatches"),
+            "lowering": cohort[0].cache_info.get("cohort_lowering"),
+            "aggregate_trajectories_per_sec": (
+                round(B / cohort_wall, 2) if cohort_wall > 0 else 0.0
+            ),
+            "aggregate_steps_per_sec": round(agg_rate, 2),
+            "sequential_cached_steps_per_sec": round(seq_rate, 2),
+            "speedup_vs_sequential_cached": (
+                round(seq_wall / cohort_wall, 3) if cohort_wall > 0 else 0.0
+            ),
+            "cohort_wall_s": round(cohort_wall, 5),
+            "sequential_cached_wall_s": round(seq_wall, 5),
+            "decode_error_vs_depth": depth_rows,
+        },
+    }
+
+
 def _fidelity_extra(cfg, data, result) -> dict:
     """Fidelity evidence for a lossy/compressed stack: final train/test
     loss of this run vs an f32-stack reference run of the IDENTICAL
@@ -930,6 +1080,16 @@ def child() -> None:
         except Exception as e:  # noqa: BLE001 — extras must never kill bench
             print(f"bench: sweep7 cohort extra failed: {e}", file=sys.stderr)
 
+        # ---- deep_cohort extra: the models/ shelf as the second headline
+        # workload — a 7-scheme x 4-seed deep-model cohort racing the
+        # sequential cached path (bar >= 3x), plus the blockwise-coded
+        # decode-error-vs-depth series into the events capture
+        deep_extra = {}
+        try:
+            deep_extra = _deep_cohort_extra()
+        except Exception as e:  # noqa: BLE001 — extras must never kill bench
+            print(f"bench: deep_cohort extra failed: {e}", file=sys.stderr)
+
         # ---- serve_pack extra: N concurrent clients vs N sequential
         # sweeps through the serve daemon (multi-tenant cohort packing) —
         # the "heavy traffic" throughput claim, with the bitwise
@@ -1060,6 +1220,7 @@ def child() -> None:
                 **mem_extra,
                 **sweep_extra,
                 **sweep7_extra,
+                **deep_extra,
                 **serve_extra,
                 **adapt_extra,
                 **fidelity_extra,

@@ -256,6 +256,25 @@ def _flags_parser() -> argparse.ArgumentParser:
                         "(parallel/step.make_flat_grad_fn): margin as one "
                         "2-D matmul, decode weights folded into the "
                         "residual")
+    p.add_argument("--layer-coding", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="per-layer (blockwise) gradient coding "
+                        "(parallel/step.make_layer_block_grad_fn): each "
+                        "layer's flattened gradient block decodes as its "
+                        "own small einsum (DeepMLP layers / MoE expert "
+                        "shards are individual coded blocks); bitwise-"
+                        "identical decode, a pure lowering knob")
+    p.add_argument("--deep-layers", type=int, default=0,
+                   help="hidden-layer count for model='deepmlp' (0 = the "
+                        "model default); the decode-error-vs-depth sweep "
+                        "knob")
+    p.add_argument("--arrival-trace", default=None, metavar="PATH",
+                   help="replay a recorded [rounds, workers] arrival-time "
+                        "trace (.npy/.npz/.csv/.txt; tiled over rounds) "
+                        "instead of drawing i.i.d. exponential delays; "
+                        "ERASUREHEAD_ARRIVAL_TRACE when unset. "
+                        "--worker-speed-spread composes as a per-worker "
+                        "multiplier on the trace rows")
     p.add_argument("--seq-shards", type=int, default=1,
                    help="sequence-parallel shards for the attention model: "
                         ">1 builds a 2-D (workers, seq) mesh and spans the "
@@ -358,6 +377,9 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         sparse_lanes=ns.sparse_lanes,
         dense_margin_cols=ns.dense_margin_cols,
         flat_grad=ns.flat_grad,
+        layer_coding=ns.layer_coding,
+        deep_layers=ns.deep_layers,
+        arrival_trace=ns.arrival_trace,
         scan_unroll=ns.scan_unroll,
         sparse_format=ns.sparse_format,
         fields_scatter=ns.fields_scatter,

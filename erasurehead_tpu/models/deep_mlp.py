@@ -38,6 +38,11 @@ PIPE_AXIS = "pipe"
 
 class DeepMLPModel(MarginClassifierBase):
     name = "deepmlp"
+    # per-layer gradient coding (ops/blocks.py): the stacked [L, H, H]
+    # hidden transforms and their biases split along the layer axis, so
+    # each hidden layer's gradient is its own coded block — decode cost
+    # stays one small einsum per block as n_layers grows
+    block_split_leaves = ("W", "b")
 
     def __init__(
         self,

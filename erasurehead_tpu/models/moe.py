@@ -34,6 +34,11 @@ EXPERT_AXIS = "expert"
 
 class MoEModel(MarginClassifierBase):
     name = "moe"
+    # per-layer gradient coding (ops/blocks.py): every expert-stacked
+    # leaf splits along the expert axis, so each expert shard's gradient
+    # is its own coded block — the experts are the natural partitions of
+    # the coded decode (ROADMAP item 4); the tiny gate stays one block
+    block_split_leaves = ("W1", "b1", "w2", "b2")
 
     def __init__(
         self,

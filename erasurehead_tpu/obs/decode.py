@@ -60,6 +60,56 @@ def decode_error_series(layout, message_weights: np.ndarray) -> np.ndarray:
     return err
 
 
+def block_decode_error(
+    layout, message_weights: np.ndarray, block_table: np.ndarray
+) -> dict:
+    """Per-layer (gradient-space) decode error: the decode-error-vs-depth
+    series of the approximate-coding-limits analysis (arXiv:1901.08166),
+    measured against a model's actual per-partition gradient blocks.
+
+    ``block_table`` is the host [P, L, width] table of per-partition
+    gradient blocks at a reference parameter point
+    (ops/blocks.partition_block_table). The decoded gradient of block l
+    in round r is ``pw[r] @ block_table[:, l]`` and the exact full
+    gradient is the same contraction with ``pw == 1``, so
+
+        per_block[r, l] = ||(pw[r] - 1) @ G_l|| / max(||1 @ G_l||, eps)
+
+    is the per-layer relative decode error the weight-space norm
+    (:func:`decode_error_series`) aggregates away, and
+
+        cumulative[r, l] = || (pw[r] - 1) @ G_{0..l} ||_F
+
+    — the unnormalized error over the first l+1 blocks — is monotone
+    non-decreasing in depth l for every round (appending coordinates
+    cannot shrink an L2 norm): the depth-sanity invariant
+    tests/test_deep_coding.py pins. Host float64; exact rounds snap to
+    0.0 like the weight-space series."""
+    from erasurehead_tpu.parallel import step as step_lib
+
+    mw = np.asarray(message_weights, dtype=np.float64)
+    slot_w = np.asarray(
+        step_lib.expand_slot_weights(
+            mw, np.asarray(layout.coeffs), np.asarray(layout.slot_is_coded)
+        )
+    )
+    pw = layout.fold_slot_weights(slot_w)  # [R, P]
+    G = np.asarray(block_table, dtype=np.float64)  # [P, L, K]
+    resid = np.einsum("rp,plk->rlk", pw - 1.0, G)  # decoded - exact
+    exact = G.sum(axis=0)  # [L, K] — the pw == 1 contraction
+    exact_norm = np.linalg.norm(exact, axis=-1)  # [L]
+    num = np.linalg.norm(resid, axis=-1)  # [R, L]
+    per_block = num / np.maximum(exact_norm[None, :], 1e-30)
+    per_block[per_block < EXACT_TOL] = 0.0
+    cumulative = np.sqrt(np.cumsum(num**2, axis=1))
+    cumulative[cumulative < EXACT_TOL] = 0.0
+    return {
+        "per_block": per_block,
+        "cumulative": cumulative,
+        "exact_block_norms": exact_norm,
+    }
+
+
 def summarize(decode_error) -> dict:
     """Mean/max summary of a [R] error series (run_end / bench fields)."""
     if decode_error is None:

@@ -45,7 +45,11 @@ SCHEMA: dict[str, tuple] = {
     "data_upload": ("run_id", "bytes", "cache_hit"),
     # chunked per-round telemetry: simulated clock + masked arrival stats
     "rounds": ("run_id", "first_round", "n_rounds", "sim_time_s"),
-    # chunked per-round AGC decode-error norms (obs/decode.py)
+    # chunked per-round AGC decode-error norms (obs/decode.py). An
+    # optional ``layer`` field (non-negative int) tags a per-layer
+    # gradient-space series under blockwise coding (obs/decode.
+    # block_decode_error): each (run_id, trajectory, layer) triple is its
+    # own monotone round stream — the decode-error-vs-depth record
     "decode": ("run_id", "first_round", "n_rounds", "error_mean",
                "error_max", "exact"),
     # eval replay summary (emitted by callers that run the eval, e.g. cli)
@@ -335,6 +339,43 @@ def emit_round_chunks(
             )
 
 
+def emit_layer_decode_chunks(
+    run_id: str,
+    layer_errors: np.ndarray,
+    *,
+    start_round: int = 0,
+    chunk: int = ROUND_CHUNK,
+    trajectory: Optional[str] = None,
+) -> None:
+    """Emit per-layer ``decode`` chunk streams for a blockwise-coded run:
+    ``layer_errors`` is the [R, L] gradient-space table from
+    obs/decode.block_decode_error (per_block or cumulative — the caller
+    picks the view), and each layer l becomes its own round-chunked
+    stream tagged ``layer=l`` — the decode-error-vs-depth series in the
+    events log. No-op without a capture, like all emission."""
+    if _current is None:
+        return
+    err_rl = np.asarray(layer_errors, dtype=np.float64)
+    rounds = err_rl.shape[0]
+    traj = {} if trajectory is None else {"trajectory": trajectory}
+    for layer in range(err_rl.shape[1]):
+        series = err_rl[:, layer]
+        for lo in range(start_round, rounds, chunk):
+            hi = min(lo + chunk, rounds)
+            seg = series[lo:hi]
+            emit(
+                "decode",
+                run_id=run_id,
+                first_round=lo,
+                n_rounds=hi - lo,
+                error_mean=round(float(seg.mean()), 10) if seg.size else 0.0,
+                error_max=round(float(seg.max()), 10) if seg.size else 0.0,
+                exact=bool((seg == 0.0).all()),
+                layer=layer,
+                **traj,
+            )
+
+
 # --------------------------------------------------------------------------
 # validation (shared by tools/validate_events.py, make telemetry-smoke,
 # and the tests)
@@ -405,7 +446,16 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
                 )
             seen_seq = True
         if rtype in ("rounds", "decode"):
-            key = (rec.get("run_id"), rtype, rec.get("trajectory"))
+            layer = rec.get("layer")
+            if layer is not None and (
+                not isinstance(layer, int) or layer < 0
+            ):
+                errors.append(
+                    f"line {i}: {rtype} layer must be a non-negative "
+                    f"int, got {layer!r}"
+                )
+                layer = None
+            key = (rec.get("run_id"), rtype, rec.get("trajectory"), layer)
             fr = rec.get("first_round")
             if isinstance(fr, int):
                 prev = last_round.get(key)
@@ -416,6 +466,11 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
                         + (
                             f" trajectory {key[2]!r}"
                             if key[2] is not None
+                            else ""
+                        )
+                        + (
+                            f" layer {key[3]}"
+                            if key[3] is not None
                             else ""
                         )
                     )

@@ -234,11 +234,15 @@ def render(paths: Sequence[str]) -> str:
             ) else "miss"
         err = end.get("decode_error_mean")
         if err is None and g["decode"]:
-            n = sum(d.get("n_rounds", 0) for d in g["decode"])
+            # layer-tagged records are per-layer gradient-space series
+            # (blockwise coding), not the run-level weight-space norm —
+            # averaging them in would mix the two metrics
+            untagged = [d for d in g["decode"] if d.get("layer") is None]
+            n = sum(d.get("n_rounds", 0) for d in untagged)
             if n:
                 err = sum(
                     d.get("error_mean", 0.0) * d.get("n_rounds", 0)
-                    for d in g["decode"]
+                    for d in untagged
                 ) / n
         lines.append(
             f"{str(g['run_id'])[:16]:16s} {str(scheme)[:16]:16s} "

@@ -1,0 +1,173 @@
+"""Gradient block tables: pytree <-> padded [L, width] layer-block views.
+
+Per-layer (blockwise) gradient coding codes each layer's flattened
+gradient block independently against the same layout matrix, so decode is
+one batched ``[k, P] x [P, L, width]`` einsum instead of a per-leaf
+gather-and-combine over the full pytree (parallel/step.
+_layer_block_local_body). This module owns the pure shape logic of that
+view: a :class:`BlockSpec` describes how a model's parameter/gradient
+pytree flattens into a zero-padded block table and back, bijectively —
+``blocks_to_tree(tree_to_blocks(g)) == g`` exactly (padding lanes are
+zeros; values are moved, never transformed, so the blockwise decode is
+bitwise-identical to the treewise decode, test-pinned).
+
+Block granularity is per LEAF by default (one block per parameter
+tensor — "per layer" for models whose layers are separate leaves).
+Models whose depth lives inside a stacked leaf opt leaves into
+row-splitting via a ``block_split_leaves`` class attribute naming the
+top-level dict keys whose leading axis should split into one block per
+slice: DeepMLP's ``[n_layers, H, H]`` hidden stack becomes one block per
+layer, and MoE's ``[n_experts, ...]`` expert stacks become one coded
+block per expert — the expert shards are the natural coded units
+(ROADMAP item 4).
+
+Everything here is static shape metadata computed once at setup from a
+parameter template; ``tree_to_blocks``/``blocks_to_tree`` are
+jit/vmap-compatible (reshape + pad + concatenate only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "BlockSpec",
+    "block_spec",
+    "model_block_spec",
+    "tree_to_blocks",
+    "blocks_to_tree",
+    "partition_block_table",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockSpec:
+    """Static description of a pytree's layer-block table view.
+
+    Leaf ``i`` contributes ``rows_per_leaf[i]`` consecutive blocks of
+    ``sizes_per_leaf[i]`` elements each (1 row = the whole leaf for
+    unsplit leaves; split leaves contribute one row per leading-axis
+    slice). Blocks are ordered leaf-major in treedef flattening order,
+    each zero-padded to ``width`` = max block size."""
+
+    treedef: Any
+    leaf_shapes: Tuple[Tuple[int, ...], ...]
+    rows_per_leaf: Tuple[int, ...]
+    sizes_per_leaf: Tuple[int, ...]
+    #: per block: (leaf index, row within the leaf) — the MoE test pins
+    #: this as the expert-shard -> coded-block mapping
+    block_of: Tuple[Tuple[int, int], ...]
+    width: int
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self.block_of)
+
+    def leaf_offsets(self) -> np.ndarray:
+        """[n_leaves + 1] block-row offsets of each leaf's slice."""
+        return np.cumsum([0, *self.rows_per_leaf])
+
+
+def block_spec(tree, split_leaves: Tuple[str, ...] = ()) -> BlockSpec:
+    """Build the :class:`BlockSpec` for a parameter/gradient template.
+
+    ``split_leaves`` names top-level dict keys whose leading axis splits
+    into one block per slice (models declare theirs via
+    ``block_split_leaves``; non-dict pytrees and unnamed leaves stay one
+    block per leaf)."""
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    split_set = set(split_leaves)
+    shapes, rows, sizes, block_of = [], [], [], []
+    for li, (path, leaf) in enumerate(paths_leaves):
+        shape = tuple(int(d) for d in np.shape(leaf))
+        key = getattr(path[0], "key", None) if path else None
+        split = key in split_set and len(shape) >= 1 and shape[0] >= 1
+        n_rows = shape[0] if split else 1
+        size = int(np.prod(shape[1:] if split else shape, dtype=np.int64))
+        if size == 0 or n_rows == 0:
+            raise ValueError(
+                f"block_spec: leaf {key or li} has zero-size shape {shape}"
+            )
+        shapes.append(shape)
+        rows.append(n_rows)
+        sizes.append(size)
+        block_of.extend((li, r) for r in range(n_rows))
+    return BlockSpec(
+        treedef=treedef,
+        leaf_shapes=tuple(shapes),
+        rows_per_leaf=tuple(rows),
+        sizes_per_leaf=tuple(sizes),
+        block_of=tuple(block_of),
+        width=max(sizes),
+    )
+
+
+def model_block_spec(model, params) -> BlockSpec:
+    """The model's coded-block view of its parameter pytree: per-leaf
+    blocks, with the model's ``block_split_leaves`` (DeepMLP layers, MoE
+    experts) split along their leading axis."""
+    return block_spec(params, getattr(model, "block_split_leaves", ()))
+
+
+def tree_to_blocks(tree, spec: BlockSpec) -> jnp.ndarray:
+    """Pytree -> zero-padded ``[n_blocks, width]`` block table
+    (jit/vmap-safe; inverse of :func:`blocks_to_tree`)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if len(leaves) != len(spec.leaf_shapes):
+        raise ValueError(
+            f"tree_to_blocks: {len(leaves)} leaves vs spec's "
+            f"{len(spec.leaf_shapes)}"
+        )
+    rows = []
+    for leaf, n_rows, size in zip(
+        leaves, spec.rows_per_leaf, spec.sizes_per_leaf
+    ):
+        flat = jnp.reshape(leaf, (n_rows, size))
+        if size < spec.width:
+            flat = jnp.pad(flat, ((0, 0), (0, spec.width - size)))
+        rows.append(flat)
+    return jnp.concatenate(rows, axis=0)
+
+
+def blocks_to_tree(table: jnp.ndarray, spec: BlockSpec):
+    """``[n_blocks, width]`` block table -> pytree (drops the zero
+    padding; inverse of :func:`tree_to_blocks`)."""
+    if table.shape[-2:] != (spec.n_blocks, spec.width):
+        raise ValueError(
+            f"blocks_to_tree: table shape {table.shape} vs spec "
+            f"[{spec.n_blocks}, {spec.width}]"
+        )
+    offsets = spec.leaf_offsets()
+    leaves = []
+    for i, (shape, n_rows, size) in enumerate(
+        zip(spec.leaf_shapes, spec.rows_per_leaf, spec.sizes_per_leaf)
+    ):
+        rows = table[offsets[i]:offsets[i + 1], :size]
+        leaves.append(jnp.reshape(rows, shape))
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)
+
+
+def partition_block_table(model, spec: BlockSpec, params, Xp, yp) -> np.ndarray:
+    """Host-side ``[P, L, width]`` table of per-partition gradient blocks
+    at ``params`` — the reference matrix behind the decode-error-vs-depth
+    telemetry (obs/decode.block_decode_error): the decoded gradient of
+    block l under fold weights pw is ``pw @ table[:, l, :]`` and the
+    exact full gradient is the same contraction with ``pw == 1``.
+
+    ``Xp``/``yp`` are the partition-major stacks ([P, rows, F] /
+    [P, rows]); one ``grad_sum`` per partition, packed through the same
+    :func:`tree_to_blocks` the step decode uses."""
+    out = []
+    for p in range(int(np.shape(yp)[0])):
+        g = model.grad_sum(
+            params,
+            jax.tree.map(lambda l: l[p], Xp),
+            jax.tree.map(lambda l: l[p], yp),
+        )
+        out.append(np.asarray(tree_to_blocks(g, spec), dtype=np.float64))
+    return np.stack(out, axis=0)

@@ -47,6 +47,8 @@ __all__ = [
     "uncoded_layout",
     "cyclic_mds_layout",
     "frc_layout",
+    "sparse_graph_layout",
+    "expander_layout",
     "partial_cyclic_layout",
     "partial_frc_layout",
     "cyclic_generator_matrix",
@@ -342,6 +344,90 @@ def random_regular_layout(
         n_stragglers=n_stragglers,
         B=B,
     )
+
+
+def sparse_graph_layout(
+    n_workers: int, n_stragglers: int, seed: int = 0
+) -> CodingLayout:
+    """Sparse random bipartite-graph code ("sparsegraph"; arXiv
+    1711.06771's random-graph family, beyond the reference).
+
+    Each of the W partitions lands on exactly d = s+1 workers drawn
+    uniformly at random (random d-regular on the PARTITION side — the
+    structural difference from :func:`random_regular_layout`, which is
+    d-regular on both sides): worker loads come out ragged, like a real
+    random bipartite assignment. The fixed-shape [W, S] slot table takes
+    S = the maximum worker degree, padding lighter workers with
+    zero-coefficient slots (they contribute nothing to messages, decode
+    folds, or the effective matrix — only redundant gather compute).
+
+    All real-edge coefficients are 1 and every partition has degree
+    exactly d, so ``w = 1/d`` decodes the exact full gradient at full
+    collection ((1/d) * column sums == all-ones) — the standard
+    zero-straggling partial-decode == full-gradient pin. Under
+    straggling, the first-``num_collect`` lstsq-optimal combination
+    (collect_first_k_optimal over the 0/1 incidence B) degrades
+    gracefully like randreg.
+    """
+    W, d = n_workers, n_stragglers + 1
+    if d > W:
+        raise ValueError(f"degree {d} exceeds n_workers {W}")
+    rng = np.random.default_rng(seed)
+    holders = [rng.choice(W, size=d, replace=False) for _ in range(W)]
+    per_worker: list[list[int]] = [[] for _ in range(W)]
+    for p, ws in enumerate(holders):
+        for w in ws:
+            per_worker[int(w)].append(p)
+    S = max(1, max(len(ps) for ps in per_worker))
+    assignment = np.zeros((W, S), dtype=np.int32)
+    coeffs = np.zeros((W, S))
+    for w, ps in enumerate(per_worker):
+        assignment[w, : len(ps)] = ps
+        coeffs[w, : len(ps)] = 1.0
+    layout = CodingLayout(
+        name="sparse_graph",
+        n_workers=W,
+        n_partitions=W,
+        assignment=assignment,
+        coeffs=coeffs,
+        slot_is_coded=np.ones(S, dtype=bool),
+        n_stragglers=n_stragglers,
+    )
+    # the 0/1 incidence matrix IS the effective coding matrix here; the
+    # first-k lstsq rules and the dynamic decode both key on layout.B
+    return dataclasses.replace(layout, B=layout.effective_matrix())
+
+
+def expander_layout(n_workers: int, n_stragglers: int) -> CodingLayout:
+    """Deterministic circulant expander-style code ("expander"; the
+    cyclic/expander constructions of arXiv 1707.03858, beyond the
+    reference).
+
+    Worker w holds the d = s+1 partitions ``w + floor(j*W/d) mod W`` —
+    evenly spread circulant chords (distinct because consecutive offsets
+    differ by >= 1 when W >= d), giving a d-regular bipartite graph on
+    both sides whose union of spread cyclic shifts mixes arrival subsets
+    the way the expander constructions intend, with ONE seed-independent
+    layout (a whole seed sweep shares its data stack and cohort).
+    Coefficients 1; ``w = 1/d`` is the exact full-collection decode; the
+    first-``num_collect`` lstsq-optimal rule covers the straggling
+    regime, as for sparsegraph/randreg.
+    """
+    W, d = n_workers, n_stragglers + 1
+    if d > W:
+        raise ValueError(f"degree {d} exceeds n_workers {W}")
+    offsets = np.array([(j * W) // d for j in range(d)], dtype=np.int64)
+    assignment = (np.arange(W)[:, None] + offsets[None, :]) % W
+    layout = CodingLayout(
+        name="expander",
+        n_workers=W,
+        n_partitions=W,
+        assignment=assignment.astype(np.int32),
+        coeffs=np.ones((W, d)),
+        slot_is_coded=np.ones(d, dtype=bool),
+        n_stragglers=n_stragglers,
+    )
+    return dataclasses.replace(layout, B=layout.effective_matrix())
 
 
 def partial_cyclic_layout(

@@ -442,6 +442,106 @@ def _deduped_local_body(model, mesh: Mesh) -> GradFn:
     return local
 
 
+# Whether layer_coding="auto" resolves to the blockwise per-layer decode
+# for supported models. False pending its end-to-end race (the repo's
+# measurement-pinned-default rule: deep_cohort rows in BASELINE.md race it
+# explicitly; the blockwise decode is bitwise-identical to the treewise
+# decode — tests/test_deep_coding.py — so the knob is a pure lowering
+# choice, forceable per run with layer_coding="on").
+LAYER_CODING_DEFAULT = False
+
+
+def supports_layer_coding(model) -> bool:
+    """Can this model's gradients take the per-layer (blockwise) decode
+    path (:func:`_layer_block_local_body`)?
+
+    Two exclusions, both structural:
+      - autodiff families under a vma-checking jax (>= 0.6): per-slot
+        ``jax.grad`` w.r.t. replicated params inside shard_map implicitly
+        psums cotangents per slot position there (see _grads_via_loss) —
+        the blockwise body's per-slot grads would double-count. On jax
+        0.4.x there is no implicit psum and the per-slot form is exact.
+      - model-internal mesh axes (seq/tp/pp/ep): those route gradients
+        through _weighted_loss_grad's multi-axis psum recipe; the
+        blockwise body decodes over the worker axis only.
+    """
+    if _grads_via_loss(model) and compat.IMPLICIT_REPLICATED_GRAD_PSUM:
+        return False
+    for ax in ("seq_axis", "tp_axis", "pp_axis", "ep_axis"):
+        if getattr(model, ax, None) is not None:
+            return False
+    return True
+
+
+def resolve_layer_coding(layer_coding: str, model) -> bool:
+    """Should this run decode per layer block? ("on" validity is the
+    caller's concern — this resolves the choice, it does not raise.)"""
+    if not supports_layer_coding(model):
+        return False
+    if layer_coding == "on":
+        return True
+    if layer_coding == "off":
+        return False
+    return LAYER_CODING_DEFAULT
+
+
+def _layer_block_local_body(model, spec, contract: str) -> GradFn:
+    """Per-device body of the per-layer (blockwise) coded step.
+
+    Each slot/partition gradient is computed as a pytree (exactly as the
+    per-slot default does), packed into the model's padded ``[L, width]``
+    block table (ops/blocks.py — DeepMLP layers and MoE expert shards are
+    individual rows), and decoded with ONE batched einsum
+    ``[..., P] x [..., P, L, width] -> [L, width]`` — a small per-block
+    contraction instead of a per-leaf gather-and-combine over the full
+    pytree, which is what keeps decode cost flat as depth grows. Values
+    are moved, never transformed: the blockwise decode is BITWISE
+    identical to :func:`_weighted_tree_sum` over the same grads
+    (tests/test_deep_coding.py pins it), so the knob is a pure lowering
+    choice.
+
+    ``contract`` is "ws" (faithful worker-major stacks) or "p" (deduped
+    partition-major stacks), mirroring the default bodies."""
+    from erasurehead_tpu.ops import blocks as blocks_lib
+
+    def local(params, Xs, ys, ws):
+        per = lambda X, y: blocks_lib.tree_to_blocks(
+            model.grad_sum(params, X, y), spec
+        )
+        for _ in range(len(contract)):
+            per = jax.vmap(per)
+        with annotate("eh_step/partial_grads"):
+            table = per(Xs, ys)  # [..., L, width]
+        with annotate("eh_step/decode"):
+            g = jnp.einsum(
+                f"{contract},{contract}lk->lk",
+                ws.astype(table.dtype),
+                table,
+                precision=lax.Precision.HIGHEST,
+            )
+            g = lax.psum(g, WORKER_AXIS)
+        return blocks_lib.blocks_to_tree(g, spec)
+
+    return local
+
+
+def make_layer_block_grad_fn(
+    model, mesh: Mesh, spec, *, faithful: bool
+) -> GradFn:
+    """Per-layer (blockwise) decoded gradient: drop-in for
+    make_faithful_grad_fn / make_deduped_grad_fn on any model whose
+    gradient is a pytree (the deep-model families). The ring transport
+    composes via make_ring_faithful_grad_fn(local_body=...) exactly as
+    the flat/margin-flat lowerings do."""
+    return shard_map(
+        _dq(_layer_block_local_body(model, spec, "ws" if faithful else "p")),
+        mesh=mesh,
+        in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
+        out_specs=P(),
+        check_vma=_vma_check(model),
+    )
+
+
 def make_deduped_grad_fn(model, mesh: Mesh) -> GradFn:
     """Each partition gradient is computed exactly once, then combined with
     folded decode weights (CodingLayout.fold_slot_weights).
@@ -771,6 +871,7 @@ def lowering_signature(cfg, model, X) -> tuple:
     return (
         bool(resolve_flat_grad(cfg.flat_grad, model, X)),
         bool(resolve_margin_flat(cfg.margin_flat, model, X)),
+        bool(resolve_layer_coding(cfg.layer_coding, model)),
         type(X).__name__,
     )
 

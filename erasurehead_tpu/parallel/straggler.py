@@ -174,6 +174,67 @@ def apply_regime_shift(
     return out
 
 
+def load_arrival_trace(trace) -> np.ndarray:
+    """A recorded per-round arrival-time trace as a float64 [R, W] matrix.
+
+    ``trace`` is an array (validated and passed through) or a path:
+    ``.npy`` / ``.npz`` (an ``arrivals`` entry, else the first array) /
+    anything else is read as whitespace/comma-delimited text, one round
+    per line. A 1-D trace is a single round. Values are per-(round,
+    worker) arrival delays in simulated seconds; negative entries are
+    refused (the collection rules' time axis starts at 0)."""
+    if isinstance(trace, (str, bytes)):
+        path = str(trace)
+        if path.endswith(".npy"):
+            arr = np.load(path)
+        elif path.endswith(".npz"):
+            with np.load(path) as z:
+                key = "arrivals" if "arrivals" in z.files else z.files[0]
+                arr = z[key]
+        else:
+            arr = np.loadtxt(path, delimiter="," if path.endswith(".csv") else None)
+    else:
+        arr = trace
+    arr = np.asarray(arr, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr[None, :]
+    if arr.ndim != 2 or arr.size == 0:
+        raise ValueError(
+            f"arrival trace must be a non-empty [rounds, workers] matrix, "
+            f"got shape {arr.shape}"
+        )
+    if (arr < 0).any():
+        raise ValueError("arrival trace has negative arrival times")
+    return arr
+
+
+def replay_arrival_trace(
+    trace, rounds: int, n_workers: int, speed: np.ndarray | None = None
+) -> np.ndarray:
+    """Tile a recorded trace (:func:`load_arrival_trace`) over ``rounds``
+    rounds, with an optional [W] per-worker speed multiplier on every row
+    (heterogeneous replay: worker w's recorded delays scale by
+    ``speed[w]``). The trace's worker count must match the run's — a
+    silently broadcast mismatch would replay the wrong cluster."""
+    arr = load_arrival_trace(trace)
+    if arr.shape[1] != n_workers:
+        raise ValueError(
+            f"arrival trace has {arr.shape[1]} workers but the run has "
+            f"{n_workers}; record and replay must agree"
+        )
+    reps = -(-rounds // arr.shape[0])  # ceil
+    out = np.tile(arr, (reps, 1))[:rounds]
+    if speed is not None:
+        speed = np.asarray(speed, dtype=np.float64)
+        if speed.shape != (n_workers,) or (speed <= 0).any():
+            raise ValueError(
+                f"trace speed multipliers must be [W] positives, got "
+                f"{speed!r}"
+            )
+        out = out * speed[None, :]
+    return out
+
+
 def arrival_schedule(
     rounds: int,
     n_workers: int,
@@ -181,6 +242,8 @@ def arrival_schedule(
     mean: float = 0.5,
     arrival_model: ArrivalModel | None = None,
     regime: RegimeShift | None = None,
+    trace=None,
+    trace_speed: np.ndarray | None = None,
 ) -> np.ndarray:
     """The full [rounds, W] arrival-time matrix for a run.
 
@@ -191,12 +254,23 @@ def arrival_schedule(
     straggler-regime change (:class:`RegimeShift`) on top of the drawn
     delays — the adversary kind applies even with delays off (a slow
     worker is slow whether or not the exponential stream is injected).
-    """
-    if add_delay:
+
+    ``trace`` replaces the drawn delay stream with a recorded per-round
+    trace (path or array; :func:`replay_arrival_trace` — tiled over
+    ``rounds``, ``trace_speed`` scales each worker's recorded delays),
+    replacing i.i.d.-exponential-only injection with real cluster replay;
+    ``add_delay`` is ignored (the trace IS the delay schedule) while
+    ``regime`` and the ``arrival_model`` compute terms still compose on
+    top, so heterogeneity studies run against recorded streams too."""
+    if trace is not None:
+        delays = replay_arrival_trace(trace, rounds, n_workers, trace_speed)
+    elif add_delay:
         delays = reference_delay_schedule(rounds, n_workers, mean)
     else:
         delays = np.zeros((rounds, n_workers))
-    if regime is not None and (add_delay or regime.kind == "adversary"):
+    if regime is not None and (
+        add_delay or trace is not None or regime.kind == "adversary"
+    ):
         delays = apply_regime_shift(delays, regime, mean)
     model = arrival_model or ArrivalModel()
     return model.arrivals(delays)

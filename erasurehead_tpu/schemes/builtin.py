@@ -402,6 +402,83 @@ def _feas_randreg(dead, num_collect):
     return _alive_cnt(dead) >= num_collect
 
 
+def _first_k_optimal_family(
+    name, summary, build_layout, *, seed_dependent,
+):
+    """The shared descriptor shape of the sparse-code families (randreg /
+    sparsegraph / expander): 0/1-incidence layouts whose collection rule
+    is first-``num_collect`` arrivals with the lstsq-optimal combination
+    over the received rows of B (arXiv 2006.09638), graceful-degradation
+    approximate, exact at full collection (w = 1/d)."""
+
+    def _sched(t, layout, *, num_collect=None, deadline=None):
+        from erasurehead_tpu.parallel import collect
+
+        if num_collect is None:
+            raise ValueError(f"{name} needs num_collect")
+        return collect.collect_first_k_optimal(t, layout.B, num_collect)
+
+    def _dyn(layout, *, num_collect=None, deadline=None):
+        import jax.numpy as jnp
+
+        from erasurehead_tpu.parallel import dynamic
+
+        if num_collect is None:
+            raise ValueError(f"{name} needs num_collect")
+        B = jnp.asarray(layout.B, jnp.float32)
+        table = _mds_table_or_warn(
+            name, layout, layout.n_workers - num_collect, exact_only=True
+        )
+        return lambda t: dynamic._first_k_lstsq_jnp(
+            t, B, num_collect, decode_table=table
+        )
+
+    return register(SchemeDescriptor(
+        name=name,
+        summary=summary,
+        build_layout=build_layout,
+        build_schedule=_sched,
+        dynamic_rule=_dyn,
+        feasibility=lambda layout, dead, *, num_collect=None: (
+            _feas_randreg(dead, num_collect),
+            f"needs first {num_collect} arrivals",
+        ),
+        optimal_decode=lstsq_optimal_decode,
+        needs_num_collect=True,
+        config_fields=("num_collect",),
+        seed_dependent_layout=seed_dependent,
+        # the same "interesting regime collects fewer than all" default
+        # the straggler sweep applies to the other first-k families
+        sweep_num_collect=lambda n_workers: n_workers // 2,
+        builtin=True,
+    ))
+
+
+SPARSE_GRAPH = _first_k_optimal_family(
+    "sparsegraph",
+    (
+        "sparse random bipartite-graph code with lstsq-optimal decoding "
+        "(arXiv:1711.06771 + 2006.09638): partition-regular, ragged "
+        "worker loads"
+    ),
+    lambda cfg: codes.sparse_graph_layout(
+        cfg.n_workers, cfg.n_stragglers, seed=cfg.seed
+    ),
+    seed_dependent=True,
+)
+
+EXPANDER = _first_k_optimal_family(
+    "expander",
+    (
+        "deterministic circulant expander-style code with lstsq decoding "
+        "(arXiv:1707.03858): evenly spread cyclic chords, seed-free "
+        "layout"
+    ),
+    lambda cfg: codes.expander_layout(cfg.n_workers, cfg.n_stragglers),
+    seed_dependent=False,
+)
+
+
 DEADLINE = register(SchemeDescriptor(
     name="deadline",
     summary=(

@@ -138,7 +138,9 @@ CONFIG_PAYLOAD_FIELDS = frozenset(
         "compute_mode", "stack_mode", "ring_pipeline", "stack_dtype",
         "donate", "seed", "dtype", "use_pallas", "sparse_lanes",
         "dense_margin_cols", "flat_grad", "margin_flat", "deadline",
-        "decode",
+        "decode", "layer_coding", "deep_layers",
+        # deliberately absent like input_dir: arrival_trace points the
+        # daemon at a host path — a remote client must not
         "scan_unroll", "sparse_format", "fields_scatter", "fields_margin",
     }
 )

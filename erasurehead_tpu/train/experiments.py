@@ -478,10 +478,16 @@ def compare(
 
     _validate_shared_shape(configs)
     if arrivals is None:
+        from erasurehead_tpu.utils.config import resolve_arrival_trace
+
         any_cfg = next(iter(configs.values()))
+        # a recorded arrival trace (config field or env) replaces the
+        # drawn exponential stream as the sweep's ONE shared schedule —
+        # the paired-comparison contract holds either way
         arrivals = straggler.arrival_schedule(
             any_cfg.rounds, any_cfg.n_workers, add_delay=True,
             mean=any_cfg.delay_mean,
+            trace=resolve_arrival_trace(any_cfg.arrival_trace),
         )
 
     if journal is None:

@@ -81,6 +81,10 @@ def build_model(cfg: RunConfig):
     if cfg.model == ModelKind.DEEPMLP:
         from erasurehead_tpu.models.deep_mlp import DeepMLPModel
 
+        # cfg.deep_layers sweeps the family's depth (0 = model default);
+        # the decode-error-vs-depth series rides this knob
+        if cfg.deep_layers:
+            return DeepMLPModel(n_layers=cfg.deep_layers)
         return DeepMLPModel()
     if cfg.model == ModelKind.MOE:
         from erasurehead_tpu.models.moe import MoEModel
@@ -341,13 +345,30 @@ def default_arrivals(cfg: RunConfig) -> np.ndarray:
     ``ERASUREHEAD_REGIME`` (utils/chaos.py) arms a deterministic mid-run
     straggler-regime shift (exp→heavy-tail, or one worker turning
     adversarially slow) on top of the drawn delays; unset, the schedule is
-    byte-for-byte the stationary reference stream it always was."""
-    from erasurehead_tpu.utils import chaos as chaos_lib
+    byte-for-byte the stationary reference stream it always was.
 
+    ``cfg.arrival_trace`` (or ``ERASUREHEAD_ARRIVAL_TRACE``) replays a
+    recorded per-round arrival trace instead of the drawn exponential
+    stream (straggler.replay_arrival_trace); ``cfg.worker_speed_spread``
+    then composes as the seeded per-worker multiplier ON the trace rows
+    (heterogeneous replay of a recorded cluster)."""
+    from erasurehead_tpu.utils import chaos as chaos_lib
+    from erasurehead_tpu.utils.config import resolve_arrival_trace
+
+    trace = resolve_arrival_trace(cfg.arrival_trace)
+    trace_speed = None
+    if trace is not None and cfg.worker_speed_spread:
+        # the same seeded draw model_from_config uses for compute_time
+        # heterogeneity, applied multiplicatively to the recorded delays
+        rng = np.random.default_rng(cfg.seed + 10_007)
+        s = float(cfg.worker_speed_spread)
+        trace_speed = rng.uniform(1.0 - s, 1.0 + s, cfg.n_workers)
     return straggler.arrival_schedule(
         cfg.rounds, cfg.n_workers, cfg.add_delay, cfg.delay_mean,
         arrival_model=straggler.model_from_config(cfg),
         regime=chaos_lib.active_regime(),
+        trace=trace,
+        trace_speed=trace_speed,
     )
 
 
@@ -721,8 +742,9 @@ def train(
                 "gradient lowerings; force at most one"
             )
         # ring transport wins over the auto-fused kernel (the fused body
-        # has no ring variant; use_pallas='on' + ring is config-refused)
-        if dense_glm and not setup.ring:
+        # has no ring variant; use_pallas='on' + ring is config-refused),
+        # as does a forced blockwise decode (config-refused combination)
+        if dense_glm and not setup.ring and cfg.layer_coding != "on":
             grad_fn = step_lib.make_fused_grad_fn(
                 kind, mesh, interpret=(platform != "tpu")
             )
@@ -732,6 +754,12 @@ def train(
                 "use_pallas='on' needs a dense logistic/linear stack; "
                 f"got model={kind!r}, X={type(X).__name__}"
             )
+
+    if not use_fused:
+        grad_fn = _apply_layer_coding(
+            cfg, model, mesh, X, grad_fn, setup.state0.params,
+            ring_plan, ring_pipe, faithful=faithful,
+        )
 
     update_fn = setup.update_fn
 
@@ -1290,7 +1318,31 @@ def _train_cohort_impl(cfg, dataset, cfgs, mesh, arrivals, measure):
             f"got model={getattr(model, 'name', type(model).__name__)!r}, "
             f"X={type(X).__name__}"
         )
-    if step_lib.supports_cohort_matmul(model, X):
+    if cfg.layer_coding == "on" and not step_lib.supports_layer_coding(model):
+        raise ValueError(
+            "layer_coding='on' needs a model whose per-slot gradients are "
+            "exact under the worker-axis step (no model-internal mesh "
+            "axes; autodiff families need a jax without the implicit "
+            "replicated-grad psum) — got "
+            f"model={getattr(model, 'name', type(model).__name__)!r}"
+        )
+    if step_lib.resolve_layer_coding(cfg.layer_coding, model):
+        # per-layer (blockwise) coded cohort: every trajectory's per-slot
+        # gradient pytrees pack into the model's block table and decode
+        # as one [B, P] x [P, L, width] einsum — DeepMLP layers and MoE
+        # expert shards are the coded units (ops/blocks.py)
+        from erasurehead_tpu.ops import blocks as blocks_lib
+
+        spec = blocks_lib.model_block_spec(
+            model, _init_params_f32(cfg, model, dataset.n_features)
+        )
+        local_body = step_lib._batched_local_body(
+            step_lib._layer_block_local_body(
+                model, spec, "ws" if faithful else "p"
+            )
+        )
+        cohort_lowering = "layer_block_vmap"
+    elif step_lib.supports_cohort_matmul(model, X):
         local_body = step_lib._cohort_matmul_local_body(model)
         cohort_lowering = "cohort_matmul"
     elif step_lib.resolve_flat_grad(cfg.flat_grad, model, X):
@@ -2179,6 +2231,45 @@ def _apply_flat_grad(
     return grad_fn
 
 
+def _apply_layer_coding(
+    cfg, model, mesh, X, grad_fn, params_template,
+    ring_plan=None, ring_pipeline=False, faithful=True,
+):
+    """Swap in the per-layer (blockwise) decode lowering
+    (step.make_layer_block_grad_fn) per cfg.layer_coding: per-slot
+    gradient pytrees pack into the model's [L, width] block table
+    (ops/blocks.model_block_spec — DeepMLP layers / MoE expert shards are
+    individual coded blocks) and decode as ONE batched einsum. "on"
+    forces (raising where the model cannot take the path); "auto" defers
+    to step.resolve_layer_coding (LAYER_CODING_DEFAULT, pending its
+    race). Composes with the ring transport like the other lowering
+    swaps; bitwise-identical decode to the treewise form is test-pinned,
+    so the swap is a pure lowering choice."""
+    if cfg.layer_coding == "on" and not step_lib.supports_layer_coding(model):
+        raise ValueError(
+            "layer_coding='on' needs a model whose per-slot gradients are "
+            "exact under the worker-axis step (no model-internal mesh "
+            "axes; autodiff families need a jax without the implicit "
+            "replicated-grad psum) — got "
+            f"model={getattr(model, 'name', type(model).__name__)!r}"
+        )
+    if not step_lib.resolve_layer_coding(cfg.layer_coding, model):
+        return grad_fn
+    from erasurehead_tpu.ops import blocks as blocks_lib
+
+    spec = blocks_lib.model_block_spec(model, params_template)
+    if ring_plan is not None:
+        return step_lib.make_ring_faithful_grad_fn(
+            model, mesh, ring_plan,
+            local_body=step_lib._layer_block_local_body(model, spec, "ws"),
+            pipeline=ring_pipeline,
+            check_vma=step_lib._vma_check(model),
+        )
+    return step_lib.make_layer_block_grad_fn(
+        model, mesh, spec, faithful=faithful
+    )
+
+
 @_with_run_sparse_lanes
 def train_dynamic(
     cfg: RunConfig,
@@ -2247,6 +2338,10 @@ def train_dynamic(
         ),
         ring_plan,
         ring_pipe,
+    )
+    grad_fn = _apply_layer_coding(
+        cfg, model, mesh, X, grad_fn, setup.state0.params,
+        ring_plan, ring_pipe, faithful=True,
     )
     update_fn = setup.update_fn
     dtype = jnp.float32  # param/update dtype (cfg.dtype is the data dtype)

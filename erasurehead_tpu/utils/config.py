@@ -45,6 +45,16 @@ class Scheme(str, enum.Enum):
     # unbiasedness; inherently failure-tolerant (a dead worker just never
     # arrives) and the practical form async-SGD systems deploy
     DEADLINE = "deadline"
+    # beyond the reference: sparse random BIPARTITE-graph code (arXiv
+    # 1711.06771's random-graph family next to randreg's d-regular form):
+    # each partition lands on exactly s+1 uniformly-drawn workers, worker
+    # loads ragged; first-k collection with lstsq-optimal decoding
+    SPARSE_GRAPH = "sparsegraph"
+    # beyond the reference: deterministic circulant expander-style code
+    # (arXiv 1707.03858's cyclic/expander constructions): worker w holds
+    # partitions w + floor(j*W/(s+1)) mod W — evenly spread chords, one
+    # seed-independent layout; first-k collection with lstsq decoding
+    EXPANDER = "expander"
 
 
 class ExtensionScheme(str):
@@ -270,6 +280,29 @@ class RunConfig:
     # transpose winner). "auto" resolves to step.MARGIN_FLAT_DEFAULT
     # pending the dense_f32_marginflat race; closed-form dense GLMs only.
     margin_flat: str = "auto"
+    # per-layer (blockwise) gradient coding (parallel/step.
+    # make_layer_block_grad_fn): code each layer's flattened gradient
+    # block independently against the same layout matrix, so decode is a
+    # batched [k,P]x[P,block] einsum per block (ops/blocks.py) instead of
+    # a per-leaf gather-and-combine over the full pytree. DeepMLP layers
+    # and MoE expert shards are individual coded blocks
+    # (model.block_split_leaves). Bitwise-identical decode to the
+    # treewise form (tests/test_deep_coding.py) — a pure lowering knob.
+    # "on" forces it (errors where unsupported: forced pallas/flat
+    # lowerings, model-internal mesh axes, measured mode); "auto"
+    # resolves via step.LAYER_CODING_DEFAULT (off pending its race).
+    layer_coding: str = "auto"
+    # hidden-layer count for the deepmlp family (models/deep_mlp.py);
+    # 0 = the model's default (4). The decode-error-vs-depth series
+    # sweeps this knob (bench.py deep_cohort extra).
+    deep_layers: int = 0
+    # replay a recorded per-round arrival-time trace instead of drawing
+    # i.i.d. exponential delays (parallel/straggler.load_arrival_trace:
+    # .npy/.npz/.csv/.txt, shape [R?, W], tiled over rounds). CLI
+    # --arrival-trace; ERASUREHEAD_ARRIVAL_TRACE overrides when unset.
+    # cfg.worker_speed_spread composes as a per-worker multiplier ON the
+    # trace rows (heterogeneous replay); simulated-arrival trainer only.
+    arrival_trace: Optional[str] = None
     # per-round collection deadline in simulated seconds (scheme="deadline")
     deadline: Optional[float] = None
     # decode-weight policy (schemes registry / arXiv:2006.09638):
@@ -357,6 +390,39 @@ class RunConfig:
         if self.flat_grad not in ("auto", "on", "off"):
             raise ValueError(
                 f"flat_grad must be auto/on/off, got {self.flat_grad!r}"
+            )
+        if self.layer_coding not in ("auto", "on", "off"):
+            raise ValueError(
+                f"layer_coding must be auto/on/off, got {self.layer_coding!r}"
+            )
+        if self.layer_coding == "on":
+            for knob, name in (
+                (self.flat_grad, "flat_grad"),
+                (self.margin_flat, "margin_flat"),
+                (self.use_pallas, "use_pallas"),
+            ):
+                if knob == "on":
+                    raise ValueError(
+                        f"layer_coding='on' and {name}='on' both force a "
+                        "gradient lowering; force at most one"
+                    )
+            if self.arrival_mode == "measured":
+                raise ValueError(
+                    "arrival_mode='measured' decodes each worker's own "
+                    "timed message through the per-slot tree contraction; "
+                    "the blockwise decode only exists inside the SPMD "
+                    "step — use layer_coding='auto' or 'off' with "
+                    "measured mode"
+                )
+        if self.deep_layers < 0:
+            raise ValueError(
+                f"deep_layers must be >= 0, got {self.deep_layers}"
+            )
+        if self.arrival_trace is not None and self.arrival_mode != "simulated":
+            raise ValueError(
+                "arrival_trace replays a recorded schedule through the "
+                "simulated-arrival trainer; arrival_mode='measured' times "
+                "real arrivals — drop one of the two"
             )
         if self.scan_unroll < 1:
             raise ValueError(
@@ -592,6 +658,13 @@ class RunConfig:
             # trainer._with_run_sparse_lanes; they retrace every jit)
             "sparse_lanes": self.sparse_lanes,
             "dense_margin_cols": self.dense_margin_cols,
+            # per-layer coding + deepmlp depth both change the compiled
+            # step (decode structure / layer count); the raw layer_coding
+            # knob here names the field in recompile-detector warnings —
+            # the trainer keys the RESOLVED choice via
+            # step.lowering_signature
+            "layer_coding": self.layer_coding,
+            "deep_layers": self.deep_layers,
             "sparse_format": self.sparse_format,
             "fields_scatter": self.fields_scatter,
             "fields_margin": self.fields_margin,
@@ -686,6 +759,26 @@ def resolve_batch_trajectories(
         f"batch-trajectories setting must be on/off/auto (or a "
         f"truthy/falsy {BATCH_TRAJECTORIES_ENV} value), got {val!r}"
     )
+
+
+#: env var selecting a recorded arrival-trace file
+#: (parallel/straggler.load_arrival_trace) when the config/CLI flag is
+#: absent — trainer.default_arrivals replays it instead of drawing
+#: i.i.d. exponential delays
+ARRIVAL_TRACE_ENV = "ERASUREHEAD_ARRIVAL_TRACE"
+
+
+def resolve_arrival_trace(
+    flag: Optional[str] = None, env: Optional[str] = None
+) -> Optional[str]:
+    """The arrival-trace path, or None (drawn delays). Precedence mirrors
+    the other sweep knobs: explicit ``--arrival-trace``/cfg value >
+    :data:`ARRIVAL_TRACE_ENV` env var > off. ``env`` overrides the real
+    environment lookup (tests)."""
+    val = flag
+    if val is None:
+        val = env if env is not None else os.environ.get(ARRIVAL_TRACE_ENV)
+    return val or None
 
 
 #: env var enabling the sweep journal (train/journal.py) when no journal

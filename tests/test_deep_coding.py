@@ -1,0 +1,577 @@
+"""Per-layer coded training for deep models (ISSUE 9).
+
+The load-bearing invariants:
+  - the blockwise layer decode (ops/blocks.py + parallel/step.
+    _layer_block_local_body) is BITWISE identical to the monolithic
+    treewise decode over the same per-partition gradient pytrees — for
+    every exact scheme's zero-straggling weights and for arbitrary
+    weights (values are moved, never transformed);
+  - deep-model trajectories under layer_coding="on" match the default
+    monolithic path to float tolerance, sequential and cohort alike (the
+    PR 4 cohort pin, repeated for mlp/attention);
+  - MoE expert shards map to individual coded blocks (the expert is the
+    partition unit of the blockwise decode);
+  - the per-layer gradient-space decode error's cumulative-over-depth
+    curve is monotone non-decreasing (obs/decode.block_decode_error);
+  - the sparse_graph / expander code families decode the exact full
+    gradient at zero straggling (partial decode == full gradient);
+  - trace-driven straggler schedules round-trip through files and the
+    config/env plumbing.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from erasurehead_tpu.data.sharding import partition_stack
+from erasurehead_tpu.data.synthetic import generate_gmm
+from erasurehead_tpu.obs import decode as obs_decode
+from erasurehead_tpu.obs import events as events_lib
+from erasurehead_tpu.ops import blocks as blocks_lib
+from erasurehead_tpu.parallel import collect, step as step_lib, straggler
+from erasurehead_tpu.train import cache, evaluate, trainer
+from erasurehead_tpu.utils.config import RunConfig
+
+W, ROUNDS = 8, 3
+N_ROWS, N_COLS = 256, 64
+
+
+@pytest.fixture(scope="module")
+def gmm():
+    return generate_gmm(N_ROWS, N_COLS, n_partitions=W, seed=0)
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    cache.clear()
+    cache.set_enabled(True)
+    yield
+    cache.clear()
+
+
+def _cfg(**kw):
+    base = dict(
+        scheme="approx",
+        model="mlp",
+        n_workers=W,
+        n_stragglers=1,
+        num_collect=6,
+        rounds=ROUNDS,
+        n_rows=N_ROWS,
+        n_cols=N_COLS,
+        update_rule="GD",
+        lr_schedule=0.1,
+        add_delay=True,
+        compute_mode="deduped",
+        seed=3,
+    )
+    base.update(kw)
+    return RunConfig(**base)
+
+
+def _close(a_tree, b_tree, rtol=5e-4, atol=5e-5):
+    for a, b in zip(jax.tree.leaves(a_tree), jax.tree.leaves(b_tree)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float64), np.asarray(b, np.float64),
+            rtol=rtol, atol=atol,
+        )
+
+
+# ---------------------------------------------------------------------------
+# block tables: round trip + the MoE expert-shard mapping
+
+
+class TestBlockSpec:
+    def test_round_trip_is_exact(self):
+        from erasurehead_tpu.models.deep_mlp import DeepMLPModel
+
+        model = DeepMLPModel(hidden=8, n_layers=3)
+        params = model.init_params(jax.random.key(0), 16)
+        spec = blocks_lib.model_block_spec(model, params)
+        table = blocks_lib.tree_to_blocks(params, spec)
+        assert table.shape == (spec.n_blocks, spec.width)
+        back = blocks_lib.blocks_to_tree(table, spec)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+    def test_deepmlp_layers_are_individual_blocks(self):
+        from erasurehead_tpu.models.deep_mlp import DeepMLPModel
+
+        model = DeepMLPModel(hidden=8, n_layers=5)
+        params = model.init_params(jax.random.key(0), 16)
+        spec = blocks_lib.model_block_spec(model, params)
+        # W [5, H, H] and b [5, H] split per layer; W_in/b_in/w_out/b_out
+        # stay one block each
+        assert spec.n_blocks == 5 + 5 + 4
+
+    def test_moe_expert_shards_are_the_coded_blocks(self):
+        """The MoE partition mapping: every expert-stacked leaf splits
+        along the expert axis, so each expert's gradient shard is its own
+        coded block — one block per (expert, leaf) pair plus the gate."""
+        from erasurehead_tpu.models.moe import MoEModel
+
+        E = 4
+        model = MoEModel(hidden=8, n_experts=E)
+        params = model.init_params(jax.random.key(0), 16)
+        spec = blocks_lib.model_block_spec(model, params)
+        # W1/b1/w2/b2 split per expert (4 leaves x E blocks); Wg/bg whole
+        assert spec.n_blocks == 4 * E + 2
+        leaves, _ = jax.tree_util.tree_flatten_with_path(params)
+        keys = [p[0].key for p, _ in leaves]
+        split_rows = {
+            keys[leaf_idx]: [row for li, row in spec.block_of if li == leaf_idx]
+            for leaf_idx in range(len(keys))
+        }
+        for name in ("W1", "b1", "w2", "b2"):
+            assert split_rows[name] == list(range(E)), name
+        for name in ("Wg", "bg"):
+            assert split_rows[name] == [0], name
+
+    def test_padding_lanes_are_zero(self):
+        from erasurehead_tpu.models.moe import MoEModel
+
+        model = MoEModel(hidden=4, n_experts=2)
+        params = model.init_params(jax.random.key(1), 8)
+        spec = blocks_lib.model_block_spec(model, params)
+        table = np.asarray(blocks_lib.tree_to_blocks(params, spec))
+        for bi, (li, _) in enumerate(spec.block_of):
+            size = spec.sizes_per_leaf[li]
+            assert (table[bi, size:] == 0.0).all()
+
+
+# ---------------------------------------------------------------------------
+# the bitwise pin: blockwise decode == monolithic treewise decode
+
+
+class TestBlockwiseDecodeBitwise:
+    EXACT_SCHEMES = ("naive", "cyccoded", "repcoded")
+
+    @pytest.mark.parametrize("model_name", ["deepmlp", "moe", "attention"])
+    def test_bitwise_at_zero_straggling_across_exact_schemes(
+        self, gmm, model_name
+    ):
+        for scheme in self.EXACT_SCHEMES:
+            cfg = _cfg(scheme=scheme, model=model_name, add_delay=False)
+            lay = trainer.build_layout(cfg)
+            model = trainer.build_model(cfg)
+            params = jax.tree.map(
+                lambda x: x.astype(jnp.float32),
+                model.init_params(jax.random.key(0), N_COLS),
+            )
+            spec = blocks_lib.model_block_spec(model, params)
+            Xp, yp = partition_stack(gmm, lay.n_partitions)
+            per_part = jax.vmap(
+                lambda X, y: model.grad_sum(
+                    params, jnp.asarray(X), jnp.asarray(y)
+                )
+            )(jnp.asarray(Xp), jnp.asarray(yp))
+            sched = collect.build_schedule(
+                cfg.scheme, np.zeros((2, W)), lay,
+                num_collect=cfg.num_collect,
+            )
+            slot_w = np.asarray(
+                step_lib.expand_slot_weights(
+                    sched.message_weights, lay.coeffs,
+                    np.asarray(lay.slot_is_coded),
+                )
+            )
+            pw = jnp.asarray(
+                lay.fold_slot_weights(slot_w)[0], jnp.float32
+            )
+            tree_dec = step_lib._weighted_tree_sum(pw, per_part, "p")
+            table = jax.vmap(
+                lambda g: blocks_lib.tree_to_blocks(g, spec)
+            )(per_part)
+            blk = jnp.einsum(
+                "p,plk->lk", pw.astype(table.dtype), table,
+                precision=lax.Precision.HIGHEST,
+            )
+            blk_dec = blocks_lib.blocks_to_tree(blk, spec)
+            for a, b in zip(
+                jax.tree.leaves(tree_dec), jax.tree.leaves(blk_dec)
+            ):
+                assert (
+                    np.asarray(a).tobytes() == np.asarray(b).tobytes()
+                ), (scheme, model_name)
+
+
+# ---------------------------------------------------------------------------
+# trajectory equivalence: sequential + cohort, across the deep families
+
+
+class TestLayerCodedTrajectories:
+    @pytest.mark.parametrize(
+        "model_name,mode",
+        [
+            ("mlp", "deduped"),
+            ("deepmlp", "faithful"),
+            ("moe", "deduped"),
+            ("attention", "deduped"),
+        ],
+    )
+    def test_layer_on_matches_monolithic_train(self, gmm, model_name, mode):
+        on = trainer.train(
+            _cfg(model=model_name, compute_mode=mode, layer_coding="on"),
+            gmm,
+        )
+        off = trainer.train(
+            _cfg(model=model_name, compute_mode=mode, layer_coding="off"),
+            gmm,
+        )
+        _close(on.params_history, off.params_history)
+        np.testing.assert_array_equal(on.timeset, off.timeset)
+        np.testing.assert_array_equal(on.decode_error, off.decode_error)
+
+    @pytest.mark.parametrize("model_name", ["mlp", "attention"])
+    def test_deep_cohort_matches_sequential_train(self, gmm, model_name):
+        """The PR 4 pin, repeated for the deep families: a cohort member
+        equals its own sequential train() to float tolerance with
+        IDENTICAL control-plane artifacts."""
+        cfgs = [
+            _cfg(model=model_name, scheme=s, seed=sd, layer_coding="on",
+                 **extra)
+            for s, extra in (
+                ("approx", {"num_collect": 6}), ("repcoded", {}),
+            )
+            for sd in (0, 1)
+        ]
+        results = trainer.train_cohort(cfgs, gmm)
+        assert results[0].cache_info["cohort_lowering"] == "layer_block_vmap"
+        for cfg, res in zip(cfgs, results):
+            single = trainer.train(cfg, gmm)
+            _close(res.params_history, single.params_history)
+            np.testing.assert_array_equal(res.timeset, single.timeset)
+            np.testing.assert_array_equal(res.collected, single.collected)
+            np.testing.assert_array_equal(
+                res.decode_error, single.decode_error
+            )
+
+    def test_layer_ring_bitwise_vs_materialized(self, gmm):
+        ring = trainer.train(
+            _cfg(model="mlp", scheme="repcoded", compute_mode="faithful",
+                 stack_mode="ring", layer_coding="on"),
+            gmm,
+        )
+        mat = trainer.train(
+            _cfg(model="mlp", scheme="repcoded", compute_mode="faithful",
+                 stack_mode="materialized", layer_coding="on"),
+            gmm,
+        )
+        for a, b in zip(
+            jax.tree.leaves(ring.params_history),
+            jax.tree.leaves(mat.params_history),
+        ):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+    def test_layer_on_refused_with_forced_lowerings(self):
+        for kw in (
+            {"flat_grad": "on"},
+            {"margin_flat": "on"},
+            {"use_pallas": "on"},
+        ):
+            with pytest.raises(ValueError, match="force at most one"):
+                _cfg(layer_coding="on", **kw)
+        with pytest.raises(ValueError, match="measured"):
+            _cfg(
+                layer_coding="on", arrival_mode="measured",
+                compute_mode="faithful",
+            )
+
+    def test_layer_on_refused_with_model_internal_axes(self, gmm):
+        cfg = _cfg(
+            model="mlp", layer_coding="on", tp_shards=2,
+            compute_mode="faithful",
+        )
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices for a tp mesh")
+        with pytest.raises(ValueError, match="layer_coding"):
+            trainer.train(cfg, generate_gmm(64, 16, n_partitions=4, seed=0))
+
+
+# ---------------------------------------------------------------------------
+# models-shelf pin: every family trains 2 rounds and replays finite
+
+
+@pytest.mark.parametrize("model_name", ["mlp", "deepmlp", "moe", "attention"])
+def test_model_shelf_two_round_smoke(gmm, model_name):
+    cfg = _cfg(model=model_name, rounds=2)
+    res = trainer.train(cfg, gmm)
+    leaves = jax.tree.leaves(res.params_history)
+    assert leaves and all(int(l.shape[0]) == 2 for l in leaves)
+    model = trainer.build_model(cfg)
+    ev = evaluate.replay(
+        model, cfg.model, res.params_history,
+        gmm.X_train[: res.n_train], gmm.y_train[: res.n_train],
+        gmm.X_test, gmm.y_test,
+    )
+    assert np.isfinite(np.asarray(ev.training_loss)).all()
+
+
+def test_deep_layers_knob_sets_depth(gmm):
+    cfg = _cfg(model="deepmlp", deep_layers=6)
+    model = trainer.build_model(cfg)
+    assert model.n_layers == 6
+    assert trainer.build_model(_cfg(model="deepmlp")).n_layers == 4
+    with pytest.raises(ValueError, match="deep_layers"):
+        _cfg(deep_layers=-1)
+
+
+# ---------------------------------------------------------------------------
+# decode-error-vs-depth telemetry
+
+
+class TestDecodeErrorVsDepth:
+    def _depth_errors(self, gmm, depth):
+        cfg = _cfg(
+            model="deepmlp", deep_layers=depth, layer_coding="on",
+            num_collect=5, rounds=4,
+        )
+        res = trainer.train(cfg, gmm)
+        model = trainer.build_model(cfg)
+        spec = blocks_lib.model_block_spec(
+            model, model.init_params(jax.random.key(0), N_COLS)
+        )
+        Xp, yp = partition_stack(gmm, res.layout.n_partitions)
+        table = blocks_lib.partition_block_table(
+            model, spec, res.final_params, Xp, yp
+        )
+        sched = collect.build_schedule(
+            cfg.scheme, trainer.default_arrivals(cfg), res.layout,
+            num_collect=cfg.num_collect,
+        )
+        return res, obs_decode.block_decode_error(
+            res.layout, sched.message_weights, table
+        )
+
+    def test_cumulative_error_monotone_in_depth_under_straggling(self, gmm):
+        res, errs = self._depth_errors(gmm, depth=6)
+        # genuinely approximate rounds exist (AGC erasures under delays)
+        assert (errs["per_block"] > 0).any()
+        cum = errs["cumulative"]
+        assert cum.shape[1] == 6 + 6 + 4
+        # monotone non-decreasing along the depth axis, every round
+        assert (np.diff(cum, axis=1) >= -1e-12).all()
+
+    def test_exact_rounds_snap_to_zero(self, gmm):
+        cfg = _cfg(
+            model="deepmlp", scheme="cyccoded", layer_coding="on",
+            add_delay=False, rounds=2,
+        )
+        res = trainer.train(cfg, gmm)
+        model = trainer.build_model(cfg)
+        spec = blocks_lib.model_block_spec(
+            model, model.init_params(jax.random.key(0), N_COLS)
+        )
+        Xp, yp = partition_stack(gmm, res.layout.n_partitions)
+        table = blocks_lib.partition_block_table(
+            model, spec, res.final_params, Xp, yp
+        )
+        sched = collect.build_schedule(
+            cfg.scheme, np.zeros((2, W)), res.layout
+        )
+        errs = obs_decode.block_decode_error(
+            res.layout, sched.message_weights, table
+        )
+        assert (errs["per_block"] == 0.0).all()
+        assert (errs["cumulative"] == 0.0).all()
+
+    def test_layer_tagged_decode_events_validate(self, gmm, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        res, errs = self._depth_errors(gmm, depth=2)
+        with events_lib.capture(path):
+            run_id = events_lib.new_run_id()
+            events_lib.emit_layer_decode_chunks(
+                run_id, errs["per_block"], trajectory="t0"
+            )
+        assert events_lib.validate_file(path) == []
+        recs = [json.loads(l) for l in open(path) if l.strip()]
+        layers = {r["layer"] for r in recs if r["type"] == "decode"}
+        assert layers == set(range(errs["per_block"].shape[1]))
+
+    def test_validator_rejects_bad_layer_tag(self):
+        lines = [
+            json.dumps(
+                {
+                    "type": "decode", "seq": 0, "t": 0.0, "run_id": "r",
+                    "first_round": 0, "n_rounds": 1, "error_mean": 0.0,
+                    "error_max": 0.0, "exact": True, "layer": -2,
+                }
+            )
+        ]
+        errors = events_lib.validate_lines(lines)
+        assert any("layer" in e for e in errors)
+
+
+# ---------------------------------------------------------------------------
+# the new code families
+
+
+class TestNewCodeFamilies:
+    @pytest.mark.parametrize("scheme", ["sparsegraph", "expander"])
+    def test_partial_decode_equals_full_gradient_at_zero_straggling(
+        self, scheme
+    ):
+        """The standard zero-straggling pin: with every message collected
+        the lstsq decode reproduces the exact full gradient (fold
+        weights == all-ones, decode error exactly 0)."""
+        for Wn, s in ((12, 2), (8, 1), (30, 3)):
+            cfg = RunConfig(
+                scheme=scheme, n_workers=Wn, n_stragglers=s,
+                num_collect=Wn, rounds=2, n_rows=Wn * 8, n_cols=16,
+                update_rule="GD", lr_schedule=0.1, add_delay=False,
+            )
+            lay = trainer.build_layout(cfg)
+            # every partition has degree exactly s+1
+            E = lay.effective_matrix()
+            np.testing.assert_array_equal(E.sum(axis=0), s + 1)
+            sched = collect.build_schedule(
+                cfg.scheme, np.zeros((3, Wn)), lay, num_collect=Wn
+            )
+            err = obs_decode.decode_error_series(
+                lay, sched.message_weights
+            )
+            assert (err == 0.0).all(), (scheme, Wn, s)
+
+    def test_registry_flags_and_config_surface(self):
+        from erasurehead_tpu import schemes
+
+        for name in ("sparsegraph", "expander"):
+            desc = schemes.get(name)
+            assert desc.builtin
+            assert desc.needs_num_collect
+            assert desc.cohort_batchable
+            assert desc.optimal_decode is not None
+            assert desc.sweep_num_collect(30) == 15
+            with pytest.raises(ValueError, match="num_collect"):
+                desc.build_schedule(
+                    np.zeros((1, 8)), trainer.build_layout(
+                        RunConfig(scheme=name, n_workers=8, n_stragglers=1)
+                    ),
+                )
+        assert schemes.get("sparsegraph").seed_dependent_layout is True
+        assert schemes.get("expander").seed_dependent_layout is False
+        # expander layouts are seed-free: one stack for a whole seed sweep
+        a = trainer.build_layout(
+            RunConfig(scheme="expander", n_workers=8, n_stragglers=1, seed=0)
+        )
+        b = trainer.build_layout(
+            RunConfig(scheme="expander", n_workers=8, n_stragglers=1, seed=9)
+        )
+        np.testing.assert_array_equal(a.assignment, b.assignment)
+
+    def test_sparse_graph_ragged_loads_pad_with_zero_coeffs(self):
+        lay = trainer.build_layout(
+            RunConfig(
+                scheme="sparsegraph", n_workers=16, n_stragglers=2,
+                num_collect=16, seed=5,
+            )
+        )
+        coeffs = np.asarray(lay.coeffs)
+        # padded slots exist (ragged worker loads) and contribute nothing
+        assert (coeffs == 0.0).any()
+        assert ((coeffs == 0.0) | (coeffs == 1.0)).all()
+
+    @pytest.mark.parametrize("scheme", ["sparsegraph", "expander"])
+    def test_trains_and_cohorts(self, gmm, scheme):
+        cfg = _cfg(scheme=scheme, model="logistic", num_collect=6)
+        res = trainer.train(cfg, gmm)
+        assert np.isfinite(
+            np.asarray(jax.tree.leaves(res.final_params)[0])
+        ).all()
+        assert (res.decode_error >= 0).all()
+        batch = trainer.train_cohort([cfg], gmm)
+        _close(batch[0].params_history, res.params_history, rtol=2e-5,
+               atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# trace-driven stragglers
+
+
+class TestArrivalTraces:
+    def test_file_round_trip_and_tiling(self, tmp_path):
+        rng = np.random.default_rng(0)
+        trace = rng.exponential(0.5, (4, W))
+        path = str(tmp_path / "trace.npy")
+        np.save(path, trace)
+        out = straggler.arrival_schedule(
+            10, W, add_delay=True, trace=path
+        )
+        np.testing.assert_array_equal(out[:4], trace)
+        np.testing.assert_array_equal(out[4:8], trace)  # tiled
+        np.testing.assert_array_equal(out[8:], trace[:2])
+        # csv round trip
+        cpath = str(tmp_path / "trace.csv")
+        np.savetxt(cpath, trace, delimiter=",")
+        out_csv = straggler.arrival_schedule(4, W, False, trace=cpath)
+        np.testing.assert_allclose(out_csv, trace, rtol=1e-12)
+
+    def test_speed_multiplier_scales_rows(self):
+        trace = np.ones((2, 4))
+        speed = np.array([1.0, 2.0, 0.5, 1.0])
+        out = straggler.arrival_schedule(
+            2, 4, False, trace=trace, trace_speed=speed
+        )
+        np.testing.assert_array_equal(out, np.tile(speed, (2, 1)))
+
+    def test_shape_and_value_validation(self, tmp_path):
+        with pytest.raises(ValueError, match="workers"):
+            straggler.replay_arrival_trace(np.ones((2, 3)), 4, 8)
+        with pytest.raises(ValueError, match="negative"):
+            straggler.load_arrival_trace(-np.ones((2, 3)))
+        with pytest.raises(ValueError, match="non-empty"):
+            straggler.load_arrival_trace(np.zeros((0, 3)))
+
+    def test_config_and_env_plumbing(self, tmp_path, monkeypatch):
+        from erasurehead_tpu.utils.config import ARRIVAL_TRACE_ENV
+
+        trace = np.full((2, W), 0.25)
+        path = str(tmp_path / "t.npy")
+        np.save(path, trace)
+        cfg = _cfg(arrival_trace=path)
+        arr = trainer.default_arrivals(cfg)
+        assert arr.shape == (ROUNDS, W)
+        np.testing.assert_array_equal(arr[:2], trace)
+        # env var kicks in when the config field is unset
+        monkeypatch.setenv(ARRIVAL_TRACE_ENV, path)
+        arr_env = trainer.default_arrivals(_cfg())
+        np.testing.assert_array_equal(arr_env[:2], trace)
+        monkeypatch.delenv(ARRIVAL_TRACE_ENV)
+        # worker_speed_spread composes as a seeded multiplier on the rows
+        cfg_s = _cfg(arrival_trace=path, worker_speed_spread=0.5)
+        arr_s = trainer.default_arrivals(cfg_s)
+        rng = np.random.default_rng(cfg_s.seed + 10_007)
+        speed = rng.uniform(0.5, 1.5, W)
+        np.testing.assert_allclose(arr_s[0], trace[0] * speed, rtol=1e-12)
+
+    def test_trace_trains_end_to_end(self, gmm, tmp_path):
+        path = str(tmp_path / "t.npy")
+        np.save(path, np.random.default_rng(1).exponential(0.5, (ROUNDS, W)))
+        res = trainer.train(_cfg(arrival_trace=path, scheme="deadline",
+                                 deadline=1.0), gmm)
+        assert res.sim_total_time > 0
+
+    def test_measured_mode_refuses_traces(self):
+        with pytest.raises(ValueError, match="measured"):
+            _cfg(
+                arrival_trace="x.npy", arrival_mode="measured",
+                compute_mode="faithful",
+            )
+
+    def test_cli_flag_reaches_config(self):
+        from erasurehead_tpu import cli as cli_lib
+
+        ns = cli_lib._flags_parser().parse_args(
+            ["--arrival-trace", "/tmp/t.npy", "--layer-coding", "on",
+             "--deep-layers", "5", "--model", "deepmlp"]
+        )
+        cfg = cli_lib._flags_to_config(ns)
+        assert cfg.arrival_trace == "/tmp/t.npy"
+        assert cfg.layer_coding == "on"
+        assert cfg.deep_layers == 5

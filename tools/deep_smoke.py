@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Smoke-check the deep-model coded-training fast path on CPU
+(`make deep-smoke`).
+
+Drives a W=8 ATTENTION cohort end-to-end through the trajectory-batched
+engine with per-layer (blockwise) gradient coding forced on, then
+asserts the deep-path contract:
+
+  - the whole 2-scheme x 2-seed attention cohort runs as ONE compiled
+    dispatch (cohort.dispatches counter; lowering = layer_block_vmap);
+  - the blockwise layer decode is BITWISE identical to the monolithic
+    treewise decode over the same per-partition gradient pytrees, on the
+    cohort's own first-round collection weights;
+  - cohort trajectories match sequential train() of the same configs to
+    float tolerance (reduction order only);
+  - the events.jsonl — cohort record, per-trajectory round/decode
+    streams, and a layer-tagged decode-error-vs-depth series
+    (obs/events.emit_layer_decode_chunks) — passes the schema check.
+
+Exit 0 = all assertions hold; 1 = failure (printed).
+"""
+
+import os
+import sys
+import tempfile
+
+# runnable from anywhere without an install (the tools/ convention)
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU relay
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from erasurehead_tpu.data.sharding import partition_stack
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.obs import decode as obs_decode
+    from erasurehead_tpu.obs import events as events_lib
+    from erasurehead_tpu.obs.metrics import REGISTRY
+    from erasurehead_tpu.ops import blocks as blocks_lib
+    from erasurehead_tpu.parallel import collect, step as step_lib
+    from erasurehead_tpu.train import cache, trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    W, rounds = 8, 4
+    n_rows, n_cols = 128, 32  # n_cols % d_in == 0 (rows -> token sequences)
+    data = generate_gmm(n_rows, n_cols, n_partitions=W, seed=0)
+    common = dict(
+        model="attention", n_workers=W, n_stragglers=1, rounds=rounds,
+        n_rows=n_rows, n_cols=n_cols, update_rule="GD", lr_schedule=0.1,
+        add_delay=True, compute_mode="deduped", layer_coding="on",
+    )
+    cfgs = [
+        RunConfig(**{**common, "scheme": s, "seed": sd, **extra})
+        for s, extra in (("approx", {"num_collect": 6}), ("repcoded", {}))
+        for sd in (0, 1)
+    ]
+
+    cache.clear()
+    for name in ("cohort.dispatches", "cohort.trajectories"):
+        REGISTRY.counter(name).reset()
+    events_path = os.path.join(
+        tempfile.mkdtemp(prefix="eh-deep-smoke-"), "events.jsonl"
+    )
+    failures = []
+    with events_lib.capture(events_path):
+        results = trainer.train_cohort(cfgs, data)
+        # layer-tagged decode-error-vs-depth series from the first
+        # trajectory's own partition gradient blocks at its final params
+        res = results[0]
+        model = trainer.build_model(res.config)
+        params0 = model.init_params(jax.random.key(res.config.seed), n_cols)
+        spec = blocks_lib.model_block_spec(model, params0)
+        Xp, yp = partition_stack(data, res.layout.n_partitions)
+        table = blocks_lib.partition_block_table(
+            model, spec, res.final_params, Xp, yp
+        )
+        sched = collect.build_schedule(
+            res.config.scheme, trainer.default_arrivals(res.config),
+            res.layout, num_collect=res.config.num_collect,
+            deadline=res.config.deadline, decode=res.config.decode,
+        )
+        errs = obs_decode.block_decode_error(
+            res.layout, sched.message_weights, table
+        )
+        events_lib.emit_layer_decode_chunks(
+            res.run_id, errs["per_block"], trajectory="smoke"
+        )
+
+    # ---- one dispatch, blockwise lowering
+    dispatches = REGISTRY.counter("cohort.dispatches").value
+    if dispatches != 1:
+        failures.append(f"cohort.dispatches={dispatches}, expected 1")
+    lowering = results[0].cache_info.get("cohort_lowering")
+    if lowering != "layer_block_vmap":
+        failures.append(f"cohort_lowering={lowering!r}, expected layer_block_vmap")
+
+    # ---- bitwise layer-decode pin: blockwise einsum == treewise decode
+    # over the SAME per-partition gradient pytrees, on the cohort's own
+    # first-round fold weights
+    per_part = jax.vmap(
+        lambda X, y: model.grad_sum(
+            jax.tree.map(jnp.asarray, res.final_params),
+            jnp.asarray(X), jnp.asarray(y),
+        )
+    )(jnp.asarray(Xp), jnp.asarray(yp))
+    slot_w = np.asarray(
+        step_lib.expand_slot_weights(
+            sched.message_weights, res.layout.coeffs,
+            np.asarray(res.layout.slot_is_coded),
+        )
+    )
+    pw = jnp.asarray(res.layout.fold_slot_weights(slot_w)[0], jnp.float32)
+    tree_dec = step_lib._weighted_tree_sum(pw, per_part, "p")
+    tbl = jax.vmap(lambda g: blocks_lib.tree_to_blocks(g, spec))(per_part)
+    blk_dec = blocks_lib.blocks_to_tree(
+        jnp.einsum(
+            "p,plk->lk", pw.astype(tbl.dtype), tbl,
+            precision=lax.Precision.HIGHEST,
+        ),
+        spec,
+    )
+    for a, b in zip(jax.tree.leaves(tree_dec), jax.tree.leaves(blk_dec)):
+        if np.asarray(a).tobytes() != np.asarray(b).tobytes():
+            failures.append(
+                "blockwise layer decode != treewise decode bitwise"
+            )
+            break
+
+    # ---- cohort trajectories match sequential train()
+    for cfg, r in zip(cfgs, results):
+        single = trainer.train(cfg, data)
+        for a, b in zip(
+            jax.tree.leaves(r.params_history),
+            jax.tree.leaves(single.params_history),
+        ):
+            if not np.allclose(
+                np.asarray(a, np.float64), np.asarray(b, np.float64),
+                rtol=5e-4, atol=5e-5,
+            ):
+                failures.append(
+                    f"cohort trajectory {cfg.scheme.value}/s{cfg.seed} "
+                    "drifted from sequential train()"
+                )
+                break
+
+    # ---- events validate, layer tags present
+    schema_errors = events_lib.validate_file(events_path)
+    failures.extend(f"events schema: {e}" for e in schema_errors)
+    import json as json_lib
+
+    with open(events_path) as f:
+        recs = [json_lib.loads(line) for line in f if line.strip()]
+    layers = {r.get("layer") for r in recs if r["type"] == "decode"}
+    layers.discard(None)
+    if len(layers) != spec.n_blocks:
+        failures.append(
+            f"expected {spec.n_blocks} layer-tagged decode streams, got "
+            f"{sorted(layers)}"
+        )
+
+    print(
+        f"deep-smoke: {len(cfgs)} attention trajectories -> "
+        f"{dispatches} dispatch ({lowering}); {spec.n_blocks} coded "
+        f"blocks; mean per-block decode error "
+        f"{float(np.mean(errs['per_block'])):.4f}"
+    )
+    print(f"events -> {events_path}")
+    for f in failures:
+        print(f"FAIL: {f}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

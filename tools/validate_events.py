@@ -5,7 +5,10 @@ Thin CLI over erasurehead_tpu.obs.events.validate_file — the validation
 logic lives in the package so the tests, `make telemetry-smoke`, and this
 tool can never drift. Checks: every line parses, record types are known,
 required keys are present, seq is monotonic per logger, chunked
-rounds/decode records have strictly increasing round indices per run,
+rounds/decode records have strictly increasing round indices per
+(run, trajectory, layer) stream — the optional `layer` tag (a
+non-negative int) marks a per-layer decode-error-vs-depth series under
+blockwise gradient coding (obs/events.emit_layer_decode_chunks) —,
 sweep_trajectory journal records (train/journal.py) carry a known status
 ("ok"/"diverged"), a non-empty key and an object row, serve-daemon
 records (erasurehead_tpu/serve/) are internally consistent (`request`
