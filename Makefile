@@ -41,7 +41,7 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 .PHONY: naive cyccoded repcoded avoidstragg approxcoded \
 	partialrepcoded partialcyccoded randreg deadline \
 	generate_random_data arrange_real_data \
-	test tier1 bench sweep rehearse watch compare real_data dryrun \
+	test lint tier1 bench sweep rehearse watch compare real_data dryrun \
 	telemetry-smoke sweep-batch-smoke chaos-smoke roofline-smoke \
 	serve-smoke adapt-smoke deep-smoke clean
 
@@ -89,7 +89,10 @@ real_data:        ## canonical comparison on genuinely real (UCI) data
 test:
 	$(PY) -m pytest tests/ -x -q
 
-tier1:            ## the ROADMAP tier-1 verify line (what CI gates on)
+lint:             ## AST invariant analyzer (erasurehead_tpu/analysis/): trace/cache/telemetry contracts
+	$(PY) -m erasurehead_tpu.analysis --strict erasurehead_tpu/ tools/
+
+tier1: lint       ## the ROADMAP tier-1 verify line (what CI gates on)
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 		-m 'not slow' --continue-on-collection-errors \

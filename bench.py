@@ -1120,6 +1120,33 @@ def child() -> None:
         except Exception as e:  # noqa: BLE001 — extras must never kill bench
             print(f"bench: fidelity extra failed: {e}", file=sys.stderr)
 
+    # ---- lint extra: the AST invariant analyzer rides the tier-1 loop -----
+    # (erasurehead_tpu/analysis/), so its wall time is a budgeted quantity:
+    # the full-tree run must stay under 5 s on CPU (lint_budget_ok)
+    lint_extra = {}
+    try:
+        from erasurehead_tpu.analysis import runner as lint_runner
+
+        pkg_dir = os.path.dirname(
+            os.path.abspath(lint_runner.__file__)
+        )
+        tree = os.path.dirname(pkg_dir)  # erasurehead_tpu/
+        t_lint = time.perf_counter()
+        lint_report = lint_runner.lint_paths([tree])
+        lint_wall = time.perf_counter() - t_lint
+        lint_extra = {
+            "lint": {
+                "wall_s": round(lint_wall, 4),
+                "budget_s": 5.0,
+                "lint_budget_ok": lint_wall < 5.0,
+                "files": lint_report.n_files,
+                "findings": len(lint_report.unsuppressed),
+                "suppressed": len(lint_report.suppressed),
+            }
+        }
+    except Exception as e:  # noqa: BLE001 — extras must never kill bench
+        print(f"bench: lint extra failed: {e}", file=sys.stderr)
+
     # ---- telemetry extra: the same fields the event log carries -----------
     telemetry_extra = {}
     try:
@@ -1224,6 +1251,7 @@ def child() -> None:
                 **serve_extra,
                 **adapt_extra,
                 **fidelity_extra,
+                **lint_extra,
                 **telemetry_extra,
             }
         )

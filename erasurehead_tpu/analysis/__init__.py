@@ -1,0 +1,46 @@
+"""Static analysis for the framework's trace/cache/telemetry contracts.
+
+``erasurehead-tpu lint [paths]`` (or ``python -m
+erasurehead_tpu.analysis``) runs five AST checkers over the tree — no
+imports of the checked code, no jax, sub-second on the full package:
+
+  =======================  ==============================================
+  checker                  contract enforced
+  =======================  ==============================================
+  trace-purity             no host effects (emit, metrics, clocks, host
+                           RNG, print/file I/O) reachable from bodies
+                           traced by jit / lax.scan / shard_map (the PR 3
+                           observation-only contract)
+  signature-completeness   every RunConfig field a jitted closure reads
+                           is in static_signature_fields() — the PR 2
+                           exec-cache-collision class
+  registry-dispatch        no hard-coded scheme comparisons, lookup
+                           tables, or match-dispatch outside
+                           erasurehead_tpu/schemes/ (the PR 8 registry
+                           contract; AST-grade successor of the grep
+                           test)
+  event-schema             every emit() call site carries the fields
+                           obs/events.SCHEMA requires; SCHEMA, the
+                           validator, and tools/validate_events.py
+                           cannot drift apart
+  donation-safety          values at donate_argnums positions are never
+                           read after the donating call (the PR 6
+                           _donate_copy class)
+  =======================  ==============================================
+
+Violations fail tier-1 (tests/test_analysis.py pins the shipped tree at
+zero unsuppressed findings). Intentional exceptions are whitelisted in
+place with ``# lint: allow(<checker>): <reason>`` (line) or ``# lint:
+allow-file(<checker>): <reason>`` (file); a suppression without a reason
+is itself a finding, and ``lint --strict`` reports suppression counts
+per checker.
+"""
+
+from erasurehead_tpu.analysis.core import Finding, SourceModule  # noqa: F401
+from erasurehead_tpu.analysis.runner import (  # noqa: F401
+    CHECKERS,
+    LintContext,
+    LintReport,
+    lint_paths,
+    main,
+)

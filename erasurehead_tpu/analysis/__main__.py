@@ -1,0 +1,10 @@
+"""``python -m erasurehead_tpu.analysis [paths]`` — the lint CLI without
+the full console entry point (no jax import on this path; the Makefile's
+``lint`` target uses it so the tier-1 loop pays AST-walk time only)."""
+
+import sys
+
+from erasurehead_tpu.analysis.runner import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,494 @@
+"""Shared AST infrastructure for the `erasurehead-tpu lint` checkers.
+
+The framework's correctness rests on a handful of contracts that no type
+system sees: jitted closures must not read config fields outside the
+executable-cache signature (the PR 2 exec-cache-collision class), telemetry
+emission must stay host-side and outside jit (the PR 3 observation-only
+contract), scheme dispatch must go through the registry (PR 8), event
+payloads must match obs/events.SCHEMA, and donated buffers must not be read
+after the donating call (the PR 6 ``_donate_copy`` class). Each checker in
+this package enforces one of those contracts by walking module ASTs — no
+imports of the checked code, no jax, so the whole tree lints in well under
+a second and rides inside the tier-1 loop.
+
+This module provides what every checker needs:
+
+  - :class:`SourceModule` — one parsed file: AST, lexical scopes
+    (module / class / function) with statement-level def indexing, import
+    aliases, and suppression comments;
+  - traced-call-graph resolution (:func:`traced_functions`) — find the
+    function bodies passed to ``jax.jit`` / ``lax.scan`` / ``shard_map``
+    (as arguments, decorators, or through ``partial``) and the local
+    functions reachable from them by direct call;
+  - :func:`dotted` — render a callee/attribute chain as a dotted string
+    ("obs_events.emit", "REGISTRY.counter().inc") for pattern matching;
+  - suppression handling — ``# lint: allow(<checker>): <reason>`` on (or
+    directly above) a line, ``# lint: allow-file(<checker>): <reason>``
+    anywhere for the whole file. A suppression without a reason string is
+    itself a finding: every whitelisted exception must say why.
+
+Static resolution is deliberately conservative: a callee that is a local
+``def`` (or a ``self.`` method of the enclosing class) is followed;
+callables passed in as VALUES (``grad_fn`` arguments, closures bound by
+assignment) are not — the factories that build them register their own
+``shard_map``/``jit`` entries, so their bodies are still covered where
+they are defined.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from typing import Iterable, Iterator, Optional
+
+#: callables whose first argument (or decorated function) becomes a traced
+#: computation — the roots of the traced call graph
+JIT_NAMES = frozenset({"jax.jit", "jit", "pjit", "jax.pjit"})
+SCAN_NAMES = frozenset({"jax.lax.scan", "lax.scan"})
+SHARD_MAP_NAMES = frozenset(
+    {"shard_map", "jax.shard_map", "jax.experimental.shard_map.shard_map"}
+)
+TRACING_NAMES = JIT_NAMES | SCAN_NAMES | SHARD_MAP_NAMES
+PARTIAL_NAMES = frozenset({"partial", "functools.partial"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One checker hit. Sort order = report order (deterministic)."""
+
+    checker: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    suppress_reason: Optional[str] = None
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.checker, self.message)
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"[{self.checker}]{tag} {self.message}"
+        )
+
+
+#: suppression comment grammar (module docstring). The reason after ":" is
+#: REQUIRED — an unexplained whitelist entry is a finding of its own.
+_ALLOW_RE = re.compile(
+    r"#\s*lint:\s*allow(?P<scope>-file)?\(\s*(?P<checker>[A-Za-z0-9_-]+)\s*\)"
+    r"(?:\s*:\s*(?P<reason>\S.*?))?\s*$"
+)
+
+
+@dataclasses.dataclass
+class Suppressions:
+    """Parsed ``# lint: allow(...)`` comments of one file."""
+
+    #: checker -> (line, reason) of a file-wide allow
+    file_allows: dict
+    #: (line, checker) -> reason; a comment-only line also covers line + 1
+    line_allows: dict
+    #: malformed / reason-less suppression comments -> Finding list
+    problems: list
+
+    def lookup(self, checker: str, line: int):
+        """(suppressed?, reason) for a finding of ``checker`` at ``line``."""
+        if checker in self.file_allows:
+            return True, self.file_allows[checker][1]
+        for ln in (line, line - 1):
+            reason = self.line_allows.get((ln, checker))
+            if reason is not None:
+                return True, reason
+        return False, None
+
+
+class Scope:
+    """One lexical scope: module, class body, or function body.
+
+    ``functions``/``classes`` index statement-level defs (including defs
+    nested inside if/for/while/with/try blocks, which are still
+    statement-level bindings at runtime)."""
+
+    def __init__(self, node, parent: Optional["Scope"]):
+        self.node = node
+        self.parent = parent
+        self.functions: dict = {}
+        self.classes: dict = {}
+        #: name -> value expr of statement-level ``name = <expr>`` binds
+        #: (callable-tracking only: lambdas, factory calls, aliases)
+        self.assigns: dict = {}
+
+    def is_class(self) -> bool:
+        return isinstance(self.node, ast.ClassDef)
+
+    def resolve_function(self, name: str):
+        """Resolve a bare callee name lexically. Class scopes are skipped
+        (Python name resolution skips them; methods need ``self.``)."""
+        scope = self
+        while scope is not None:
+            if not scope.is_class() and name in scope.functions:
+                return scope.functions[name]
+            scope = scope.parent
+        return None
+
+    def resolve_method(self, name: str):
+        """Resolve ``self.<name>`` against the nearest enclosing class."""
+        scope = self
+        while scope is not None:
+            if scope.is_class():
+                return scope.functions.get(name)
+            scope = scope.parent
+        return None
+
+    def nearest_function_scope(self) -> Optional["Scope"]:
+        scope = self
+        while scope is not None and not isinstance(
+            scope.node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            scope = scope.parent
+        return scope
+
+
+def _index_statements(body, scope: Scope) -> None:
+    """Register statement-level function/class defs of ``body`` into
+    ``scope``, descending into compound statements but not into nested
+    function/class bodies (those open their own scopes)."""
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scope.functions[stmt.name] = stmt
+        elif isinstance(stmt, ast.ClassDef):
+            scope.classes[stmt.name] = stmt
+        elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and (
+            isinstance(stmt.targets[0], ast.Name)
+        ):
+            scope.assigns[stmt.targets[0].id] = stmt.value
+        elif isinstance(stmt, (ast.If, ast.For, ast.AsyncFor, ast.While)):
+            _index_statements(stmt.body, scope)
+            _index_statements(stmt.orelse, scope)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            _index_statements(stmt.body, scope)
+        elif isinstance(stmt, ast.Try):
+            _index_statements(stmt.body, scope)
+            for handler in stmt.handlers:
+                _index_statements(handler.body, scope)
+            _index_statements(stmt.orelse, scope)
+            _index_statements(stmt.finalbody, scope)
+
+
+def dotted(node) -> Optional[str]:
+    """Render a Name/Attribute/Call chain as a dotted string, or None.
+
+    Calls in the middle of a chain render as ``()``:
+    ``REGISTRY.counter("x").inc`` -> ``"REGISTRY.counter().inc"`` — so
+    suffix patterns like ``.inc`` still match through builder chains."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return None if base is None else f"{base}.{node.attr}"
+    if isinstance(node, ast.Call):
+        base = dotted(node.func)
+        return None if base is None else f"{base}()"
+    return None
+
+
+def walk_own(node) -> Iterator[ast.AST]:
+    """Yield ``node`` and descendants, NOT descending into nested
+    function/class definitions (they are separate traced-or-not units);
+    lambdas ARE descended into (an inline lambda in a traced body runs
+    traced)."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            stack.append(child)
+
+
+class SourceModule:
+    """One parsed source file plus the derived indexes checkers share."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.module_scope = Scope(self.tree, None)
+        #: ast function/class node -> its own Scope
+        self.scopes: dict = {id(self.tree): self.module_scope}
+        #: function node -> the Scope it was DEFINED in (for resolution)
+        self.def_scope: dict = {}
+        self._build_scopes(self.tree, self.module_scope)
+        self.events_aliases, self.imported_modules, self.emit_is_events = (
+            self._scan_imports()
+        )
+        self.suppressions = parse_suppressions(path, source)
+        self._traced = None
+
+    # ---- scopes ----------------------------------------------------------
+
+    def _build_scopes(self, node, scope: Scope) -> None:
+        body = getattr(node, "body", None)
+        if isinstance(body, list):
+            _index_statements(body, scope)
+        for fn in list(scope.functions.values()) + list(
+            scope.classes.values()
+        ):
+            child = Scope(fn, scope)
+            self.scopes[id(fn)] = child
+            self.def_scope[id(fn)] = scope
+            self._build_scopes(fn, child)
+
+    def scope_of(self, fn_node) -> Scope:
+        return self.scopes.get(id(fn_node), self.module_scope)
+
+    # ---- imports ---------------------------------------------------------
+
+    def _scan_imports(self):
+        """(events-module aliases, top-level imported module names,
+        bare-``emit``-is-events?) — the schema checker's resolution inputs
+        and the purity checker's stdlib-``random`` disambiguator."""
+        events_aliases = set()
+        modules = set()
+        emit_is_events = False
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    modules.add(alias.asname or alias.name.split(".")[0])
+                    if alias.name == "erasurehead_tpu.obs.events":
+                        events_aliases.add(alias.asname or "erasurehead_tpu")
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    if mod.endswith("obs") and alias.name == "events":
+                        events_aliases.add(bound)
+                    if mod.endswith("obs.events") and alias.name == "emit":
+                        emit_is_events = True
+        return events_aliases, modules, emit_is_events
+
+    # ---- traced call graph ----------------------------------------------
+
+    def traced_functions(self) -> dict:
+        """Map of traced function/lambda nodes -> entry description.
+
+        Roots: callables passed to jit/scan/shard_map (directly or through
+        ``partial``) and functions decorated with jit (bare, called, or
+        partial-wrapped). From each root, local functions reachable by
+        direct call (bare name or ``self.`` method) are traced too."""
+        if self._traced is not None:
+            return self._traced
+        roots: dict = {}
+
+        def note(target, scope, why):
+            for fn in self.callable_defs(target, scope):
+                roots.setdefault(id(fn), (fn, why))
+
+        def visit(node, scope):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_jit_expr(dec):
+                        roots.setdefault(
+                            id(node),
+                            (node, f"@{dotted(dec) or 'jit'} line {node.lineno}"),
+                        )
+                scope = self.scope_of(node)
+            elif isinstance(node, ast.Lambda):
+                fn_scope = Scope(node, scope)
+                self.scopes[id(node)] = fn_scope
+                scope = fn_scope
+            elif isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if name in TRACING_NAMES and node.args:
+                    note(node.args[0], scope, f"{name} line {node.lineno}")
+            for child in ast.iter_child_nodes(node):
+                visit(child, scope)
+
+        visit(self.tree, self.module_scope)
+
+        # transitive closure over locally-resolvable calls
+        traced: dict = {}
+        queue = list(roots.values())
+        while queue:
+            fn, why = queue.pop()
+            if id(fn) in traced:
+                continue
+            traced[id(fn)] = (fn, why)
+            scope = self.scope_of(fn)
+            for node in walk_own(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                for callee in self.call_targets(node, scope):
+                    if id(callee) not in traced:
+                        queue.append((callee, why))
+        self._traced = traced
+        return traced
+
+    # ---- callable resolution ---------------------------------------------
+
+    def callable_defs(self, expr, scope: Scope, _seen=None) -> list:
+        """Resolve a callable EXPRESSION to the local function/lambda
+        definitions it may denote. Follows: bare names (defs, and simple
+        ``name = <expr>`` rebinds), ``self.`` methods, ``partial(f, ...)``,
+        ``a or b`` / ternary alternatives, and — the factory idiom the
+        step/trainer modules are built on — CALLS of local factories,
+        resolving to whatever the factory ``return``s plus any callable
+        arguments threaded through it (``shard_map(_dq(_body(model)))``
+        traces the wrapper AND the wrapped body)."""
+        if _seen is None:
+            _seen = set()
+        key = id(expr)
+        if key in _seen or expr is None:
+            return []
+        _seen.add(key)
+        if isinstance(expr, ast.Lambda):
+            return [expr]
+        if isinstance(expr, ast.Name):
+            fn = scope.resolve_function(expr.id)
+            if fn is not None:
+                return [fn]
+            # simple value bind: follow the bound expression lexically
+            s = scope
+            while s is not None:
+                if not s.is_class() and expr.id in s.assigns:
+                    return self.callable_defs(
+                        s.assigns[expr.id], s, _seen
+                    )
+                s = s.parent
+            return []
+        if isinstance(expr, ast.Attribute):
+            if dotted(expr.value) == "self":
+                fn = scope.resolve_method(expr.attr)
+                return [fn] if fn is not None else []
+            return []
+        if isinstance(expr, ast.BoolOp):
+            out = []
+            for v in expr.values:
+                out += self.callable_defs(v, scope, _seen)
+            return out
+        if isinstance(expr, ast.IfExp):
+            return self.callable_defs(
+                expr.body, scope, _seen
+            ) + self.callable_defs(expr.orelse, scope, _seen)
+        if isinstance(expr, ast.Call):
+            fname = dotted(expr.func)
+            if fname in PARTIAL_NAMES and expr.args:
+                return self.callable_defs(expr.args[0], scope, _seen)
+            out = []
+            factories = self.callable_defs(expr.func, scope, set(_seen))
+            for factory in factories:
+                fscope = self.scope_of(factory)
+                for node in walk_own(factory):
+                    if isinstance(node, ast.Return) and node.value is not None:
+                        out += self.callable_defs(node.value, fscope, _seen)
+            # callables threaded through the factory's arguments are part
+            # of the traced graph too (wrapper factories like _dq)
+            if factories or fname in PARTIAL_NAMES:
+                for arg in expr.args:
+                    out += self.callable_defs(arg, scope, _seen)
+            return out
+        return []
+
+    def call_targets(self, call: ast.Call, scope: Scope) -> list:
+        """Locally-resolvable defs this Call may invoke (reachability
+        step): the callee itself plus partial-forwarded callables. The
+        callee being a factory CALL is handled by callable_defs."""
+        targets = []
+        if isinstance(call.func, (ast.Name, ast.Attribute)):
+            targets += self.callable_defs(call.func, scope)
+        fname = dotted(call.func)
+        if fname in PARTIAL_NAMES and call.args:
+            targets += self.callable_defs(call.args[0], scope)
+        return targets
+
+
+def _is_jit_expr(expr) -> bool:
+    """Is this decorator/callee expression a jit wrapper? Covers
+    ``jax.jit``, ``jit``, ``jax.jit(...)`` and ``partial(jax.jit, ...)``."""
+    name = dotted(expr)
+    if name in JIT_NAMES:
+        return True
+    if isinstance(expr, ast.Call):
+        fname = dotted(expr.func)
+        if fname in JIT_NAMES:
+            return True
+        if fname in PARTIAL_NAMES and expr.args:
+            return dotted(expr.args[0]) in JIT_NAMES
+    return False
+
+
+def parse_suppressions(path: str, source: str) -> Suppressions:
+    """Extract ``# lint: allow(...)`` comments via the tokenizer (so
+    string literals containing the pattern are never misread)."""
+    file_allows: dict = {}
+    line_allows: dict = {}
+    problems: list = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):  # pragma: no cover
+        tokens = []
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        text = tok.string
+        if "lint:" not in text:
+            continue
+        m = _ALLOW_RE.search(text)
+        line = tok.start[0]
+        if m is None:
+            problems.append(
+                Finding(
+                    "suppression", path, line, tok.start[1],
+                    "malformed lint suppression comment; want "
+                    "'# lint: allow(<checker>): <reason>' or "
+                    "'# lint: allow-file(<checker>): <reason>'",
+                )
+            )
+            continue
+        checker, reason = m.group("checker"), m.group("reason")
+        if not reason:
+            problems.append(
+                Finding(
+                    "suppression", path, line, tok.start[1],
+                    f"suppression allow({checker}) has no reason string; "
+                    "every whitelisted exception must say why",
+                )
+            )
+            reason = "<no reason given>"
+        if m.group("scope"):
+            file_allows.setdefault(checker, (line, reason))
+        else:
+            line_allows[(line, checker)] = reason
+            # a comment-only line suppresses the line below it
+            if text.strip() == tok.line.strip():
+                line_allows.setdefault((line + 1, checker), reason)
+    return Suppressions(file_allows, line_allows, problems)
+
+
+def apply_suppressions(
+    findings: Iterable[Finding], modules: dict
+) -> list:
+    """Mark findings suppressed per their file's allow comments and append
+    the suppression-hygiene problems; returns a sorted list."""
+    out = []
+    for f in findings:
+        mod = modules.get(f.path)
+        if mod is not None:
+            ok, reason = mod.suppressions.lookup(f.checker, f.line)
+            if ok:
+                f = dataclasses.replace(
+                    f, suppressed=True, suppress_reason=reason
+                )
+        out.append(f)
+    for mod in modules.values():
+        out.extend(mod.suppressions.problems)
+    return sorted(out, key=Finding.sort_key)

@@ -1,0 +1,188 @@
+"""donation-safety: donated buffers are never read after the donating call.
+
+The PR 6 ``_donate_copy`` bug class: ``jax.jit(fn, donate_argnums=...)``
+lets XLA reuse the donated argument's HBM in place — after the call the
+original array is INVALID. Reading it afterwards raises a
+RuntimeError on real hardware but can silently *work* on CPU backends,
+so the bug ships green from a CPU-only tier-1 run. Every warm-up path in
+the trainers feeds ``_donate_copy(...)`` clones for exactly this reason.
+
+The checker tracks, per function scope:
+
+  - names bound to ``jax.jit(..., donate_argnums=...)`` results (the
+    donated positions are the union of integer constants inside the
+    ``donate_argnums`` expression — a conditional like ``(0, 4) if donate
+    else ()`` is treated as donating, the conservative reading);
+  - names bound to AOT chains off those (``ex =
+    run.lower(...).compile()``), which execute with the same aliasing;
+
+then flags any donating call whose argument at a donated position is a
+plain name that is READ again later in the same function body without an
+intervening rebind. Arguments that are expressions (``_donate_copy(x)``,
+slices, constructor calls) produce fresh values per call and are skipped;
+assignment targets of the donating call itself count as rebinds
+(``state, hist = run(state, ...)`` is the sanctioned consume-and-replace
+idiom).
+
+Static limits, stated honestly: executables that travel through
+factories or caches (``cache_lib.get_or_compile``) are not tracked, and
+loop-carried reads that textually precede the call are not seen. The
+checker is a tripwire for the direct patterns — the ones the PR 6
+regression actually shipped.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from erasurehead_tpu.analysis.core import (
+    Finding,
+    SourceModule,
+    dotted,
+    walk_own,
+)
+from erasurehead_tpu.analysis.core import JIT_NAMES
+
+CHECKER = "donation-safety"
+
+
+def _donated_positions(call: ast.Call):
+    """The union of integer constants inside this jit call's
+    ``donate_argnums`` value, or None when it doesn't donate."""
+    for kw in call.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        nums = sorted(
+            {
+                n.value
+                for n in ast.walk(kw.value)
+                if isinstance(n, ast.Constant) and isinstance(n.value, int)
+                and not isinstance(n.value, bool)
+            }
+        )
+        if nums:
+            return tuple(nums)
+    return None
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    return dotted(call.func) in JIT_NAMES
+
+
+def _assign_single_name(stmt):
+    """The bound name of ``name = <expr>`` (plain single-target), else
+    None."""
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and (
+        isinstance(stmt.targets[0], ast.Name)
+    ):
+        return stmt.targets[0].id
+    return None
+
+
+def _stmt_store_names(stmt) -> set:
+    """Every name the statement (re)binds."""
+    return {
+        n.id
+        for n in ast.walk(stmt)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store, ast.Del))
+    }
+
+
+def _check_scope(mod: SourceModule, fn, findings: list) -> None:
+    """Analyze one function (or module) body."""
+    donating: dict = {}  # name -> donated positions
+
+    # pass 1: donating bindings — direct jit results and AOT chains
+    # (in source-line order, so `ex = run.lower(...).compile()` sees the
+    # earlier `run = jax.jit(...)` bind)
+    assigns = sorted(
+        (node for node in walk_own(fn) if _assign_single_name(node)),
+        key=lambda n: n.lineno,
+    )
+    for node in assigns:
+        name = _assign_single_name(node)
+        value = node.value
+        if isinstance(value, ast.Call) and _is_jit_call(value):
+            pos = _donated_positions(value)
+            if pos:
+                donating[name] = pos
+        elif isinstance(value, ast.Call):
+            # ex = run.lower(...).compile() — same aliasing at execution
+            # (dotted renders the chain as "run.lower().compile")
+            chain = dotted(value.func) or ""
+            root = chain.split(".", 1)[0]
+            if root in donating and chain.endswith(".compile") and (
+                ".lower()" in chain
+            ):
+                donating[name] = donating[root]
+
+    if not donating:
+        return
+
+    # pass 2: donating call sites + later reads of donated names
+    body_nodes = list(walk_own(fn))
+    for node in body_nodes:
+        if not isinstance(node, ast.Call) or not isinstance(
+            node.func, ast.Name
+        ):
+            continue
+        pos = donating.get(node.func.id)
+        if not pos:
+            continue
+        for p in pos:
+            if p >= len(node.args):
+                continue
+            arg = node.args[p]
+            if not isinstance(arg, ast.Name):
+                continue  # fresh expression per call (copy/slice/ctor)
+            _flag_late_reads(mod, fn, node, arg.id, p, findings)
+
+
+def _flag_late_reads(mod, fn, call, name, position, findings):
+    """Is ``name`` loaded after ``call`` without an intervening rebind?"""
+    call_line = call.lineno
+    rebind_lines = []
+    for node in walk_own(fn):
+        # statements that rebind the name (including the donating call's
+        # own assignment targets — the consume-and-replace idiom)
+        if isinstance(
+            node, (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.For)
+        ) and name in _stmt_store_names(node):
+            rebind_lines.append(node.lineno)
+    for node in walk_own(fn):
+        if (
+            isinstance(node, ast.Name)
+            and node.id == name
+            and isinstance(node.ctx, ast.Load)
+            and node.lineno > call_line
+        ):
+            rebound = any(
+                call_line <= rl <= node.lineno for rl in rebind_lines
+            )
+            if not rebound:
+                findings.append(
+                    Finding(
+                        CHECKER, mod.path, node.lineno, node.col_offset,
+                        f"{name!r} is read after being donated at "
+                        f"position {position} of the jitted call on line "
+                        f"{call_line}; donated buffers are invalid after "
+                        "the call — pass a copy (_donate_copy) or rebind "
+                        "from the result",
+                    )
+                )
+                return  # one finding per donated arg is enough
+
+
+def check(mod: SourceModule, context) -> list:
+    findings: list = []
+    seen = set()
+    scopes = [mod.tree] + [
+        node
+        for node in ast.walk(mod.tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for fn in scopes:
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            _check_scope(mod, fn, findings)
+    return findings

@@ -1,0 +1,103 @@
+"""trace-purity: no host effects reachable from traced function bodies.
+
+The PR 3 observation-only contract: telemetry emission (events, metrics
+counters) is strictly host-side and outside jit, and traced code — function
+bodies passed to ``jax.jit`` / ``lax.scan`` / ``shard_map`` — must be pure
+(same trace, same program, bitwise-reproducible trajectories). A host
+effect inside a traced body is at best silently frozen into the compiled
+program at trace time (``time.time()`` becomes a constant; ``np.random``
+draws once and bakes the sample in) and at worst breaks the
+bitwise-reproducibility pin the whole sweep engine keys on.
+
+Flags, inside the traced call graph (core.traced_functions):
+
+  - event emission: any ``*.emit(...)`` call, and bare ``emit(...)`` when
+    the module imports it from obs.events;
+  - metrics mutation: ``*.inc(...)`` / ``*.observe(...)`` (the
+    obs/metrics counter-and-histogram surface; ``.set`` is excluded —
+    ``x.at[i].set(v)`` is the jax functional-update idiom);
+  - host clocks: ``time.time/perf_counter/monotonic/process_time/sleep``;
+  - host randomness: ``np.random.*`` / ``numpy.random.*`` (and stdlib
+    ``random.*`` when the module imports ``random`` — ``jax.random`` stays
+    legal, it is traced-pure by design);
+  - console/file I/O: ``print``, ``open``, ``input``, ``breakpoint``,
+    ``sys.stdout/stderr.write``, ``os.remove/rename/makedirs/unlink``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from erasurehead_tpu.analysis.core import Finding, SourceModule, dotted, walk_own
+
+CHECKER = "trace-purity"
+
+_BARE_CALLS = frozenset({"print", "open", "input", "breakpoint"})
+_EXACT_DOTTED = frozenset(
+    {
+        "time.time",
+        "time.perf_counter",
+        "time.monotonic",
+        "time.process_time",
+        "time.sleep",
+        "sys.stdout.write",
+        "sys.stderr.write",
+        "os.remove",
+        "os.rename",
+        "os.makedirs",
+        "os.unlink",
+        "os.open",
+    }
+)
+_NUMPY_RANDOM_PREFIXES = ("np.random.", "numpy.random.")
+_EFFECT_SUFFIXES = (".emit", ".inc", ".observe")
+
+
+def _effect(name: str, mod: SourceModule) -> str | None:
+    """A short label when ``name`` is a host effect, else None."""
+    if name in _BARE_CALLS:
+        return f"host I/O call {name}()"
+    if name in _EXACT_DOTTED:
+        return f"host call {name}()"
+    if name.startswith(_NUMPY_RANDOM_PREFIXES):
+        return f"host RNG {name}() (use jax.random inside traced code)"
+    if name.startswith("random.") and "random" in mod.imported_modules:
+        return f"host RNG {name}()"
+    if name == "emit" and mod.emit_is_events:
+        return "event emission emit()"
+    for suffix in _EFFECT_SUFFIXES:
+        if name.endswith(suffix):
+            kind = (
+                "event emission"
+                if suffix == ".emit"
+                else "metrics mutation"
+            )
+            return f"{kind} {name}()"
+    return None
+
+
+def check(mod: SourceModule, context) -> list:
+    findings = []
+    for fn, why in mod.traced_functions().values():
+        scope = mod.scope_of(fn)
+        for node in walk_own(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name is None:
+                continue
+            if name == "emit" and scope.resolve_function("emit") is not None:
+                continue  # a local helper def named emit, not the event sink
+            label = _effect(name, mod)
+            if label is not None:
+                findings.append(
+                    Finding(
+                        CHECKER,
+                        mod.path,
+                        node.lineno,
+                        node.col_offset,
+                        f"{label} inside traced code (traced via {why}); "
+                        "host effects must stay outside jit/scan/shard_map",
+                    )
+                )
+    return findings

@@ -1,0 +1,227 @@
+"""event-schema: every emit() call site matches obs/events.SCHEMA.
+
+The event log's value is that its records can be trusted without running
+the producer: the validator, the report renderer, the journal resume map
+and the serve per-tenant accounting all key on SCHEMA's required fields.
+Today a drifted emit site (a new record type, a renamed field) is caught
+only at runtime by ``validate_lines`` — on whichever run first exercises
+the site. This checker moves that to lint time, and cross-checks the
+three schema surfaces against each other so a record type added to one
+but not the others is a lint error, not a runtime surprise.
+
+Rules:
+
+  - **emit sites** (any module): for ``<events alias>.emit("type", ...)``
+    and bare ``emit(...)`` imported from obs.events, the type string must
+    be a SCHEMA key and every required field for that type must be among
+    the keyword arguments (a ``**splat`` waives the field check — the
+    payload is dynamic — but never the known-type check). For other
+    ``*.emit(...)`` callees (logger objects), the same field check
+    applies whenever the first argument is a SCHEMA type string.
+  - **validator drift** (modules defining both ``SCHEMA`` and
+    ``validate_lines``, i.e. obs/events.py and fixtures shaped like it):
+    every record-type string literal the validator compares ``rtype``
+    against must exist in that module's own SCHEMA — a per-type
+    consistency check for a type SCHEMA doesn't declare is drift.
+  - **CLI wrapper drift** (``tools/validate_events.py``): the wrapper
+    must delegate to ``obs.events.validate_file``/``validate_lines`` and
+    must not carry an independent record-type table (any dict literal
+    with 2+ SCHEMA-type string keys) — the whole point of the shared
+    validator is that the two can never drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from erasurehead_tpu.analysis.core import Finding, SourceModule, dotted
+
+CHECKER = "event-schema"
+
+
+def parse_schema(source: str) -> dict:
+    """type -> required-field tuple from an obs/events.py-shaped module
+    (the top-level ``SCHEMA`` dict literal), parsed without importing."""
+    tree = ast.parse(source)
+    for node in tree.body:
+        target = None
+        if isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            target, value = node.target.id, node.value
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+            isinstance(node.targets[0], ast.Name)
+        ):
+            target, value = node.targets[0].id, node.value
+        if target != "SCHEMA" or not isinstance(value, ast.Dict):
+            continue
+        schema = {}
+        for key, val in zip(value.keys, value.values):
+            if not (
+                isinstance(key, ast.Constant) and isinstance(key.value, str)
+            ):
+                continue
+            fields = tuple(
+                e.value
+                for e in getattr(val, "elts", [])
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+            schema[key.value] = fields
+        return schema
+    return {}
+
+
+def _module_defines_validator(mod: SourceModule) -> bool:
+    return "validate_lines" in mod.module_scope.functions
+
+
+def _emit_type(call: ast.Call):
+    """The event-type argument when it is a string constant, else None."""
+    if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+        call.args[0].value, str
+    ):
+        return call.args[0].value
+    for kw in call.keywords:
+        if kw.arg == "type" and isinstance(kw.value, ast.Constant) and (
+            isinstance(kw.value.value, str)
+        ):
+            return kw.value.value
+    return None
+
+
+def _check_emit_sites(mod: SourceModule, schema: dict, findings: list):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if name is None:
+            continue
+        is_events_call = False
+        if name == "emit":
+            # a lexically-resolvable local helper named emit is not the
+            # event sink (train/artifacts.py's artifact writer)
+            if mod.module_scope.resolve_function("emit") is not None:
+                continue
+            is_events_call = mod.emit_is_events
+            if not is_events_call:
+                continue
+        elif name.endswith(".emit"):
+            base = name[: -len(".emit")]
+            is_events_call = base in mod.events_aliases
+        else:
+            continue
+        etype = _emit_type(node)
+        if etype is None:
+            continue  # dynamic type expression; runtime validation owns it
+        if etype not in schema:
+            if is_events_call:
+                findings.append(
+                    Finding(
+                        CHECKER, mod.path, node.lineno, node.col_offset,
+                        f"emit of unknown event type {etype!r}; "
+                        "obs/events.SCHEMA declares "
+                        f"{sorted(schema) if schema else 'no types'} — "
+                        "add the type to SCHEMA first",
+                    )
+                )
+            continue
+        kwargs = {kw.arg for kw in node.keywords if kw.arg is not None}
+        has_splat = any(kw.arg is None for kw in node.keywords)
+        missing = [f for f in schema[etype] if f not in kwargs]
+        if missing and not has_splat:
+            findings.append(
+                Finding(
+                    CHECKER, mod.path, node.lineno, node.col_offset,
+                    f"emit({etype!r}) missing required field(s) "
+                    f"{missing}; SCHEMA declares {list(schema[etype])}",
+                )
+            )
+
+
+def _check_validator_drift(mod: SourceModule, findings: list):
+    own_schema = parse_schema(mod.source)
+    if not own_schema:
+        return
+    validator = mod.module_scope.functions.get("validate_lines")
+    if validator is None:
+        return
+    for node in ast.walk(validator):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        if not any(
+            isinstance(s, ast.Name) and s.id == "rtype" for s in sides
+        ):
+            continue
+        for side in sides:
+            literals = (
+                [side]
+                if isinstance(side, ast.Constant)
+                else list(getattr(side, "elts", []))
+            )
+            for lit in literals:
+                if isinstance(lit, ast.Constant) and isinstance(
+                    lit.value, str
+                ) and lit.value not in own_schema:
+                    findings.append(
+                        Finding(
+                            CHECKER, mod.path, lit.lineno, lit.col_offset,
+                            f"validate_lines checks record type "
+                            f"{lit.value!r} which SCHEMA does not declare "
+                            "— schema/validator drift",
+                        )
+                    )
+
+
+def _check_cli_wrapper(mod: SourceModule, schema: dict, findings: list):
+    if os.path.basename(mod.path) != "validate_events.py":
+        return
+    delegates = any(
+        isinstance(node, (ast.Name, ast.Attribute))
+        and (
+            getattr(node, "id", None) in ("validate_file", "validate_lines")
+            or getattr(node, "attr", None)
+            in ("validate_file", "validate_lines")
+        )
+        for node in ast.walk(mod.tree)
+    )
+    if not delegates:
+        findings.append(
+            Finding(
+                CHECKER, mod.path, 1, 0,
+                "validate_events.py does not delegate to obs.events."
+                "validate_file/validate_lines; an independent validator "
+                "drifts from SCHEMA",
+            )
+        )
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Dict):
+            type_keys = [
+                k.value
+                for k in node.keys
+                if isinstance(k, ast.Constant)
+                and isinstance(k.value, str)
+                and k.value in schema
+            ]
+            if len(type_keys) >= 2:
+                findings.append(
+                    Finding(
+                        CHECKER, mod.path, node.lineno, node.col_offset,
+                        f"independent record-type table {sorted(type_keys)} "
+                        "in the CLI wrapper; the schema lives in "
+                        "obs/events.SCHEMA only",
+                    )
+                )
+
+
+def check(mod: SourceModule, context) -> list:
+    findings: list = []
+    own_schema = parse_schema(mod.source)
+    schema = own_schema or context.schema
+    if schema:
+        _check_emit_sites(mod, schema, findings)
+    _check_validator_drift(mod, findings)
+    if context.schema:
+        _check_cli_wrapper(mod, context.schema, findings)
+    return findings

@@ -1,0 +1,108 @@
+"""signature-completeness: jitted closures read only signature-keyed cfg.
+
+The PR 2 bug class: the sweep engine's executable cache
+(train/cache.py) keys compiled programs on
+``RunConfig.static_signature()`` plus argument shapes/dtypes. A jitted
+closure that reads a config field NOT in that signature bakes the field's
+current value into the compiled program as a constant — and a later run
+with a different value silently *hits the cache* and executes the stale
+program (a real exec-cache collision was found exactly this way when the
+ring transport landed). The recompile detector (obs/detect.py) can only
+name knobs the signature carries.
+
+The checker resolves the ``RunConfig`` dataclass field set and the
+``static_signature_fields()`` key set from utils/config.py BY AST (no
+import, no jax), then flags every ``cfg.<field>`` / ``self.cfg.<field>``
+attribute read inside the traced call graph where ``<field>`` is a config
+field missing from the signature.
+
+Fields whose value is fully determined by traced ARGUMENT shapes are
+exempt (:data:`SHAPE_CAPTURED`): ``rounds`` shows up as the schedule
+length, ``n_rows``/``n_cols`` as the data stack shape, ``n_workers`` as
+the mesh — a changed value changes the shapes and re-keys the cache by
+construction. Value-like fields (``num_collect``, ``deadline``,
+``delay_mean``, ...) get no such free ride: reading one inside a traced
+body without a signature entry is exactly the collision class.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from erasurehead_tpu.analysis.core import Finding, SourceModule, dotted, walk_own
+
+CHECKER = "signature-completeness"
+
+#: attribute-chain bases treated as a RunConfig value inside closures
+CONFIG_BASES = frozenset(
+    {"cfg", "config", "run_config", "arm_cfg", "self.cfg", "self.config"}
+)
+
+#: config fields captured by traced-argument SHAPES (see module docstring);
+#: everything else must be in static_signature_fields() to be read traced
+SHAPE_CAPTURED = frozenset(
+    {"rounds", "n_rows", "n_cols", "n_workers", "partitions_per_worker"}
+)
+
+
+def parse_config_info(source: str):
+    """(dataclass field names, static-signature keys) from utils/config.py
+    source. Fields = annotated assignments in ``class RunConfig``; keys =
+    string keys of the dict literal returned by
+    ``static_signature_fields``. Parsed, not imported — the linter never
+    executes the code it checks."""
+    tree = ast.parse(source)
+    fields: set = set()
+    keys: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "RunConfig":
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    fields.add(stmt.target.id)
+                if (
+                    isinstance(stmt, ast.FunctionDef)
+                    and stmt.name == "static_signature_fields"
+                ):
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Dict):
+                            for key in sub.keys:
+                                if isinstance(
+                                    key, ast.Constant
+                                ) and isinstance(key.value, str):
+                                    keys.add(key.value)
+    return fields, keys
+
+
+def check(mod: SourceModule, context) -> list:
+    fields = context.config_fields
+    keys = context.signature_keys
+    if not fields or not keys:
+        return []
+    findings = []
+    for fn, why in mod.traced_functions().values():
+        for node in walk_own(fn):
+            if not isinstance(node, ast.Attribute) or not isinstance(
+                node.ctx, ast.Load
+            ):
+                continue
+            base = dotted(node.value)
+            if base not in CONFIG_BASES:
+                continue
+            attr = node.attr
+            if attr in fields and attr not in keys and attr not in SHAPE_CAPTURED:
+                findings.append(
+                    Finding(
+                        CHECKER,
+                        mod.path,
+                        node.lineno,
+                        node.col_offset,
+                        f"traced closure (via {why}) reads {base}.{attr}, "
+                        "which is not in RunConfig."
+                        "static_signature_fields(); the executable cache "
+                        "cannot key on it — add it to the signature or "
+                        "pass it as a traced argument",
+                    )
+                )
+    return findings

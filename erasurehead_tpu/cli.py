@@ -42,6 +42,11 @@ into shared cohort dispatches under an HBM admission budget::
 
        erasurehead-tpu serve --socket /tmp/eh.sock --budget 2g \\
            --journal-dir /var/lib/eh-serve --events serve_events.jsonl
+
+A sixth runs the AST invariant analyzer (erasurehead_tpu/analysis/) over
+the tree — the trace/cache/telemetry contract checks tier-1 gates on::
+
+       erasurehead-tpu lint [--strict] [paths]
 """
 
 from __future__ import annotations
@@ -722,6 +727,15 @@ def main(argv: list[str] | None = None) -> int:
         from erasurehead_tpu.serve import server as serve_lib
 
         return serve_lib.main(argv[1:])
+    if argv and argv[0] == "lint":
+        # `erasurehead-tpu lint [--strict] [paths]` — the AST invariant
+        # analyzer (erasurehead_tpu/analysis/): trace-purity,
+        # signature-completeness, registry-dispatch, event-schema and
+        # donation-safety checks over the given files/dirs (default: the
+        # installed package). Exit 0 = no unsuppressed findings.
+        from erasurehead_tpu.analysis import runner as lint_lib
+
+        return lint_lib.main(argv[1:])
     if len(argv) == 13 and not argv[0].startswith("-"):
         cfg = _legacy_to_config(argv)
         run(cfg)

@@ -109,6 +109,17 @@ class SchemeDescriptor:
     #: "AGC's interesting regime collects fewer than all")
     sweep_num_collect: Optional[Callable] = None
 
+    # ---- artifact naming -------------------------------------------------
+    #: reference artifact filename stem (train/artifacts.run_prefix, e.g.
+    #: "coded_acc" for cyccoded per src/coded.py:250-254); None =
+    #: "<name>_acc" — so schemes registered after the artifact writer was
+    #: written get a stem by construction instead of a KeyError
+    artifact_stem: Optional[str] = None
+    #: artifacts carry the reference's "_<n_stragglers>" filename suffix
+    #: (partial schemes append "_<partitions_per_worker>" too, keyed on
+    #: ``partial``); naive is the reference's one suffix-free scheme
+    artifact_straggler_suffix: bool = True
+
     #: ships with erasurehead_tpu (entry-point/third-party schemes: False)
     builtin: bool = False
 

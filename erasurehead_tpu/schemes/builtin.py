@@ -287,6 +287,7 @@ NAIVE = register(SchemeDescriptor(
     ),
     optimal_decode=lstsq_optimal_decode,
     exact=True,
+    artifact_straggler_suffix=False,  # "naive_acc", no _<s> (src/naive.py:203)
     builtin=True,
 ))
 
@@ -305,6 +306,7 @@ CYCLIC_MDS = register(SchemeDescriptor(
     optimal_decode=lstsq_optimal_decode,
     exact=True,
     seed_dependent_layout=True,
+    artifact_stem="coded_acc",  # src/coded.py:250-254
     builtin=True,
 ))
 
@@ -321,6 +323,7 @@ FRC = register(SchemeDescriptor(
     ),
     optimal_decode=lstsq_optimal_decode,
     exact=True,
+    artifact_stem="replication_acc",  # src/replication.py
     builtin=True,
 ))
 
@@ -522,6 +525,7 @@ PARTIAL_CYCLIC = register(SchemeDescriptor(
     supports_measured=False,  # two-part send has no single-message timing
     config_fields=("partitions_per_worker",),
     validate_config=_validate_partial,
+    artifact_stem="partialcoded",  # src/partial_coded.py (stem bug fixed)
     builtin=True,
 ))
 
@@ -546,5 +550,6 @@ PARTIAL_FRC = register(SchemeDescriptor(
     supports_measured=False,
     config_fields=("partitions_per_worker",),
     validate_config=_validate_partial,
+    artifact_stem="partialreplication",  # src/partial_replication.py
     builtin=True,
 ))

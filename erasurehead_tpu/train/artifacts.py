@@ -35,35 +35,24 @@ from erasurehead_tpu.train.evaluate import EvalResult
 from erasurehead_tpu.train.trainer import TrainResult
 from erasurehead_tpu.utils.config import RunConfig
 
-#: scheme -> artifact filename stem, matching the reference's conventions
-#: (src/naive.py:203-208 "naive_acc", src/coded.py:250-254 "coded_acc_%d",
-#: src/replication.py "replication_acc_%d", src/avoidstragg.py
-#: "avoidstragg_acc_%d", partial schemes "<name>_%d_%d") with its two filename
-#: bugs fixed: AGC gets its own "approx_acc" stem instead of clobbering
-#: replication's (src/approximate_coding.py:259-263), and partial-coded's
-#: training loss no longer carries the partialreplication stem
-#: (src/partial_coded.py:286).
-SCHEME_PREFIX = {
-    "naive": "naive_acc",
-    "cyccoded": "coded_acc",
-    "repcoded": "replication_acc",
-    "approx": "approx_acc",
-    "avoidstragg": "avoidstragg_acc",
-    "partialcyccoded": "partialcoded",
-    "partialrepcoded": "partialreplication",
-    "randreg": "randreg_acc",  # beyond-reference scheme, own prefix
-    "deadline": "deadline_acc",  # beyond-reference scheme, own prefix
-}
-
-
 def run_prefix(cfg: RunConfig) -> str:
-    """Reference filename prefix: naive has no straggler suffix, partial
-    schemes carry <s>_<partitions>, the rest carry <s>."""
-    stem = SCHEME_PREFIX[cfg.scheme.value]
-    if cfg.scheme.value == "naive":
-        return stem
-    if cfg.scheme.value in ("partialcyccoded", "partialrepcoded"):
+    """Reference filename prefix, from the scheme's registry descriptor
+    (``artifact_stem`` / ``artifact_straggler_suffix`` / ``partial`` —
+    matching src/naive.py:203-208 "naive_acc", src/coded.py:250-254
+    "coded_acc_%d", partial schemes "<name>_%d_%d", with the reference's
+    two stem-clobbering filename bugs fixed; see schemes/builtin.py).
+    Schemes registered after this writer was written — sparsegraph,
+    expander, entry-point third parties — get "<name>_acc" stems by
+    construction instead of a KeyError: the registry, not a table here,
+    is the source of scheme behavior."""
+    from erasurehead_tpu import schemes
+
+    desc = schemes.get(cfg.scheme)
+    stem = desc.artifact_stem or f"{desc.name}_acc"
+    if desc.partial:
         return f"{stem}_{cfg.n_stragglers}_{cfg.partitions_per_worker}"
+    if not desc.artifact_straggler_suffix:
+        return stem
     return f"{stem}_{cfg.n_stragglers}"
 
 

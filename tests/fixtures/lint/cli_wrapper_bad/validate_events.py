@@ -1,0 +1,27 @@
+"""A drifted CLI wrapper: re-implements record-type validation with its
+own table instead of delegating to obs.events — the exact drift the
+shared-validator design exists to prevent (flagged twice: no delegation,
+independent type table)."""
+
+import json
+import sys
+
+MY_SCHEMA = {
+    "run_start": ("run_id",),
+    "run_end": ("run_id",),
+    "compile": ("run_id", "seconds"),
+}
+
+
+def main(path):
+    errors = 0
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("type") not in MY_SCHEMA:
+                errors += 1
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))

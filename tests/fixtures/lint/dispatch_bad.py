@@ -1,0 +1,16 @@
+"""registry-dispatch violations, including the classic if/elif spine the
+old grep test already caught."""
+
+from erasurehead_tpu.utils.config import Scheme
+
+
+def stop_count(cfg):
+    if cfg.scheme == Scheme.APPROX:  # enum compare in an if: dispatch
+        return cfg.num_collect
+    elif cfg.scheme == "avoidstragg":  # string compare: dispatch
+        return cfg.n_workers - cfg.n_stragglers
+    return cfg.n_workers
+
+
+def is_partial(scheme):
+    return scheme in ("partialcyccoded", "partialrepcoded")

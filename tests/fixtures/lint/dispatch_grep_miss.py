@@ -1,0 +1,21 @@
+"""Dispatch forms the OLD grep test could not see (its regex required an
+``if``/``elif`` line with ``scheme`` directly followed by a comparator).
+Every site here is real dispatch; the grep finds none of them — the
+regression fixture for the AST-grade checker."""
+
+PREFIX = {"naive": "naive_acc", "approx": "approx_acc"}
+
+
+def run_prefix(cfg):
+    # dict-keyed dispatch: an if/elif spine in data clothing, and it
+    # KeyErrors for every scheme registered after the table was written
+    stem = PREFIX[cfg.scheme.value]
+    # ternary dispatch on .value: the ".value ==" form the grep regex
+    # missed (scheme is not directly followed by the comparator)
+    label = "uncoded" if cfg.scheme.value == "naive" else "coded"
+    return stem, label
+
+
+def pick_weights(scheme, w_exact, w_approx):
+    # comparison inside a comprehension filter, not an if statement
+    return [w_exact if scheme.value == "cyccoded" else w_approx]

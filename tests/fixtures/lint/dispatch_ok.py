@@ -1,0 +1,22 @@
+"""Clean counterpart: scheme handling through the registry, plus the
+comparisons that are NOT dispatch (two scheme VALUES compared for
+compatibility; subscripting by non-scheme keys)."""
+
+TABLE = {0: "cyccoded", 1: "repcoded"}
+
+
+def compatible(a, b):
+    return a.scheme == b.scheme  # value-to-value: compatibility, not dispatch
+
+
+def legacy_scheme(coded_ver):
+    return TABLE[coded_ver]  # keyed by coded_ver, not by a scheme
+
+
+def stop_count(cfg):
+    from erasurehead_tpu import schemes
+
+    desc = schemes.get(cfg.scheme)  # the sanctioned lookup
+    if desc.needs_num_collect:
+        return cfg.num_collect
+    return cfg.n_workers

@@ -1,0 +1,17 @@
+"""donation-safety violation: the PR 6 _donate_copy bug class — a donated
+carry read after the donating call (works on CPU, RuntimeErrors on TPU)."""
+
+import jax
+
+
+def train(state0, xs, weights):
+    run = jax.jit(lambda s, w: (s, w), donate_argnums=(0, 1))
+    final, _ = run(state0, weights)
+    return final, state0  # state0's buffer was donated: invalid read
+
+
+def train_aot(state0, xs):
+    run = jax.jit(lambda s, x: s, donate_argnums=(0,))
+    ex = run.lower(state0, xs).compile()
+    out = ex(state0, xs)  # executes with the jit's aliasing
+    return out + state0  # read after donation through the AOT chain

@@ -1,0 +1,20 @@
+"""Clean counterpart: the sanctioned donation idioms — consume-and-
+replace rebinding, _donate_copy clones for warm-ups, and conditional
+donation with fresh per-call expressions."""
+
+import jax
+
+
+def _donate_copy(tree):
+    return jax.tree.map(lambda l: l.copy(), tree)
+
+
+def train(state0, xs, weights, donate=True):
+    run = jax.jit(
+        lambda s, w: (s, w), donate_argnums=(0, 1) if donate else ()
+    )
+    run(_donate_copy(state0), _donate_copy(weights))  # warm-up on clones
+    state = state0
+    for chunk in (xs, xs):
+        state, _ = run(state, weights[: len(chunk)])  # rebind from result
+    return state

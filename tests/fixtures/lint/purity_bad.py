@@ -1,0 +1,41 @@
+"""trace-purity violations: host effects inside traced bodies — the
+jit-interior emit() is the PR 3 observation-only-contract mutation."""
+
+import time
+
+import jax
+import numpy as np
+from erasurehead_tpu.obs import events as obs_events
+from erasurehead_tpu.obs.metrics import REGISTRY
+from erasurehead_tpu.utils.compat import shard_map
+
+
+def _helper(carry):
+    # reachable from the traced scan body below -> still flagged
+    obs_events.emit("warning", kind="k", message="inside jit")
+    return carry + np.random.normal()
+
+
+def scan_body(carry, x):
+    t = time.time()
+    print("round", x)
+    REGISTRY.counter("bad.counter").inc()
+    return _helper(carry) + t, None
+
+
+def run(xs):
+    return jax.lax.scan(scan_body, 0.0, xs)
+
+
+def make_grad(mesh):
+    def local(params, X):
+        with open("/tmp/leak.txt", "w") as f:
+            f.write("host I/O")
+        return params
+    return shard_map(local, mesh=mesh, in_specs=(), out_specs=None)
+
+
+@jax.jit
+def jitted(x):
+    obs_events.emit("warning", kind="k", message="direct jit interior")
+    return x * 2

@@ -1,0 +1,23 @@
+"""Clean counterpart of purity_bad: the same effects, host-side — outside
+any traced body — plus traced-pure jax.random, which stays legal."""
+
+import time
+
+import jax
+import numpy as np
+from erasurehead_tpu.obs import events as obs_events
+
+
+def scan_body(carry, x):
+    noise = jax.random.normal(jax.random.PRNGKey(0))
+    return carry + x + noise, None
+
+
+def run(xs):
+    t0 = time.time()  # host-side: fine
+    out, _ = jax.lax.scan(scan_body, 0.0, xs)
+    obs_events.emit(
+        "warning", kind="timing", message=f"{time.time() - t0}"
+    )  # host-side, after the dispatch: fine
+    print("done", np.random.normal())  # host-side: fine
+    return out

@@ -1,0 +1,10 @@
+"""event-schema violations: a missing required field, an unknown record
+type, and a logger-object emit missing a required field."""
+
+from erasurehead_tpu.obs import events as events_lib
+
+
+def emit_run(run_id, logger):
+    events_lib.emit("compile", run_id=run_id)  # missing seconds, cache_hit
+    events_lib.emit("not_in_schema", run_id=run_id)  # unknown type
+    logger.emit("run_end", run_id=run_id)  # missing wall_time_s et al.

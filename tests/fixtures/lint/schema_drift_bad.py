@@ -1,0 +1,18 @@
+"""Schema/validator drift: a module shaped like obs/events.py whose
+validate_lines checks a record type its own SCHEMA does not declare."""
+
+SCHEMA = {
+    "run_start": ("run_id",),
+    "run_end": ("run_id", "wall_time_s"),
+}
+
+
+def validate_lines(lines):
+    errors = []
+    for i, rec in enumerate(lines):
+        rtype = rec.get("type")
+        if rtype == "run_start":
+            pass
+        if rtype == "checkpointed":  # not in SCHEMA above: drift
+            errors.append(f"line {i}: bad checkpoint record")
+    return errors

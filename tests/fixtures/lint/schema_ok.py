@@ -1,0 +1,20 @@
+"""Clean counterpart: required fields present, optional fields riding
+along, a **splat payload (runtime-validated), and a local helper that
+happens to be named emit (not the event sink)."""
+
+from erasurehead_tpu.obs import events as events_lib
+
+
+def emit_run(run_id, fields):
+    events_lib.emit(
+        "compile", run_id=run_id, seconds=1.0, cache_hit=False,
+        chunk_rounds=10,  # optional extras ride along
+    )
+    events_lib.emit("rounds", **fields)  # dynamic payload: runtime's job
+
+
+def write_artifacts(paths):
+    def emit(name, data):  # a local helper named emit, not the event sink
+        paths[name] = data
+
+    emit("training_loss", [1.0])

@@ -1,0 +1,20 @@
+"""signature-completeness violation: the PR 2 mutation — a jitted closure
+reads RunConfig fields (delay_mean, num_collect) that are NOT in
+static_signature_fields(), so the executable cache cannot key on them and
+a changed value silently hits a stale compiled program."""
+
+import jax
+
+
+def train(cfg, xs):
+    def body(carry, x):
+        # delay_mean and num_collect are real RunConfig fields, absent
+        # from the static signature -> both flagged
+        step = carry * cfg.delay_mean + cfg.num_collect
+        return step + x, None
+
+    def _run(state, chunk):
+        return jax.lax.scan(body, state, chunk, unroll=cfg.scan_unroll)
+
+    run = jax.jit(_run)
+    return run(0.0, xs)

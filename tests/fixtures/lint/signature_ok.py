@@ -1,0 +1,18 @@
+"""Clean counterpart of signature_bad: traced closures read only
+signature-keyed fields (scan_unroll, dtype), shape-captured fields
+(rounds), or read non-signature fields HOST-SIDE before the dispatch."""
+
+import jax
+
+
+def train(cfg, xs):
+    collect = cfg.num_collect  # host-side read, becomes a traced argument
+
+    def body(carry, x):
+        return carry + x * collect, None
+
+    def _run(state, chunk):
+        return jax.lax.scan(body, state, chunk, unroll=cfg.scan_unroll)
+
+    run = jax.jit(_run)
+    return run(float(cfg.rounds), xs)

@@ -1,0 +1,20 @@
+"""Suppression syntax exercise: a reasoned line allow, a reasoned
+file-wide allow, and one reason-less allow (which is itself a finding)."""
+
+# lint: allow-file(registry-dispatch): fixture exercises file-wide allows
+
+import jax
+from erasurehead_tpu.obs import events as obs_events
+
+
+def body(carry, x):
+    # lint: allow(trace-purity): fixture proves line suppression works
+    obs_events.emit("warning", kind="k", message="suppressed emit")
+    print("also suppressed")  # lint: allow(trace-purity)
+    return carry + x, None
+
+
+def run(cfg, xs):
+    if cfg.scheme == "naive":  # suppressed by the file-wide allow above
+        return xs
+    return jax.lax.scan(body, 0.0, xs)

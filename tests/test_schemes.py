@@ -99,31 +99,51 @@ def test_registry_round_trip_bitwise(scheme, kw, layout_fn, rule_fn, arrivals):
     assert np.array_equal(sched_reg.collected, sched_dir.collected)
 
 
+#: the retired grep body of the dispatch test — kept to PROVE the AST
+#: checker is strictly stronger (the regression fixture below matches
+#: zero lines against it)
+_OLD_GREP = re.compile(r"^\s*(?:el)?if\b.*\bscheme\b\s*(?:==|!=|\bin\b)")
+
+
 def test_no_scheme_dispatch_outside_schemes_package():
-    """Grep-enforced acceptance criterion: zero `if scheme ==`/`elif
-    scheme` dispatch sites outside erasurehead_tpu/schemes/."""
+    """Acceptance criterion, AST-grade (ISSUE 10): zero scheme-dispatch
+    sites outside erasurehead_tpu/schemes/ — now via the
+    registry-dispatch checker (erasurehead_tpu/analysis/dispatch.py),
+    which also sees the string-compare, dict-keyed, ternary and
+    match-statement forms the old grep body of this test could not."""
+    from erasurehead_tpu.analysis import runner as lint_runner
+
     pkg_root = os.path.dirname(
         os.path.dirname(os.path.abspath(schemes.__file__))
     )
-    pattern = re.compile(
-        r"^\s*(?:el)?if\b.*\bscheme\b\s*(?:==|!=|\bin\b)"
+    report = lint_runner.lint_paths(
+        [pkg_root], checkers=["registry-dispatch"]
     )
-    offenders = []
-    for dirpath, _dirnames, filenames in os.walk(pkg_root):
-        if os.path.sep + "schemes" in dirpath or "__pycache__" in dirpath:
-            continue
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path) as f:
-                for lineno, line in enumerate(f, 1):
-                    if pattern.search(line):
-                        offenders.append(f"{path}:{lineno}: {line.strip()}")
+    offenders = [f.render() for f in report.findings if not f.suppressed]
     assert not offenders, (
         "scheme dispatch outside schemes/ (use the registry):\n"
         + "\n".join(offenders)
     )
+
+
+def test_dispatch_checker_catches_what_the_grep_missed():
+    """Regression fixture: dict-keyed and `.value ==` ternary dispatch
+    (the exact forms train/artifacts.py shipped with for 7 PRs) match
+    ZERO lines of the old grep pattern but are flagged by the checker."""
+    from erasurehead_tpu.analysis import runner as lint_runner
+
+    fixture = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "fixtures", "lint", "dispatch_grep_miss.py",
+    )
+    with open(fixture) as f:
+        grep_hits = [line for line in f if _OLD_GREP.search(line)]
+    assert grep_hits == [], "fixture no longer evades the old grep"
+    report = lint_runner.lint_paths(
+        [fixture], checkers=["registry-dispatch"]
+    )
+    findings = [f for f in report.findings if not f.suppressed]
+    assert len(findings) >= 3, report.render()
 
 
 def test_every_builtin_registered_and_flagged():

@@ -144,9 +144,11 @@ def main() -> int:
         for sch in ("repcoded", "approx")
         for sd in (0, 1)
     ]
+    from erasurehead_tpu import schemes as schemes_lib
+
     cfgs = [
         dataclasses.replace(c, num_collect=6)
-        if c.scheme.value == "approx" else c
+        if schemes_lib.get(c.scheme).needs_num_collect else c
         for c in cfgs
     ]
     cohort = trainer.train_cohort(cfgs, data)
