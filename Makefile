@@ -43,7 +43,7 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 	generate_random_data arrange_real_data \
 	test lint tier1 bench sweep rehearse watch compare real_data dryrun \
 	telemetry-smoke sweep-batch-smoke chaos-smoke roofline-smoke \
-	serve-smoke adapt-smoke deep-smoke clean
+	serve-smoke adapt-smoke deep-smoke elastic-smoke clean
 
 naive:            ## uncoded wait-for-all baseline (src/naive.py)
 	$(RUN) --scheme naive
@@ -131,6 +131,9 @@ adapt-smoke:      ## CPU regime-shift drive of the adaptive controller: policy s
 
 deep-smoke:       ## CPU W=8 attention cohort with per-layer coding: 1 dispatch, bitwise layer-decode pin, layer-tagged events validate (tools/deep_smoke.py)
 	JAX_PLATFORMS=cpu $(PY) tools/deep_smoke.py
+
+elastic-smoke:    ## CPU chaos-driven die-then-rejoin + kill->resume row rehydration through the elastic membership controller (tools/elastic_smoke.py)
+	JAX_PLATFORMS=cpu $(PY) tools/elastic_smoke.py
 
 sweep:            ## the full on-TPU measurement program (resumable, tagged)
 	bash tools/tpu_measurements.sh

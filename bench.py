@@ -780,6 +780,169 @@ def _adapt_extra() -> dict:
     }
 
 
+#: elastic extra scenario (ISSUE 11): W=8 workers, 25% (2 workers) killed
+#: at round 12 of 36, master patience (timeout) 4 s vs 0.5 s mean delays.
+#: Three recoveries race to the same loss target on the same world:
+#:   (a) elastic    — the online membership controller (elastic/):
+#:                    detection costs ~death_rounds timeout-priced rounds,
+#:                    then a W'=6 re-layout trains at full speed with every
+#:                    partition contributing;
+#:   (b) limping    — the static run keeps the dead workers in the layout
+#:                    for the whole horizon (failover decode), paying the
+#:                    timeout EVERY post-kill round — the reference's
+#:                    hang-forever, priced instead of infinite;
+#:   (c) restart    — notice the death and relaunch on the survivors from
+#:                    SCRATCH: pre-kill progress is thrown away and the
+#:                    loss curve re-pays it.
+#: Bar: elastic time-to-target < both.
+ELASTIC_WORKERS = 8
+ELASTIC_ROUNDS = 36
+ELASTIC_KILL_ROUND = 12
+ELASTIC_DEAD = (6, 7)  # 25% of the cluster
+ELASTIC_TIMEOUT = 4.0
+ELASTIC_CHUNK = 6
+ELASTIC_DEATH_ROUNDS = 2
+
+
+def _elastic_extra() -> dict:
+    """Time-to-target under a mid-run 25% worker loss: the elastic
+    membership controller vs keep-limping vs restart-from-scratch."""
+    import dataclasses as _dc
+
+    import numpy as _np
+
+    from erasurehead_tpu import elastic as elastic_lib
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.parallel import failures
+    from erasurehead_tpu.train import evaluate, experiments, trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    We, R = ELASTIC_WORKERS, ELASTIC_ROUNDS
+    kill = ELASTIC_KILL_ROUND
+    ds = generate_gmm(64 * We, 16, We, seed=0)
+    cfg = RunConfig(
+        scheme="naive", n_workers=We, n_stragglers=1, rounds=R,
+        n_rows=64 * We, n_cols=16, update_rule="AGD", lr_schedule=1.0,
+        add_delay=True, seed=0,
+    )
+    deaths = {w: kill for w in ELASTIC_DEAD}
+    world = failures.inject_worker_death(
+        trainer.default_arrivals(cfg), deaths
+    )
+
+    def curve(result):
+        model = trainer.build_model(cfg)
+        n = result.n_train
+        ev = evaluate.replay(
+            model, cfg.model, result.params_history,
+            ds.X_train[:n], ds.y_train[:n], ds.X_test, ds.y_test,
+        )
+        return _np.asarray(ev.training_loss, dtype=_np.float64)
+
+    # (a) elastic: online detection + W'=6 re-layout
+    eres = elastic_lib.train_elastic_online(
+        cfg, ds,
+        elastic=elastic_lib.ElasticConfig(
+            chunk_rounds=ELASTIC_CHUNK,
+            death_rounds=ELASTIC_DEATH_ROUNDS,
+            timeout=ELASTIC_TIMEOUT,
+        ),
+        deaths=deaths,
+    )
+    curve_a, time_a = curve(eres.result), eres.result.timeset
+
+    # (b) keep limping: dead workers stay in the layout; every infeasible
+    # round degrades to the failover decode at the full timeout price
+    layout = trainer.build_layout(cfg)
+    sched, _rep = failures.plan_run(
+        cfg.scheme, layout, world, num_collect=cfg.num_collect,
+        timeout=ELASTIC_TIMEOUT, on_infeasible="failover",
+    )
+    limp = trainer.train(
+        cfg, ds, arrivals=world, schedule=sched, measure=False
+    )
+    curve_b, time_b = curve(limp), limp.timeset
+
+    # (c) restart from scratch on the survivors. A scratch restart pays
+    # the SAME detection latency the controller did (nobody can restart
+    # before noticing the death — the timeout-priced rounds up to the
+    # re-layout boundary come straight from the elastic run's own
+    # decisions), then relaunches a fresh W'=6 run from init: identical
+    # clock prefix, but the pre-kill progress is thrown away. The
+    # comparison therefore isolates exactly the controller's value: the
+    # carried-over optimizer state.
+    relayout_round = next(
+        d["round"] for d in eres.decisions if d["action"] == "relayout"
+    )
+    survivors = [w for w in range(We) if w not in ELASTIC_DEAD]
+    cfg_scratch = failures.survivor_config(cfg, len(survivors))
+    scratch = trainer.train(
+        cfg_scratch, ds,
+        arrivals=world[:, survivors], measure=False,
+    )
+    curve_c = _np.concatenate(
+        [curve_a[:relayout_round], curve(scratch)]
+    )
+    time_c = _np.concatenate(
+        [time_a[:relayout_round], scratch.timeset]
+    )
+
+    # shared target: reachable by every contender (2% above the WORST
+    # final loss), so the comparison is about time, not attainability
+    target = 1.02 * float(
+        max(curve_a[-1], curve_b[-1], curve_c[-1])
+    )
+    t2t = {
+        "elastic": experiments.time_to_target_loss(curve_a, time_a, target),
+        "limping": experiments.time_to_target_loss(curve_b, time_b, target),
+        "restart": experiments.time_to_target_loss(curve_c, time_c, target),
+    }
+    t_el = t2t["elastic"]
+    beats_limping = t_el is not None and (
+        t2t["limping"] is None or t_el < t2t["limping"]
+    )
+    beats_restart = t_el is not None and (
+        t2t["restart"] is None or t_el < t2t["restart"]
+    )
+    relayouts = [
+        d for d in eres.decisions if d["action"] == "relayout"
+    ]
+    return {
+        "elastic": {
+            "workers": We,
+            "rounds": R,
+            "kill_round": kill,
+            "killed_workers": list(ELASTIC_DEAD),
+            "killed_fraction": round(len(ELASTIC_DEAD) / We, 3),
+            "timeout_s": ELASTIC_TIMEOUT,
+            "chunk_rounds": ELASTIC_CHUNK,
+            "death_rounds": ELASTIC_DEATH_ROUNDS,
+            "relayouts": len(relayouts),
+            "detected_dead": sorted(
+                w for d in relayouts for w in d.get("dead", [])
+            ),
+            "target_loss": round(target, 6),
+            "time_to_target_s": {
+                k: (round(v, 2) if v is not None else None)
+                for k, v in t2t.items()
+            },
+            # the acceptance bars: elastic beats BOTH baselines
+            "elastic_beats_limping": beats_limping,
+            "elastic_beats_restart": beats_restart,
+            "speedup_vs_limping": (
+                round(t2t["limping"] / t_el, 3)
+                if t_el and t2t["limping"]
+                else None
+            ),
+            "speedup_vs_restart": (
+                round(t2t["restart"] / t_el, 3)
+                if t_el and t2t["restart"]
+                else None
+            ),
+        },
+    }
+
+
 #: deep_cohort extra: a 7-scheme x 4-seed DEEP-MODEL cohort at W=30
 #: racing the sequential cached path (the PR 4 amortization win, repeated
 #: off the convex GLMs), plus a decode-error-vs-depth series from
@@ -1109,6 +1272,15 @@ def child() -> None:
         except Exception as e:  # noqa: BLE001 — extras must never kill bench
             print(f"bench: adapt extra failed: {e}", file=sys.stderr)
 
+        # ---- elastic extra: time-to-target under a mid-run 25% worker
+        # kill — the online membership controller vs keep-limping vs
+        # restart-from-scratch (bar: elastic beats both)
+        elastic_extra = {}
+        try:
+            elastic_extra = _elastic_extra()
+        except Exception as e:  # noqa: BLE001 — extras must never kill bench
+            print(f"bench: elastic extra failed: {e}", file=sys.stderr)
+
         # ---- fidelity extra: the compressed-stack knob ships with evidence
         # (eval-loss delta vs an f32-stack reference run of the same
         # schedule), not vibes — only measured when a lossy/compressed
@@ -1250,6 +1422,7 @@ def child() -> None:
                 **deep_extra,
                 **serve_extra,
                 **adapt_extra,
+                **elastic_extra,
                 **fidelity_extra,
                 **lint_extra,
                 **telemetry_extra,

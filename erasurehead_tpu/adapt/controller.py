@@ -258,3 +258,47 @@ class AdaptiveController:
             "weights": [round(float(w), 6) for w in self._weight],
             "chunks": self._chunk_index,
         }
+
+    # ---- persistence (elastic/driver.py checkpoints the bandit in its
+    # aux sidecar so a killed->resumed elastic-with-adapt run replays the
+    # identical arm sequence: values, weights AND the exploration rng
+    # state all round-trip through JSON exactly)
+
+    def state_dict(self) -> dict:
+        """JSON-serializable full state; :meth:`load_state_dict` restores
+        it bitwise (floats survive JSON via repr round-trip, the seeded
+        Generator via its bit_generator state dict)."""
+        import json
+
+        return {
+            "value": [float(v) for v in self._value],
+            "weight": [float(w) for w in self._weight],
+            "last_arrival_mean": self._last_arrival_mean,
+            "chunk_index": self._chunk_index,
+            "pending_shift": self._pending_shift,
+            "decisions": list(self.decisions),
+            # the bit-generator state is plain ints/lists after one JSON
+            # round-trip, matching what a restored aux sidecar holds
+            "rng_state": json.loads(
+                json.dumps(self._rng.bit_generator.state)
+            ),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        n = len(self.arms)
+        value = np.asarray(state["value"], dtype=np.float64)
+        weight = np.asarray(state["weight"], dtype=np.float64)
+        if value.shape != (n,) or weight.shape != (n,):
+            raise ValueError(
+                f"state_dict covers {value.shape[0]} arms, controller has "
+                f"{n} — arm sets must match to restore"
+            )
+        self._value = value
+        self._weight = weight
+        self._last_arrival_mean = state.get("last_arrival_mean")
+        self._chunk_index = int(state["chunk_index"])
+        self._pending_shift = bool(state.get("pending_shift", False))
+        self.decisions = list(state.get("decisions", []))
+        rng_state = state.get("rng_state")
+        if rng_state is not None:
+            self._rng.bit_generator.state = rng_state

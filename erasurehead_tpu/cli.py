@@ -158,6 +158,25 @@ def _flags_parser() -> argparse.ArgumentParser:
                         "events")
     p.add_argument("--adapt-chunk", type=int, default=10,
                    help="rounds per adaptive decision window")
+    p.add_argument("--elastic", default="off", choices=["off", "on"],
+                   help="online elastic membership (elastic/): train in "
+                        "chunks and, between chunks, detect dead workers "
+                        "from the run's own telemetry (the -1 never-"
+                        "arrived sentinel persisting --death-rounds "
+                        "rounds, or a --death-timeout trip), re-layout "
+                        "onto the survivors via the scheme registry's "
+                        "layout builders with params+momentum carried "
+                        "over, and scale back UP when a worker rejoins "
+                        "(chaos worker_revive). --kill-workers scripts "
+                        "the ground-truth world; the controller only "
+                        "ever sees telemetry. Decisions land as typed "
+                        "`membership` events")
+    p.add_argument("--elastic-chunk", type=int, default=10,
+                   help="rounds per elastic membership chunk (the "
+                        "checkpoint/re-layout granularity)")
+    p.add_argument("--death-rounds", type=int, default=3,
+                   help="consecutive never-arrived rounds that declare a "
+                        "worker dead (elastic mode)")
     p.add_argument("--adapt-arms", default=None, metavar="SPEC",
                    help="comma-separated arms 'scheme[:cN][:dSECS]', e.g. "
                         "'naive,approx:c4,deadline:d1.5'; default: the "
@@ -466,14 +485,42 @@ def _validate_checkpoint_flags(parser, ns) -> None:
     # masquerade as a recovery experiment
     if ns.on_death != "error" and not ns.kill_workers:
         parser.error("--on-death requires --kill-workers")
-    if ns.death_timeout is not None and ns.on_death != "failover":
-        parser.error("--death-timeout only applies to --on-death failover")
+    if ns.death_timeout is not None and ns.on_death != "failover" \
+            and ns.elastic != "on":
+        parser.error(
+            "--death-timeout only applies to --on-death failover or "
+            "--elastic on"
+        )
     if ns.kill_workers and ns.on_death == "failover" and ns.death_timeout is None:
         parser.error("--on-death failover requires --death-timeout")
     if ns.kill_workers and (ns.checkpoint_dir or ns.resume):
         parser.error("--kill-workers does not compose with checkpointing")
     if ns.kill_workers and ns.arrival_mode == "measured":
         parser.error("--kill-workers needs the simulated-arrival trainer")
+    # elastic membership: the driver owns the chunking and the failure
+    # handling, so the static death paths don't compose with it
+    if ns.elastic == "on":
+        if ns.arrival_mode == "measured":
+            parser.error("--elastic needs the simulated-arrival trainer")
+        if ns.checkpoint_dir or ns.resume:
+            parser.error(
+                "--elastic manages its own chunk-boundary checkpoints; "
+                "drop --checkpoint-dir/--resume (elastic resume is the "
+                "driver API's checkpoint_dir/resume)"
+            )
+        if ns.adapt == "on":
+            parser.error(
+                "--elastic composes the adapt bandit internally (per-"
+                "epoch re-seeded arms); drop --adapt"
+            )
+        if ns.on_death != "error":
+            parser.error(
+                "--elastic IS the death handling; drop --on-death"
+            )
+    if ns.elastic_chunk < 1:
+        parser.error("--elastic-chunk must be >= 1")
+    if ns.death_rounds < 1:
+        parser.error("--death-rounds must be >= 1")
     # adaptive collection: the driver owns the chunking, so the static
     # checkpoint/fault paths don't compose with it
     if ns.adapt == "on":
@@ -552,6 +599,9 @@ def run(
     adapt: str = "off",
     adapt_chunk: int = 10,
     adapt_arms: str | None = None,
+    elastic: str = "off",
+    elastic_chunk: int = 10,
+    death_rounds: int = 3,
 ):
     # argument-only checks: fail before backend init / dataset load
     if (checkpoint_dir or resume) and cfg.arrival_mode == "measured":
@@ -562,8 +612,14 @@ def run(
     deaths = _parse_deaths(kill_workers) if kill_workers else None
     if on_death != "error" and not deaths:
         raise ValueError("on_death requires kill_workers")
-    if death_timeout is not None and on_death != "failover":
-        raise ValueError("death_timeout only applies to on_death='failover'")
+    if death_timeout is not None and on_death != "failover" \
+            and elastic != "on":
+        raise ValueError(
+            "death_timeout only applies to on_death='failover' or "
+            "elastic='on'"
+        )
+    if elastic == "on" and cfg.arrival_mode == "measured":
+        raise ValueError("elastic needs the simulated-arrival trainer")
     if deaths and cfg.arrival_mode == "measured":
         raise ValueError("--kill-workers needs the simulated-arrival trainer")
     if deaths and (checkpoint_dir or resume):
@@ -602,7 +658,41 @@ def run(
         else contextlib.nullcontext()
     )
     with capture, device_trace(trace_dir):
-        if adapt == "on":
+        if elastic == "on":
+            from erasurehead_tpu import elastic as elastic_lib
+
+            ecfg_kw = dict(
+                chunk_rounds=elastic_chunk, death_rounds=death_rounds,
+                seed=cfg.seed,
+            )
+            if death_timeout is not None:
+                ecfg_kw["timeout"] = death_timeout
+            eres = elastic_lib.train_elastic_online(
+                cfg, dataset,
+                elastic=elastic_lib.ElasticConfig(**ecfg_kw),
+                deaths=deaths,
+                journal_dir=output_dir if telemetry_on else None,
+            )
+            result = eres.result
+            if not quiet:
+                relayouts = [
+                    d for d in eres.decisions
+                    if d["action"] == "relayout"
+                ]
+                print(
+                    f"elastic membership: {len(eres.rows)} chunk(s), "
+                    f"{len(relayouts)} re-layout(s) across "
+                    f"{len(eres.epochs)} epoch(s)"
+                )
+                for d in eres.decisions:
+                    print(
+                        f"  round {d['round']:>4} {d['action']:10s} "
+                        + str({
+                            k: v for k, v in d.items()
+                            if k not in ("round", "action")
+                        })
+                    )
+        elif adapt == "on":
             from erasurehead_tpu import adapt as adapt_lib
 
             arms = _parse_arms(adapt_arms) if adapt_arms else None
@@ -763,6 +853,9 @@ def main(argv: list[str] | None = None) -> int:
         adapt=ns.adapt,
         adapt_chunk=ns.adapt_chunk,
         adapt_arms=ns.adapt_arms,
+        elastic=ns.elastic,
+        elastic_chunk=ns.elastic_chunk,
+        death_rounds=ns.death_rounds,
     )
     return 0
 

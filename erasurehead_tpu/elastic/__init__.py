@@ -1,0 +1,28 @@
+"""Elastic membership: online death detection, mid-run re-layout, and
+worker join — the wall the reference's README concedes (README.md:120-122,
+any worker death hangs the master forever) taken down WITHOUT scripting
+the deaths in advance.
+
+- :mod:`erasurehead_tpu.elastic.controller` — the telemetry-driven
+  membership detector (K-round ``-1``-sentinel streaks, detect_dead
+  timeout trips, collapsed-arrival probes, join offers) and its
+  deterministic ledger.
+- :mod:`erasurehead_tpu.elastic.driver` — ``train_elastic_online``: the
+  chunked restart loop that re-layouts onto W' via the scheme registry's
+  layout builders, journals typed ``membership`` events, checkpoints the
+  ledger, and composes with the adapt/ bandit, chaos harness, ring/int8
+  stacks and deep models.
+"""
+
+from erasurehead_tpu.elastic.controller import (  # noqa: F401
+    ChunkObservation,
+    ElasticConfig,
+    MembershipChange,
+    MembershipController,
+    auto_survivor_config,
+)
+from erasurehead_tpu.elastic.driver import (  # noqa: F401
+    ElasticResult,
+    science_fields,
+    train_elastic_online,
+)

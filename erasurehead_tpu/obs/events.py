@@ -95,10 +95,27 @@ SCHEMA: dict[str, tuple] = {
     # telemetry-driven, so a resumed run replays the identical sequence —
     # the event log is the decision journal.
     "adapt": ("round", "arm", "reason"),
+    # one per elastic-membership decision or completed chunk
+    # (elastic/driver.py): "action" says what happened at chunk-boundary
+    # "round" — a worker declared dead from its own telemetry (the -1
+    # sentinel persisting / detect_dead tripping), a join accepted, a
+    # re-layout onto n_workers workers, a collapsed-arrival probe, or a
+    # finished chunk's science row ("chunk" records carry the sim clock,
+    # decode-error mean and params digest that make a killed->resumed run
+    # rehydrate its rows bitwise from this journal). Deterministic given
+    # (config, world, chaos env), so the event log doubles as the
+    # membership decision journal.
+    "membership": ("round", "action", "n_workers"),
 }
 
 #: adapt decision reasons (adapt/controller.AdaptiveController.choose)
 ADAPT_REASONS = ("warmup", "exploit", "explore", "regime_shift")
+
+#: membership actions (elastic/controller.py): deaths/joins are detector
+#: decisions, "relayout" commits them into a fresh W'-worker layout,
+#: "probe" marks a collapsed-arrival re-evaluation, "chunk" is a finished
+#: chunk's journal row
+MEMBERSHIP_ACTIONS = ("death", "join", "relayout", "probe", "chunk")
 
 #: sweep_trajectory completion statuses (train/journal.py); "diverged"
 #: rows are quarantined, not retried — divergence is deterministic under
@@ -393,8 +410,10 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
     key, and an object row; serve records are internally consistent
     (``request`` names tenant/request_id/label, ``pack``'s trajectory
     count matches its label list, ``admit`` carries non-negative byte
-    figures, ``evict`` names its reason); every ``run_start`` has a
-    matching later ``run_end``."""
+    figures, ``evict`` names its reason); ``membership`` records carry a
+    non-negative round, a known action (:data:`MEMBERSHIP_ACTIONS`), a
+    positive worker count and — when present — a list of non-negative
+    worker ids; every ``run_start`` has a matching later ``run_end``."""
     errors: list[str] = []
     # seq checking is MULTI-STREAM: a file may interleave several
     # append-mode loggers (concurrent journal writers, the serve daemon
@@ -565,6 +584,36 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
                 errors.append(
                     f"line {i}: adapt reason must be one of "
                     f"{ADAPT_REASONS}, got {reason!r}"
+                )
+        if rtype == "membership":
+            rnd = rec.get("round")
+            if not isinstance(rnd, int) or rnd < 0:
+                errors.append(
+                    f"line {i}: membership round must be a non-negative "
+                    f"int, got {rnd!r}"
+                )
+            action = rec.get("action")
+            if action not in MEMBERSHIP_ACTIONS:
+                errors.append(
+                    f"line {i}: membership action must be one of "
+                    f"{MEMBERSHIP_ACTIONS}, got {action!r}"
+                )
+            nw = rec.get("n_workers")
+            if not isinstance(nw, int) or nw < 1:
+                errors.append(
+                    f"line {i}: membership n_workers must be a positive "
+                    f"int, got {nw!r}"
+                )
+            workers = rec.get("workers")
+            if workers is not None and (
+                not isinstance(workers, list)
+                or any(
+                    not isinstance(w, int) or w < 0 for w in workers
+                )
+            ):
+                errors.append(
+                    f"line {i}: membership workers must be a list of "
+                    f"non-negative worker ids, got {workers!r}"
                 )
         if rtype == "run_start":
             started.add(rec.get("run_id"))

@@ -31,6 +31,7 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
     warnings: list = []
     trajectories: list = []
     adapt: list = []
+    membership: list = []
     serve: dict = {"requests": [], "packs": [], "admits": [], "evicts": []}
 
     def run(rid):
@@ -75,6 +76,8 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
                     trajectories.append(rec)
                 elif rtype == "adapt":
                     adapt.append(rec)
+                elif rtype == "membership":
+                    membership.append(rec)
                 elif rtype == "request":
                     serve["requests"].append(rec)
                 elif rtype == "pack":
@@ -84,11 +87,14 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
                 elif rtype == "evict":
                     serve["evicts"].append(rec)
     out = [runs[rid] for rid in order]
-    if warnings or trajectories or adapt or any(serve.values()):
+    if (
+        warnings or trajectories or adapt or membership
+        or any(serve.values())
+    ):
         out.append({
             "run_id": None, "warnings": warnings,
             "trajectories": trajectories, "serve": serve,
-            "adapt": adapt,
+            "adapt": adapt, "membership": membership,
         })
     return out
 
@@ -121,6 +127,55 @@ def _adapt_section(stray: list) -> list[str]:
             f"  sim/round={_fmt(d.get('sim_per_round'), '.4f')}"
             f"  decode_err={_fmt(err, '.6f')}"
             + ("  REGIME SHIFT" if d.get("regime_shift") else "")
+        )
+    return lines
+
+
+def _membership_section(stray: list) -> list[str]:
+    """The elastic-membership section: the run's membership timeline
+    (deaths, joins, re-layouts, probes) plus a per-chunk row summary —
+    the controller's trajectory, reconstructed from its `membership`
+    events (elastic/driver.py)."""
+    recs: list = []
+    for g in stray:
+        recs.extend(g.get("membership", []))
+    if not recs:
+        return []
+    decisions = [r for r in recs if r.get("action") != "chunk"]
+    chunks = [r for r in recs if r.get("action") == "chunk"]
+    relayouts = [r for r in decisions if r.get("action") == "relayout"]
+    deaths = [w for r in decisions if r.get("action") == "death"
+              for w in (r.get("workers") or [])]
+    joins = [w for r in decisions if r.get("action") == "join"
+             for w in (r.get("workers") or [])]
+    lines = [
+        f"\nelastic membership: {len(chunks)} chunk(s), "
+        f"{len(relayouts)} re-layout(s)"
+        + (f", {len(deaths)} death(s) {sorted(set(deaths))}" if deaths
+           else "")
+        + (f", {len(joins)} join(s) {sorted(set(joins))}" if joins else "")
+    ]
+    for r in decisions:
+        action = r.get("action", "?")
+        detail = ""
+        if r.get("workers"):
+            detail = f" workers={r['workers']}"
+        if action == "relayout":
+            detail += (
+                f"  {r.get('n_workers_before', '?')} -> "
+                f"{r.get('n_workers', '?')} workers"
+            )
+        lines.append(
+            f"  round {r.get('round', '?'):>5} {action:10s}{detail}"
+        )
+    for r in chunks:
+        arm = r.get("arm")
+        lines.append(
+            f"  round {r.get('round', '?'):>5} chunk      "
+            f"W={r.get('n_workers', '?'):<3} "
+            f"sim={_fmt(r.get('sim_time'), '.3f'):>8s} "
+            f"decode_err={_fmt(r.get('decode_error_mean'), '.6f')}"
+            + (f" arm={arm}" if arm else "")
         )
     return lines
 
@@ -268,6 +323,7 @@ def render(paths: Sequence[str]) -> str:
             )
     lines.extend(_serve_section(stray))
     lines.extend(_adapt_section(stray))
+    lines.extend(_membership_section(stray))
     # serve rows (tenant-tagged) render in the serving section above; the
     # journal listing keeps the local-sweep rows
     trajectories = [

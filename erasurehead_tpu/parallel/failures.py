@@ -73,12 +73,19 @@ def detect_dead(arrivals: np.ndarray, timeout: float) -> np.ndarray:
     ``timeout`` simulated seconds into the round.
 
     The reference cannot express this (its Waitany has no timeout); here it
-    is an exact readout of the schedule.
+    is an exact readout of the schedule. ``arrivals`` may also be a
+    TELEMETRY worker_times block carrying the reference's ``-1``
+    never-collected sentinel (src/coded.py:171-173; the masking rule of
+    obs/events.arrival_summary): real arrival times are >= 0 by
+    construction, so negative entries mean the master never heard from
+    that worker and land on the dead side — feeding raw telemetry here
+    must never read ``-1`` as "arrived one second early".
     """
     t = np.asarray(arrivals)
     # non-finite is dead regardless of timeout (inf <= inf would pass a
-    # plain comparison); NaN also lands on the dead side
-    return ~np.isfinite(t) | (t > timeout)
+    # plain comparison); NaN also lands on the dead side, as does the -1
+    # never-arrived sentinel (negative = no arrival, not an early one)
+    return ~np.isfinite(t) | (t > timeout) | (t < 0.0)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -220,6 +227,49 @@ def plan_run(
     )
 
 
+def survivor_config(
+    cfg,
+    n_survivors: int,
+    survivor_overrides: Optional[dict] = None,
+    lr_schedule=None,
+):
+    """The survivor-phase RunConfig for ``n_survivors`` workers, validated
+    UP FRONT through the scheme registry.
+
+    ``num_collect`` is clamped to W' (a stop count above the worker count
+    is unsatisfiable), but clamping alone is not validation: schemes carry
+    structural divisibility constraints — FRC's ``(s+1) | W'``
+    (src/replication.py:24-26), the partial schemes' partition counts —
+    that an unlucky W' violates. Without this check those used to surface
+    as an opaque error deep inside layout construction; here the registry
+    descriptor's ``validate_config`` runs at config-build time and the
+    raised error names ``survivor_overrides`` as the fix (e.g. a smaller
+    ``n_stragglers``). ``survivor_overrides`` wins over the derived
+    fields, exactly as in :func:`train_elastic`."""
+    overrides = dict(
+        n_workers=n_survivors,
+        num_collect=(
+            None
+            if cfg.num_collect is None
+            else min(cfg.num_collect, n_survivors)
+        ),
+    )
+    if lr_schedule is not None:
+        overrides["lr_schedule"] = lr_schedule
+    overrides.update(survivor_overrides or {})
+    try:
+        # RunConfig.__post_init__ delegates to the registry descriptor's
+        # validate_config — the single home of scheme invariants
+        return dataclasses.replace(cfg, **overrides)
+    except ValueError as e:
+        raise ValueError(
+            f"survivor phase invalid for scheme "
+            f"{cfg.scheme.value!r} at W'={n_survivors}: {e}. Pass "
+            f"survivor_overrides= adjusting the violated knob (e.g. a "
+            f"smaller n_stragglers where FRC requires (s+1) | W')"
+        ) from e
+
+
 @dataclasses.dataclass(frozen=True)
 class ElasticReport:
     """What an elastic restart did (train_elastic)."""
@@ -299,6 +349,12 @@ def train_elastic(
     # prefix) so per-round lr arrays and presets alike stay continuous
     # through the restart
     lr_full = cfg.resolve_lr_schedule()
+    # survivor config BEFORE phase 1: an invalid W' (e.g. FRC's (s+1) | W'
+    # divisibility) must fail fast with an error naming survivor_overrides,
+    # not burn the pre-death phase and then die inside layout construction
+    cfg2 = survivor_config(
+        cfg, W2, survivor_overrides, lr_schedule=lr_full
+    )
     train_fn = trainer.train_dynamic if dynamic else trainer.train
     phase_kw = {} if dynamic else {"measure": measure}
     phase1 = train_fn(
@@ -309,16 +365,6 @@ def train_elastic(
         mesh=mesh,
         **phase_kw,
     )
-
-    overrides = dict(
-        n_workers=W2,
-        num_collect=(
-            None if cfg.num_collect is None else min(cfg.num_collect, W2)
-        ),
-        lr_schedule=lr_full,
-    )
-    overrides.update(survivor_overrides or {})
-    cfg2 = dataclasses.replace(cfg, **overrides)
     phase2 = train_fn(
         cfg2,
         dataset,

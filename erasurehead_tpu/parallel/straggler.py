@@ -108,7 +108,7 @@ class RegimeShift:
     stream every round); the worst-case analyses the retrieved papers run
     are not — "Fundamental Limits of Approximate Gradient Coding"
     (arXiv:1901.08166) shows the cost of straggling concentrates in
-    adversarial/non-stationary patterns. Two kinds:
+    adversarial/non-stationary patterns. Three kinds:
 
       - ``"heavytail"``: Exponential(mean) delays through round
         ``round``-1, then Pareto(``alpha``)-tailed delays (seeded per
@@ -121,29 +121,47 @@ class RegimeShift:
         drawn delay) — the fixed-straggler worst case of 1901.08166,
         where any scheme that must hear from that worker stalls every
         round.
+      - ``"targeted"``: from round ``round`` on, EVERY replica of coded
+        partition group ``group`` turns slow at once (+``slowdown`` each)
+        — 1901.08166's worst case for fractional-repetition codes, where
+        replication buys nothing because the adversary slows the whole
+        replica set instead of one worker. The attacked worker set is
+        derived from the run's layout (:func:`targeted_workers`: all
+        workers holding partition ``group`` — for FRC exactly the
+        partition's repetition group), so the same ``slowdown`` budget
+        spread over unrelated workers leaves every group a fast member
+        while the targeted form stalls one group every round
+        (test-pinned: targeted hurts repcoded more than a uniform attack
+        of equal total budget).
 
     This is what the adapt/ controller reacts to: a policy tuned to the
     pre-shift regime stops being the best arm at ``round``.
     """
 
-    kind: str  # "heavytail" | "adversary"
+    kind: str  # "heavytail" | "adversary" | "targeted"
     round: int  # first round of the new regime
     alpha: float = 1.2  # heavytail: Pareto tail index
     worker: int = 0  # adversary: which worker turns slow
-    slowdown: float = 5.0  # adversary: extra seconds per round
+    slowdown: float = 5.0  # adversary/targeted: extra seconds per round
+    group: int = 0  # targeted: which coded partition group is attacked
 
     def __post_init__(self):
-        if self.kind not in ("heavytail", "adversary"):
+        if self.kind not in ("heavytail", "adversary", "targeted"):
             raise ValueError(
-                f"regime kind must be heavytail/adversary, got {self.kind!r}"
+                f"regime kind must be heavytail/adversary/targeted, "
+                f"got {self.kind!r}"
             )
         if self.round < 0:
             raise ValueError(f"regime round must be >= 0, got {self.round}")
         if self.kind == "heavytail" and self.alpha <= 0:
             raise ValueError(f"heavytail alpha must be > 0, got {self.alpha}")
-        if self.kind == "adversary" and self.slowdown < 0:
+        if self.kind in ("adversary", "targeted") and self.slowdown < 0:
             raise ValueError(
-                f"adversary slowdown must be >= 0, got {self.slowdown}"
+                f"{self.kind} slowdown must be >= 0, got {self.slowdown}"
+            )
+        if self.kind == "targeted" and self.group < 0:
+            raise ValueError(
+                f"targeted group must be >= 0, got {self.group}"
             )
 
 
@@ -152,13 +170,35 @@ class RegimeShift:
 _REGIME_SEED_BASE = 104_729
 
 
+def targeted_workers(layout, group: int) -> tuple[int, ...]:
+    """The worker set a ``"targeted"`` regime attacks: every worker
+    holding partition ``group % P`` of ``layout`` — for fractional
+    repetition exactly the members of that partition's repetition group
+    (all its replicas, the pattern arXiv:1901.08166 proves worst-case for
+    FRC), and for any other layout the partition's full replica set."""
+    assignment = np.asarray(layout.assignment)
+    p = int(group) % int(layout.n_partitions)
+    workers = np.flatnonzero((assignment == p).any(axis=1))
+    if workers.size == 0:
+        raise ValueError(
+            f"targeted regime: no worker holds partition {p} of layout "
+            f"{layout.name!r} — nothing to attack"
+        )
+    return tuple(int(w) for w in workers)
+
+
 def apply_regime_shift(
-    delays: np.ndarray, shift: RegimeShift, mean: float = 0.5
+    delays: np.ndarray,
+    shift: RegimeShift,
+    mean: float = 0.5,
+    workers=None,
 ) -> np.ndarray:
     """Rewrite a [R, W] delay matrix's rounds >= shift.round per the shift
     (deterministic: heavy-tail rounds re-seed per round exactly like
     :func:`reference_delay_schedule`, so every scheme in a paired sweep
-    sees the identical shifted stream)."""
+    sees the identical shifted stream). ``workers`` is the resolved
+    attacked set for the ``"targeted"`` kind (:func:`targeted_workers` —
+    the caller resolves it because only the caller holds the layout)."""
     out = np.array(delays, dtype=np.float64, copy=True)
     R, W = out.shape
     r0 = min(max(int(shift.round), 0), R)
@@ -171,6 +211,15 @@ def apply_regime_shift(
             out[i] = mean * rs.pareto(shift.alpha, W)
     elif shift.kind == "adversary":
         out[r0:, shift.worker % W] += shift.slowdown
+    elif shift.kind == "targeted":
+        if workers is None:
+            raise ValueError(
+                "targeted regime shift needs the resolved attacked worker "
+                "set (straggler.targeted_workers(layout, group)); the "
+                "delay matrix alone cannot name a coded group"
+            )
+        idx = np.asarray(sorted(int(w) % W for w in workers), dtype=int)
+        out[r0:, idx] += shift.slowdown
     return out
 
 
@@ -244,6 +293,7 @@ def arrival_schedule(
     regime: RegimeShift | None = None,
     trace=None,
     trace_speed: np.ndarray | None = None,
+    regime_workers=None,
 ) -> np.ndarray:
     """The full [rounds, W] arrival-time matrix for a run.
 
@@ -261,7 +311,12 @@ def arrival_schedule(
     replacing i.i.d.-exponential-only injection with real cluster replay;
     ``add_delay`` is ignored (the trace IS the delay schedule) while
     ``regime`` and the ``arrival_model`` compute terms still compose on
-    top, so heterogeneity studies run against recorded streams too."""
+    top, so heterogeneity studies run against recorded streams too.
+
+    ``regime_workers`` is the resolved attacked worker set for a
+    ``"targeted"`` regime (:func:`targeted_workers`); like the adversary
+    kind, a targeted attack applies even with delays off (a slowed group
+    is slow whether or not the exponential stream is injected)."""
     if trace is not None:
         delays = replay_arrival_trace(trace, rounds, n_workers, trace_speed)
     elif add_delay:
@@ -269,8 +324,10 @@ def arrival_schedule(
     else:
         delays = np.zeros((rounds, n_workers))
     if regime is not None and (
-        add_delay or trace is not None or regime.kind == "adversary"
+        add_delay
+        or trace is not None
+        or regime.kind in ("adversary", "targeted")
     ):
-        delays = apply_regime_shift(delays, regime, mean)
+        delays = apply_regime_shift(delays, regime, mean, regime_workers)
     model = arrival_model or ArrivalModel()
     return model.arrivals(delays)

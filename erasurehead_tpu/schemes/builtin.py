@@ -205,6 +205,21 @@ def _validate_partial(cfg) -> None:
         )
 
 
+def _validate_frc(cfg) -> None:
+    # the reference guard (src/replication.py:24-26), surfaced at CONFIG
+    # time: frc_layout raises the same constraint deep inside layout
+    # construction, which is too late for callers picking a worker count
+    # online (elastic re-layout onto W' survivors) — they need the
+    # violated invariant named before any compute is spent
+    if cfg.n_workers % (cfg.n_stragglers + 1):
+        raise ValueError(
+            f"scheme={cfg.scheme.value!r} needs (n_stragglers+1) | "
+            f"n_workers for its fractional-repetition layout (reference "
+            f"guard src/replication.py:24-26); got n_workers="
+            f"{cfg.n_workers}, n_stragglers={cfg.n_stragglers}"
+        )
+
+
 def _validate_deadline(cfg) -> None:
     if cfg.deadline is None or cfg.deadline <= 0:
         raise ValueError(
@@ -323,6 +338,7 @@ FRC = register(SchemeDescriptor(
     ),
     optimal_decode=lstsq_optimal_decode,
     exact=True,
+    validate_config=_validate_frc,
     artifact_stem="replication_acc",  # src/replication.py
     builtin=True,
 ))
@@ -345,6 +361,7 @@ APPROX = register(SchemeDescriptor(
     optimal_decode=lstsq_optimal_decode,
     needs_num_collect=True,
     config_fields=("num_collect",),
+    validate_config=_validate_frc,  # AGC shares FRC's grouped layout
     # the straggler sweep's "interesting regime collects fewer than all"
     sweep_num_collect=lambda n_workers: n_workers // 2,
     builtin=True,

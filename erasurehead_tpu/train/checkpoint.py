@@ -121,6 +121,79 @@ def latest(checkpoint_dir: str) -> Optional[str]:
     return None
 
 
+#: controller-state sidecar inside a ``round_N`` directory (elastic
+#: membership driver): written AFTER the orbax commit, so a kill between
+#: the two leaves a committed-but-auxless checkpoint that the aux-aware
+#: resume path skips (falling back older) instead of resuming with state
+#: but no membership ledger
+AUX_NAME = "elastic_aux.json"
+
+
+def save_aux(path: str, aux: dict) -> None:
+    """Atomically attach a JSON sidecar to checkpoint directory ``path``
+    (write-to-temp + rename: a kill mid-write never leaves a torn aux)."""
+    import json
+
+    target = os.path.join(os.path.abspath(path), AUX_NAME)
+    tmp = target + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(aux, f)
+    os.replace(tmp, target)
+
+
+def load_aux(path: str) -> Optional[dict]:
+    """The checkpoint's aux sidecar, or None (absent or torn)."""
+    import json
+
+    target = os.path.join(os.path.abspath(path), AUX_NAME)
+    try:
+        with open(target) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def save_with_aux(
+    path: str, state: OptState, next_round: int, aux: dict
+) -> None:
+    """Checkpoint plus controller-state sidecar (elastic driver): the aux
+    commits only after the orbax save does, so every recoverable
+    checkpoint carries a consistent (state, ledger) pair."""
+    save(path, state, next_round)
+    save_aux(path, aux)
+
+
+def restore_latest_with_aux(
+    checkpoint_dir: str, template_state: OptState
+) -> Optional[Tuple[OptState, int, str, dict]]:
+    """Like :func:`restore_latest`, but only candidates carrying a
+    readable aux sidecar qualify — a checkpoint without its membership
+    ledger cannot resume an elastic run, so it is skipped with a warning
+    exactly like a torn one. Returns (state, next_round, path, aux)."""
+    for path in _candidates(checkpoint_dir):
+        if not is_valid(path):
+            _warn_invalid(path, "partially written: commit marker missing")
+            continue
+        aux = load_aux(path)
+        if aux is None:
+            _warn_invalid(
+                path, "aux sidecar missing/torn (killed between orbax "
+                "commit and aux write)"
+            )
+            continue
+        try:
+            state, next_round = restore(path, template_state)
+        except Exception as e:  # noqa: BLE001 — any torn checkpoint must
+            # fall back, whatever layer of orbax/tensorstore it broke in
+            _warn_invalid(
+                path, f"restore failed: {type(e).__name__}: "
+                f"{str(e).splitlines()[0][:160]}"
+            )
+            continue
+        return state, next_round, path, aux
+    return None
+
+
 def restore_latest(
     checkpoint_dir: str, template_state: OptState
 ) -> Optional[Tuple[OptState, int, str]]:

@@ -363,12 +363,22 @@ def default_arrivals(cfg: RunConfig) -> np.ndarray:
         rng = np.random.default_rng(cfg.seed + 10_007)
         s = float(cfg.worker_speed_spread)
         trace_speed = rng.uniform(1.0 - s, 1.0 + s, cfg.n_workers)
+    regime = chaos_lib.active_regime()
+    regime_workers = None
+    if regime is not None and regime.kind == "targeted":
+        # a targeted attack slows every replica of one coded partition
+        # group — the attacked set is a property of THIS config's layout,
+        # so only this resolver (which can build it) can name the workers
+        regime_workers = straggler.targeted_workers(
+            build_layout(cfg), regime.group
+        )
     return straggler.arrival_schedule(
         cfg.rounds, cfg.n_workers, cfg.add_delay, cfg.delay_mean,
         arrival_model=straggler.model_from_config(cfg),
-        regime=chaos_lib.active_regime(),
+        regime=regime,
         trace=trace,
         trace_speed=trace_speed,
+        regime_workers=regime_workers,
     )
 
 

@@ -10,7 +10,9 @@ The chaos harness (tools/chaos_sweep.py, ``make chaos-smoke``) drives
 kill→resume cycles through it and asserts the resumed sweep's rows are
 identical to an uninterrupted baseline.
 
-Spec grammar (``ERASUREHEAD_CHAOS=mode:site:count[:message]``):
+Spec grammar (``ERASUREHEAD_CHAOS=spec[,spec...]`` — a comma-separated
+list of independently armed faults; each spec is
+``mode:site:count[:message]``):
 
   - ``mode``   — ``kill`` (the process dies via ``os._exit`` with
                  :data:`KILL_EXIT`, simulating a preemption: no cleanup, no
@@ -18,13 +20,16 @@ Spec grammar (``ERASUREHEAD_CHAOS=mode:site:count[:message]``):
                  ``raise`` (a :class:`ChaosInjection` whose message carries
                  an XLA-style status marker, default ``RESOURCE_EXHAUSTED``,
                  so the cohort-degradation guard exercises its real
-                 classification path);
+                 classification path). For the MEMBERSHIP sites below the
+                 mode field is a WORKER ID instead (an integer — the fault
+                 is a membership change, not a process fault).
   - ``site``   — which instrumented hook arms: ``trajectory`` (after a
                  sweep trajectory's summary row is finalized/journaled —
                  experiments.compare), ``cohort`` (at the head of a
                  trajectory-batched cohort dispatch — trainer.train_cohort),
                  ``checkpoint`` (at the head of checkpoint.save, i.e. the
-                 save never commits);
+                 save never commits), ``adapt`` / ``elastic`` (the chunk
+                 boundaries of the adaptive and elastic drivers);
   - ``count``  — fire on the Nth invocation of that site (``2``), or on the
                  Nth and every later one (``2+`` — e.g. ``raise:cohort:1+``
                  fails every cohort dispatch, forcing full degradation to
@@ -33,8 +38,17 @@ Spec grammar (``ERASUREHEAD_CHAOS=mode:site:count[:message]``):
                  OOM from it (``raise:cohort:1:UNAVAILABLE`` produces a
                  retryable transient instead of an OOM-style failure).
 
+Membership sites (:data:`MEMBERSHIP_SITES`, consumed by the elastic
+membership driver — erasurehead_tpu/elastic/) use the worker-id form
+``worker:site:count``: ``3:worker_death:2`` kills live worker 3 at the
+elastic driver's 2nd chunk boundary, and ``3:worker_revive:5`` offers it
+back at the 5th — so one env var drives a full die-then-rejoin cycle::
+
+    ERASUREHEAD_CHAOS=3:worker_death:2,3:worker_revive:5
+
 The hook is a no-op when the env var is unset; library code pays one dict
 lookup. Invocation counters are process-global (:func:`reset` for tests).
+Multi-spec messages cannot contain commas (the list separator).
 """
 
 from __future__ import annotations
@@ -52,8 +66,18 @@ KILL_EXIT = 43
 
 #: instrumented call sites ("adapt" fires at the adaptive controller's
 #: chunk boundaries — adapt/driver.py — so kill→resume decision-replay
-#: invariance is testable mid-adaptation)
-SITES = ("trajectory", "cohort", "checkpoint", "adapt")
+#: invariance is testable mid-adaptation; "elastic" is the same hook in
+#: the elastic membership driver — elastic/driver.py)
+SITES = (
+    "trajectory", "cohort", "checkpoint", "adapt", "elastic",
+    "worker_death", "worker_revive",
+)
+
+#: sites whose fault is a MEMBERSHIP change (a worker dying or offering
+#: to join) rather than a process fault; their specs carry a worker id in
+#: the mode field and fire through :func:`fire_membership`, never
+#: :func:`maybe_fire`
+MEMBERSHIP_SITES = ("worker_death", "worker_revive")
 
 
 class ChaosInjection(RuntimeError):
@@ -64,16 +88,18 @@ class ChaosInjection(RuntimeError):
 
 @dataclasses.dataclass(frozen=True)
 class ChaosSpec:
-    mode: str  # "kill" | "raise"
+    mode: str  # "kill" | "raise" | "member" (membership sites)
     site: str
     count: int  # 1-based invocation number that fires
     sticky: bool  # True = fire on count and every later invocation
     message: str
+    worker: Optional[int] = None  # membership sites: which worker
 
 
 def parse_spec(spec: str) -> ChaosSpec:
-    """Parse ``mode:site:count[:message]``; loud on malformed specs — a
-    typo'd chaos run silently doing nothing would invalidate the harness."""
+    """Parse one ``mode:site:count[:message]`` spec (worker-id mode for
+    membership sites); loud on malformed specs — a typo'd chaos run
+    silently doing nothing would invalidate the harness."""
     parts = spec.split(":", 3)
     if len(parts) < 3:
         raise ValueError(
@@ -81,12 +107,28 @@ def parse_spec(spec: str) -> ChaosSpec:
         )
     mode, site, count = parts[0], parts[1], parts[2]
     message = parts[3] if len(parts) > 3 else "RESOURCE_EXHAUSTED"
-    if mode not in ("kill", "raise"):
-        raise ValueError(f"{CHAOS_ENV}={spec!r}: mode must be kill|raise")
     if site not in SITES:
         raise ValueError(
             f"{CHAOS_ENV}={spec!r}: site must be one of {SITES}"
         )
+    worker = None
+    if site in MEMBERSHIP_SITES:
+        # membership grammar: the first field is the worker id the event
+        # concerns (3:worker_death:2 = worker 3 dies at invocation 2)
+        try:
+            worker = int(mode)
+        except ValueError:
+            raise ValueError(
+                f"{CHAOS_ENV}={spec!r}: membership sites take a worker id "
+                f"first (e.g. 3:{site}:2), got {mode!r}"
+            ) from None
+        if worker < 0:
+            raise ValueError(
+                f"{CHAOS_ENV}={spec!r}: worker id must be >= 0"
+            )
+        mode = "member"
+    elif mode not in ("kill", "raise"):
+        raise ValueError(f"{CHAOS_ENV}={spec!r}: mode must be kill|raise")
     sticky = count.endswith("+")
     try:
         n = int(count[:-1] if sticky else count)
@@ -97,8 +139,15 @@ def parse_spec(spec: str) -> ChaosSpec:
     if n < 1:
         raise ValueError(f"{CHAOS_ENV}={spec!r}: count must be >= 1")
     return ChaosSpec(
-        mode=mode, site=site, count=n, sticky=sticky, message=message
+        mode=mode, site=site, count=n, sticky=sticky, message=message,
+        worker=worker,
     )
+
+
+def parse_specs(value: str) -> list[ChaosSpec]:
+    """Parse the full env value: a comma-separated spec list (one spec,
+    no comma, is the historical grammar unchanged)."""
+    return [parse_spec(part) for part in value.split(",") if part]
 
 
 _counts: dict[str, int] = {}
@@ -110,32 +159,83 @@ def reset() -> None:
 
 
 def active() -> Optional[ChaosSpec]:
-    """The armed spec, or None when chaos is off."""
-    spec = os.environ.get(CHAOS_ENV)
-    return parse_spec(spec) if spec else None
+    """The first armed spec, or None when chaos is off (compat accessor;
+    multi-spec callers use :func:`active_specs`)."""
+    specs = active_specs()
+    return specs[0] if specs else None
+
+
+def active_specs() -> list[ChaosSpec]:
+    """All armed specs ([] when chaos is off)."""
+    value = os.environ.get(CHAOS_ENV)
+    return parse_specs(value) if value else []
+
+
+def _fires(spec: ChaosSpec, n: int) -> bool:
+    return n == spec.count or (spec.sticky and n > spec.count)
 
 
 def maybe_fire(site: str) -> None:
     """Count one invocation of ``site``; fire the armed fault if its
-    trigger condition is met. No-op (beyond one env lookup) when unarmed."""
+    trigger condition is met. No-op (beyond one env lookup) when unarmed.
+    Membership sites never fire here (:func:`fire_membership`)."""
     if CHAOS_ENV not in os.environ:
         return
-    spec = active()
-    if spec is None or spec.site != site:
+    specs = [s for s in active_specs() if s.site == site]
+    if not specs or site in MEMBERSHIP_SITES:
         return
     _counts[site] = _counts.get(site, 0) + 1
     n = _counts[site]
-    if n != spec.count and not (spec.sticky and n > spec.count):
-        return
-    if spec.mode == "kill":
-        # preemption semantics: no cleanup, no atexit — only what already
-        # reached disk (the journal flushes per line) survives
-        os._exit(KILL_EXIT)
-    raise ChaosInjection(
-        f"{spec.message}: chaos injection at site {site!r} "
-        f"(invocation {n}, spec {spec.mode}:{spec.site}:"
-        f"{spec.count}{'+' if spec.sticky else ''})"
+    for spec in specs:
+        if not _fires(spec, n):
+            continue
+        if spec.mode == "kill":
+            # preemption semantics: no cleanup, no atexit — only what
+            # already reached disk (the journal flushes per line) survives
+            os._exit(KILL_EXIT)
+        raise ChaosInjection(
+            f"{spec.message}: chaos injection at site {site!r} "
+            f"(invocation {n}, spec {spec.mode}:{spec.site}:"
+            f"{spec.count}{'+' if spec.sticky else ''})"
+        )
+
+
+def membership_fires(site: str, invocation: int) -> tuple[int, ...]:
+    """PURE query: the worker ids of armed MEMBERSHIP specs firing at the
+    1-based ``invocation`` of ``site``. No counters are touched — the
+    elastic driver indexes invocations by its own absolute chunk-boundary
+    number, so a killed-and-resumed run replays the identical membership
+    chaos without re-firing already-applied events (process-global
+    counters would restart at zero and shift every firing)."""
+    if site not in MEMBERSHIP_SITES:
+        raise ValueError(
+            f"membership_fires: {site!r} is not one of {MEMBERSHIP_SITES}"
+        )
+    if invocation < 1:
+        raise ValueError(f"invocation must be >= 1, got {invocation}")
+    if CHAOS_ENV not in os.environ:
+        return ()
+    return tuple(
+        s.worker
+        for s in active_specs()
+        if s.site == site and _fires(s, invocation)
     )
+
+
+def fire_membership(site: str) -> tuple[int, ...]:
+    """Counter-based form of :func:`membership_fires`: count one
+    invocation of ``site`` and return the worker ids firing at it. Never
+    kills or raises; returns () when unarmed."""
+    if site not in MEMBERSHIP_SITES:
+        raise ValueError(
+            f"fire_membership: {site!r} is not one of {MEMBERSHIP_SITES}"
+        )
+    if CHAOS_ENV not in os.environ:
+        return ()
+    if not any(s.site == site for s in active_specs()):
+        return ()
+    _counts[site] = _counts.get(site, 0) + 1
+    return membership_fires(site, _counts[site])
 
 
 # ---------------------------------------------------------------------------
@@ -149,7 +249,11 @@ def maybe_fire(site: str) -> None:
 #: (``kind:round[:param[:param2]]``): ``heavytail:50[:alpha]`` switches
 #: the delay stream from exponential to Pareto(alpha)-tailed at round 50;
 #: ``adversary:50[:worker[:slowdown]]`` turns one worker adversarially
-#: slow from round 50 (arXiv:1901.08166's fixed-straggler worst case).
+#: slow from round 50 (arXiv:1901.08166's fixed-straggler worst case);
+#: ``targeted:50[:group[:slowdown]]`` slows EVERY replica of one coded
+#: partition group at once — the fractional-repetition worst case the same
+#: paper proves (the attacked workers are derived from the run's layout by
+#: trainer.default_arrivals; see straggler.targeted_workers).
 #: Consumed by trainer.default_arrivals — unset, arrival schedules are
 #: byte-for-byte what they always were.
 REGIME_ENV = "ERASUREHEAD_REGIME"
@@ -181,8 +285,14 @@ def parse_regime(spec: str):
         return RegimeShift(
             kind=kind, round=rnd, worker=worker, slowdown=slowdown
         )
+    if kind == "targeted":
+        group = int(parts[2]) if len(parts) > 2 else 0
+        slowdown = float(parts[3]) if len(parts) > 3 else 5.0
+        return RegimeShift(
+            kind=kind, round=rnd, group=group, slowdown=slowdown
+        )
     raise ValueError(
-        f"{REGIME_ENV}={spec!r}: kind must be heavytail|adversary"
+        f"{REGIME_ENV}={spec!r}: kind must be heavytail|adversary|targeted"
     )
 
 

@@ -1,0 +1,11 @@
+"""event-schema violations against the elastic `membership` record: a
+missing required field (action/n_workers absent), and a journal-logger
+emit missing the round — the contract the elastic driver's decision
+journal (elastic/driver.py) must satisfy."""
+
+from erasurehead_tpu.obs import events as events_lib
+
+
+def emit_membership(logger):
+    events_lib.emit("membership", round=0)  # missing action, n_workers
+    logger.emit("membership", action="death", n_workers=4)  # missing round

@@ -11,6 +11,10 @@ def emit_run(run_id, fields):
         chunk_rounds=10,  # optional extras ride along
     )
     events_lib.emit("rounds", **fields)  # dynamic payload: runtime's job
+    events_lib.emit(  # membership record, full required set + extras
+        "membership", round=5, action="relayout", n_workers=6,
+        workers=[0, 1, 2, 3, 4, 5], epoch=1,
+    )
 
 
 def write_artifacts(paths):

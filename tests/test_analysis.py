@@ -95,6 +95,20 @@ def test_schema_fixture_clean_counterpart():
     assert _unsup(_lint(_fx("schema_ok.py"))) == []
 
 
+def test_schema_membership_fixture():
+    """The elastic `membership` record is lint-enforced like every other
+    type: emits missing required fields (round/action/n_workers) are
+    findings, and the clean counterpart's full-field membership emit in
+    schema_ok.py stays silent."""
+    findings = _unsup(
+        _lint(_fx("schema_membership_bad.py")), "event-schema"
+    )
+    msgs = "\n".join(f.message for f in findings)
+    assert "action" in msgs and "n_workers" in msgs
+    assert "round" in msgs  # the logger-object emit is checked too
+    assert len(findings) == 2
+
+
 def test_schema_validator_drift_fixture():
     findings = _unsup(_lint(_fx("schema_drift_bad.py")), "event-schema")
     assert len(findings) == 1

@@ -6,6 +6,7 @@ eval replay -> the five reference artifacts on disk. Deduped compute mode
 keeps it fast on the CPU mesh.
 """
 
+import json
 import os
 
 import numpy as np
@@ -231,6 +232,51 @@ def test_cli_kill_workers_more_validation():
         cli.run(base, kill_workers="1:2", death_timeout=5.0, quiet=True)
     with pytest.raises(ValueError, match="outside"):
         cli.run(base, kill_workers="9:2", quiet=True)
+
+
+def test_cli_elastic_online(tmp_path):
+    """--elastic on: online membership through the CLI — two scripted
+    deaths are DETECTED from telemetry and the run re-layouts, with the
+    membership journal landing beside the events log under telemetry."""
+    data_dir = str(tmp_path / "d")
+    rc = cli.main([
+        "--scheme", "naive", "--workers", "8", "--stragglers", "0",
+        "--rounds", "18", "--rows", "256", "--cols", "8", "--lr", "1.0",
+        "--add-delay", "--kill-workers", "6:4,7:4", "--elastic", "on",
+        "--elastic-chunk", "6", "--death-rounds", "2",
+        "--death-timeout", "4.0", "--telemetry", "on",
+        "--input-dir", data_dir,
+        "--output-dir", str(tmp_path / "out"), "--quiet",
+    ])
+    assert rc == 0
+    journal = tmp_path / "out" / "elastic_journal.jsonl"
+    assert journal.exists()
+    from erasurehead_tpu.obs import events as events_lib
+
+    assert not events_lib.validate_file(str(journal))
+    recs = [json.loads(line) for line in open(journal)]
+    assert any(r.get("action") == "relayout" for r in recs)
+
+
+def test_cli_elastic_flag_validation():
+    parser = cli._flags_parser()
+    base = [
+        "--scheme", "naive", "--workers", "4", "--rounds", "4",
+        "--rows", "64", "--cols", "8",
+    ]
+    for extra, msg in (
+        (["--elastic", "on", "--adapt", "on"], "adapt"),
+        (["--elastic", "on", "--on-death", "failover",
+          "--kill-workers", "1:2", "--death-timeout", "2.0"], "on-death"),
+        (["--elastic", "on", "--checkpoint-dir", "/tmp/x",
+          "--checkpoint-every", "2"], "checkpoint"),
+        (["--elastic-chunk", "0"], "elastic-chunk"),
+        (["--death-rounds", "0"], "death-rounds"),
+        (["--death-timeout", "2.0"], "death-timeout"),
+    ):
+        ns = parser.parse_args(base + extra)
+        with pytest.raises(SystemExit):
+            cli._validate_checkpoint_flags(parser, ns)
 
 
 def test_cli_dense_margin_cols_validation():

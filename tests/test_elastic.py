@@ -1,0 +1,544 @@
+"""Elastic membership (ISSUE 11): online death detection from telemetry,
+mid-run re-layout, worker join, adversarial targeted straggler attacks,
+chaos worker_death/worker_revive sites, and the kill->resume row
+rehydration contract.
+
+The controller must decide membership from what the run itself observed
+(the -1 never-collected sentinel, detect_dead timeout trips) — never from
+the scripted ground truth the tests construct the world with.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from erasurehead_tpu import elastic
+from erasurehead_tpu.data.synthetic import generate_gmm
+from erasurehead_tpu.elastic.controller import (
+    ElasticConfig,
+    MembershipController,
+)
+from erasurehead_tpu.obs import events as obs_events
+from erasurehead_tpu.ops import codes
+from erasurehead_tpu.parallel import collect, straggler
+from erasurehead_tpu.utils import chaos as chaos_lib
+from erasurehead_tpu.utils.config import RunConfig
+
+W, R, CHUNK = 8, 30, 5
+
+
+@pytest.fixture(autouse=True)
+def _reset_chaos():
+    chaos_lib.reset()
+    yield
+    chaos_lib.reset()
+
+
+@pytest.fixture(scope="module")
+def ds():
+    return generate_gmm(32 * W, 16, n_partitions=W, seed=0)
+
+
+def _cfg(**kw):
+    base = dict(
+        scheme="naive", n_workers=W, n_stragglers=0, rounds=R,
+        n_rows=32 * W, n_cols=16, lr_schedule=1.0, update_rule="AGD",
+        add_delay=True, seed=0,
+    )
+    base.update(kw)
+    return RunConfig(**base)
+
+
+def _ecfg(**kw):
+    base = dict(chunk_rounds=CHUNK, death_rounds=3, timeout=4.0)
+    base.update(kw)
+    return ElasticConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# controller unit behavior
+
+
+def test_controller_streaks_and_k_rule():
+    """K CONSECUTIVE suspect rounds declare death; a single arrival resets
+    the streak (the all--1 vs transiently-slow distinction)."""
+    ctl = MembershipController(4, _ecfg(death_rounds=3))
+    # worker 3 silent all 3 rounds; worker 2 silent twice then arrives
+    wt = np.array([
+        [0.1, 0.2, -1.0, -1.0],
+        [0.1, 0.2, -1.0, -1.0],
+        [0.1, 0.2, 0.3, -1.0],
+    ])
+    obs = ctl.observe_chunk(0, wt)
+    assert obs.deaths == (3,)
+    change = ctl.commit(3)
+    assert change.dead == (3,) and change.n_workers_after == 3
+    assert ctl.active == (0, 1, 2)
+    # streaks carry ACROSS chunks: two more silent rounds finish worker 2
+    ctl2 = MembershipController(4, _ecfg(death_rounds=3))
+    ctl2.observe_chunk(0, wt[:2])  # streaks: w2=2, w3=2
+    obs2 = ctl2.observe_chunk(2, np.array([[0.1, 0.2, -1.0, -1.0]]))
+    assert set(obs2.deaths) == {2, 3}
+
+
+def test_controller_timeout_trip_counts_as_suspect():
+    """A finite arrival beyond the master's patience suspects the worker
+    exactly like the sentinel (failures.detect_dead semantics)."""
+    ctl = MembershipController(3, _ecfg(death_rounds=2, timeout=1.0))
+    wt = np.array([[0.1, 0.2, 50.0], [0.1, 0.2, 60.0]])
+    obs = ctl.observe_chunk(0, wt)
+    assert obs.deaths == (2,)
+
+
+def test_controller_evidential_gate_blocks_early_stop_sentinels():
+    """The false-eviction regression found at the canonical W=30 AGC
+    collect=15 config: a sentinel in a round the master ended EARLY
+    (sim < window) is 'stopped listening', not death evidence — the
+    streak must not advance on it, while a full-window round advances it
+    and an in-patience arrival still resets everything."""
+    ctl = MembershipController(
+        3, _ecfg(death_rounds=2, timeout=4.0, absence_rounds=100)
+    )
+    # worker 2 uncollected 4 rounds straight, but every round stopped
+    # early (the AGC first-k pattern): NEVER declared dead
+    wt = np.array([[0.1, 0.2, -1.0]] * 4)
+    obs = ctl.observe_chunk(0, wt, sim_time=np.full(4, 0.3), window=4.0)
+    assert obs.deaths == ()
+    assert ctl._streaks[2] == 0 and ctl._absence[2] == 4
+    # two full-window rounds with the sentinel: now it IS evidence
+    obs = ctl.observe_chunk(
+        4, wt[:2], sim_time=np.full(2, 4.0), window=4.0
+    )
+    assert obs.deaths == (2,)
+    death = next(d for d in ctl.decisions if d["action"] == "death")
+    assert death["rule"] == "streak"
+
+
+def test_controller_absence_backstop():
+    """A scheme with slack never produces evidential rounds for a dead
+    worker (AGC keeps ending early on the survivors); the long-window
+    absence rule catches it anyway, and an occasional collection resets
+    the window so rotating early-stop policies never false-positive."""
+    ctl = MembershipController(
+        3, _ecfg(death_rounds=2, timeout=4.0, absence_rounds=6)
+    )
+    cheap = np.full(3, 0.3)
+    # healthy worker 1: uncollected often but arrives sometimes
+    w1 = [[0.1, -1.0, -1.0], [0.1, -1.0, -1.0], [0.1, 0.2, -1.0]]
+    obs = ctl.observe_chunk(0, np.array(w1), sim_time=cheap, window=4.0)
+    assert obs.deaths == ()
+    # worker 2 stays absent: 3 + 3 = 6 consecutive rounds -> absence rule
+    w2 = [[0.1, 0.3, -1.0]] * 3
+    obs = ctl.observe_chunk(3, np.array(w2), sim_time=cheap, window=4.0)
+    assert obs.deaths == (2,)
+    death = next(d for d in ctl.decisions if d["action"] == "death")
+    assert death["rule"] == "absence" and death["absent"] == 6
+
+
+def test_online_detection_no_false_positives_under_agc(ds):
+    """Driver-level pin of the same regression: an AGC run collecting
+    half the cluster every round must evict ONLY the genuinely dead
+    workers (via the absence backstop), never the healthy ones the stop
+    rule left uncollected."""
+    cfg = _cfg(scheme="approx", n_stragglers=1, num_collect=4, rounds=40)
+    res = elastic.train_elastic_online(
+        cfg, ds,
+        elastic=_ecfg(chunk_rounds=8, death_rounds=3),
+        deaths={6: 5, 7: 5},
+    )
+    dead = sorted(
+        d["worker"] for d in res.decisions if d["action"] == "death"
+    )
+    assert dead == [6, 7], res.decisions
+    assert all(
+        d["rule"] == "absence"
+        for d in res.decisions
+        if d["action"] == "death"
+    )
+    assert res.epochs[-1]["n_workers"] == 6
+
+
+def test_controller_collapse_probe_corroborates():
+    """A collapsed arrival regime (shift_factor jump) halves the streak
+    threshold: a half-streak suspect is promoted at the probe."""
+    ctl = MembershipController(3, _ecfg(death_rounds=4, shift_factor=2.0))
+    ctl.observe_chunk(0, np.array([[0.1, 0.2, -1.0], [0.1, 0.2, -1.0]]))
+    assert not ctl._pending_deaths  # streak 2 < K=4
+    # arrival mean jumps 10x -> collapse; streak 4 >= ceil(4/2)=2 anyway,
+    # but a FRESH half-streak worker is also promoted
+    obs = ctl.observe_chunk(
+        2, np.array([[3.0, 2.0, -1.0], [1.5, 2.5, -1.0]])
+    )
+    assert obs.collapse
+    assert 2 in obs.deaths
+    assert any(d["action"] == "probe" for d in ctl.decisions)
+
+
+def test_controller_join_and_min_workers():
+    ctl = MembershipController(3, _ecfg(death_rounds=1, min_workers=2))
+    # both 1 and 2 silent -> both suspected; the floor keeps one
+    ctl.observe_chunk(0, np.array([[0.1, -1.0, -1.0]]))
+    change = ctl.commit(1)
+    assert change.n_workers_after == 2  # floor held
+    assert len(change.dead) == 1
+    # the kept suspect stays pending; a join restores headroom and it goes
+    dead_w = change.dead[0]
+    kept = ({1, 2} - {dead_w}).pop()
+    assert ctl.request_join(dead_w, round=2)  # rejoin offer for the dead one
+    change2 = ctl.commit(2)
+    assert dead_w in change2.joined
+    assert kept in change2.dead  # pending suspect finally applied
+    # double-join offers are ignored
+    assert not ctl.request_join(0)
+
+
+def test_controller_snapshot_round_trip():
+    ctl = MembershipController(4, _ecfg())
+    ctl.observe_chunk(0, np.array([[0.1, 0.2, -1.0, 5.0]] * 2))
+    ctl.request_join(3) if 3 not in ctl.active else None
+    snap = json.loads(json.dumps(ctl.snapshot()))  # through JSON like aux
+    back = MembershipController.restore(snap, _ecfg())
+    assert back.active == ctl.active
+    assert back._streaks == ctl._streaks
+    assert back._pending_deaths == ctl._pending_deaths
+    assert back.decisions == ctl.decisions
+
+
+def test_elastic_config_validation():
+    with pytest.raises(ValueError, match="death_rounds"):
+        ElasticConfig(death_rounds=0)
+    with pytest.raises(ValueError, match="finite"):
+        ElasticConfig(timeout=np.inf)
+    with pytest.raises(ValueError, match="min_workers"):
+        ElasticConfig(min_workers=0)
+
+
+# ---------------------------------------------------------------------------
+# targeted straggler attacks (arXiv:1901.08166 — satellite)
+
+
+def test_targeted_workers_frc_group():
+    layout = codes.frc_layout(12, 2)
+    assert straggler.targeted_workers(layout, 0) == (0, 1, 2)
+    assert straggler.targeted_workers(layout, 4) == (3, 4, 5)  # partition 4
+
+
+def test_targeted_attack_hurts_frc_more_than_uniform():
+    """The 1901.08166 FRC worst case, pinned: slowing ALL replicas of one
+    partition group stalls every round (the group's first arrival IS the
+    attack), while the same total slowdown budget spread over workers in
+    distinct groups leaves every group a fast member."""
+    Wt, S, Rt = 12, 2, 30
+    layout = codes.frc_layout(Wt, S)
+    delays = straggler.reference_delay_schedule(Rt, Wt)
+    shift = straggler.RegimeShift(
+        kind="targeted", round=10, group=0, slowdown=5.0
+    )
+    tw = straggler.targeted_workers(layout, 0)
+    t_targeted = straggler.apply_regime_shift(delays, shift, workers=tw)
+    # equal budget: len(tw) workers x 5 s, one attacked worker per group
+    t_uniform = np.array(delays, copy=True)
+    t_uniform[10:, [0, 3, 6]] += 5.0
+    st = collect.collect_frc(t_targeted, layout.groups)
+    su = collect.collect_frc(t_uniform, layout.groups)
+    # pre-shift rounds identical; post-shift the targeted attack costs
+    # ~slowdown EVERY round, the uniform attack almost nothing
+    np.testing.assert_array_equal(st.sim_time[:10], su.sim_time[:10])
+    assert st.sim_time[10:].sum() > 2.0 * su.sim_time[10:].sum()
+    assert (st.sim_time[10:] >= 5.0).all()
+
+
+def test_targeted_regime_env_plumbing(tmp_path):
+    """ERASUREHEAD_REGIME=targeted:... resolves the attacked set from the
+    run's own layout inside trainer.default_arrivals."""
+    from erasurehead_tpu.train import trainer
+
+    s = chaos_lib.parse_regime("targeted:10:1:3.5")
+    assert (s.kind, s.round, s.group, s.slowdown) == ("targeted", 10, 1, 3.5)
+    cfg = _cfg(scheme="repcoded", n_stragglers=1, rounds=12)
+    layout = codes.frc_layout(W, 1)
+    os.environ["ERASUREHEAD_REGIME"] = "targeted:6:0:3.5"
+    try:
+        arr = trainer.default_arrivals(cfg)
+    finally:
+        del os.environ["ERASUREHEAD_REGIME"]
+    expect = straggler.apply_regime_shift(
+        straggler.reference_delay_schedule(12, W),
+        straggler.RegimeShift(
+            kind="targeted", round=6, group=0, slowdown=3.5
+        ),
+        workers=straggler.targeted_workers(layout, 0),
+    )
+    np.testing.assert_allclose(arr, expect)
+
+
+def test_targeted_needs_resolved_workers():
+    shift = straggler.RegimeShift(kind="targeted", round=0, group=0)
+    with pytest.raises(ValueError, match="targeted_workers"):
+        straggler.apply_regime_shift(np.zeros((4, 4)), shift)
+
+
+# ---------------------------------------------------------------------------
+# chaos grammar: multi-spec + membership sites (satellite)
+
+
+def test_chaos_multi_spec_and_membership_grammar():
+    specs = chaos_lib.parse_specs(
+        "3:worker_death:2,3:worker_revive:6,kill:elastic:4"
+    )
+    assert [s.site for s in specs] == [
+        "worker_death", "worker_revive", "elastic"
+    ]
+    assert specs[0].mode == "member" and specs[0].worker == 3
+    assert specs[2].mode == "kill" and specs[2].worker is None
+    with pytest.raises(ValueError, match="worker id"):
+        chaos_lib.parse_spec("kill:worker_death:2")
+    with pytest.raises(ValueError, match="site"):
+        chaos_lib.parse_spec("kill:nonsite:2")
+
+
+def test_chaos_membership_fires_is_pure():
+    os.environ[chaos_lib.CHAOS_ENV] = "5:worker_death:2,1:worker_death:3+"
+    try:
+        assert chaos_lib.membership_fires("worker_death", 1) == ()
+        assert chaos_lib.membership_fires("worker_death", 2) == (5,)
+        assert chaos_lib.membership_fires("worker_death", 4) == (1,)  # sticky
+        # pure: repeated queries at the same invocation agree
+        assert chaos_lib.membership_fires("worker_death", 2) == (5,)
+        # counter-based form walks the sequence
+        assert chaos_lib.fire_membership("worker_death") == ()
+        assert chaos_lib.fire_membership("worker_death") == (5,)
+        with pytest.raises(ValueError, match="not one of"):
+            chaos_lib.fire_membership("trajectory")
+    finally:
+        del os.environ[chaos_lib.CHAOS_ENV]
+
+
+def test_chaos_process_sites_ignore_membership_specs():
+    """maybe_fire must never kill/raise on a membership spec, and the
+    historical single-spec grammar still parses via active()."""
+    os.environ[chaos_lib.CHAOS_ENV] = "3:worker_death:1+"
+    try:
+        chaos_lib.maybe_fire("worker_death")  # no-op, never raises
+        assert chaos_lib.active().mode == "member"
+    finally:
+        del os.environ[chaos_lib.CHAOS_ENV]
+
+
+# ---------------------------------------------------------------------------
+# the driver: detection -> re-layout -> join, replay, journal
+
+
+def test_online_death_detection_and_relayout(ds):
+    res = elastic.train_elastic_online(
+        _cfg(), ds, elastic=_ecfg(), deaths={6: 7, 7: 7}
+    )
+    deaths = [d for d in res.decisions if d["action"] == "death"]
+    assert sorted(d["worker"] for d in deaths) == [6, 7]
+    relayouts = [d for d in res.decisions if d["action"] == "relayout"]
+    assert len(relayouts) == 1 and relayouts[0]["n_workers"] == 6
+    # the re-layout lands at the first chunk boundary after K=3 silent
+    # rounds (death at 7 -> streak complete at 9 -> boundary 10)
+    assert relayouts[0]["round"] == 10
+    hist = np.asarray(res.result.params_history)
+    assert hist.shape[0] == R and np.isfinite(hist).all()
+    # dead columns carry the -1 sentinel after the re-layout, original ids
+    assert (res.result.worker_times[10:, 6:] == -1.0).all()
+    assert not res.result.collected[10:, 6:].any()
+    # detection rounds were priced at the timeout, survivor rounds are not
+    assert (res.result.timeset[7:10] == 4.0).all()
+    # loss keeps improving through the whole membership change
+    from erasurehead_tpu.models.glm import LogisticModel
+
+    model = LogisticModel()
+    losses = [
+        float(model.loss_mean(hist[r], ds.X_train, ds.y_train))
+        for r in (0, 9, R - 1)
+    ]
+    assert losses[2] < losses[1] < losses[0]
+
+
+def test_online_join_scales_back_up(ds):
+    res = elastic.train_elastic_online(
+        _cfg(rounds=40), ds, elastic=_ecfg(),
+        deaths={7: 6}, revives={7: 21},
+    )
+    widths = [e["n_workers"] for e in res.epochs]
+    assert widths == [W, W - 1, W], widths
+    joins = [d for d in res.decisions if d["action"] == "join"]
+    assert [d["worker"] for d in joins] == [7]
+    # the rejoined worker's clocks are real again in the final epoch
+    start = res.epochs[-1]["start_round"]
+    assert (res.result.worker_times[start:, 7] > -1.0).any()
+
+
+def test_chaos_driven_membership(ds):
+    os.environ[chaos_lib.CHAOS_ENV] = "3:worker_death:2,3:worker_revive:5"
+    try:
+        res = elastic.train_elastic_online(
+            _cfg(rounds=40), ds, elastic=_ecfg()
+        )
+    finally:
+        del os.environ[chaos_lib.CHAOS_ENV]
+    widths = [e["n_workers"] for e in res.epochs]
+    assert widths == [W, W - 1, W], widths
+    assert [d["worker"] for d in res.decisions
+            if d["action"] == "death"] == [3]
+
+
+def test_replay_is_bitwise(ds):
+    import jax
+
+    kw = dict(elastic=_ecfg(), deaths={6: 7, 7: 7})
+    a = elastic.train_elastic_online(_cfg(), ds, **kw)
+    b = elastic.train_elastic_online(_cfg(), ds, **kw)
+    assert a.decisions == b.decisions
+    assert [elastic.science_fields(r) for r in a.rows] == [
+        elastic.science_fields(r) for r in b.rows
+    ]
+    for x, y in zip(
+        jax.tree.leaves(a.result.params_history),
+        jax.tree.leaves(b.result.params_history),
+    ):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_membership_events_validate(tmp_path, ds):
+    events_path = str(tmp_path / "events.jsonl")
+    with obs_events.capture(events_path):
+        res = elastic.train_elastic_online(
+            _cfg(), ds, elastic=_ecfg(), deaths={7: 7},
+            journal_dir=str(tmp_path),
+        )
+    for path in (events_path, res.journal_path):
+        errors = obs_events.validate_file(path)
+        assert not errors, f"{path}:\n" + "\n".join(errors)
+    recs = [
+        json.loads(line) for line in open(res.journal_path)
+    ]
+    actions = [r["action"] for r in recs if r["type"] == "membership"]
+    assert "death" in actions and "relayout" in actions
+    assert actions.count("chunk") == len(res.rows)
+    # report renders the section
+    from erasurehead_tpu.obs import report as report_lib
+
+    rendered = report_lib.render([res.journal_path])
+    assert "elastic membership:" in rendered
+
+
+def test_membership_validator_rejects_malformed():
+    def rec(seq, **kw):
+        base = {"type": "membership", "seq": seq, "t": 0.0}
+        base.update(kw)
+        return json.dumps(base)
+
+    lines = [
+        rec(0, round=0, action="relayout", n_workers=4),  # valid
+        rec(1, round=-1, action="death", n_workers=4),  # bad round
+        rec(2, round=1, action="resurrect", n_workers=4),  # bad action
+        rec(3, round=2, action="join", n_workers=0),  # bad count
+        rec(4, round=3, action="chunk", n_workers=4,
+            workers=[1, -2]),  # bad worker id list
+    ]
+    errors = obs_events.validate_lines(lines)
+    assert len(errors) == 4
+    assert "round" in errors[0]
+    assert "action" in errors[1]
+    assert "n_workers" in errors[2]
+    assert "workers" in errors[3]
+
+
+def test_resume_rehydrates_rows_bitwise(tmp_path, ds):
+    """An interrupted elastic run (here: a shorter first horizon standing
+    in for the chaos kill the smoke drives with real process death)
+    resumes from checkpoint+aux, REHYDRATES completed rows from the
+    journal, and matches the uninterrupted baseline bitwise."""
+    base = elastic.train_elastic_online(
+        _cfg(rounds=40), ds, elastic=_ecfg(), deaths={6: 7, 7: 7},
+    )
+    part_dir = str(tmp_path / "part")
+    os.makedirs(part_dir)
+    # leg 1: same world, stopped at round 20 (checkpoint + journal live)
+    elastic.train_elastic_online(
+        _cfg(rounds=20), ds, elastic=_ecfg(), deaths={6: 7, 7: 7},
+        journal_dir=part_dir, checkpoint_dir=os.path.join(part_dir, "ck"),
+    )
+    # leg 2: resume to the full horizon
+    res = elastic.train_elastic_online(
+        _cfg(rounds=40), ds, elastic=_ecfg(), deaths={6: 7, 7: 7},
+        journal_dir=part_dir, checkpoint_dir=os.path.join(part_dir, "ck"),
+        resume=True,
+    )
+    assert res.resumed_from == 20
+    assert [elastic.science_fields(r) for r in res.rows] == [
+        elastic.science_fields(r) for r in base.rows
+    ]
+    # control-plane arrays cover the FULL horizon on the resumed run
+    np.testing.assert_array_equal(
+        res.result.timeset, base.result.timeset
+    )
+    np.testing.assert_array_equal(
+        res.result.worker_times, base.result.worker_times
+    )
+    # resumed history covers [start_round, R) per the trainer convention
+    assert res.result.start_round == 20
+    hist = np.asarray(res.result.params_history)
+    base_hist = np.asarray(base.result.params_history)
+    np.testing.assert_array_equal(hist, base_hist[20:])
+
+
+def test_adapt_composition_reseeds_per_epoch(ds):
+    from erasurehead_tpu import adapt
+
+    arms = [adapt.Arm("naive"), adapt.Arm("avoidstragg")]
+    res = elastic.train_elastic_online(
+        _cfg(n_stragglers=1, compute_mode="deduped"), ds,
+        elastic=_ecfg(), deaths={7: 7}, adapt_arms=arms,
+    )
+    assert res.arm_decisions, "bandit never chose an arm"
+    epochs_seen = {d["epoch"] for d in res.arm_decisions}
+    assert epochs_seen == {0, 1}
+    # the epoch-1 bandit restarted its warmup: fresh values per layout
+    first_epoch1 = next(
+        d for d in res.arm_decisions if d["epoch"] == 1
+    )
+    assert first_epoch1["reason"] in ("warmup", "regime_shift")
+    # replay invariance holds with the bandit composed
+    res2 = elastic.train_elastic_online(
+        _cfg(n_stragglers=1, compute_mode="deduped"), ds,
+        elastic=_ecfg(), deaths={7: 7}, adapt_arms=arms,
+    )
+    assert res.arm_decisions == res2.arm_decisions
+
+
+def test_driver_refuses_partial_and_measured(ds):
+    with pytest.raises(ValueError, match="partial"):
+        elastic.train_elastic_online(
+            _cfg(scheme="partialrepcoded", n_stragglers=1,
+                 partitions_per_worker=4),
+            ds, elastic=_ecfg(),
+        )
+    with pytest.raises(ValueError, match="measured"):
+        elastic.train_elastic_online(
+            _cfg(arrival_mode="measured"), ds, elastic=_ecfg()
+        )
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        elastic.train_elastic_online(
+            _cfg(), ds, elastic=_ecfg(), resume=True
+        )
+
+
+def test_auto_survivor_config_shrinks_stragglers(ds):
+    """repcoded at W'=5 violates (s+1)|W' for s=1; the online controller
+    auto-shrinks to the largest valid s instead of dying mid-run."""
+    cfg = _cfg(scheme="repcoded", n_stragglers=1)
+    shrunk = elastic.auto_survivor_config(cfg, 5)
+    assert shrunk.n_workers == 5 and shrunk.n_stragglers == 0
+    # an EXPLICIT override is honored as-is — including its failure
+    with pytest.raises(ValueError, match="survivor_overrides"):
+        elastic.auto_survivor_config(
+            cfg, 5, survivor_overrides={"n_stragglers": 1}
+        )

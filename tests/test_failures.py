@@ -41,6 +41,87 @@ def test_detect_dead_timeout(arrivals):
     assert failures.detect_dead(slow, timeout=100.0)[0, 5]
 
 
+def test_detect_dead_sentinel_columns(arrivals):
+    """detect_dead on TELEMETRY (worker_times with the reference's -1
+    never-collected sentinel): an all--1 column is dead every round — the
+    sentinel must never read as 'arrived at t=-1', which would pass any
+    timeout — while a transiently-slow column is dead only in the rounds
+    its (finite, positive) arrival overran the timeout."""
+    wt = np.array(arrivals, copy=True)
+    wt[:, 3] = -1.0  # never collected, every round
+    wt[2, 5] = 500.0  # transiently slow: one round beyond the timeout
+    dead = failures.detect_dead(wt, timeout=100.0)
+    assert dead[:, 3].all()  # all--1 column: dead throughout
+    assert dead[2, 5] and not dead[np.arange(R) != 2, 5].any()
+    # the rest of the cluster is alive everywhere
+    others = np.delete(dead, [3, 5], axis=1)
+    assert not others.any()
+    # sentinel masking matches obs/events.arrival_summary's rule: the
+    # same entries arrival_summary masks out are the ones detect_dead
+    # calls dead at any timeout
+    from erasurehead_tpu.obs.events import arrival_summary
+
+    assert arrival_summary(wt[:, 3])["n_arrivals"] == 0
+    assert failures.detect_dead(wt[:, 3:4], timeout=np.inf)[:, 0].all()
+
+
+def test_survivor_config_validates_divisibility_up_front():
+    """Bugfix regression: an unlucky W' violating FRC's (s+1) | W' used to
+    raise deep inside layout construction; survivor_config (and
+    train_elastic through it) now fails at config-build time with an
+    error naming survivor_overrides — BEFORE any phase-1 compute."""
+    cfg = RunConfig(
+        scheme="approx", n_workers=8, n_stragglers=1, num_collect=6,
+        rounds=10, n_rows=256, n_cols=8, lr_schedule=1.0, add_delay=True,
+    )
+    # W'=5: (1+1) does not divide 5
+    with pytest.raises(ValueError, match="survivor_overrides"):
+        failures.survivor_config(cfg, 5)
+    # the clear error also names the violated constraint
+    with pytest.raises(ValueError, match="n_stragglers"):
+        failures.survivor_config(cfg, 5)
+    # a valid override passes and clamps num_collect to W'
+    cfg2 = failures.survivor_config(
+        cfg, 5, survivor_overrides={"n_stragglers": 0}
+    )
+    assert cfg2.n_workers == 5 and cfg2.num_collect == 5
+
+
+def test_train_elastic_divisibility_error_before_training():
+    """train_elastic with 3 deaths out of W=8 leaves W'=5, which breaks
+    approx's FRC layout at s=1: the ValueError must name
+    survivor_overrides and fire before any training happens."""
+    from erasurehead_tpu.data.synthetic import generate_gmm
+
+    ds = generate_gmm(64, 8, n_partitions=8, seed=0)
+    cfg = RunConfig(
+        scheme="approx", n_workers=8, n_stragglers=1, num_collect=6,
+        rounds=10, n_rows=64, n_cols=8, lr_schedule=1.0, add_delay=True,
+    )
+    with pytest.raises(ValueError, match="survivor_overrides"):
+        failures.train_elastic(cfg, ds, {5: 4, 6: 4, 7: 4})
+    # with the override, the same deaths recover fine
+    res, rep = failures.train_elastic(
+        cfg, ds, {5: 4, 6: 4, 7: 4},
+        survivor_overrides={"n_stragglers": 0},
+    )
+    assert rep.n_workers_after == 5
+    assert np.isfinite(np.asarray(res.params_history)).all()
+
+
+def test_frc_config_divisibility_validated_at_config_time():
+    """The registry descriptor's validate_config carries the reference
+    guard (src/replication.py:24-26) for the FRC-family schemes, so the
+    violation surfaces at RunConfig construction, not layout time."""
+    for scheme in ("repcoded", "approx"):
+        with pytest.raises(ValueError, match="n_stragglers"):
+            RunConfig(
+                scheme=scheme, n_workers=10, n_stragglers=2,
+                num_collect=5, rounds=4, n_rows=64, n_cols=8,
+                lr_schedule=1.0,
+            )
+
+
 @pytest.mark.parametrize(
     "scheme,layout_fn,kw,deaths,expect_feasible",
     [
@@ -374,6 +455,44 @@ def test_elastic_restart_mlp():
     l_end = float(model.loss_mean(
         jax.tree.map(lambda l: l[-1], hist), Xt, yt))
     assert l_end < l_at_death, (l_at_death, l_end)
+
+
+def test_elastic_dynamic_deadline_telemetry_feeds_detection():
+    """train_elastic(dynamic=True) x deadline interplay, round 2: the
+    on-device rule's telemetry must itself be usable as the membership
+    detector's input — detect_dead over the merged worker_times (sentinel
+    + deadline semantics) flags exactly the dead worker's post-death
+    rounds, and no alive worker accumulates a death-length streak. This
+    is the contract the elastic/ controller builds on."""
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.parallel.mesh import worker_mesh
+
+    Wd, Rd, DEATH = 8, 12, 5
+    ds = generate_gmm(32 * Wd, 16, n_partitions=Wd, seed=0)
+    cfg = RunConfig(
+        scheme="deadline", deadline=0.8, n_workers=Wd, n_stragglers=1,
+        rounds=Rd, n_rows=32 * Wd, n_cols=16, lr_schedule=1.0,
+        update_rule="AGD", add_delay=True, seed=0,
+    )
+    res, rep = failures.train_elastic(
+        cfg, ds, {2: DEATH}, mesh=worker_mesh(4), dynamic=True
+    )
+    # the dead column reads dead from the telemetry alone, every
+    # post-death round (sentinel), at any detection timeout
+    dead = failures.detect_dead(res.worker_times, timeout=cfg.deadline)
+    assert dead[DEATH:, 2].all()
+    # no surviving worker shows a K=3-round consecutive dead streak under
+    # a timeout at the deadline (a deadline miss stamps the sentinel, but
+    # the seeded exponential stream never misses 3 in a row here)
+    K = 3
+    alive_cols = [w for w in range(Wd) if w != 2]
+    for w in alive_cols:
+        col = dead[:, w]
+        streak = longest = 0
+        for s in col:
+            streak = streak + 1 if s else 0
+            longest = max(longest, streak)
+        assert longest < K, (w, longest)
 
 
 def test_elastic_dynamic_deadline_death_midrun():

@@ -1,0 +1,188 @@
+"""elastic-smoke: CPU end-to-end drive of the elastic membership
+controller (`make elastic-smoke`).
+
+Asserts, end to end:
+
+  1. chaos-driven die-then-rejoin: ``ERASUREHEAD_CHAOS=
+     3:worker_death:2,3:worker_revive:6`` kills live worker 3 at the 2nd
+     chunk boundary and offers it back at the 6th — the controller must
+     DETECT the death from telemetry alone (the -1 sentinel streak),
+     re-layout W -> W-1, then accept the join and re-layout back to W;
+  2. every decision and chunk row lands as a typed `membership` event and
+     both the driver journal and the telemetry capture validate
+     (obs/events.SCHEMA via the tools/validate_events.py logic);
+  3. kill -> resume row rehydration: a run chaos-killed at an elastic
+     chunk boundary (``kill:elastic:N``, preemption semantics) resumes
+     from its checkpoint + aux ledger, REHYDRATES the completed chunks'
+     rows bitwise from the journal, and finishes with the same rows and
+     final-params digest as an uninterrupted baseline;
+  4. `erasurehead-tpu report` renders the membership section from the
+     journal.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from erasurehead_tpu.obs import events as obs_events  # noqa: E402
+from erasurehead_tpu.utils.chaos import KILL_EXIT  # noqa: E402
+
+W, R, CHUNK = 8, 40, 5
+OUT = os.environ.get("ELASTIC_SMOKE_DIR", "/tmp/eh-elastic-smoke")
+
+#: the child program both smoke legs run: a seeded elastic run with a
+#: scripted 2-worker death, journaled + checkpointed into argv[1]
+_CHILD = """
+import json, os, sys
+from erasurehead_tpu.data.synthetic import generate_gmm
+from erasurehead_tpu import elastic
+from erasurehead_tpu.utils.config import RunConfig
+
+out = sys.argv[1]
+W, R = 8, 40
+ds = generate_gmm(32 * W, 16, n_partitions=W, seed=0)
+cfg = RunConfig(scheme="naive", n_workers=W, n_stragglers=0, rounds=R,
+                n_rows=32 * W, n_cols=16, lr_schedule=1.0,
+                update_rule="AGD", add_delay=True, seed=0)
+res = elastic.train_elastic_online(
+    cfg, ds,
+    elastic=elastic.ElasticConfig(chunk_rounds=5, death_rounds=3,
+                                  timeout=4.0),
+    deaths={6: 7, 7: 7},
+    journal_dir=out,
+    checkpoint_dir=os.path.join(out, "ckpt"),
+    resume=os.environ.get("EH_ELASTIC_RESUME") == "1",
+)
+with open(os.path.join(out, "rows.json"), "w") as f:
+    json.dump({
+        "rows": [elastic.science_fields(r) for r in res.rows],
+        "digest": res.rows[-1]["params_digest"],
+        "resumed_from": res.resumed_from,
+    }, f)
+"""
+
+
+def _run_child(out_dir, chaos=None, resume=False):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("ERASUREHEAD_CHAOS", None)
+    env.pop("EH_ELASTIC_RESUME", None)
+    if chaos:
+        env["ERASUREHEAD_CHAOS"] = chaos
+    if resume:
+        env["EH_ELASTIC_RESUME"] = "1"
+    return subprocess.run(
+        [sys.executable, "-c", _CHILD, out_dir], env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+
+
+def main() -> int:
+    import numpy as np
+
+    from erasurehead_tpu import elastic
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.utils import chaos as chaos_lib
+    from erasurehead_tpu.utils.config import RunConfig
+
+    shutil.rmtree(OUT, ignore_errors=True)
+    os.makedirs(OUT, exist_ok=True)
+
+    # ---- 1. chaos-driven die-then-rejoin ---------------------------------
+    ds = generate_gmm(32 * W, 16, n_partitions=W, seed=0)
+    cfg = RunConfig(
+        scheme="naive", n_workers=W, n_stragglers=0, rounds=R,
+        n_rows=32 * W, n_cols=16, lr_schedule=1.0, update_rule="AGD",
+        add_delay=True, seed=0,
+    )
+    jdir = os.path.join(OUT, "chaos")
+    os.makedirs(jdir, exist_ok=True)
+    os.environ["ERASUREHEAD_CHAOS"] = (
+        "3:worker_death:2,3:worker_revive:6"
+    )
+    chaos_lib.reset()
+    try:
+        events_path = os.path.join(jdir, "events.jsonl")
+        with obs_events.capture(events_path):
+            res = elastic.train_elastic_online(
+                cfg, ds,
+                elastic=elastic.ElasticConfig(
+                    chunk_rounds=CHUNK, death_rounds=3, timeout=4.0
+                ),
+                journal_dir=jdir,
+            )
+    finally:
+        del os.environ["ERASUREHEAD_CHAOS"]
+    actions = [d["action"] for d in res.decisions]
+    assert actions.count("relayout") == 2, res.decisions
+    assert "death" in actions and "join" in actions, res.decisions
+    widths = [e["n_workers"] for e in res.epochs]
+    assert widths == [W, W - 1, W], widths
+    hist = np.asarray(res.result.params_history)
+    assert hist.shape[0] == R and np.isfinite(hist).all()
+    print(
+        f"elastic-smoke: chaos die-then-rejoin OK "
+        f"(epoch widths {widths}, {len(res.rows)} chunk rows)"
+    )
+
+    # ---- 2. journal + capture validate -----------------------------------
+    for path in (res.journal_path, events_path):
+        errors = obs_events.validate_file(path)
+        assert not errors, f"{path} invalid:\n" + "\n".join(errors)
+    n_membership = sum(
+        1
+        for line in open(res.journal_path)
+        if json.loads(line).get("type") == "membership"
+    )
+    assert n_membership >= len(res.rows) + 4  # rows + death/join/relayouts
+    print(
+        f"elastic-smoke: {n_membership} membership events validate "
+        f"(journal + capture)"
+    )
+
+    # ---- 3. kill -> resume: rows rehydrate bitwise -----------------------
+    base_dir = os.path.join(OUT, "base")
+    kr_dir = os.path.join(OUT, "killresume")
+    os.makedirs(base_dir, exist_ok=True)
+    os.makedirs(kr_dir, exist_ok=True)
+    p = _run_child(base_dir)
+    assert p.returncode == 0, f"baseline leg rc={p.returncode}"
+    p = _run_child(kr_dir, chaos="kill:elastic:4")
+    assert p.returncode == KILL_EXIT, (
+        f"kill leg rc={p.returncode}, want {KILL_EXIT}"
+    )
+    assert not os.path.exists(os.path.join(kr_dir, "rows.json"))
+    p = _run_child(kr_dir, resume=True)
+    assert p.returncode == 0, f"resume leg rc={p.returncode}"
+    base = json.load(open(os.path.join(base_dir, "rows.json")))
+    kr = json.load(open(os.path.join(kr_dir, "rows.json")))
+    assert kr["resumed_from"] > 0, "resume leg did not actually resume"
+    assert base["rows"] == kr["rows"], "kill->resume rows diverged"
+    assert base["digest"] == kr["digest"], "final params digest diverged"
+    errors = obs_events.validate_file(
+        os.path.join(kr_dir, "elastic_journal.jsonl")
+    )
+    assert not errors, "kill->resume journal invalid:\n" + "\n".join(errors)
+    print(
+        f"elastic-smoke: kill->resume OK (resumed from round "
+        f"{kr['resumed_from']}, {len(base['rows'])} rows bitwise, "
+        f"digest {base['digest']})"
+    )
+
+    # ---- 4. report renders the membership section ------------------------
+    from erasurehead_tpu.obs import report as report_lib
+
+    rendered = report_lib.render([res.journal_path])
+    assert "elastic membership:" in rendered
+    assert "relayout" in rendered
+    print("elastic-smoke: report renders the membership section")
+    print(f"elastic-smoke: OK (artifacts -> {OUT})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,7 +17,10 @@ label list, `admit` carries non-negative byte figures, `evict` names its
 reason), adaptive-controller `adapt` records (erasurehead_tpu/adapt/)
 carry a non-negative chunk-start round, a non-empty arm label and a
 known reason (warmup/exploit/explore/regime_shift — obs/events.
-ADAPT_REASONS), and every run_start has a matching run_end. Sweep journals and
+ADAPT_REASONS), elastic `membership` records (erasurehead_tpu/elastic/)
+carry a non-negative round, a known action (death/join/relayout/probe/
+chunk — obs/events.MEMBERSHIP_ACTIONS), a positive worker count and
+well-formed worker-id lists, and every run_start has a matching run_end. Sweep journals and
 serve event logs are events.jsonl files too — point this tool at
 DIR/sweep_journal.jsonl or the daemon's --events log to check them.
 
