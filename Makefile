@@ -43,7 +43,7 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 	generate_random_data arrange_real_data \
 	test lint tier1 bench sweep rehearse watch compare real_data dryrun \
 	telemetry-smoke sweep-batch-smoke chaos-smoke roofline-smoke \
-	serve-smoke adapt-smoke deep-smoke elastic-smoke clean
+	serve-smoke adapt-smoke deep-smoke elastic-smoke whatif-smoke clean
 
 naive:            ## uncoded wait-for-all baseline (src/naive.py)
 	$(RUN) --scheme naive
@@ -134,6 +134,9 @@ deep-smoke:       ## CPU W=8 attention cohort with per-layer coding: 1 dispatch,
 
 elastic-smoke:    ## CPU chaos-driven die-then-rejoin + kill->resume row rehydration through the elastic membership controller (tools/elastic_smoke.py)
 	JAX_PLATFORMS=cpu $(PY) tools/elastic_smoke.py
+
+whatif-smoke:     ## CPU what-if cycle: tiny grid -> surface artifact -> adapt priors + serve ETA round-trips, events validate, identical-spec rerun bitwise (tools/whatif_smoke.py)
+	JAX_PLATFORMS=cpu $(PY) tools/whatif_smoke.py
 
 sweep:            ## the full on-TPU measurement program (resumable, tagged)
 	bash tools/tpu_measurements.sh

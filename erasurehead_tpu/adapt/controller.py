@@ -105,6 +105,11 @@ class ControllerConfig:
     #: exploration seed (decision replay: same seed + same telemetry ->
     #: same decisions, bitwise)
     seed: int = 0
+    #: observation weight a simulated prior counts as (controller
+    #: ``priors``): 1.0 = one real chunk's worth of evidence — strong
+    #: enough to skip the warm-up visit, weak enough that one real
+    #: observation halves its influence under the default discount
+    prior_weight: float = 1.0
 
     def __post_init__(self):
         if self.chunk_rounds < 1:
@@ -123,6 +128,10 @@ class ControllerConfig:
         if self.shift_factor <= 1.0:
             raise ValueError(
                 f"shift_factor must be > 1, got {self.shift_factor}"
+            )
+        if self.prior_weight <= 0.0:
+            raise ValueError(
+                f"prior_weight must be > 0, got {self.prior_weight}"
             )
 
 
@@ -154,9 +163,26 @@ class AdaptiveController:
     ``choose()`` -> (arm_index, reason); ``observe(arm_index, stats)``
     feeds the chunk's telemetry back. The decision log (``decisions``)
     is the journal payload: one dict per choice, stable field order.
+
+    ``priors`` seeds the cold start from a what-if surface
+    (whatif/surface.Surface.adapt_priors): {arm label: prior value} in
+    the controller's own reward units. A primed arm starts with its
+    simulated expected reward at ``cfg.prior_weight`` observations of
+    evidence instead of zero at zero — so the warm-up pass (which
+    otherwise burns one chunk per arm exploring policies the registry's
+    simulation could already rank) only visits arms the surface could
+    NOT speak for, and the first free choice exploits the simulated
+    ranking. Real telemetry then overwrites the prior at the discount's
+    usual pace; a detected regime shift still wipes primed values — the
+    priors were conditioned on the regime that just ended.
     """
 
-    def __init__(self, arms: Sequence[Arm], cfg: ControllerConfig = None):
+    def __init__(
+        self,
+        arms: Sequence[Arm],
+        cfg: ControllerConfig = None,
+        priors: Optional[dict] = None,
+    ):
         self.arms = list(arms)
         if not self.arms:
             raise ValueError("AdaptiveController needs at least one arm")
@@ -169,6 +195,18 @@ class AdaptiveController:
         # discounted value estimate + discounted observation weight per arm
         self._value = np.zeros(n)
         self._weight = np.zeros(n)
+        self.priors = dict(priors) if priors else {}
+        if self.priors:
+            unknown = sorted(set(self.priors) - set(labels))
+            if unknown:
+                raise ValueError(
+                    f"priors name unknown arms {unknown}; controller arms "
+                    f"are {labels}"
+                )
+            for i, label in enumerate(labels):
+                if label in self.priors:
+                    self._value[i] = float(self.priors[label])
+                    self._weight[i] = self.cfg.prior_weight
         self._last_arrival_mean: Optional[float] = None
         self._chunk_index = 0
         self._pending_shift = False

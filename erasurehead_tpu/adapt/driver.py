@@ -136,16 +136,20 @@ def train_adaptive(
     controller: Optional[ControllerConfig] = None,
     mesh=None,
     arrivals: Optional[np.ndarray] = None,
+    priors: Optional[dict] = None,
 ) -> AdaptiveResult:
     """Train ``cfg.rounds`` rounds, re-choosing the collection policy at
     every ``controller.chunk_rounds`` boundary (module docstring).
 
     ``cfg`` provides everything but the per-chunk policy: model, data
     shape, update rule, decode mode, memory knobs. ``arms`` defaults to
-    :func:`default_arms`. Returns an :class:`AdaptiveResult` whose
-    ``result`` quacks like a single ``trainer.train`` result over the
-    full horizon (history, clocks with the -1 sentinel, decode-error
-    series stitched from the chunks).
+    :func:`default_arms`. ``priors`` ({arm label: simulated expected
+    reward}, e.g. a what-if surface's ``adapt_priors``) seeds the
+    bandit's cold start so the warm-up only explores arms the surface
+    could not rank (controller docstring). Returns an
+    :class:`AdaptiveResult` whose ``result`` quacks like a single
+    ``trainer.train`` result over the full horizon (history, clocks with
+    the -1 sentinel, decode-error series stitched from the chunks).
     """
     import jax
 
@@ -161,7 +165,7 @@ def train_adaptive(
     arms = list(arms) if arms is not None else default_arms(cfg)
     ctl_cfg = controller or ControllerConfig()
     _validate_arms(cfg, arms)
-    ctl = AdaptiveController(arms, ctl_cfg)
+    ctl = AdaptiveController(arms, ctl_cfg, priors=priors)
 
     # chunk-boundary loss probe (reward_mode="progress"): one-snapshot
     # eval replays on the full host training set — evaluate.replay caches

@@ -47,6 +47,16 @@ A sixth runs the AST invariant analyzer (erasurehead_tpu/analysis/) over
 the tree — the trace/cache/telemetry contract checks tier-1 gates on::
 
        erasurehead-tpu lint [--strict] [paths]
+
+A seventh runs the what-if engine (erasurehead_tpu/whatif/): Monte-Carlo
+policy search over the (scheme, W, s, collect, deadline, regime) grid as
+batched cohort dispatches, reduced to an expected-time-to-target surface
+artifact whose rows seed the adapt/ bandit's cold start and the serve
+daemon's admission-time ETA quotes::
+
+       erasurehead-tpu whatif --policies naive,cyccoded,approx \\
+           --workers 8 --stragglers 1,3 --regimes exp:0.1,exp:2.0 \\
+           --seeds 16 --out surfaces/small --crossover approx,cyccoded
 """
 
 from __future__ import annotations
@@ -182,6 +192,13 @@ def _flags_parser() -> argparse.ArgumentParser:
                         "'naive,approx:c4,deadline:d1.5'; default: the "
                         "run's own policy plus the uncoded-layout "
                         "alternatives (adapt.default_arms)")
+    p.add_argument("--adapt-priors", default=None, metavar="DIR",
+                   help="seed the adapt bandit's cold start from a "
+                        "what-if surface artifact (`erasurehead-tpu "
+                        "whatif --out DIR`): arm values start at the "
+                        "surface's simulated expected reward instead of "
+                        "zero, so warm-up only explores arms the surface "
+                        "could not rank")
     p.add_argument("--rounds", type=int, default=100)
     p.add_argument("--dataset", default="artificial")
     p.add_argument("--rows", type=int, default=4096)
@@ -534,6 +551,8 @@ def _validate_checkpoint_flags(parser, ns) -> None:
         parser.error("--adapt-chunk must be >= 1")
     if ns.adapt_arms is not None and ns.adapt != "on":
         parser.error("--adapt-arms requires --adapt on")
+    if ns.adapt_priors is not None and ns.adapt != "on":
+        parser.error("--adapt-priors requires --adapt on")
 
 
 def _parse_deaths(spec: str) -> dict[int, int]:
@@ -599,6 +618,7 @@ def run(
     adapt: str = "off",
     adapt_chunk: int = 10,
     adapt_arms: str | None = None,
+    adapt_priors: str | None = None,
     elastic: str = "off",
     elastic_chunk: int = 10,
     death_rounds: int = 3,
@@ -696,11 +716,28 @@ def run(
             from erasurehead_tpu import adapt as adapt_lib
 
             arms = _parse_arms(adapt_arms) if adapt_arms else None
+            priors = None
+            if adapt_priors:
+                from erasurehead_tpu.whatif import Surface
+
+                surface = Surface.load(adapt_priors)
+                priors = surface.adapt_priors(
+                    arms if arms is not None else adapt_lib.default_arms(cfg),
+                    n_workers=cfg.n_workers,
+                    n_stragglers=cfg.n_stragglers,
+                )
+                if not quiet:
+                    print(
+                        f"adapt priors <- {adapt_priors} "
+                        f"(spec {surface.spec_hash}): "
+                        f"{len(priors)} arm(s) primed"
+                    )
             ares = adapt_lib.train_adaptive(
                 cfg, dataset, arms=arms,
                 controller=adapt_lib.ControllerConfig(
                     chunk_rounds=adapt_chunk, seed=cfg.seed
                 ),
+                priors=priors,
             )
             result = ares.result
             if not quiet:
@@ -817,6 +854,13 @@ def main(argv: list[str] | None = None) -> int:
         from erasurehead_tpu.serve import server as serve_lib
 
         return serve_lib.main(argv[1:])
+    if argv and argv[0] == "whatif":
+        # `erasurehead-tpu whatif ...` — the Monte-Carlo policy-search
+        # engine (erasurehead_tpu/whatif/): grid spec -> batched cohort
+        # simulation -> expected-time-to-target surface artifact
+        from erasurehead_tpu.whatif import engine as whatif_lib
+
+        return whatif_lib.main(argv[1:])
     if argv and argv[0] == "lint":
         # `erasurehead-tpu lint [--strict] [paths]` — the AST invariant
         # analyzer (erasurehead_tpu/analysis/): trace-purity,
@@ -853,6 +897,7 @@ def main(argv: list[str] | None = None) -> int:
         adapt=ns.adapt,
         adapt_chunk=ns.adapt_chunk,
         adapt_arms=ns.adapt_arms,
+        adapt_priors=ns.adapt_priors,
         elastic=ns.elastic,
         elastic_chunk=ns.elastic_chunk,
         death_rounds=ns.death_rounds,

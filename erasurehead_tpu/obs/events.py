@@ -106,6 +106,15 @@ SCHEMA: dict[str, tuple] = {
     # (config, world, chaos env), so the event log doubles as the
     # membership decision journal.
     "membership": ("round", "action", "n_workers"),
+    # one per what-if engine phase (erasurehead_tpu/whatif/): "kind" says
+    # which — "grid" after feasibility enumeration (point counts ride
+    # along), "point" per reduced surface row (label + feasibility +
+    # expected time-to-target), "surface" when the artifact saves,
+    # "rehydrate" when an identical spec loads the saved surface instead
+    # of re-simulating. Every record carries the grid's spec_hash, so a
+    # surface artifact is attributable to its event stream and a
+    # rehydrated run is distinguishable from a simulated one.
+    "whatif": ("spec_hash", "kind"),
 }
 
 #: adapt decision reasons (adapt/controller.AdaptiveController.choose)
@@ -116,6 +125,11 @@ ADAPT_REASONS = ("warmup", "exploit", "explore", "regime_shift")
 #: "probe" marks a collapsed-arrival re-evaluation, "chunk" is a finished
 #: chunk's journal row
 MEMBERSHIP_ACTIONS = ("death", "join", "relayout", "probe", "chunk")
+
+#: what-if engine phases (whatif/engine.py): "grid" = enumeration +
+#: feasibility filter, "point" = one reduced surface row, "surface" =
+#: artifact saved, "rehydrate" = identical spec served from its artifact
+WHATIF_KINDS = ("grid", "point", "surface", "rehydrate")
 
 #: sweep_trajectory completion statuses (train/journal.py); "diverged"
 #: rows are quarantined, not retried — divergence is deterministic under
@@ -413,7 +427,10 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
     figures, ``evict`` names its reason); ``membership`` records carry a
     non-negative round, a known action (:data:`MEMBERSHIP_ACTIONS`), a
     positive worker count and — when present — a list of non-negative
-    worker ids; every ``run_start`` has a matching later ``run_end``."""
+    worker ids; ``whatif`` records carry a non-empty ``spec_hash`` and a
+    known ``kind`` (:data:`WHATIF_KINDS`), point records a non-empty
+    label and a bool feasibility verdict, grid records non-negative point
+    counts; every ``run_start`` has a matching later ``run_end``."""
     errors: list[str] = []
     # seq checking is MULTI-STREAM: a file may interleave several
     # append-mode loggers (concurrent journal writers, the serve daemon
@@ -615,6 +632,42 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
                     f"line {i}: membership workers must be a list of "
                     f"non-negative worker ids, got {workers!r}"
                 )
+        if rtype == "whatif":
+            kind = rec.get("kind")
+            if kind not in WHATIF_KINDS:
+                errors.append(
+                    f"line {i}: whatif kind must be one of "
+                    f"{WHATIF_KINDS}, got {kind!r}"
+                )
+            sh = rec.get("spec_hash")
+            if not isinstance(sh, str) or not sh:
+                errors.append(
+                    f"line {i}: whatif spec_hash must be a non-empty "
+                    f"string, got {sh!r}"
+                )
+            if kind == "point":
+                if not isinstance(rec.get("label"), str) or not rec.get(
+                    "label"
+                ):
+                    errors.append(
+                        f"line {i}: whatif point record must carry a "
+                        f"non-empty label, got {rec.get('label')!r}"
+                    )
+                if not isinstance(rec.get("feasible"), bool):
+                    errors.append(
+                        f"line {i}: whatif point record must carry a "
+                        f"bool feasible, got {rec.get('feasible')!r}"
+                    )
+            if kind == "grid":
+                for field in ("n_points", "n_feasible", "n_infeasible"):
+                    v = rec.get(field)
+                    if v is not None and (
+                        not isinstance(v, int) or v < 0
+                    ):
+                        errors.append(
+                            f"line {i}: whatif grid {field} must be a "
+                            f"non-negative int, got {v!r}"
+                        )
         if rtype == "run_start":
             started.add(rec.get("run_id"))
         if rtype == "run_end":

@@ -33,6 +33,11 @@ Every decision is observable: ``admit`` events carry the estimate vs the
 budget and the verdict, ``evict`` events name what was dropped, and the
 ``serve.admitted`` / ``serve.deferred`` / ``serve.evictions`` counters
 aggregate them.
+
+This module also hosts :class:`EtaQuoter`, the admission-time read side
+of the what-if engine (erasurehead_tpu/whatif/): a loaded surface quotes
+each arriving request's simulated expected time-to-target, so the daemon
+can tell a tenant what its request will cost before dispatching it.
 """
 
 from __future__ import annotations
@@ -209,3 +214,36 @@ class AdmissionController:
             prev = self._measured.get(cohort.key_digest, 0)
             if measured > prev:
                 self._measured[cohort.key_digest] = measured
+
+
+class EtaQuoter:
+    """Admission-time ETA quotes from a what-if surface.
+
+    The what-if engine's surface rows (whatif/surface.py) carry each
+    policy coordinate's SIMULATED expected time-to-target; the quoter is
+    the serve daemon's read side: given an arriving request's RunConfig,
+    look up the nearest feasible row and quote its expected simulated
+    seconds-to-target. The quote rides the ``request`` event and the
+    socket front's ``accepted`` reply (``eta_s``), so a tenant knows the
+    expected cost of what it just enqueued BEFORE any dispatch runs.
+
+    A quote is a simulation-derived expectation, not a promise: None
+    whenever the surface has no feasible row for the policy (the daemon
+    serves the request either way). The per-request lookup is a host-side
+    list scan over the surface rows — microseconds against a packing
+    window of tens of milliseconds.
+    """
+
+    def __init__(self, surface):
+        if surface is None:
+            raise ValueError(
+                "EtaQuoter needs a whatif Surface (erasurehead-tpu "
+                "whatif --out DIR; Surface.load(DIR))"
+            )
+        self.surface = surface
+
+    def quote(self, cfg) -> Optional[float]:
+        """Expected time-to-target (simulated seconds) for a request's
+        policy coordinate, or None when the surface cannot speak for
+        it."""
+        return self.surface.eta(cfg)

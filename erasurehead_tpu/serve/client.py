@@ -97,6 +97,10 @@ class ServeClient:
                 f"serve daemon refused the request: "
                 f"{reply.get('message', reply)}"
             )
+        # what-if ETA quote (daemon --eta-surface; None without one):
+        # exposed on the client rather than the return value so existing
+        # submit() callers keep their request_id contract
+        self.last_eta_s = reply.get("eta_s")
         return reply["request_id"]
 
     def result(self, timeout: Optional[float] = None) -> dict:

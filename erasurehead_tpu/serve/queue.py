@@ -106,6 +106,11 @@ class RequestHandle:
         # the tenant-journal identity key, assigned at server intake once
         # the dataset/arrivals are resolved (None = journaling off)
         self.journal_key: Optional[str] = None
+        # admission-time ETA quote in simulated seconds, assigned at
+        # submit() when the daemon holds a what-if surface
+        # (serve/admission.EtaQuoter); None = no surface or no matching
+        # feasible row
+        self.eta_s: Optional[float] = None
 
     @property
     def request_id(self) -> str:

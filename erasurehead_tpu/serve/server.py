@@ -160,8 +160,17 @@ class SweepServer:
         resume: bool = True,
         dispatch_workers: int = DEFAULT_DISPATCH_WORKERS,
         pad_cohorts: bool = True,
+        eta_surface=None,
     ):
         self.admission = admission_lib.AdmissionController(budget_bytes)
+        # admission-time ETA quotes from a what-if surface
+        # (whatif/surface.Surface; None = quoting off): each accepted
+        # request learns its simulated expected time-to-target up front
+        self.eta = (
+            admission_lib.EtaQuoter(eta_surface)
+            if eta_surface is not None
+            else None
+        )
         self.max_cohort = int(max_cohort)
         # fixed-width dispatch: pad every batchable cohort to exactly
         # max_cohort trajectories (replicating the first request's config;
@@ -260,6 +269,11 @@ class SweepServer:
         if self._thread is None or self._stopping:
             raise RuntimeError("serve loop is not running")
         handle = RequestHandle(request)
+        if self.eta is not None:
+            # quoted HERE, before the enqueue, so the submitter (and the
+            # socket front's "accepted" reply) reads the ETA immediately
+            # rather than racing the intake loop
+            handle.eta_s = self.eta.quote(request.config)
         _METRICS.counter("serve.requests").inc()
         self._inbox.put(handle)
         return handle
@@ -337,6 +351,7 @@ class SweepServer:
             request_id=req.request_id,
             label=req.label,
             scheme=req.config.scheme.value,
+            eta_s=handle.eta_s,
         )
         try:
             req.dataset = self._resolve_dataset(req)
@@ -622,6 +637,12 @@ def main(argv=None) -> int:
                    help="write the daemon's serve/run event log here "
                         "(request/pack/admit/evict records; render with "
                         "`erasurehead-tpu report`)")
+    p.add_argument("--eta-surface", default=None, metavar="DIR",
+                   help="quote each accepted request an expected "
+                        "time-to-target from a what-if surface artifact "
+                        "(`erasurehead-tpu whatif --out DIR`); the quote "
+                        "rides the socket front's accepted reply and the "
+                        "request event as eta_s")
     ns = p.parse_args(argv)
     budget = resolve_serve_budget(ns.budget)
     max_cohort = resolve_serve_max_cohort(
@@ -631,6 +652,11 @@ def main(argv=None) -> int:
     from erasurehead_tpu.parallel.backend import initialize_distributed
 
     initialize_distributed()
+    eta_surface = None
+    if ns.eta_surface:
+        from erasurehead_tpu.whatif import Surface
+
+        eta_surface = Surface.load(ns.eta_surface)
     capture = (
         events_lib.capture(ns.events)
         if ns.events
@@ -645,6 +671,7 @@ def main(argv=None) -> int:
             resume=not ns.no_resume,
             dispatch_workers=ns.dispatch_workers,
             pad_cohorts=not ns.no_pad,
+            eta_surface=eta_surface,
         )
         srv.start()
         front = SocketFront(srv, ns.socket)
@@ -822,6 +849,9 @@ class SocketFront:
                             {
                                 "type": "accepted",
                                 "request_id": handle.request_id,
+                                # what-if ETA quote (simulated seconds to
+                                # the loss target; None = no surface row)
+                                "eta_s": handle.eta_s,
                             }
                         )
                         threading.Thread(

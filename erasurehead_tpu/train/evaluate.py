@@ -45,39 +45,107 @@ class EvalResult:
 _replay_fns: dict = {}
 
 
-def _replay_fn(model, is_regression: bool):
-    key = (
+def _replay_core(model, is_regression: bool):
+    """The un-jitted replay scan for one trajectory's history — shared by
+    the scalar (:func:`_replay_fn`) and trajectory-batched
+    (:func:`_replay_batch_fn`) compiled forms, so the two can never
+    compute different curves."""
+
+    def one(carry, params, X_train, y_train, X_test, y_test):
+        train_loss = model.loss_mean(params, X_train, y_train)
+        pred_test = model.predict(params, X_test)
+        test_loss = (
+            metrics.mse_mean(y_test, pred_test)
+            if is_regression
+            else metrics.log_loss_mean(y_test, pred_test)
+        )
+        auc_val = (
+            jnp.nan if is_regression else metrics.auc(y_test, pred_test)
+        )
+        return carry, (train_loss, test_loss, auc_val)
+
+    def run(history, X_train, y_train, X_test, y_test):
+        _, out = jax.lax.scan(
+            lambda c, p: one(c, p, X_train, y_train, X_test, y_test),
+            0,
+            history,
+        )
+        return out
+
+    return run
+
+
+def _model_key(model, is_regression: bool) -> tuple:
+    return (
         type(model),
         repr(sorted(getattr(model, "__dict__", {}).items())),
         is_regression,
     )
+
+
+def _replay_fn(model, is_regression: bool):
+    key = _model_key(model, is_regression)
     fn = _replay_fns.get(key)
     if fn is None:
+        _replay_fns[key] = fn = jax.jit(_replay_core(model, is_regression))
+    return fn
 
-        def one(carry, params, X_train, y_train, X_test, y_test):
-            train_loss = model.loss_mean(params, X_train, y_train)
-            pred_test = model.predict(params, X_test)
-            test_loss = (
-                metrics.mse_mean(y_test, pred_test)
-                if is_regression
-                else metrics.log_loss_mean(y_test, pred_test)
-            )
-            auc_val = (
-                jnp.nan if is_regression else metrics.auc(y_test, pred_test)
-            )
-            return carry, (train_loss, test_loss, auc_val)
+
+def _replay_batch_fn(model, is_regression: bool):
+    """The trajectory-batched form of :func:`_replay_fn`: one jitted
+    vmap-of-scan evaluating a [B, R, ...] stacked history in a single
+    dispatch — the what-if engine's reduction path, where hundreds of
+    Monte-Carlo trajectories would otherwise pay one replay dispatch
+    each. Cached per model identity exactly like the scalar form."""
+    key = _model_key(model, is_regression) + ("batch",)
+    fn = _replay_fns.get(key)
+    if fn is None:
+        core = _replay_core(model, is_regression)
 
         @jax.jit
-        def run(history, X_train, y_train, X_test, y_test):
-            _, out = jax.lax.scan(
-                lambda c, p: one(c, p, X_train, y_train, X_test, y_test),
-                0,
-                history,
-            )
-            return out
+        def run(histories, X_train, y_train, X_test, y_test):
+            return jax.vmap(
+                lambda h: core(h, X_train, y_train, X_test, y_test)
+            )(histories)
 
         _replay_fns[key] = fn = run
     return fn
+
+
+def replay_batch(
+    model,
+    model_kind: ModelKind,
+    histories: Any,
+    X_train,
+    y_train,
+    X_test,
+    y_test,
+) -> EvalResult:
+    """Batched :func:`replay`: ``histories`` carries a leading trajectory
+    axis ([B, R, ...] per leaf); the returned curves are [B, R]. Same
+    math per lane as the scalar replay — the vmap only adds the batch
+    dimension."""
+    import scipy.sparse as sps
+
+    from erasurehead_tpu.ops.features import PaddedRows
+
+    if sps.issparse(X_train):
+        X_train = PaddedRows.from_scipy(X_train)
+    if sps.issparse(X_test):
+        X_test = PaddedRows.from_scipy(X_test)
+    y_train = jnp.asarray(np.asarray(y_train, np.float32))
+    y_test = jnp.asarray(np.asarray(y_test, np.float32))
+    is_regression = ModelKind(model_kind) == ModelKind.LINEAR
+
+    run = _replay_batch_fn(model, is_regression)
+    train_l, test_l, auc_l = run(
+        histories, X_train, y_train, X_test, y_test
+    )
+    return EvalResult(
+        training_loss=np.asarray(train_l),
+        testing_loss=np.asarray(test_l),
+        auc=np.asarray(auc_l),
+    )
 
 
 def replay(

@@ -1,0 +1,45 @@
+"""What-if engine: Monte-Carlo policy search over the scheme x regime grid.
+
+The simulator already vmaps trajectory batches (trainer.train_cohort) and
+the scheme registry makes every collection policy a data object — this
+package composes them into a policy-search engine (ROADMAP item 5):
+
+  - :mod:`spec` enumerates (scheme, W, s, num_collect, deadline, decode,
+    arrival-regime) grid points from registry descriptors, with
+    per-point feasibility filtered through each descriptor's own config
+    validation (infeasible points are recorded with a reason, never
+    dispatched);
+  - :mod:`sampler` vmaps seeded arrival-time draws on-device (exp /
+    heavytail / adversary / targeted regimes, plus trace replay), so one
+    cohort dispatch simulates hundreds of (policy, seed) trajectories;
+  - :mod:`engine` groups grid points into cohort dispatches through the
+    existing sweep degradation/journal path and reduces trajectories into
+    expected-time-to-target surfaces;
+  - :mod:`surface` holds the reduced artifact (.npz + JSONL rows): the
+    ErasureHead Fig. 4-6 family reproduced from simulation alone, plus
+    the two consumers that make it load-bearing — cold-start priors for
+    the adapt/ bandit and admission-time ETAs for the serve/ daemon.
+
+Entry point: ``erasurehead-tpu whatif`` (engine.main).
+"""
+
+from erasurehead_tpu.whatif.sampler import RegimeSpec, sample_arrivals
+from erasurehead_tpu.whatif.spec import (
+    GridPoint,
+    GridSpec,
+    PolicySpec,
+    enumerate_points,
+)
+from erasurehead_tpu.whatif.surface import Surface
+from erasurehead_tpu.whatif.engine import run_whatif
+
+__all__ = [
+    "GridPoint",
+    "GridSpec",
+    "PolicySpec",
+    "RegimeSpec",
+    "Surface",
+    "enumerate_points",
+    "run_whatif",
+    "sample_arrivals",
+]

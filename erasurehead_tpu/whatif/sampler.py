@@ -1,0 +1,266 @@
+"""Seeded Monte-Carlo arrival sampling for the what-if engine.
+
+The reference's delay model is one stream: i.i.d. Exponential(0.5) per
+(round, worker), re-seeded per round (parallel/straggler.
+reference_delay_schedule). A what-if surface needs MANY independent draws
+of MANY regimes — the straggler-regime families the retrieved papers
+analyze (heavy Pareto tails, fixed adversaries and targeted replica-group
+attacks from arXiv:1901.08166) plus recorded-trace replay — so this
+module batches the draw itself: one vmapped, jitted function produces the
+whole ``[n_seeds, rounds, workers]`` arrival block on-device, and the
+engine feeds each seed's slice to the host collection rules exactly as a
+single run's schedule.
+
+Determinism contract: every draw is a pure function of (seed, regime,
+shape) through JAX's counter-based threefry PRNG — rerunning an identical
+grid spec redraws identical arrivals, which is what makes a what-if
+surface bitwise-rehydratable (tools/whatif_smoke.py pins it). The drawn
+streams are the sampler's OWN universe (threefry, not the reference's
+MT19937): what-if surfaces are comparable to each other, and the paired-
+comparison contract holds because every policy at the same (W, regime,
+seed) grid coordinate reads the same slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import numpy as np
+
+#: the arrival-regime families a grid point may run under
+REGIME_KINDS = ("exp", "heavytail", "adversary", "targeted", "trace")
+
+
+@dataclasses.dataclass(frozen=True)
+class RegimeSpec:
+    """One straggler regime a grid axis enumerates.
+
+    ``kind``:
+
+      - ``"exp"``       — the reference's stationary stream: i.i.d.
+        Exponential(``mean``) delays every round;
+      - ``"heavytail"`` — Exponential through round ``shift_round``-1,
+        then Pareto(``alpha``)-tailed delays scaled by ``mean`` (small
+        alpha = heavier tail; alpha <= 1 has infinite mean);
+      - ``"adversary"`` — Exponential plus ``slowdown`` extra seconds on
+        worker ``worker`` from round ``shift_round`` on (the fixed-
+        straggler worst case of arXiv:1901.08166);
+      - ``"targeted"``  — Exponential plus ``slowdown`` on EVERY replica
+        of coded partition group ``group`` from ``shift_round`` on
+        (1901.08166's fractional-repetition worst case; the attacked
+        worker set is layout-resolved per grid point, straggler.
+        targeted_workers);
+      - ``"trace"``     — replay a recorded [R?, W] arrival trace
+        (straggler.replay_arrival_trace), rotated by a seeded round
+        offset per Monte-Carlo seed so seeds stay independent draws.
+
+    ``compute_time`` adds a uniform per-round compute cost on top of the
+    delay draw — with ``compute_slots=True`` it scales by each worker's
+    SLOT COUNT from the grid point's layout, so coded redundancy costs
+    (s+1)x compute per round exactly as it did on the reference cluster
+    (the axis the AGC-vs-exact crossover lives on).
+    """
+
+    kind: str = "exp"
+    mean: float = 0.5
+    alpha: float = 1.2
+    shift_round: int = 0
+    worker: int = 0
+    slowdown: float = 5.0
+    group: int = 0
+    trace: Optional[str] = None
+    compute_time: float = 0.0
+    compute_slots: bool = False
+
+    def __post_init__(self):
+        if self.kind not in REGIME_KINDS:
+            raise ValueError(
+                f"regime kind must be one of {REGIME_KINDS}, got "
+                f"{self.kind!r}"
+            )
+        if self.mean < 0:
+            raise ValueError(f"regime mean must be >= 0, got {self.mean}")
+        if self.kind == "heavytail" and self.alpha <= 0:
+            raise ValueError(
+                f"heavytail alpha must be > 0, got {self.alpha}"
+            )
+        if self.kind in ("adversary", "targeted") and self.slowdown < 0:
+            raise ValueError(
+                f"{self.kind} slowdown must be >= 0, got {self.slowdown}"
+            )
+        if self.kind == "trace" and not self.trace:
+            raise ValueError("trace regime needs a trace path/array")
+        if self.shift_round < 0:
+            raise ValueError(
+                f"shift_round must be >= 0, got {self.shift_round}"
+            )
+        if self.compute_time < 0:
+            raise ValueError(
+                f"compute_time must be >= 0, got {self.compute_time}"
+            )
+
+    @property
+    def tag(self) -> str:
+        """Short label for surface rows / grid-point names."""
+        if self.kind == "exp":
+            base = f"exp{self.mean:g}"
+        elif self.kind == "heavytail":
+            base = f"heavytail{self.alpha:g}x{self.mean:g}"
+        elif self.kind == "adversary":
+            base = f"adversary{self.slowdown:g}"
+        elif self.kind == "targeted":
+            base = f"targeted{self.slowdown:g}g{self.group}"
+        else:
+            base = "trace"
+        if self.compute_time:
+            base += f"+c{self.compute_time:g}"
+            if self.compute_slots:
+                base += "xslots"
+        return base
+
+    def payload(self) -> dict:
+        """JSON form for the spec hash / saved surface header."""
+        out = {"kind": self.kind, "mean": self.mean}
+        if self.kind == "heavytail":
+            out["alpha"] = self.alpha
+        if self.kind in ("adversary", "targeted"):
+            out["slowdown"] = self.slowdown
+        if self.kind == "adversary":
+            out["worker"] = self.worker
+        if self.kind == "targeted":
+            out["group"] = self.group
+        if self.kind == "trace":
+            out["trace"] = str(self.trace)
+        if self.shift_round:
+            out["shift_round"] = self.shift_round
+        if self.compute_time:
+            out["compute_time"] = self.compute_time
+            out["compute_slots"] = self.compute_slots
+        return out
+
+
+@functools.lru_cache(maxsize=None)
+def _batch_draw_fn(kind: str, rounds: int, n_workers: int):
+    """The jitted batched draw for one (kind, shape): seeds -> [S, R, W].
+
+    The seed axis is a vmap, the per-round keys a fold_in — every (seed,
+    round, worker) cell is an independent counter-PRNG draw, so the whole
+    Monte-Carlo block is ONE device dispatch instead of S x R host draws.
+    Shape/kind are static (cached per combination); mean/alpha/slowdown/
+    the attacked-worker mask are traced arguments, so regime parameter
+    sweeps share the compiled draw.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def draw_one(seed, mean, alpha, shift_round, slowdown, worker_mask):
+        key = jax.random.PRNGKey(seed)
+        keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+            jnp.arange(rounds)
+        )
+        # standard-exponential base draw; everything else is a transform
+        e = jax.vmap(
+            lambda k: jax.random.exponential(k, (n_workers,))
+        )(keys)
+        out = mean * e
+        shifted = (jnp.arange(rounds) >= shift_round)[:, None]
+        if kind == "heavytail":
+            # Pareto(alpha) via the exponential inverse-CDF transform:
+            # U = exp(-E) uniform, X = U^(-1/alpha) - 1 = expm1(E/alpha)
+            out = jnp.where(shifted, mean * jnp.expm1(e / alpha), out)
+        elif kind in ("adversary", "targeted"):
+            # the attacked worker set rides in as a traced [W] mask (one
+            # worker for adversary, a layout-resolved replica group for
+            # targeted), so the compiled draw is shared across targets
+            out = out + slowdown * shifted * worker_mask[None, :]
+        return out
+
+    return jax.jit(
+        jax.vmap(draw_one, in_axes=(0, None, None, None, None, None))
+    )
+
+
+def sample_arrivals(
+    regime: RegimeSpec,
+    rounds: int,
+    n_workers: int,
+    seeds,
+    layout=None,
+    slots_per_worker: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Draw the regime's full Monte-Carlo arrival block: ``[len(seeds),
+    rounds, n_workers]`` float64 arrival times, one deterministic draw per
+    seed.
+
+    ``layout`` resolves the ``"targeted"`` kind's attacked worker set
+    (straggler.targeted_workers — only the layout knows which workers
+    replicate the attacked group) and, with ``compute_slots``, each
+    worker's slot count; ``slots_per_worker`` overrides the latter.
+    """
+    from erasurehead_tpu.parallel import straggler
+
+    seeds = np.asarray(list(seeds), dtype=np.int64)
+    if seeds.ndim != 1 or seeds.size == 0:
+        raise ValueError(f"seeds must be a non-empty 1-D list, got {seeds!r}")
+
+    if regime.kind == "trace":
+        base = straggler.replay_arrival_trace(
+            regime.trace, rounds, n_workers
+        )
+        # independent per-seed draws from one recorded stream: rotate the
+        # replay window by a seeded round offset (seed 0 = the raw trace)
+        out = np.stack(
+            [np.roll(base, -(int(s) % rounds), axis=0) for s in seeds]
+        ).astype(np.float64)
+    else:
+        mask = np.zeros(n_workers, dtype=np.float64)
+        if regime.kind == "adversary":
+            mask[regime.worker % n_workers] = 1.0
+        elif regime.kind == "targeted":
+            if layout is None:
+                raise ValueError(
+                    "targeted regime needs the grid point's layout to "
+                    "resolve the attacked replica group "
+                    "(straggler.targeted_workers)"
+                )
+            for w in straggler.targeted_workers(layout, regime.group):
+                mask[w % n_workers] = 1.0
+        fn = _batch_draw_fn(regime.kind, int(rounds), int(n_workers))
+        out = np.asarray(
+            fn(
+                seeds,
+                float(regime.mean),
+                float(regime.alpha),
+                int(regime.shift_round),
+                float(regime.slowdown),
+                mask,
+            ),
+            dtype=np.float64,
+        )
+
+    if regime.compute_time:
+        per_worker = np.full(n_workers, float(regime.compute_time))
+        if regime.compute_slots:
+            if slots_per_worker is None:
+                if layout is None:
+                    raise ValueError(
+                        "compute_slots needs the grid point's layout (or "
+                        "an explicit slots_per_worker) to price each "
+                        "worker's redundant compute"
+                    )
+                slots_per_worker = slot_counts(layout)
+            per_worker = per_worker * np.asarray(
+                slots_per_worker, dtype=np.float64
+            )
+        out = out + per_worker[None, None, :]
+    return out
+
+
+def slot_counts(layout) -> np.ndarray:
+    """[W] slots (partition copies) each worker computes per round — the
+    faithful compute price of the layout's redundancy ((s+1) for the
+    replication/MDS families, ragged for sparse-graph codes)."""
+    assignment = np.asarray(layout.assignment)
+    return (assignment >= 0).sum(axis=1).astype(np.float64)

@@ -15,6 +15,10 @@ def emit_run(run_id, fields):
         "membership", round=5, action="relayout", n_workers=6,
         workers=[0, 1, 2, 3, 4, 5], epoch=1,
     )
+    events_lib.emit(  # whatif record, full required set + extras
+        "whatif", spec_hash="abc123", kind="point",
+        label="approx:c4@W8s1/exp0.5", feasible=True,
+    )
 
 
 def write_artifacts(paths):

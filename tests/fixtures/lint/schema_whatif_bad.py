@@ -1,0 +1,11 @@
+"""whatif event-schema violations: an events-module emit missing the
+required spec_hash, and a logger-object emit missing both required
+fields — the what-if engine's record type is lint-enforced like every
+other (the fixture for ISSUE 12's `whatif` SCHEMA entry)."""
+
+from erasurehead_tpu.obs import events as events_lib
+
+
+def emit_whatif(logger):
+    events_lib.emit("whatif", kind="grid", n_points=4)  # missing spec_hash
+    logger.emit("whatif", n_rows=9)  # missing spec_hash AND kind

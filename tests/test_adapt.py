@@ -180,9 +180,71 @@ def test_controller_rejects_bad_config():
     with pytest.raises(ValueError):
         ControllerConfig(shift_factor=1.0)
     with pytest.raises(ValueError):
+        ControllerConfig(prior_weight=0.0)
+    with pytest.raises(ValueError):
         AdaptiveController([], ControllerConfig())
     with pytest.raises(ValueError, match="duplicate"):
         AdaptiveController([Arm("naive"), Arm("naive")], ControllerConfig())
+    with pytest.raises(ValueError, match="unknown arms"):
+        AdaptiveController(
+            _arms(), ControllerConfig(), priors={"nonesuch": -1.0}
+        )
+
+
+def test_priors_skip_cold_start_exploration():
+    """The ISSUE 12 cold-start regression pin: unprimed, the first
+    len(arms) chunks are burned on warm-up — one forced visit per arm,
+    including arms the registry's simulation could already rule out
+    under the observed regime. With what-if priors, warm-up shrinks to
+    exactly the arms the surface could NOT rank (zero when it ranked
+    them all) and the first free decision exploits the simulated best
+    arm."""
+    arms = _arms()
+    rewards = {0: 2.0, 1: 0.5, 2: 1.0}  # arm 1 (avoidstragg) is best
+
+    def run(priors):
+        ctl = AdaptiveController(
+            arms, ControllerConfig(epsilon=0.0, seed=0), priors=priors
+        )
+        for _ in range(6):
+            idx, _ = ctl.choose()
+            ctl.observe(idx, _stats(rewards[idx]))
+        return ctl.decisions
+
+    cold = run(None)
+    # priors in the controller's own time_error units (reward of _stats)
+    primed = run(
+        {"naive": -2.0, "avoidstragg": -0.5, "deadline:d1.5": -1.0}
+    )
+    warmups = lambda ds: sum(d["reason"] == "warmup" for d in ds)  # noqa: E731
+    assert warmups(cold) == len(arms)
+    assert warmups(primed) == 0  # the regression: no exploration burned
+    assert primed[0]["reason"] == "exploit"
+    assert all(d["arm"] == "avoidstragg" for d in primed)
+    # partially-ranked surface: warm-up only visits the unranked arm
+    partial = run({"naive": -2.0, "deadline:d1.5": -1.0})
+    assert warmups(partial) == 1
+    assert partial[0]["arm"] == "avoidstragg"  # the unranked one, first
+
+
+def test_priors_state_roundtrip_and_shift_reset():
+    """Primed values survive the state_dict round-trip bitwise, and a
+    regime shift wipes them exactly like learned values — the priors
+    were conditioned on the regime that just ended."""
+    priors = {"naive": -2.0, "avoidstragg": -0.5, "deadline:d1.5": -1.0}
+    ctl = AdaptiveController(
+        _arms(), ControllerConfig(epsilon=0.0, seed=0), priors=priors
+    )
+    clone = AdaptiveController(_arms(), ControllerConfig(epsilon=0.0, seed=0))
+    clone.load_state_dict(ctl.state_dict())
+    assert clone.snapshot() == ctl.snapshot()
+    idx, _ = ctl.choose()
+    ctl.observe(idx, _stats(1.0, mean=0.5))
+    idx, _ = ctl.choose()
+    shift = ctl.observe(idx, _stats(9.0, mean=50.0))  # huge arrival jump
+    assert shift == "regime_shift"
+    snap = ctl.snapshot()
+    assert sum(1 for w in snap["weights"] if w > 0) == 1
 
 
 # ---------------------------------------------------------------------------

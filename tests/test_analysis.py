@@ -109,6 +109,17 @@ def test_schema_membership_fixture():
     assert len(findings) == 2
 
 
+def test_schema_whatif_fixture():
+    """The what-if engine's `whatif` record (ISSUE 12) is lint-enforced
+    like every other type: emits missing spec_hash/kind are findings,
+    and schema_ok.py's full-field whatif emit stays silent."""
+    findings = _unsup(_lint(_fx("schema_whatif_bad.py")), "event-schema")
+    msgs = "\n".join(f.message for f in findings)
+    assert "spec_hash" in msgs
+    assert "kind" in msgs  # the logger-object emit is checked too
+    assert len(findings) == 2
+
+
 def test_schema_validator_drift_fixture():
     findings = _unsup(_lint(_fx("schema_drift_bad.py")), "event-schema")
     assert len(findings) == 1

@@ -1,0 +1,429 @@
+"""What-if engine tests (ISSUE 12): grid feasibility filtering (infeasible
+points recorded with a reason, never dispatched), on-device Monte-Carlo
+arrival sampling determinism, the surface artifact's bitwise
+save/load/rerun contract, the paper's AGC-vs-exact expected-time-to-target
+crossover reproduced from simulation alone, typed `whatif` event
+emission + validation, and the two consumers — adapt cold-start priors
+and the serve daemon's admission-time ETA quote."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from erasurehead_tpu import adapt
+from erasurehead_tpu.obs import events as obs_events
+from erasurehead_tpu.whatif import (
+    GridSpec,
+    PolicySpec,
+    RegimeSpec,
+    Surface,
+    enumerate_points,
+    run_whatif,
+    sample_arrivals,
+)
+from erasurehead_tpu.whatif.spec import (
+    parse_policies,
+    parse_regimes,
+)
+
+W, R = 6, 10
+
+
+def _tiny_spec(**kw):
+    base = dict(
+        policies=(
+            PolicySpec("naive"),
+            PolicySpec("approx", num_collect=4),
+        ),
+        n_workers=(W,),
+        n_stragglers=(1,),
+        regimes=(RegimeSpec(mean=0.5),),
+        n_seeds=3,
+        rounds=R,
+        n_rows=96,
+        n_cols=8,
+    )
+    base.update(kw)
+    return GridSpec(**base)
+
+
+# ---------------------------------------------------------------------------
+# grid enumeration + feasibility filtering (whatif/spec.py)
+# ---------------------------------------------------------------------------
+
+
+def test_enumeration_covers_the_product_in_order():
+    spec = _tiny_spec(n_stragglers=(1, 2))
+    points = enumerate_points(spec)
+    assert len(points) == spec.n_points == 4
+    assert [p.label for p in points] == [
+        "naive@W6s1/exp0.5",
+        "naive@W6s2/exp0.5",
+        "approx:c4@W6s1/exp0.5",
+        "approx:c4@W6s2/exp0.5",
+    ]
+
+
+def test_infeasible_points_recorded_with_validator_reason():
+    """Each descriptor's own validation decides feasibility: the FRC
+    divisibility guard ((s+1) | W fails at s=3, W=6), the needs_deadline
+    contract, a num_collect past the worker set, and the partial
+    partition-count rule — all recorded, none raising."""
+    spec = _tiny_spec(
+        policies=(
+            PolicySpec("repcoded"),       # (3+1) does not divide 6
+            PolicySpec("deadline"),       # no deadline given
+            PolicySpec("approx", num_collect=9),  # collect > W
+            PolicySpec("partialrepcoded"),  # partitions_per_worker unset
+            PolicySpec("naive"),          # the one feasible policy
+        ),
+        n_stragglers=(3,),
+    )
+    points = enumerate_points(spec)
+    by_scheme = {p.policy.scheme: p for p in points}
+    assert by_scheme["naive"].feasible
+    for scheme, marker in (
+        ("repcoded", "n_stragglers+1"),
+        ("deadline", "deadline"),
+        ("approx", "num_collect"),
+        ("partialrepcoded", "partitions_per_worker"),
+    ):
+        p = by_scheme[scheme]
+        assert not p.feasible
+        assert p.config is None
+        assert marker in p.reason, (scheme, p.reason)
+
+
+def test_infeasible_points_never_dispatched(monkeypatch):
+    """The engine hands ONLY feasible labels to the sweep dispatch path;
+    infeasible rows come back with reason and no science columns."""
+    from erasurehead_tpu.train import experiments
+
+    spec = _tiny_spec(
+        policies=(
+            PolicySpec("naive"),
+            PolicySpec("repcoded"),  # infeasible at s=3
+        ),
+        n_stragglers=(3,),
+    )
+    dispatched: list = []
+    real = experiments._run_configs
+
+    def spy(configs, dataset, arrivals, batch, on_result=None):
+        dispatched.extend(configs)
+        return real(configs, dataset, arrivals, batch, on_result=on_result)
+
+    monkeypatch.setattr(experiments, "_run_configs", spy)
+    surf = run_whatif(spec)
+    assert dispatched and all(l.startswith("naive@") for l in dispatched)
+    bad = [r for r in surf.rows if r["scheme"] == "repcoded"]
+    assert len(bad) == 1 and not bad[0]["feasible"]
+    assert "n_stragglers+1" in bad[0]["reason"]
+    assert bad[0]["expected_time_to_target"] is None
+    assert bad[0]["n_seeds"] == 0
+
+
+def test_policy_and_regime_parsing():
+    pols = parse_policies("naive,approx:c4,deadline:d1.5,randreg:f0.5")
+    assert [p.scheme for p in pols] == [
+        "naive", "approx", "deadline", "randreg",
+    ]
+    assert pols[1].num_collect == 4
+    assert pols[2].deadline == 1.5
+    assert pols[3].collect_frac == 0.5
+    assert pols[3].resolve_num_collect(8) == 4
+    regs = parse_regimes("exp:0.1,heavytail:1.2:0.5,adversary:5:2,exp+c0.3xslots")
+    assert [r.kind for r in regs] == [
+        "exp", "heavytail", "adversary", "exp",
+    ]
+    assert regs[0].mean == 0.1
+    assert regs[1].alpha == 1.2 and regs[1].mean == 0.5
+    assert regs[2].slowdown == 5.0 and regs[2].worker == 2
+    assert regs[3].compute_time == 0.3 and regs[3].compute_slots
+    with pytest.raises(ValueError, match="bad policy field"):
+        parse_policies("approx:x9")
+    with pytest.raises(ValueError, match="forms:"):
+        parse_regimes("pareto:1.2")
+
+
+def test_spec_hash_stable_and_sensitive():
+    a, b = _tiny_spec(), _tiny_spec()
+    assert a.spec_hash() == b.spec_hash()
+    assert a.spec_hash() != _tiny_spec(n_seeds=4).spec_hash()
+
+
+# ---------------------------------------------------------------------------
+# Monte-Carlo arrival sampling (whatif/sampler.py)
+# ---------------------------------------------------------------------------
+
+
+def test_sampler_deterministic_and_seed_independent():
+    reg = RegimeSpec(mean=0.5)
+    a = sample_arrivals(reg, R, W, [0, 1, 2])
+    b = sample_arrivals(reg, R, W, [0, 1, 2])
+    assert a.shape == (3, R, W)
+    assert np.array_equal(a, b)  # bitwise-identical redraw
+    assert not np.array_equal(a[0], a[1])  # seeds are independent draws
+    assert (a >= 0).all()
+
+
+def test_sampler_regime_kinds():
+    base = sample_arrivals(RegimeSpec(mean=0.5), R, W, [0])[0]
+    heavy = sample_arrivals(
+        RegimeSpec(kind="heavytail", alpha=0.8, mean=0.5), R, W, [0]
+    )[0]
+    assert heavy.max() > 2 * base.max()  # the tail is the point
+    adv = sample_arrivals(
+        RegimeSpec(kind="adversary", slowdown=9.0, worker=2, shift_round=4),
+        R, W, [0],
+    )[0]
+    assert np.array_equal(adv[:4], base[:4])  # pre-shift untouched
+    # f32 device add, so the slowdown lands to float tolerance
+    np.testing.assert_allclose(adv[4:, 2] - base[4:, 2], 9.0, rtol=1e-5)
+    assert np.array_equal(
+        np.delete(adv, 2, axis=1), np.delete(base, 2, axis=1)
+    )
+    shifted = sample_arrivals(
+        RegimeSpec(mean=0.5, compute_time=0.25), R, W, [0]
+    )[0]
+    np.testing.assert_allclose(shifted, base + 0.25)
+
+
+def test_targeted_regime_needs_layout():
+    with pytest.raises(ValueError, match="layout"):
+        sample_arrivals(
+            RegimeSpec(kind="targeted", slowdown=5.0), R, W, [0]
+        )
+
+
+def test_trace_regime_rotates_per_seed(tmp_path):
+    trace = np.arange(R * W, dtype=float).reshape(R, W)
+    path = os.path.join(tmp_path, "trace.npy")
+    np.save(path, trace)
+    out = sample_arrivals(RegimeSpec(kind="trace", trace=path), R, W, [0, 1])
+    assert np.array_equal(out[0], trace)  # seed 0 = the raw replay
+    assert np.array_equal(out[1], np.roll(trace, -1, axis=0))
+
+
+# ---------------------------------------------------------------------------
+# engine + surface artifact (whatif/engine.py, whatif/surface.py)
+# ---------------------------------------------------------------------------
+
+
+def test_surface_roundtrip_and_bitwise_rerun(tmp_path):
+    spec = _tiny_spec()
+    a_dir = os.path.join(tmp_path, "a")
+    b_dir = os.path.join(tmp_path, "b")
+    surf = run_whatif(spec, out_dir=a_dir)
+    assert surf.stats["n_trajectories"] == 2 * spec.n_seeds
+
+    # load round-trip: rows identical, header metadata preserved
+    loaded = Surface.load(a_dir)
+    assert loaded.rows == surf.rows
+    assert loaded.spec_hash == spec.spec_hash()
+    assert loaded.target_loss == surf.target_loss
+
+    # rehydration: an identical spec is served from the artifact
+    rehydrated = run_whatif(spec, out_dir=a_dir)
+    assert rehydrated.stats is None
+    assert rehydrated.rows == surf.rows
+
+    # bitwise rerun: forced re-simulation reproduces both files exactly
+    run_whatif(spec, out_dir=b_dir, rehydrate=False)
+    for name in ("surface_rows.jsonl", "surface.npz"):
+        with open(os.path.join(a_dir, name), "rb") as f:
+            a_bytes = f.read()
+        with open(os.path.join(b_dir, name), "rb") as f:
+            b_bytes = f.read()
+        assert a_bytes == b_bytes, name
+
+    # the npz mirror stays np.load-readable
+    with np.load(os.path.join(a_dir, "surface.npz")) as z:
+        assert list(z["labels"]) == [r["label"] for r in surf.rows]
+        assert z["expected_time_to_target"].shape == (len(surf.rows),)
+
+
+def test_paired_sampling_shares_streams_across_policies():
+    """All policies at the same (W, regime, seed) coordinate read the
+    same arrival stream — naive (wait-for-all) must therefore clock the
+    per-round max of exactly the draw approx saw."""
+    spec = _tiny_spec(n_seeds=2)
+    surf = run_whatif(spec)
+    rows = {r["scheme"]: r for r in surf.feasible_rows()}
+    # same streams => naive's per-round time >= approx's, every time
+    assert (
+        rows["naive"]["sim_time_per_round"]
+        > rows["approx"]["sim_time_per_round"]
+    )
+
+
+def test_whatif_events_emitted_and_valid(tmp_path):
+    spec = _tiny_spec(
+        policies=(PolicySpec("naive"), PolicySpec("deadline")),
+    )
+    events_path = os.path.join(tmp_path, "events.jsonl")
+    with obs_events.capture(events_path):
+        surf = run_whatif(spec)
+    assert obs_events.validate_file(events_path) == []
+    with open(events_path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    whatif = [r for r in recs if r["type"] == "whatif"]
+    kinds = [r["kind"] for r in whatif]
+    assert kinds[0] == "grid"
+    assert kinds.count("point") == len(surf.rows)
+    grid = whatif[0]
+    assert grid["n_points"] == 2 and grid["n_infeasible"] == 1
+    assert all(r["spec_hash"] == spec.spec_hash() for r in whatif)
+    point = next(r for r in whatif if r["kind"] == "point")
+    assert isinstance(r_label := point["label"], str) and r_label
+
+
+def test_whatif_validator_rejects_malformed_records():
+    lines = [
+        json.dumps({"type": "whatif", "seq": 0, "t": 0.0,
+                    "spec_hash": "", "kind": "grid"}),
+        json.dumps({"type": "whatif", "seq": 1, "t": 0.0,
+                    "spec_hash": "abc", "kind": "nope"}),
+        json.dumps({"type": "whatif", "seq": 2, "t": 0.0,
+                    "spec_hash": "abc", "kind": "point",
+                    "feasible": "yes"}),
+    ]
+    errors = obs_events.validate_lines(lines)
+    text = "\n".join(errors)
+    assert "spec_hash" in text
+    assert "kind" in text
+    assert "feasible" in text and "label" in text
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pin: the AGC-vs-exact crossover from simulation alone
+# ---------------------------------------------------------------------------
+
+
+def test_agc_vs_exact_crossover_reproduced():
+    """ErasureHead's central figure family, from simulation alone: under
+    a mild compute-dominated regime the exact code (cyccoded, zero decode
+    error) reaches the target first; under heavy straggling AGC's
+    earlier stop rule wins despite its decode error — and the surface's
+    crossover finder locates the flip. Grid + target verified stable
+    across seed counts before pinning."""
+    spec = GridSpec(
+        policies=(
+            PolicySpec("cyccoded"),
+            PolicySpec("approx", num_collect=4),
+        ),
+        n_workers=(W,),
+        n_stragglers=(1,),
+        regimes=(
+            RegimeSpec(mean=0.05, compute_time=0.3),  # mild straggling
+            RegimeSpec(mean=2.0),                     # heavy straggling
+        ),
+        n_seeds=3,
+        rounds=60,
+        n_rows=96,
+        n_cols=8,
+        target_loss=0.145,
+    )
+    surf = run_whatif(spec)
+    x = surf.crossover("approx", "cyccoded", axis="regime")
+    winners = {v: winner for v, _a, _b, winner in x["points"]}
+    assert winners["exp0.05+c0.3"] == "cyccoded"  # exact wins mild
+    assert winners["exp2"] == "approx"            # AGC wins heavy
+    assert x["crossover"] == "exp2"               # the flip is located
+    table = surf.format_crossover_table("approx", "cyccoded", "regime")
+    assert "<- crossover" in table
+
+
+# ---------------------------------------------------------------------------
+# consumers: adapt priors + serve ETA
+# ---------------------------------------------------------------------------
+
+
+def _surface_fixture(tmp_path):
+    spec = _tiny_spec(
+        policies=(
+            PolicySpec("naive"),
+            PolicySpec("avoidstragg"),
+            PolicySpec("approx", num_collect=4),
+        ),
+    )
+    return run_whatif(spec, out_dir=os.path.join(tmp_path, "surf"))
+
+
+def test_surface_lookup_and_eta(tmp_path):
+    from erasurehead_tpu.utils.config import RunConfig
+
+    surf = _surface_fixture(tmp_path)
+    row = surf.lookup("approx", n_workers=W, n_stragglers=1, num_collect=4)
+    assert row is not None and row["scheme"] == "approx"
+    assert surf.lookup("cyccoded") is None  # not on this surface
+    cfg = RunConfig(
+        scheme="approx", n_workers=W, n_stragglers=1, num_collect=4,
+        rounds=R, n_rows=96, n_cols=8, compute_mode="deduped",
+    )
+    eta = surf.eta(cfg)
+    assert eta == row["expected_time_to_target"] and eta > 0
+
+
+def test_surface_adapt_priors_units(tmp_path):
+    surf = _surface_fixture(tmp_path)
+    arms = [
+        adapt.Arm("naive"),
+        adapt.Arm("avoidstragg"),
+        adapt.Arm("approx", num_collect=4),
+        adapt.Arm("deadline", deadline=1.0),  # no row -> omitted
+    ]
+    priors = surf.adapt_priors(arms, n_workers=W, n_stragglers=1)
+    assert set(priors) == {"naive", "avoidstragg", "approx:c4"}
+    # time_error units: minus sim-seconds-per-round, error-inflated
+    naive_row = surf.lookup("naive", n_workers=W, n_stragglers=1)
+    assert priors["naive"] == pytest.approx(
+        -naive_row["sim_time_per_round"]
+    )
+    assert all(v < 0 for v in priors.values())
+
+
+def test_serve_quotes_surface_eta(tmp_path):
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.serve.server import SweepServer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    surf = _surface_fixture(tmp_path)
+    cfg = RunConfig(
+        scheme="approx", n_workers=W, n_stragglers=1, num_collect=4,
+        rounds=R, n_rows=96, n_cols=8, lr_schedule=1.0, add_delay=True,
+        compute_mode="deduped", update_rule="GD", seed=0,
+    )
+    ds = generate_gmm(96, 8, W, seed=0)
+    with SweepServer(eta_surface=surf) as srv:
+        h = srv.submit(tenant="t", label="agc", config=cfg, dataset=ds)
+        assert h.eta_s == surf.eta(cfg) and h.eta_s > 0
+        res = h.result(timeout=300)
+    assert res.status == "ok"
+    # without a surface the quote stays None (quoting off, serving on)
+    with SweepServer() as srv:
+        h = srv.submit(tenant="t", label="agc2", config=cfg, dataset=ds)
+        assert h.eta_s is None
+        assert h.result(timeout=300).status == "ok"
+
+
+def test_cli_whatif_subcommand(tmp_path):
+    from erasurehead_tpu import cli
+
+    out = os.path.join(tmp_path, "surface")
+    rc = cli.main([
+        "whatif",
+        "--policies", "naive,approx:c4",
+        "--workers", str(W), "--stragglers", "1",
+        "--regimes", "exp:0.5", "--seeds", "2", "--rounds", "8",
+        "--rows", "96", "--cols", "8",
+        "--out", out, "--crossover", "approx,naive", "--quiet",
+    ])
+    assert rc == 0
+    assert os.path.exists(os.path.join(out, "surface_rows.jsonl"))
+    assert os.path.exists(os.path.join(out, "surface.npz"))
+    assert obs_events.validate_file(
+        os.path.join(out, "events.jsonl")
+    ) == []

@@ -20,7 +20,12 @@ known reason (warmup/exploit/explore/regime_shift — obs/events.
 ADAPT_REASONS), elastic `membership` records (erasurehead_tpu/elastic/)
 carry a non-negative round, a known action (death/join/relayout/probe/
 chunk — obs/events.MEMBERSHIP_ACTIONS), a positive worker count and
-well-formed worker-id lists, and every run_start has a matching run_end. Sweep journals and
+well-formed worker-id lists, what-if engine `whatif` records
+(erasurehead_tpu/whatif/) carry a non-empty spec_hash and a known kind
+(grid/point/surface/rehydrate — obs/events.WHATIF_KINDS) with per-kind
+field checks (point records name their grid point and feasibility
+verdict, grid records carry non-negative point counts), and every
+run_start has a matching run_end. Sweep journals and
 serve event logs are events.jsonl files too — point this tool at
 DIR/sweep_journal.jsonl or the daemon's --events log to check them.
 
