@@ -43,7 +43,8 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 	generate_random_data arrange_real_data \
 	test lint tier1 bench sweep rehearse watch compare real_data dryrun \
 	telemetry-smoke sweep-batch-smoke chaos-smoke roofline-smoke \
-	serve-smoke adapt-smoke deep-smoke elastic-smoke whatif-smoke clean
+	serve-smoke serve-load-smoke serve-chaos-smoke adapt-smoke \
+	deep-smoke elastic-smoke whatif-smoke clean
 
 naive:            ## uncoded wait-for-all baseline (src/naive.py)
 	$(RUN) --scheme naive
@@ -125,6 +126,12 @@ roofline-smoke:   ## CPU ring+pipelined+int8 sweep: asserts bytes accounting, di
 
 serve-smoke:      ## CPU serve daemon race: 4 clients pack into shared dispatches, rows bitwise vs sequential (tools/serve_smoke.py)
 	JAX_PLATFORMS=cpu $(PY) tools/serve_smoke.py
+
+serve-load-smoke: ## CPU HTTP-front load harness: closed-loop fleet, 2x-capacity backpressure (0 lost/dup), fairness >= 0.5x under a flooding tenant, warm restart with 0 recompiles (tools/serve_load_smoke.py)
+	JAX_PLATFORMS=cpu $(PY) tools/serve_load_smoke.py
+
+serve-chaos-smoke: ## CPU restart-under-load with REAL kills: daemon dies mid-dispatch (chaos serve_dispatch), restarts, WAL replays, rows rehydrate bitwise, 0 recompiles of warm signatures (tools/serve_chaos_smoke.py)
+	JAX_PLATFORMS=cpu $(PY) tools/serve_chaos_smoke.py
 
 adapt-smoke:      ## CPU regime-shift drive of the adaptive controller: policy switches, adapt events validate, decisions replay bitwise (tools/adapt_smoke.py)
 	JAX_PLATFORMS=cpu $(PY) tools/adapt_smoke.py

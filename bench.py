@@ -73,6 +73,14 @@ dispatched sequentially one singleton cohort at a time. Aggregate
 throughput = trajectories/sec across all clients; the packed and
 sequential science rows must agree BITWISE (completion order aside),
 because a cohort's per-trajectory results are independent of its width.
+``serve_load`` is the robustness twin: closed-loop HTTP clients
+(serve/http_front.py + serve/loadgen.py) reporting p50/p99
+time-to-first-row and time-to-last-row plus the packed-dispatch ratio,
+backpressure correctness at 2x-capacity offered load (zero
+accepted-then-lost, zero duplicates, 429s retried to success on the
+daemon's retry-after schedule), goodput fairness under one flooding
+tenant (bar >= 0.5x solo), and a warm-restart phase pinning bitwise
+rehydration with zero new on-disk compile-cache entries.
 """
 
 import json
@@ -644,6 +652,184 @@ def _serve_pack_extra(data, n_rows: int) -> dict:
             "unpadded_singleton_wall_s": round(unpadded_wall, 4),
         },
     }
+
+
+#: serve_load extra shape: closed-loop tenants x requests over the HTTP
+#: front (each request is a config-resolvable trajectory the daemon packs
+#: by signature), one flooding tenant for the fairness phase, and a
+#: warm-restart phase against the on-disk compilation cache
+SERVE_LOAD_TENANTS = 6
+SERVE_LOAD_REQUESTS = 8
+SERVE_LOAD_FLOOD = 48
+SERVE_LOAD_WORKERS = 4
+SERVE_LOAD_ROUNDS = 3
+SERVE_LOAD_ROWS = 256
+
+
+def _serve_load_extra() -> dict:
+    """The robustness twin of serve_pack: hundreds of requests from
+    concurrent HTTP clients driven closed-loop through the network front
+    (serve/http_front.py + serve/loadgen.py). Reports p50/p99
+    time-to-first-row and time-to-last-row, the packed-dispatch ratio,
+    backpressure correctness under 2x-capacity offered load (zero
+    accepted-then-lost, zero duplicate rows, 429'd clients succeeding on
+    their retry-after schedule), goodput fairness under one flooding
+    tenant (bar: >= 0.5x solo), and a warm-restart phase (bitwise
+    rehydration, zero new on-disk compile-cache entries)."""
+    import shutil
+    import tempfile
+
+    from erasurehead_tpu.obs.metrics import REGISTRY
+    from erasurehead_tpu.serve import loadgen
+    from erasurehead_tpu.serve import server as serve_server
+    from erasurehead_tpu.serve.http_front import HttpFront
+
+    base = tempfile.mkdtemp(prefix="eh-serve-load-")
+    journal_dir = os.path.join(base, "journal")
+    cache_dir = os.path.join(base, "xla-cache")
+    common = dict(
+        scheme="naive", n_workers=SERVE_LOAD_WORKERS, n_stragglers=1,
+        rounds=SERVE_LOAD_ROUNDS, n_rows=SERVE_LOAD_ROWS, n_cols=N_COLS,
+        update_rule="AGD", lr_schedule=0.5, add_delay=True,
+        compute_mode="deduped",
+    )
+
+    def jobs_for(tenant: str, n: int, seed0: int = 0):
+        # per-request seeds: distinct trajectories (and distinct
+        # idempotency digests), one shared signature — they all pack
+        return [
+            (f"{tenant}-r{k}", {**common, "seed": seed0 + k})
+            for k in range(n)
+        ]
+
+    def make_front(**server_kw):
+        kw = dict(
+            window_s=0.05, journal_dir=journal_dir, cache_dir=cache_dir,
+            max_cohort=16,
+        )
+        kw.update(server_kw)
+        srv = serve_server.SweepServer(**kw).start()
+        front = HttpFront(srv)
+
+        def close():
+            front.close()
+            srv.stop()
+
+        return srv, front, front.host, front.port, close
+
+    out: dict = {}
+
+    # ---- phase 1: closed-loop latency + packed-dispatch ratio ----------
+    d0 = REGISTRY.counter("serve.dispatches").value
+    _srv, _front, host, port, close = make_front()
+    try:
+        fleet = loadgen.run_fleet(
+            host, port,
+            {
+                f"tenant{k}": jobs_for(f"tenant{k}", SERVE_LOAD_REQUESTS,
+                                       seed0=100 * k)
+                for k in range(SERVE_LOAD_TENANTS)
+            },
+            concurrency=4,
+        )
+    finally:
+        close()
+    dispatches = REGISTRY.counter("serve.dispatches").value - d0
+    n_requests = SERVE_LOAD_TENANTS * SERVE_LOAD_REQUESTS
+    out["closed_loop"] = {
+        "tenants": SERVE_LOAD_TENANTS,
+        "requests": n_requests,
+        "dispatches": dispatches,
+        "packed_ratio": (
+            round(n_requests / dispatches, 2) if dispatches else None
+        ),
+        "ttfr_p50_s": loadgen.percentile(
+            [x for led in fleet["tenants"].values()
+             for x in led["latencies_s"]], 50,
+        ),
+        "ttfr_p99_s": fleet["latency_p99_s"],
+        "ttlr_p99_s": fleet["ttlr_p99_s"],
+        "lost": fleet["lost"],
+        "duplicates": fleet["duplicates"],
+    }
+
+    # ---- phase 2: backpressure at 2x capacity --------------------------
+    # max_pending well under the offered burst: 429s must flow, retries
+    # must land every job, and nothing may be accepted-then-lost
+    _srv, _front, host, port, close = make_front(max_pending=8)
+    try:
+        pressured = loadgen.run_fleet(
+            host, port,
+            {
+                f"burst{k}": jobs_for(f"burst{k}", SERVE_LOAD_REQUESTS,
+                                      seed0=1000 + 100 * k)
+                for k in range(2 * SERVE_LOAD_TENANTS)
+            },
+            concurrency=8,
+            max_retries=10,
+        )
+    finally:
+        close()
+    out["backpressure"] = {
+        "offered_requests": 2 * SERVE_LOAD_TENANTS * SERVE_LOAD_REQUESTS,
+        "rejected_429s": pressured["rejected_429s"],
+        "retries": pressured["retries"],
+        "lost": pressured["lost"],
+        "duplicates": pressured["duplicates"],
+        "all_jobs_landed": all(
+            led["rows"] == led["jobs"] - led["rejected_final"]
+            for led in pressured["tenants"].values()
+        ),
+    }
+
+    # ---- phase 3: fairness under one flooding tenant -------------------
+    # journal OFF here: rehydrating the solo phase's rows would fake the
+    # contended goodput (signatures are warm from the phases above, so
+    # this measures scheduling, not compiles)
+    import functools
+
+    fair = loadgen.fairness_run(
+        functools.partial(make_front, journal_dir=None),
+        victim_jobs={
+            f"victim{k}": jobs_for(f"victim{k}", 4, seed0=5000 + 100 * k)
+            for k in range(2)
+        },
+        flood_jobs=jobs_for("flood", SERVE_LOAD_FLOOD, seed0=9000),
+        flood_concurrency=SERVE_LOAD_FLOOD,
+    )
+    out["fairness"] = {
+        "flood_requests": SERVE_LOAD_FLOOD,
+        "goodput_ratio": fair["goodput_ratio"],
+        "min_goodput_ratio": fair["min_goodput_ratio"],
+        "bar_met": (
+            fair["min_goodput_ratio"] is not None
+            and fair["min_goodput_ratio"] >= 0.5
+        ),
+    }
+
+    # ---- phase 4: warm restart -----------------------------------------
+    # fresh seeds: the first pass must genuinely dispatch (and write the
+    # on-disk cache) so the bounce proves rehydration, not journal reuse
+    restart = loadgen.restart_run(
+        make_front,
+        {
+            f"rst{k}": jobs_for(f"rst{k}", SERVE_LOAD_REQUESTS,
+                                seed0=7000 + 100 * k)
+            for k in range(2)
+        },
+        cache_dir=cache_dir,
+        concurrency=4,
+    )
+    out["restart"] = {
+        "rows_first": restart["rows_first"],
+        "rows_resubmitted": restart["rows_resubmitted"],
+        "resumed": restart["resumed"],
+        "bitwise_mismatches": restart["bitwise_mismatches"],
+        "new_compile_cache_entries": restart["new_compile_cache_entries"],
+        "restart_wall_s": restart["restart_wall_s"],
+    }
+    shutil.rmtree(base, ignore_errors=True)
+    return {"serve_load": out}
 
 
 #: adapt extra scenario (ISSUE 8): W=4 non-iid (label-sorted) partitions,
@@ -1468,6 +1654,16 @@ def child() -> None:
         except Exception as e:  # noqa: BLE001 — extras must never kill bench
             print(f"bench: serve_pack extra failed: {e}", file=sys.stderr)
 
+        # ---- serve_load extra: the robustness twin — closed-loop HTTP
+        # load (p50/p99 time-to-first/last-row, packed ratio), 2x-capacity
+        # backpressure correctness, goodput fairness under a flooding
+        # tenant, and the warm-restart (WAL + on-disk compile cache) phase
+        serve_load_extra = {}
+        try:
+            serve_load_extra = _serve_load_extra()
+        except Exception as e:  # noqa: BLE001 — extras must never kill bench
+            print(f"bench: serve_load extra failed: {e}", file=sys.stderr)
+
         # ---- adapt extra: the online straggler-adaptive controller under
         # a deterministic regime shift — controller overhead per chunk
         # (bar < 2% of run wall) and time-to-target vs every static arm
@@ -1638,6 +1834,7 @@ def child() -> None:
                 **sweep7_extra,
                 **deep_extra,
                 **serve_extra,
+                **serve_load_extra,
                 **adapt_extra,
                 **elastic_extra,
                 **whatif_extra,

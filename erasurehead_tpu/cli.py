@@ -38,10 +38,16 @@ same console entry, with the resilient-sweep flags::
 
 A fifth runs the multi-tenant sweep-as-a-service daemon
 (erasurehead_tpu/serve/): concurrent clients' compatible requests bin-pack
-into shared cohort dispatches under an HBM admission budget::
+into shared cohort dispatches under an HBM admission budget — weighted-
+fair across tenants, with an HTTP/1.1 JSONL front (per-tenant bearer
+tokens, chunked result streaming, 429 + Retry-After backpressure) and
+crash-safe warm restarts (intake WAL + JAX's on-disk compilation cache)::
 
        erasurehead-tpu serve --socket /tmp/eh.sock --budget 2g \\
-           --journal-dir /var/lib/eh-serve --events serve_events.jsonl
+           --http 0.0.0.0:8080 --auth-tokens tokens.json \\
+           --journal-dir /var/lib/eh-serve --cache-dir /var/lib/eh-xla \\
+           --max-pending 256 --request-timeout 600 \\
+           --events serve_events.jsonl
 
 A sixth runs the AST invariant analyzer (erasurehead_tpu/analysis/) over
 the tree — the trace/cache/telemetry contract checks tier-1 gates on::

@@ -89,6 +89,24 @@ SCHEMA: dict[str, tuple] = {
     # sweep data cache's HBM pins (or timed a request out of the packing
     # window) to make room — "reason" says which
     "evict": ("reason",),
+    # one per backpressure rejection (HTTP 429 / socket "rejected" /
+    # in-process ServeOverloadedError): which tenant was pushed back and
+    # why ("overloaded" when the intake queue crossed its high-water
+    # mark, "unauthorized" when an HTTP bearer token failed). The
+    # optional ``retry_after_s`` is the deferral-derived schedule quote
+    # the client's capped-exponential backoff honors.
+    "reject": ("tenant", "reason"),
+    # one per result-streaming lifecycle transition on a network front
+    # connection: "event" says which ("open" when a reader attaches,
+    # "overflow" when a slow reader's bounded outbox dropped journaled
+    # rows — the client re-fetches by resubmitting, "close" when the
+    # reader detaches). Optional ``dropped`` counts rows shed so far.
+    "stream": ("tenant", "event"),
+    # one per daemon warm restart (serve/wal.py replay): how many intake
+    # WAL records were read, how many re-dispatched because their rows
+    # were not yet journaled, and how many rehydrated straight from the
+    # per-tenant journals without a dispatch
+    "restart": ("wal_records", "resubmitted", "rehydrated"),
     # one per adaptive-controller decision (adapt/driver.py): which
     # (scheme, collect, deadline) arm ran the chunk starting at "round",
     # and why (warmup / exploit / explore / regime_shift). Seeded and
@@ -125,6 +143,14 @@ ADAPT_REASONS = ("warmup", "exploit", "explore", "regime_shift")
 #: "probe" marks a collapsed-arrival re-evaluation, "chunk" is a finished
 #: chunk's journal row
 MEMBERSHIP_ACTIONS = ("death", "join", "relayout", "probe", "chunk")
+
+#: result-stream lifecycle events (serve network fronts): a reader
+#: attached, a slow reader's bounded outbox shed journaled rows, a
+#: reader detached
+STREAM_EVENTS = ("open", "overflow", "close")
+
+#: backpressure rejection reasons (serve/server.py + serve/http_front.py)
+REJECT_REASONS = ("overloaded", "unauthorized")
 
 #: what-if engine phases (whatif/engine.py): "grid" = enumeration +
 #: feasibility filter, "point" = one reduced surface row, "surface" =
@@ -424,7 +450,11 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
     key, and an object row; serve records are internally consistent
     (``request`` names tenant/request_id/label, ``pack``'s trajectory
     count matches its label list, ``admit`` carries non-negative byte
-    figures, ``evict`` names its reason); ``membership`` records carry a
+    figures, ``evict`` names its reason, ``reject`` carries a tenant and
+    a known reason (:data:`REJECT_REASONS`) plus an optional
+    non-negative retry-after, ``stream`` carries a tenant and a known
+    lifecycle event (:data:`STREAM_EVENTS`), ``restart`` carries
+    non-negative WAL-replay counts); ``membership`` records carry a
     non-negative round, a known action (:data:`MEMBERSHIP_ACTIONS`), a
     positive worker count and — when present — a list of non-negative
     worker ids; ``whatif`` records carry a non-empty ``spec_hash`` and a
@@ -583,6 +613,56 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
                     f"line {i}: evict reason must be a non-empty string, "
                     f"got {reason!r}"
                 )
+        if rtype == "reject":
+            tenant = rec.get("tenant")
+            if not isinstance(tenant, str) or not tenant:
+                errors.append(
+                    f"line {i}: reject tenant must be a non-empty string, "
+                    f"got {tenant!r}"
+                )
+            reason = rec.get("reason")
+            if reason not in REJECT_REASONS:
+                errors.append(
+                    f"line {i}: reject reason must be one of "
+                    f"{REJECT_REASONS}, got {reason!r}"
+                )
+            ra = rec.get("retry_after_s")
+            if ra is not None and (
+                not isinstance(ra, (int, float)) or ra < 0
+            ):
+                errors.append(
+                    f"line {i}: reject retry_after_s must be a "
+                    f"non-negative number, got {ra!r}"
+                )
+        if rtype == "stream":
+            tenant = rec.get("tenant")
+            if not isinstance(tenant, str) or not tenant:
+                errors.append(
+                    f"line {i}: stream tenant must be a non-empty string, "
+                    f"got {tenant!r}"
+                )
+            ev = rec.get("event")
+            if ev not in STREAM_EVENTS:
+                errors.append(
+                    f"line {i}: stream event must be one of "
+                    f"{STREAM_EVENTS}, got {ev!r}"
+                )
+            dropped = rec.get("dropped")
+            if dropped is not None and (
+                not isinstance(dropped, int) or dropped < 0
+            ):
+                errors.append(
+                    f"line {i}: stream dropped must be a non-negative "
+                    f"int, got {dropped!r}"
+                )
+        if rtype == "restart":
+            for field in ("wal_records", "resubmitted", "rehydrated"):
+                v = rec.get(field)
+                if not isinstance(v, int) or v < 0:
+                    errors.append(
+                        f"line {i}: restart {field} must be a "
+                        f"non-negative int, got {v!r}"
+                    )
         if rtype == "adapt":
             rnd = rec.get("round")
             if not isinstance(rnd, int) or rnd < 0:

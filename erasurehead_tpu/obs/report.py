@@ -32,7 +32,10 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
     trajectories: list = []
     adapt: list = []
     membership: list = []
-    serve: dict = {"requests": [], "packs": [], "admits": [], "evicts": []}
+    serve: dict = {
+        "requests": [], "packs": [], "admits": [], "evicts": [],
+        "rejects": [], "streams": [], "restarts": [],
+    }
 
     def run(rid):
         if rid not in runs:
@@ -86,6 +89,12 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
                     serve["admits"].append(rec)
                 elif rtype == "evict":
                     serve["evicts"].append(rec)
+                elif rtype == "reject":
+                    serve["rejects"].append(rec)
+                elif rtype == "stream":
+                    serve["streams"].append(rec)
+                elif rtype == "restart":
+                    serve["restarts"].append(rec)
     out = [runs[rid] for rid in order]
     if (
         warnings or trajectories or adapt or membership
@@ -182,21 +191,33 @@ def _membership_section(stray: list) -> list[str]:
 
 def _serve_section(stray: list) -> list[str]:
     """The per-tenant serving section: requests, packed-dispatch ratio,
-    admission pressure, and quarantined/diverged rows, from the serve
-    daemon's request/pack/admit/evict + sweep_trajectory records."""
-    serve = {"requests": [], "packs": [], "admits": [], "evicts": []}
+    admission pressure, backpressure (rejects + retried-after-429
+    acceptances), stream overflow drops, warm restarts, and
+    quarantined/diverged rows — from the serve daemon's request/pack/
+    admit/evict/reject/stream/restart + sweep_trajectory records."""
+    serve = {
+        "requests": [], "packs": [], "admits": [], "evicts": [],
+        "rejects": [], "streams": [], "restarts": [],
+    }
     trajectories: list = []
     for g in stray:
         for k in serve:
             serve[k].extend((g.get("serve") or {}).get(k, []))
         trajectories.extend(g.get("trajectories", []))
-    if not serve["requests"] and not serve["packs"]:
+    if not serve["requests"] and not serve["packs"] and not (
+        serve["rejects"] or serve["restarts"]
+    ):
         return []
     packs = serve["packs"]
     n_packed_traj = sum(p.get("n_trajectories", 0) for p in packs)
     ratio = n_packed_traj / len(packs) if packs else 0.0
     deferred = sum(
         1 for a in serve["admits"] if a.get("admitted") is False
+    )
+    overflow_dropped = sum(
+        s.get("dropped") or 0
+        for s in serve["streams"]
+        if s.get("event") == "overflow"
     )
     lines = [
         f"\nserve (multi-tenant cohort packing): "
@@ -205,21 +226,32 @@ def _serve_section(stray: list) -> list[str]:
         + (f", {deferred} deferred by admission" if deferred else "")
         + (f", {len(serve['evicts'])} eviction(s)" if serve["evicts"]
            else "")
+        + (f", {len(serve['rejects'])} rejected (429)"
+           if serve["rejects"] else "")
     ]
+    def _blank():
+        return {
+            "requests": 0, "rows": 0, "diverged": 0, "errors": 0,
+            "rejects": 0, "retried": 0,
+        }
+
     by_tenant: dict = {}
     for r in serve["requests"]:
-        t = by_tenant.setdefault(
-            r.get("tenant", "?"),
-            {"requests": 0, "rows": 0, "diverged": 0, "errors": 0},
-        )
+        t = by_tenant.setdefault(r.get("tenant", "?"), _blank())
         t["requests"] += 1
+        if r.get("retry"):
+            # an acceptance whose submit attempt number is > 0: the
+            # client's backoff schedule worked — count it as a retried
+            # request that eventually got in
+            t["retried"] += 1
+    for r in serve["rejects"]:
+        t = by_tenant.setdefault(r.get("tenant", "?"), _blank())
+        t["rejects"] += 1
     for rec in trajectories:
         tenant = rec.get("tenant")
         if tenant is None:
             continue  # a local sweep journal row, not a serve row
-        t = by_tenant.setdefault(
-            tenant, {"requests": 0, "rows": 0, "diverged": 0, "errors": 0}
-        )
+        t = by_tenant.setdefault(tenant, _blank())
         t["rows"] += 1
         if rec.get("status") == "diverged":
             t["diverged"] += 1
@@ -232,14 +264,26 @@ def _serve_section(stray: list) -> list[str]:
                 t["errors"] += 1
     header = (
         f"  {'tenant':16s} {'requests':>9s} {'rows':>6s} "
-        f"{'diverged':>9s} {'errors':>7s}"
+        f"{'diverged':>9s} {'errors':>7s} {'rejects':>8s} {'retried':>8s}"
     )
     lines += [header, "  " + "-" * (len(header) - 2)]
     for tenant in sorted(by_tenant):
         t = by_tenant[tenant]
         lines.append(
             f"  {tenant[:16]:16s} {t['requests']:>9d} {t['rows']:>6d} "
-            f"{t['diverged']:>9d} {t['errors']:>7d}"
+            f"{t['diverged']:>9d} {t['errors']:>7d} {t['rejects']:>8d} "
+            f"{t['retried']:>8d}"
+        )
+    for r in serve["restarts"]:
+        lines.append(
+            f"  warm restart: {r.get('wal_records', 0)} WAL record(s) -> "
+            f"{r.get('resubmitted', 0)} re-dispatched, "
+            f"{r.get('rehydrated', 0)} rehydrated from journal"
+        )
+    if overflow_dropped:
+        lines.append(
+            f"  stream backpressure: {overflow_dropped} row(s) shed to "
+            f"slow readers (journaled; re-fetchable by resubmission)"
         )
     return lines
 

@@ -3,25 +3,43 @@
 The serve daemon generalizes the cohort engine's batch dimension from "one
 user's sweep" (train/trainer.train_cohort, PR 4) to "many concurrent
 clients": compatible requests from different tenants bin-pack into shared
-compiled dispatches, an admission controller bounds in-flight HBM, and
-results stream back per tenant with journal-backed resume and the sweep
-guard's full degradation ladder as fault isolation.
+compiled dispatches — weighted-fair across tenants, so one chatty client
+can't starve the rest — an admission controller bounds in-flight HBM,
+backpressure rejects (429 / "rejected") instead of starving once the
+intake queue crosses its high-water mark, and results stream back per
+tenant with journal-backed resume and the sweep guard's full degradation
+ladder as fault isolation. Acceptances are WAL'd and executables persist
+in JAX's on-disk compilation cache, so a crashed daemon restarts warm:
+zero fresh compiles, every accepted request rehydrated bitwise.
 
-    serve/queue.py      request/result model + in-process handles
-    serve/packer.py     signature bin-packing (cohort_signature + dataset)
-    serve/admission.py  HBM budget: estimates, measured refinement, evict
-    serve/server.py     the SweepServer loop + the unix-socket front
-    serve/client.py     socket client for `erasurehead-tpu serve`
+    serve/queue.py       request/result model + in-process handles
+    serve/packer.py      signature bin-packing, weighted-fair + quotas
+    serve/admission.py   HBM budget: estimates, measured refinement, evict
+    serve/wal.py         intake write-ahead log (crash-safe acceptances)
+    serve/server.py      the SweepServer loop + the unix-socket front
+    serve/http_front.py  HTTP/1.1 JSONL front: auth, streaming, 429s
+    serve/client.py      socket + HTTP clients for `erasurehead-tpu serve`
+    serve/loadgen.py     closed-loop load generator (bench + smokes)
 """
 
+from erasurehead_tpu.serve.client import (  # noqa: F401
+    HttpServeClient,
+    ServeClient,
+    ServeRejectedError,
+    ServeUnavailableError,
+)
 from erasurehead_tpu.serve.queue import (  # noqa: F401
     RequestHandle,
     RunRequest,
+    ServeOverloadedError,
     ServeResult,
     config_from_payload,
+    config_payload,
+    request_digest,
 )
 from erasurehead_tpu.serve.server import (  # noqa: F401
     SocketFront,
     SweepServer,
     serving,
 )
+from erasurehead_tpu.serve.wal import IntakeWAL  # noqa: F401

@@ -92,6 +92,23 @@ class AdmissionController:
         self._lock = threading.Lock()
         self._in_flight: dict[str, int] = {}  # key digest -> charged bytes
         self._measured: dict[str, int] = {}  # key digest -> measured bytes
+        self._deferred_total = 0  # lifetime defer verdicts (pressure())
+
+    def pressure(self) -> dict:
+        """Admission pressure snapshot for operators: what /healthz and
+        the retry-after story expose — charged in-flight bytes against
+        the budget, live dispatch count, and how often this controller
+        has had to defer (the overload trend a load balancer watches)."""
+        with self._lock:
+            in_flight = sum(self._in_flight.values())
+            dispatches = len(self._in_flight)
+            deferred = self._deferred_total
+        return {
+            "budget_bytes": self.budget_bytes,
+            "in_flight_bytes": in_flight,
+            "in_flight_dispatches": dispatches,
+            "deferred_total": deferred,
+        }
 
     @property
     def in_flight_bytes(self) -> int:
@@ -179,6 +196,9 @@ class AdmissionController:
                 f"ALONE (refusing forever would deadlock the tenant) — "
                 f"the OOM-bisection ladder is its safety net",
             )
+        if not admitted:
+            with self._lock:
+                self._deferred_total += 1
         _METRICS.counter(
             "serve.admitted" if admitted else "serve.deferred"
         ).inc()

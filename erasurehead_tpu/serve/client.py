@@ -1,20 +1,35 @@
-"""Socket client for the serve daemon's unix-socket front.
+"""Clients for the serve daemon's network fronts.
 
-The wire protocol is newline-delimited JSON (see server.SocketFront): one
-``submit`` line per request, streamed ``result`` lines back as the
-daemon's packed dispatches land. A reader thread demultiplexes the
-responses, so any number of submissions may be in flight on one
-connection; results arrive in COMPLETION order — match them up by
-``request_id`` (or ``label``). Submissions themselves serialize briefly:
-the daemon answers ``accepted`` lines in submit order with no correlation
-tag, so :meth:`submit` holds a lock across its send + reply to keep
-concurrent submitters from swapping request_ids.
+Two transports, one contract:
 
-    client = ServeClient("/tmp/eh-serve.sock")
-    rid = client.submit("alice", "agc_s2", {"scheme": "approx",
-                        "n_workers": 8, "num_collect": 4, "rounds": 20})
-    res = client.result(timeout=300)   # {"request_id": rid, "row": ...}
-    client.close()
+  - :class:`ServeClient` — newline-delimited JSON over the AF_UNIX
+    socket (see server.SocketFront): one ``submit`` line per request,
+    streamed ``result`` lines back as the daemon's packed dispatches
+    land. A reader thread demultiplexes the responses, so any number of
+    submissions may be in flight on one connection; results arrive in
+    COMPLETION order — match them up by ``request_id`` (or ``label``).
+  - :class:`HttpServeClient` — the HTTP/1.1 JSONL front
+    (serve/http_front.py): ``POST /v1/submit`` per request plus one
+    long-lived chunked ``GET /v1/stream`` connection the reader thread
+    drains. Auth is a per-tenant bearer token.
+
+Failure taxonomy (the part the reference's mpirun-and-pray lifecycle
+never had):
+
+  - **daemon death** raises :class:`ServeUnavailableError` naming the
+    endpoint and the last event seen on the wire — never a raw
+    ``queue.Empty`` or socket errno;
+  - **backpressure** (socket ``rejected`` line / HTTP 429) raises
+    :class:`ServeRejectedError` carrying the daemon's ``retry_after_s``
+    quote — or, with ``max_retries > 0``, is retried in-client on a
+    DETERMINISTIC capped-exponential schedule that honors the quote
+    (``wait = max(retry_after_s, min(cap, base * 2**attempt))``, no
+    jitter: a rejected request's resubmission is idempotent by digest,
+    so synchronized retries cost duplicate 429s, not duplicate rows);
+  - **a client-side wait timeout** stays ``queue.Empty`` (the daemon is
+    alive, the result genuinely isn't ready); the server-side
+    ``request_timeout_s`` knob turns a stalled dispatch into a typed
+    error *result* instead.
 """
 
 from __future__ import annotations
@@ -23,7 +38,51 @@ import json
 import queue as queue_lib
 import socket
 import threading
+import time
 from typing import Optional
+
+
+class ServeUnavailableError(RuntimeError):
+    """The daemon went away (connect refused, connection dropped, or the
+    reader hit EOF) — distinguishable from a result that merely isn't
+    ready yet. ``endpoint`` names the socket path or URL; ``last_event``
+    is the last wire message type seen before the drop (None = the
+    connection never spoke)."""
+
+    def __init__(self, endpoint: str, last_event: Optional[str],
+                 detail: str = ""):
+        self.endpoint = endpoint
+        self.last_event = last_event
+        msg = (
+            f"serve daemon unavailable at {endpoint} "
+            f"(last event seen: {last_event or 'none'})"
+        )
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class ServeRejectedError(RuntimeError):
+    """Backpressure: the daemon answered 429/"rejected" instead of
+    accepting. ``retry_after_s`` is the schedule quote to honor."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+def backoff_s(
+    attempt: int,
+    retry_after_s: Optional[float],
+    base: float = 0.1,
+    cap: float = 10.0,
+) -> float:
+    """The deterministic capped-exponential wait before retry number
+    ``attempt`` (0-based): the daemon's retry-after quote wins when it is
+    the longer, the exponential floor keeps a client whose quotes are
+    stale from hammering, and the cap bounds the tail."""
+    exp = min(cap, base * (2.0 ** attempt))
+    return max(float(retry_after_s or 0.0), exp)
 
 
 class ServeClient:
@@ -31,12 +90,19 @@ class ServeClient:
 
     def __init__(self, path: str, timeout: Optional[float] = None):
         self.path = path
+        self.last_event: Optional[str] = None
         self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._sock.settimeout(timeout)
-        self._sock.connect(path)
+        try:
+            self._sock.connect(path)
+        except OSError as e:
+            raise ServeUnavailableError(path, None, str(e)) from e
         self._wlock = threading.Lock()
         self._accepted: "queue_lib.Queue[dict]" = queue_lib.Queue()
         self._results: "queue_lib.Queue[dict]" = queue_lib.Queue()
+        self._closed = threading.Event()
+        self.rejected_total = 0  # 429/"rejected" replies seen
+        self.retried_total = 0  # submissions re-sent after a rejection
         self._reader = threading.Thread(
             target=self._read_loop, name="eh-serve-client", daemon=True
         )
@@ -44,26 +110,33 @@ class ServeClient:
 
     def _read_loop(self) -> None:
         buf = b""
-        while True:
-            try:
-                chunk = self._sock.recv(1 << 16)
-            except OSError:
-                return
-            if not chunk:
-                return
-            buf += chunk
-            while b"\n" in buf:
-                raw, buf = buf.split(b"\n", 1)
-                if not raw.strip():
-                    continue
+        try:
+            while True:
                 try:
-                    msg = json.loads(raw)
-                except json.JSONDecodeError:
-                    continue
-                if msg.get("type") == "result":
-                    self._results.put(msg)
-                else:  # accepted / error — answers to submit, in order
-                    self._accepted.put(msg)
+                    chunk = self._sock.recv(1 << 16)
+                except OSError:
+                    return
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    raw, buf = buf.split(b"\n", 1)
+                    if not raw.strip():
+                        continue
+                    try:
+                        msg = json.loads(raw)
+                    except json.JSONDecodeError:
+                        continue
+                    self.last_event = msg.get("type")
+                    if msg.get("type") == "result":
+                        self._results.put(msg)
+                    else:  # accepted / rejected / error — submit replies
+                        self._accepted.put(msg)
+        finally:
+            self._closed.set()
+
+    def _unavailable(self, detail: str = "") -> ServeUnavailableError:
+        return ServeUnavailableError(self.path, self.last_event, detail)
 
     def submit(
         self,
@@ -73,42 +146,111 @@ class ServeClient:
         target_loss: Optional[float] = None,
         data_seed: int = 0,
         timeout: Optional[float] = 30.0,
+        priority: int = 0,
+        max_retries: int = 0,
+        backoff_base: float = 0.1,
+        backoff_cap: float = 10.0,
     ) -> str:
-        """Submit one trajectory request; returns its request_id. Raises
-        RuntimeError when the daemon refuses the payload. Thread-safe:
-        the accepted reply is correlated purely by submit order, so the
-        lock spans the send AND the reply — two concurrent submitters
-        must not each read the other's request_id."""
-        line = json.dumps(
-            {
-                "op": "submit",
-                "tenant": tenant,
-                "label": label,
-                "config": config,
-                "target_loss": target_loss,
-                "data_seed": data_seed,
-            }
-        ) + "\n"
-        with self._wlock:
-            self._sock.sendall(line.encode())
-            reply = self._accepted.get(timeout=timeout)
-        if reply.get("type") != "accepted":
+        """Submit one trajectory request; returns its request_id.
+
+        Raises RuntimeError when the daemon refuses the payload,
+        :class:`ServeRejectedError` on backpressure once ``max_retries``
+        deterministic capped-exponential attempts (honoring the daemon's
+        retry-after quotes) are exhausted, and
+        :class:`ServeUnavailableError` when the daemon is gone. Thread-
+        safe: the accepted reply is correlated purely by submit order, so
+        the lock spans the send AND the reply — two concurrent
+        submitters must not each read the other's request_id."""
+        for attempt in range(max_retries + 1):
+            line = json.dumps(
+                {
+                    "op": "submit",
+                    "tenant": tenant,
+                    "label": label,
+                    "config": config,
+                    "target_loss": target_loss,
+                    "data_seed": data_seed,
+                    "priority": priority,
+                    "retry": attempt,
+                }
+            ) + "\n"
+            with self._wlock:
+                if self._closed.is_set():
+                    raise self._unavailable("connection closed")
+                try:
+                    self._sock.sendall(line.encode())
+                except OSError as e:
+                    raise self._unavailable(str(e)) from e
+                deadline = (
+                    None
+                    if timeout is None
+                    else time.monotonic() + timeout
+                )
+                while True:
+                    try:
+                        reply = self._accepted.get(timeout=0.2)
+                        break
+                    except queue_lib.Empty:
+                        if self._closed.is_set():
+                            raise self._unavailable(
+                                "connection closed while awaiting the "
+                                "accepted reply"
+                            ) from None
+                        if deadline is not None and (
+                            time.monotonic() >= deadline
+                        ):
+                            raise
+            rtype = reply.get("type")
+            if rtype == "accepted":
+                # what-if ETA quote (daemon --eta-surface; None without
+                # one): exposed on the client rather than the return
+                # value so existing submit() callers keep their
+                # request_id contract
+                self.last_eta_s = reply.get("eta_s")
+                return reply["request_id"]
+            if rtype == "rejected":
+                retry_after = float(reply.get("retry_after_s") or 0.0)
+                self.rejected_total += 1
+                if attempt < max_retries:
+                    self.retried_total += 1
+                    time.sleep(
+                        backoff_s(
+                            attempt, retry_after,
+                            base=backoff_base, cap=backoff_cap,
+                        )
+                    )
+                    continue
+                raise ServeRejectedError(
+                    reply.get("message", "serve daemon rejected the "
+                              "request (overloaded)"),
+                    retry_after_s=retry_after,
+                )
             raise RuntimeError(
                 f"serve daemon refused the request: "
                 f"{reply.get('message', reply)}"
             )
-        # what-if ETA quote (daemon --eta-surface; None without one):
-        # exposed on the client rather than the return value so existing
-        # submit() callers keep their request_id contract
-        self.last_eta_s = reply.get("eta_s")
-        return reply["request_id"]
+        raise AssertionError("unreachable")  # loop always returns/raises
 
     def result(self, timeout: Optional[float] = None) -> dict:
         """The next finished trajectory (completion order, any of this
         connection's requests): {"request_id", "tenant", "label",
         "status", "row", "error", "resumed"}. Raises ``queue.Empty`` on
-        timeout."""
-        return self._results.get(timeout=timeout)
+        a live-daemon timeout and :class:`ServeUnavailableError` when
+        the daemon died with results still owed."""
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        while True:
+            try:
+                return self._results.get(timeout=0.2)
+            except queue_lib.Empty:
+                if self._closed.is_set() and self._results.empty():
+                    raise self._unavailable(
+                        "connection closed with results still owed "
+                        "(rows are journaled; resubmit to re-fetch)"
+                    ) from None
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise
 
     def close(self) -> None:
         try:
@@ -116,3 +258,208 @@ class ServeClient:
         except OSError:
             pass
         self._sock.close()
+
+
+class HttpServeClient:
+    """One tenant's connection to the HTTP JSONL front.
+
+    ``submit`` POSTs per request (a fresh connection each time — the
+    submit path is stateless, so daemon restarts are invisible to it
+    beyond a retriable :class:`ServeUnavailableError`); ``result`` drains
+    the long-lived chunked ``/v1/stream`` connection a reader thread
+    owns. Timing hooks for the load generator: ``on_line(msg)`` fires on
+    every stream line as it is read."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        tenant: str,
+        token: Optional[str] = None,
+        timeout: float = 30.0,
+        on_line=None,
+    ):
+        self.host, self.port = host, int(port)
+        self.tenant = tenant
+        self.token = token
+        self.timeout = float(timeout)
+        self.endpoint = f"http://{host}:{port}"
+        self.last_event: Optional[str] = None
+        self.overflow_dropped = 0  # rows the daemon shed on our stream
+        self._on_line = on_line
+        self.rejected_total = 0  # 429 replies seen
+        self.retried_total = 0  # submissions re-sent after a 429
+        self._results: "queue_lib.Queue[dict]" = queue_lib.Queue()
+        self._closed = threading.Event()
+        self._stop = False
+        self._stream_resp = None
+        self._reader = threading.Thread(
+            target=self._stream_loop, name="eh-serve-http-client",
+            daemon=True,
+        )
+        self._reader.start()
+
+    # ---- submit ----------------------------------------------------------
+
+    def _headers(self) -> dict:
+        h = {"Content-Type": "application/json"}
+        if self.token is not None:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
+
+    def submit(
+        self,
+        label: str,
+        config: dict,
+        target_loss: Optional[float] = None,
+        data_seed: int = 0,
+        priority: int = 0,
+        max_retries: int = 0,
+        backoff_base: float = 0.1,
+        backoff_cap: float = 10.0,
+    ) -> str:
+        """POST one request; returns its request_id. 429s retry on the
+        deterministic capped-exponential schedule honoring Retry-After
+        (see :func:`backoff_s`); exhausted retries raise
+        :class:`ServeRejectedError`; a dead daemon raises
+        :class:`ServeUnavailableError`."""
+        import http.client
+
+        for attempt in range(max_retries + 1):
+            body = json.dumps(
+                {
+                    "tenant": self.tenant,
+                    "label": label,
+                    "config": config,
+                    "target_loss": target_loss,
+                    "data_seed": data_seed,
+                    "priority": priority,
+                    "retry": attempt,
+                }
+            )
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout
+            )
+            try:
+                conn.request(
+                    "POST", "/v1/submit", body=body,
+                    headers=self._headers(),
+                )
+                resp = conn.getresponse()
+                payload = json.loads(resp.read() or b"{}")
+            except (OSError, http.client.HTTPException) as e:
+                # a reset/refused under burst load is transient (accept
+                # backlog, front mid-restart): retriable on the same
+                # schedule as a 429 — submission is idempotent by
+                # digest, so a resent acceptance can't double-dispatch
+                if attempt < max_retries and isinstance(
+                    e, (ConnectionError, TimeoutError)
+                ):
+                    time.sleep(
+                        backoff_s(
+                            attempt, None,
+                            base=backoff_base, cap=backoff_cap,
+                        )
+                    )
+                    continue
+                raise ServeUnavailableError(
+                    self.endpoint, self.last_event, str(e)
+                ) from e
+            finally:
+                conn.close()
+            if resp.status == 202:
+                self.last_eta_s = payload.get("eta_s")
+                return payload["request_id"]
+            if resp.status == 429:
+                retry_after = float(
+                    payload.get("retry_after_s")
+                    or resp.getheader("Retry-After")
+                    or 0.0
+                )
+                self.rejected_total += 1
+                if attempt < max_retries:
+                    self.retried_total += 1
+                    time.sleep(
+                        backoff_s(
+                            attempt, retry_after,
+                            base=backoff_base, cap=backoff_cap,
+                        )
+                    )
+                    continue
+                raise ServeRejectedError(
+                    payload.get("message", "serve daemon rejected the "
+                                "request (overloaded)"),
+                    retry_after_s=retry_after,
+                )
+            raise RuntimeError(
+                f"serve daemon refused the request "
+                f"(HTTP {resp.status}): {payload.get('message', payload)}"
+            )
+        raise AssertionError("unreachable")
+
+    # ---- result stream ---------------------------------------------------
+
+    def _stream_loop(self) -> None:
+        import http.client
+
+        try:
+            path = "/v1/stream"
+            if self.token is None:
+                path += f"?tenant={self.tenant}"
+            conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=max(self.timeout, 10.0)
+            )
+            conn.request("GET", path, headers=self._headers())
+            resp = conn.getresponse()
+            self._stream_resp = conn
+            if resp.status != 200:
+                return
+            while not self._stop:
+                raw = resp.readline()  # chunked decoding is transparent
+                if not raw:
+                    return
+                try:
+                    msg = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                self.last_event = msg.get("type")
+                if self._on_line is not None:
+                    self._on_line(msg)
+                if msg.get("type") == "result":
+                    self._results.put(msg)
+                elif msg.get("type") == "overflow":
+                    # the daemon shed rows our reader was too slow for;
+                    # they are journaled — re-fetch by resubmitting
+                    self.overflow_dropped += int(msg.get("dropped", 0))
+        except Exception:  # noqa: BLE001 — reader thread must not crash
+            return
+        finally:
+            self._closed.set()
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        """The next finished trajectory off the stream; ``queue.Empty``
+        on a live timeout, :class:`ServeUnavailableError` once the
+        stream is dead and drained."""
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        while True:
+            try:
+                return self._results.get(timeout=0.2)
+            except queue_lib.Empty:
+                if self._closed.is_set() and self._results.empty():
+                    raise ServeUnavailableError(
+                        self.endpoint, self.last_event,
+                        "stream closed with results still owed (rows "
+                        "are journaled; resubmit to re-fetch)",
+                    ) from None
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise
+
+    def close(self) -> None:
+        self._stop = True
+        if self._stream_resp is not None:
+            try:
+                self._stream_resp.close()
+            except OSError:
+                pass

@@ -20,14 +20,23 @@ own straggler streams inside a shared dispatch.
 Packing changes throughput, never bits: a cohort dispatch's per-trajectory
 results are bitwise independent of the cohort's width (a packed request
 and the same request dispatched alone produce identical rows — pinned in
-tests/test_serve.py), so the packer needs no fairness/correctness
-tradeoff, only a size cap.
+tests/test_serve.py), so the packer has no fairness/CORRECTNESS tradeoff
+— but it does have a fairness/LATENCY one. FIFO-by-signature let one
+chatty tenant fill every dispatch window and starve the rest; the
+weighted-fair order (:func:`fair_windows`) interleaves tenants
+round-robin per window instead (each tenant's own queue stays FIFO
+within a priority class) — work-conserving by construction, since a
+lone tenant still fills whole windows — and an optional per-tenant slot
+quota HARD-caps how much of one window a single tenant may hold (the
+absolute bound for operators who need one, at the cost of short windows
+when only over-quota traffic remains).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import hashlib
+from collections import deque
 from typing import Optional
 
 from erasurehead_tpu.serve.queue import RunRequest
@@ -75,19 +84,86 @@ class PackedCohort:
         return [r.label for r in self.requests]
 
 
+def fair_windows(
+    reqs: list, max_cohort: int, tenant_quota: Optional[int] = None
+) -> list[list]:
+    """Split one signature group's requests into dispatch windows of at
+    most ``max_cohort``, weighted-fair across tenants:
+
+      - each tenant's requests form their own FIFO queue, ordered by
+        priority class first (higher ``RunRequest.priority`` sooner;
+        arrival order preserved within a class — the sort is stable);
+      - each window drains the tenant queues round-robin (tenants in
+        first-arrival order), so W tenants sharing a window get ~1/W of
+        its slots each regardless of how deep any one backlog is — this
+        alone is work-conserving fairness (a lone tenant still fills
+        whole windows);
+      - ``tenant_quota`` additionally HARD-caps one tenant's slots per
+        window: when every backlogged tenant is at quota the window
+        closes short and the overflow waits for the next one. Round-
+        robin already equalizes shares under contention; the strict
+        quota is the operator's lever when a tenant's share must be
+        bounded absolutely (e.g. ``pad_cohorts=False``, where window
+        width is real compute, or admission-footprint shaping — the
+        weight tables scale with width).
+    """
+    queues: "dict[str, deque]" = {}
+    tenant_order: list[str] = []
+    for r in reqs:
+        if r.tenant not in queues:
+            queues[r.tenant] = deque()
+            tenant_order.append(r.tenant)
+    for tenant in tenant_order:
+        mine = [r for r in reqs if r.tenant == tenant]
+        mine.sort(key=lambda r: -r.priority)  # stable: FIFO within class
+        queues[tenant].extend(mine)
+    windows: list[list] = []
+    while any(queues.values()):
+        window: list = []
+        taken = dict.fromkeys(tenant_order, 0)
+        while len(window) < max_cohort:
+            progress = False
+            for tenant in tenant_order:
+                if len(window) >= max_cohort:
+                    break
+                if not queues[tenant]:
+                    continue
+                if (
+                    tenant_quota is not None
+                    and taken[tenant] >= tenant_quota
+                ):
+                    continue
+                window.append(queues[tenant].popleft())
+                taken[tenant] += 1
+                progress = True
+            if not progress:
+                break  # every backlogged tenant is at quota (or drained)
+        windows.append(window)
+    return windows
+
+
 def plan_packs(
-    pending: list, max_cohort: int = 64
+    pending: list,
+    max_cohort: int = 64,
+    fair: bool = True,
+    tenant_quota: Optional[int] = None,
 ) -> list[PackedCohort]:
-    """Group pending requests into dispatch cohorts, first-seen key order
-    (arrival order within a key is preserved — FIFO per signature).
+    """Group pending requests into dispatch cohorts, first-seen key order.
     Cohorts larger than ``max_cohort`` split into chunks: the per-round
     weight tables scale with cohort width, so an unbounded pack would let
     one burst of traffic balloon a single dispatch's footprint past what
     the admission controller (serve/admission.py) can usefully reason
-    about. Cohort-ineligible requests come back as their own
-    ``batchable=False`` singletons."""
+    about. Within a key, ``fair=True`` (the daemon default) orders each
+    chunk weighted-fair across tenants (:func:`fair_windows`);
+    ``fair=False`` keeps the historical FIFO-by-arrival order.
+    Cohort-ineligible requests come back as their own ``batchable=False``
+    singletons."""
     if max_cohort < 1:
         raise ValueError(f"max_cohort must be >= 1, got {max_cohort}")
+    if tenant_quota is not None and tenant_quota < 1:
+        raise ValueError(
+            f"tenant_quota must be >= 1 (or None), got {tenant_quota}"
+        )
     groups: dict = {}
     order: list = []
     for req in pending:
@@ -103,12 +179,15 @@ def plan_packs(
         if key is None:
             out.append(PackedCohort(key=None, requests=reqs, batchable=False))
             continue
-        for lo in range(0, len(reqs), max_cohort):
+        if fair:
+            chunks = fair_windows(reqs, max_cohort, tenant_quota)
+        else:
+            chunks = [
+                reqs[lo:lo + max_cohort]
+                for lo in range(0, len(reqs), max_cohort)
+            ]
+        for chunk in chunks:
             out.append(
-                PackedCohort(
-                    key=key,
-                    requests=reqs[lo:lo + max_cohort],
-                    batchable=True,
-                )
+                PackedCohort(key=key, requests=chunk, batchable=True)
             )
     return out

@@ -58,6 +58,14 @@ class RunRequest:
     target_loss: Optional[float] = None
     data_seed: int = 0
     request_id: str = ""
+    #: scheduling priority: higher dispatches sooner WITHIN a tenant's
+    #: own queue (weighted-fair packing keeps tenants from outbidding
+    #: each other — priority orders your work, not the neighborhood's)
+    priority: int = 0
+    #: client retry attempt number (0 = first try); rides the wire
+    #: payload so the request event and per-tenant report can count
+    #: retries that followed a 429
+    retry: int = 0
 
     def __post_init__(self):
         if not self.tenant or not isinstance(self.tenant, str):
@@ -111,13 +119,50 @@ class RequestHandle:
         # (serve/admission.EtaQuoter); None = no surface or no matching
         # feasible row
         self.eta_s: Optional[float] = None
+        # deliver-once bookkeeping: a request-timeout watchdog and the
+        # dispatch that eventually lands must not both count/reply
+        self._delivered = False
+        self._deliver_lock = threading.Lock()
+        # handles coalesced onto this one by request digest (an
+        # idempotent resubmission of an in-flight request): they receive
+        # a copy of this handle's result, re-tagged with their own ids
+        self._followers: list["RequestHandle"] = []
 
     @property
     def request_id(self) -> str:
         return self.request.request_id
 
-    def _deliver(self, result: ServeResult) -> None:
+    def _deliver(self, result: ServeResult) -> bool:
+        """Deliver once; later deliveries (a dispatch landing after the
+        watchdog already timed the request out) are dropped. Returns
+        whether THIS call was the delivery. Followers get a re-tagged
+        copy so their submitters see their own request_id/label."""
+        with self._deliver_lock:
+            if self._delivered:
+                return False
+            self._delivered = True
+            followers = list(self._followers)
         self._q.put(result)
+        for f in followers:
+            f._deliver(
+                dataclasses.replace(
+                    result,
+                    request_id=f.request_id,
+                    label=f.request.label,
+                    resumed=True,
+                )
+            )
+        return True
+
+    def _follow(self, follower: "RequestHandle") -> bool:
+        """Attach ``follower`` to receive this handle's result (digest
+        coalescing). False when this handle already delivered — the
+        caller should serve the follower from the journal instead."""
+        with self._deliver_lock:
+            if self._delivered:
+                return False
+            self._followers.append(follower)
+            return True
 
     def result(self, timeout: Optional[float] = None) -> ServeResult:
         """Block until this request's result lands (memoized after the
@@ -149,6 +194,82 @@ CONFIG_PAYLOAD_FIELDS = frozenset(
         "scan_unroll", "sparse_format", "fields_scatter", "fields_margin",
     }
 )
+
+
+class ServeOverloadedError(RuntimeError):
+    """Backpressure: the daemon's intake queue crossed its high-water
+    mark and this request was REJECTED rather than accepted-then-starved.
+    ``retry_after_s`` is the deferral-derived schedule quote (the HTTP
+    front's Retry-After header, the socket front's ``rejected`` reply) a
+    client's capped-exponential backoff should honor. Nothing was
+    enqueued, journaled, or WAL'd — resubmitting is always safe."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+def request_digest(
+    tenant: str,
+    label: str,
+    config: RunConfig,
+    data_seed: int = 0,
+    target_loss: Optional[float] = None,
+) -> str:
+    """The request's idempotency key: everything that determines WHAT a
+    config-resolvable request computes (tenant, label, full config hash,
+    data seed, loss target) — deliberately NOT the request_id, priority
+    or retry count, which only say when/how it was asked. The intake WAL
+    dedupes on it, and a resubmission after a crash or 429 coalesces
+    onto the in-flight original instead of double-dispatching."""
+    import hashlib
+    import json as json_lib
+
+    from erasurehead_tpu.obs import events as events_lib
+
+    payload = json_lib.dumps(
+        {
+            "tenant": tenant,
+            "label": label,
+            "config": events_lib.config_hash(config),
+            "data_seed": int(data_seed),
+            "target_loss": target_loss,
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(payload.encode()).hexdigest()[:24]
+
+
+def config_payload(cfg: RunConfig) -> Optional[dict]:
+    """RunConfig -> the wire payload that reconstructs it, or None when
+    the config sets fields outside :data:`CONFIG_PAYLOAD_FIELDS` (e.g.
+    ``input_dir`` — not expressible on the wire, so not WAL-replayable).
+    Round-trip contract: ``config_from_payload(config_payload(cfg)) ==
+    cfg`` field-for-field, which is what makes a WAL-rehydrated request's
+    journal key (events.config_hash over the FULL config) identical to
+    the original's."""
+    import dataclasses as dc
+
+    payload: dict = {}
+    for f in dc.fields(cfg):
+        v = getattr(cfg, f.name)
+        default = (
+            f.default
+            if f.default is not dc.MISSING
+            else f.default_factory()  # type: ignore[misc]
+            if f.default_factory is not dc.MISSING
+            else None
+        )
+        if v == default:
+            continue
+        if f.name not in CONFIG_PAYLOAD_FIELDS:
+            return None
+        if hasattr(v, "value") and not isinstance(v, (int, float, bool)):
+            v = v.value  # enums serialize as their string values
+        elif isinstance(v, tuple):
+            v = list(v)
+        payload[f.name] = v
+    return payload
 
 
 def config_from_payload(payload: dict) -> RunConfig:

@@ -39,12 +39,13 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import itertools
+import math
 import os
 import queue as queue_lib
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -52,13 +53,18 @@ from erasurehead_tpu.obs import events as events_lib
 from erasurehead_tpu.obs.metrics import REGISTRY as _METRICS
 from erasurehead_tpu.serve import admission as admission_lib
 from erasurehead_tpu.serve import packer as packer_lib
+from erasurehead_tpu.serve import wal as wal_lib
 from erasurehead_tpu.serve.queue import (
     RequestHandle,
     RunRequest,
+    ServeOverloadedError,
     ServeResult,
+    config_payload,
+    request_digest,
 )
 from erasurehead_tpu.train import experiments, trainer
 from erasurehead_tpu.train import journal as journal_lib
+from erasurehead_tpu.utils import chaos
 from erasurehead_tpu.utils.config import RunConfig
 
 #: how long the packing window stays open once a request arrives: the
@@ -145,10 +151,16 @@ class SweepServer:
 
     Use as a context manager, or ``start()``/``stop()`` explicitly::
 
-        with SweepServer(budget_bytes=2 << 30) as srv:
+        with SweepServer(budget_bytes=2 << 30,
+                         request_timeout_s=120) as srv:
             h = srv.submit(tenant="alice", label="agc", config=cfg,
                            dataset=data)
-            row = h.result(timeout=120)
+            row = h.result()
+
+    ``request_timeout_s`` is the server-side result deadline (a config
+    knob, not a per-call literal): on expiry the daemon delivers a typed
+    timeout error and emits a ``request_timeout`` warning, so a stalled
+    dispatch is distinguishable from a client-side queue timeout.
     """
 
     def __init__(
@@ -161,6 +173,11 @@ class SweepServer:
         dispatch_workers: int = DEFAULT_DISPATCH_WORKERS,
         pad_cohorts: bool = True,
         eta_surface=None,
+        max_pending: Optional[int] = None,
+        request_timeout_s: Optional[float] = None,
+        fair: bool = True,
+        tenant_quota: Optional[int] = None,
+        cache_dir: Optional[str] = None,
     ):
         self.admission = admission_lib.AdmissionController(budget_bytes)
         # admission-time ETA quotes from a what-if surface
@@ -190,6 +207,40 @@ class SweepServer:
         self.window_s = float(window_s)
         self.journal_dir = journal_dir
         self.resume = bool(resume)
+        # ---- overload robustness knobs -----------------------------------
+        # high-water mark on OUTSTANDING accepted requests (queued +
+        # dispatched-but-unfinished): beyond it, submit() REJECTS
+        # (ServeOverloadedError / HTTP 429 / socket "rejected") with a
+        # deferral-derived retry-after, instead of accepting work it can
+        # only starve. None = unbounded (the historical in-process
+        # behavior).
+        if max_pending is not None and max_pending < 1:
+            raise ValueError(
+                f"max_pending must be >= 1 (or None), got {max_pending}"
+            )
+        self.max_pending = max_pending
+        # per-request result deadline, measured from intake: on expiry
+        # the daemon DELIVERS a typed timeout error (and emits a
+        # request_timeout warning) instead of leaving the submitter to an
+        # indistinguishable queue.Empty. None = wait forever.
+        if request_timeout_s is not None and request_timeout_s <= 0:
+            raise ValueError(
+                f"request_timeout_s must be positive (or None), got "
+                f"{request_timeout_s}"
+            )
+        self.request_timeout_s = request_timeout_s
+        # weighted-fair packing across tenants (packer.fair_windows);
+        # tenant_quota hard-caps one tenant's slots per dispatch window
+        self.fair = bool(fair)
+        self.tenant_quota = tenant_quota
+        # warm restarts: route XLA compiles through JAX's on-disk
+        # compilation cache so a bounced daemon re-serves its working set
+        # with zero fresh backend compiles
+        self.cache_dir = cache_dir
+        if cache_dir is not None:
+            from erasurehead_tpu.train import cache as cache_lib
+
+            cache_lib.enable_persistent_compilation_cache(cache_dir)
         self._inbox: "queue_lib.Queue[Optional[RequestHandle]]" = (
             queue_lib.Queue()
         )
@@ -208,6 +259,39 @@ class SweepServer:
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
         self._drain = True
+        # accepted-but-undispatched depth (inbox + pending) and
+        # dispatched-but-unfinished request count: their sum is the
+        # outstanding-work depth the max_pending high-water mark bounds
+        # (counting only the undispatched half would let work pile up
+        # unbounded in the executor's internal queue while the mark
+        # reads zero); guarded by _state_lock
+        self._queued = 0
+        self._in_flight_requests = 0
+        # EWMA of dispatch wall seconds — the admission-deferral estimate
+        # behind retry-after quotes; guarded by _state_lock
+        self._dispatch_ewma_s: Optional[float] = None
+        # digest -> in-flight handle: idempotent resubmission coalesces
+        # onto the original instead of double-dispatching
+        self._by_digest: dict[str, RequestHandle] = {}
+        self._digest_lock = threading.Lock()
+        # delivered-result listeners (the HTTP front's stream hub).
+        # Contract: a listener MUST NOT block — it runs on the dispatch
+        # pool; network fronts buffer into bounded per-connection
+        # outboxes and shed on overflow (the rows are journaled).
+        self._result_listeners: list[Callable[[ServeResult], None]] = []
+        # intake WAL (journal_dir only): acceptances persisted before any
+        # dispatch work, replayed on start()
+        self.wal: Optional[wal_lib.IntakeWAL] = (
+            wal_lib.IntakeWAL(journal_dir) if journal_dir else None
+        )
+        self._watch: dict[str, tuple[RequestHandle, float]] = {}
+        self._watch_lock = threading.Lock()
+        self._watchdog: Optional[threading.Thread] = None
+        # WAL-replay accounting (populated by _replay_wal)
+        self._replay_records = 0
+        self._replay_outstanding = 0
+        self._replay_resubmitted = 0
+        self._replay_rehydrated = 0
 
     # ---- lifecycle -------------------------------------------------------
 
@@ -218,6 +302,13 @@ class SweepServer:
             target=self._loop, name="eh-serve-loop", daemon=True
         )
         self._thread.start()
+        if self.request_timeout_s is not None:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, name="eh-serve-watchdog",
+                daemon=True,
+            )
+            self._watchdog.start()
+        self._replay_wal()
         return self
 
     def stop(self, drain: bool = True, timeout: Optional[float] = None):
@@ -232,10 +323,15 @@ class SweepServer:
         self._inbox.put(_STOP)
         self._thread.join(timeout=timeout)
         self._thread = None
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2)
+            self._watchdog = None
         self._executor.shutdown(wait=True)
         for j in self._journals.values():
             j.close()
         self._journals.clear()
+        if self.wal is not None:
+            self.wal.close()
 
     def __enter__(self) -> "SweepServer":
         return self.start()
@@ -256,27 +352,127 @@ class SweepServer:
         arrivals=None,
         target_loss: Optional[float] = None,
         data_seed: int = 0,
+        priority: int = 0,
+        retry: int = 0,
+        _replayed: bool = False,
     ) -> RequestHandle:
         """Submit one trajectory request; returns immediately with the
         handle its result will land on. Thread-safe (any number of client
-        threads may submit concurrently)."""
+        threads may submit concurrently). Raises
+        :class:`ServeOverloadedError` when ``max_pending`` is set and the
+        intake queue is at its high-water mark (``_replayed`` marks WAL
+        rehydration traffic, which was accepted before the crash and is
+        never re-rejected)."""
         if request is None:
             request = RunRequest(
                 tenant=tenant, label=label, config=config, dataset=dataset,
                 arrivals=arrivals, target_loss=target_loss,
-                data_seed=data_seed,
+                data_seed=data_seed, priority=priority, retry=retry,
             )
         if self._thread is None or self._stopping:
             raise RuntimeError("serve loop is not running")
+        if (
+            self.max_pending is not None
+            and not _replayed
+            and self.queued_depth() >= self.max_pending
+        ):
+            retry_after = self.retry_after_s(request.config)
+            _METRICS.counter("serve.rejected").inc()
+            events_lib.emit(
+                "reject",
+                tenant=request.tenant,
+                reason="overloaded",
+                label=request.label,
+                retry_after_s=round(retry_after, 3),
+                queued=self.queued_depth(),
+                max_pending=self.max_pending,
+            )
+            raise ServeOverloadedError(
+                f"serve: intake queue at high-water mark "
+                f"({self.max_pending} accepted-but-undispatched); retry "
+                f"in {retry_after:.3f}s",
+                retry_after_s=retry_after,
+            )
         handle = RequestHandle(request)
+        handle.replayed = _replayed
+        # the digest covers config-resolvable requests only: a live
+        # dataset OBJECT has no wire identity, so in-process requests
+        # carrying one keep the historical always-dispatch semantics
+        handle.digest = None
+        if request.dataset is None:
+            handle.digest = request_digest(
+                request.tenant, request.label, request.config,
+                data_seed=request.data_seed,
+                target_loss=request.target_loss,
+            )
+            if self.wal is not None:
+                payload = config_payload(request.config)
+                if payload is not None:
+                    # WAL'd HERE, before the accepted reply goes out:
+                    # once a front says "accepted", the acceptance is on
+                    # disk — a kill any time after cannot lose it
+                    self.wal.append(
+                        tenant=request.tenant,
+                        request_id=request.request_id,
+                        label=request.label,
+                        digest=handle.digest,
+                        config_payload=payload,
+                        data_seed=request.data_seed,
+                        target_loss=request.target_loss,
+                        priority=request.priority,
+                    )
+        # crash site: acceptance is on disk, nothing dispatched yet — a
+        # kill here must rehydrate this request on restart
+        chaos.maybe_fire("serve_intake")
         if self.eta is not None:
             # quoted HERE, before the enqueue, so the submitter (and the
             # socket front's "accepted" reply) reads the ETA immediately
             # rather than racing the intake loop
             handle.eta_s = self.eta.quote(request.config)
         _METRICS.counter("serve.requests").inc()
+        with self._state_lock:
+            self._queued += 1
         self._inbox.put(handle)
         return handle
+
+    def queued_depth(self) -> int:
+        """Outstanding accepted requests — undispatched (inbox +
+        pending) plus dispatched-but-unfinished — the quantity the
+        ``max_pending`` high-water mark bounds."""
+        with self._state_lock:
+            return self._queued + self._in_flight_requests
+
+    def retry_after_s(self, config: Optional[RunConfig] = None) -> float:
+        """The deferral-derived schedule quote a rejected client's
+        backoff honors: (observed EWMA dispatch wall seconds — the
+        admission deferral estimate) x (packing windows queued ahead).
+        Before any dispatch has been observed, the what-if ETA quoter
+        seeds the per-dispatch term (simulated seconds are the only
+        cost model the daemon has yet), clamped so a pessimistic surface
+        can't quote minutes. Deterministic given daemon state."""
+        with self._state_lock:
+            queued = self._queued
+            ewma = self._dispatch_ewma_s
+        per_dispatch = ewma
+        if per_dispatch is None and self.eta is not None and (
+            config is not None
+        ):
+            eta = self.eta.quote(config)
+            if eta is not None:
+                per_dispatch = min(float(eta), 30.0)
+        if per_dispatch is None:
+            per_dispatch = 1.0
+        windows = max(1, math.ceil((queued + 1) / self.max_cohort))
+        return float(min(60.0, max(self.window_s, per_dispatch * windows)))
+
+    def add_result_listener(
+        self, fn: Callable[[ServeResult], None]
+    ) -> None:
+        """Subscribe to every delivered result (the network fronts'
+        streaming hub). ``fn`` runs on the delivering thread and MUST NOT
+        block — buffer into a bounded outbox and shed on overflow (rows
+        are journaled; a shed client re-fetches by resubmitting)."""
+        self._result_listeners.append(fn)
 
     # ---- loop internals --------------------------------------------------
 
@@ -322,6 +518,30 @@ class SweepServer:
             self._datasets[key] = ds
         return ds
 
+    def _finish(self, handle: RequestHandle, result: ServeResult) -> bool:
+        """Single delivery point: deliver once, fan out to any coalesced
+        followers, release the digest slot, notify stream listeners.
+        Returns whether this call won the delivery (a dispatch landing
+        after the watchdog already timed the request out loses)."""
+        if not handle._deliver(result):
+            return False
+        digest = getattr(handle, "digest", None)
+        if digest is not None:
+            with self._digest_lock:
+                if self._by_digest.get(digest) is handle:
+                    del self._by_digest[digest]
+        _METRICS.counter("serve.results").inc()
+        for fn in self._result_listeners:
+            try:
+                fn(result)
+            except Exception:  # noqa: BLE001 — a front must not kill us
+                pass
+        return True
+
+    def _dec_queued(self, n: int = 1) -> None:
+        with self._state_lock:
+            self._queued -= n
+
     def _fail(self, handle: RequestHandle, error: str) -> None:
         _METRICS.counter("serve.errors").inc()
         req = handle.request
@@ -333,17 +553,23 @@ class SweepServer:
                 f"{req.tenant!r}) failed: {error.splitlines()[0][:200]}"
             ),
         )
-        handle._deliver(
+        self._finish(
+            handle,
             ServeResult(
                 request_id=req.request_id, tenant=req.tenant,
                 label=req.label, status="error", error=error,
-            )
+            ),
         )
 
     def _intake(self, handle: RequestHandle) -> None:
         """Admit one arriving request into the pending set: emit its
-        ``request`` event, resolve its dataset and arrivals, and serve it
-        straight from the tenant's journal when resumable."""
+        ``request`` event, coalesce digest duplicates onto the in-flight
+        original, resolve its dataset and arrivals, and serve it
+        straight from the tenant's journal when resumable. (The WAL
+        append happened in ``submit`` — acceptance durability precedes
+        the accepted reply.) Every exit path balances the submit-side
+        ``_queued`` increment except the pending append (dispatch
+        decrements it)."""
         req = handle.request
         events_lib.emit(
             "request",
@@ -352,12 +578,28 @@ class SweepServer:
             label=req.label,
             scheme=req.config.scheme.value,
             eta_s=handle.eta_s,
+            priority=req.priority,
+            retry=req.retry,
+            digest=handle.digest,
         )
+        if handle.digest is not None:
+            with self._digest_lock:
+                live = self._by_digest.get(handle.digest)
+                if live is not None and live._follow(handle):
+                    # idempotent resubmission: ride the in-flight
+                    # original instead of double-dispatching
+                    _METRICS.counter("serve.coalesced").inc()
+                    self._dec_queued()
+                    self._classify_replay(handle, resubmitted=False)
+                    return
+                self._by_digest[handle.digest] = handle
         try:
             req.dataset = self._resolve_dataset(req)
             if req.arrivals is None:
                 req.arrivals = trainer.default_arrivals(req.config)
         except Exception as e:  # noqa: BLE001 — isolate to this request
+            self._dec_queued()
+            self._classify_replay(handle, resubmitted=True)
             self._fail(handle, f"{type(e).__name__}: {e}")
             return
         journal = self._journal_for(req.tenant)
@@ -372,18 +614,154 @@ class SweepServer:
                 summary = journal_lib.rehydrate_summary(
                     rec["row"], req.config
                 )
-                handle._deliver(
+                self._dec_queued()
+                self._classify_replay(handle, resubmitted=False)
+                self._finish(
+                    handle,
                     ServeResult(
                         request_id=req.request_id, tenant=req.tenant,
                         label=req.label, status=rec.get("status", "ok"),
                         row=rec["row"], summary=summary, resumed=True,
-                    )
+                    ),
                 )
-                _METRICS.counter("serve.results").inc()
                 return
         else:
             handle.journal_key = None
+        self._classify_replay(handle, resubmitted=True)
+        if self.request_timeout_s is not None:
+            with self._watch_lock:
+                self._watch[req.request_id] = (
+                    handle, time.monotonic() + self.request_timeout_s,
+                )
         self._pending.append(handle)
+
+    # ---- warm restart: WAL replay ---------------------------------------
+
+    def _classify_replay(self, handle, resubmitted: bool) -> None:
+        """Count one replayed handle's intake outcome toward the pending
+        ``restart`` event (no-op for ordinary traffic); emits the event
+        once the last replayed acceptance is classified."""
+        if not getattr(handle, "replayed", False):
+            return
+        with self._state_lock:
+            if resubmitted:
+                self._replay_resubmitted += 1
+            else:
+                self._replay_rehydrated += 1
+            self._replay_outstanding -= 1
+            done = self._replay_outstanding == 0
+            counts = (
+                self._replay_records,
+                self._replay_resubmitted,
+                self._replay_rehydrated,
+            )
+        if done:
+            _METRICS.counter("serve.restarts").inc()
+            events_lib.emit(
+                "restart",
+                wal_records=counts[0],
+                resubmitted=counts[1],
+                rehydrated=counts[2],
+            )
+
+    def _replay_wal(self) -> None:
+        """Re-serve the working set a previous daemon accepted but never
+        finished: resubmit every WAL acceptance through the normal intake
+        path. Records whose rows are already journaled rehydrate with no
+        dispatch; the rest re-dispatch — warm against the on-disk
+        compilation cache, so a restart costs zero fresh compiles of warm
+        signatures. Nobody waits on these handles: the point is that the
+        rows land in the per-tenant journals, where the original
+        submitters' idempotent resubmissions find them."""
+        if self.wal is None:
+            return
+        records = self.wal.replay()
+        with self._state_lock:
+            self._replay_records = len(records)
+            self._replay_outstanding = len(records)
+            self._replay_resubmitted = 0
+            self._replay_rehydrated = 0
+        if not records:
+            return
+        from erasurehead_tpu.serve.queue import config_from_payload
+
+        for rec in records:
+            try:
+                cfg = config_from_payload(rec["config"])
+                self.submit(
+                    tenant=rec["tenant"], label=rec["label"], config=cfg,
+                    target_loss=rec.get("target_loss"),
+                    data_seed=int(rec.get("data_seed", 0)),
+                    priority=int(rec.get("priority", 0)),
+                    _replayed=True,
+                )
+            except Exception as e:  # noqa: BLE001 — one bad WAL record
+                # must not strand the rest of the working set
+                events_lib.emit(
+                    "warning",
+                    kind="wal_replay_error",
+                    message=(
+                        f"serve: WAL record {rec.get('digest')!r} "
+                        f"(tenant {rec.get('tenant')!r}) failed to "
+                        f"replay: {type(e).__name__}: {e}"
+                    ),
+                )
+                with self._state_lock:
+                    self._replay_outstanding -= 1
+
+    # ---- request-timeout watchdog ---------------------------------------
+
+    def _watchdog_loop(self) -> None:
+        """Deliver a TYPED timeout error for any request that has not
+        produced a result within ``request_timeout_s`` of intake — the
+        submitter (and the socket front's relay) gets a distinguishable
+        reply instead of an indistinguishable queue.Empty. The late
+        dispatch, when it eventually lands, loses the deliver-once race
+        and its row still journals (a resubmission rehydrates it)."""
+        while True:
+            if self._stopping and self._thread is None:
+                return
+            now = time.monotonic()
+            expired: list[RequestHandle] = []
+            with self._watch_lock:
+                for rid in list(self._watch):
+                    h, deadline = self._watch[rid]
+                    if h._delivered:
+                        del self._watch[rid]
+                    elif deadline <= now:
+                        del self._watch[rid]
+                        expired.append(h)
+                empty = not self._watch
+            for h in expired:
+                req = h.request
+                _METRICS.counter("serve.timeouts").inc()
+                events_lib.emit(
+                    "warning",
+                    kind="request_timeout",
+                    message=(
+                        f"serve: request {req.request_id!r} (tenant "
+                        f"{req.tenant!r}, label {req.label!r}) produced "
+                        f"no result within request_timeout_s="
+                        f"{self.request_timeout_s:g}s; typed timeout "
+                        f"error delivered"
+                    ),
+                )
+                self._finish(
+                    h,
+                    ServeResult(
+                        request_id=req.request_id, tenant=req.tenant,
+                        label=req.label, status="error",
+                        error=(
+                            f"RequestTimeout: no result within "
+                            f"{self.request_timeout_s:g}s (server "
+                            f"request_timeout_s; the dispatch may still "
+                            f"land and journal — resubmit to re-fetch)"
+                        ),
+                    ),
+                )
+            if self._stopping and empty and not expired:
+                return
+            time.sleep(0.05)
 
     def _loop(self) -> None:
         last_packed_gen = -1
@@ -431,6 +809,7 @@ class SweepServer:
             if stop_seen:
                 if not self._drain and self._pending:
                     for h in self._pending:
+                        self._dec_queued()
                         self._fail(h, "server stopped before dispatch")
                     self._pending.clear()
                 with self._state_lock:
@@ -449,7 +828,10 @@ class SweepServer:
         the admission controller lets through, keep the rest pending."""
         by_id = {h.request.request_id: h for h in self._pending}
         packs = packer_lib.plan_packs(
-            [h.request for h in self._pending], max_cohort=self.max_cohort
+            [h.request for h in self._pending],
+            max_cohort=self.max_cohort,
+            fair=self.fair,
+            tenant_quota=self.tenant_quota,
         )
         dispatched: set[str] = set()
         for cohort in packs:
@@ -484,6 +866,9 @@ class SweepServer:
                 self._run_cohort, cohort, handles, dispatch_id
             )
         if dispatched:
+            with self._state_lock:
+                self._queued -= len(dispatched)
+                self._in_flight_requests += len(dispatched)
             self._pending = [
                 h for h in self._pending
                 if h.request.request_id not in dispatched
@@ -493,7 +878,11 @@ class SweepServer:
         """Dispatch one admitted cohort (executor thread) and deliver each
         request's result as it is summarized. Failures here are isolated:
         this cohort's requests get status="error", the daemon lives on."""
+        t_start = time.monotonic()
         try:
+            # crash site: accepted + WAL'd, rows not yet journaled — the
+            # warm-restart working set a kill here leaves behind
+            chaos.maybe_fire("serve_dispatch")
             ids = [h.request.request_id for h in handles]
             configs = {h.request.request_id: h.request.config for h in handles}
             arrivals = {
@@ -540,22 +929,34 @@ class SweepServer:
                     tenant=req.tenant,
                     request_id=req.request_id,
                 )
-                h._deliver(
+                # crash site: row journaled, reply not yet delivered —
+                # the submitter re-fetches by resubmitting (rehydrates)
+                chaos.maybe_fire("serve_reply")
+                self._finish(
+                    h,
                     ServeResult(
                         request_id=req.request_id, tenant=req.tenant,
                         label=req.label, status=summary.status,
                         row=payload, summary=summary,
-                    )
+                    ),
                 )
-                _METRICS.counter("serve.results").inc()
         except Exception as e:  # noqa: BLE001 — tenant isolation boundary
             err = f"{type(e).__name__}: {e}"
             for h in handles:
                 self._fail(h, err)
         finally:
+            wall = time.monotonic() - t_start
             self.admission.release(dispatch_id)
             with self._state_lock:
+                # EWMA of dispatch wall seconds: the deferral estimate
+                # behind retry_after_s quotes (alpha=0.3 — recent
+                # traffic shape wins, one outlier doesn't)
+                prev = self._dispatch_ewma_s
+                self._dispatch_ewma_s = (
+                    wall if prev is None else 0.7 * prev + 0.3 * wall
+                )
                 self._in_flight -= 1
+                self._in_flight_requests -= len(handles)
                 self._gen += 1
 
 
@@ -574,9 +975,13 @@ def serving(**kw):
 # ---------------------------------------------------------------------------
 # thin unix-socket front: newline-delimited JSON over AF_UNIX. One line in:
 #   {"op": "submit", "tenant": ..., "label": ..., "config": {...},
-#    "target_loss"?: float, "data_seed"?: int}
+#    "target_loss"?: float, "data_seed"?: int, "priority"?: int,
+#    "retry"?: int}
 # lines out (interleaved, tagged by request_id):
 #   {"type": "accepted", "request_id": ...}
+#   {"type": "rejected", "retry_after_s": float, "message": ...}
+#                                            (backpressure — resubmit
+#                                             after retry_after_s)
 #   {"type": "result", "request_id", "tenant", "label", "status",
 #    "row"?: {...}, "error"?: ..., "resumed": bool}
 #   {"type": "error", "message": ...}        (malformed request line)
@@ -643,6 +1048,43 @@ def main(argv=None) -> int:
                         "(`erasurehead-tpu whatif --out DIR`); the quote "
                         "rides the socket front's accepted reply and the "
                         "request event as eta_s")
+    p.add_argument("--http", default=None, metavar="HOST:PORT",
+                   help="also listen on an HTTP/1.1 JSONL front "
+                        "(serve/http_front.py): POST /v1/submit, "
+                        "chunked-streaming GET /v1/stream, GET /healthz. "
+                        "PORT 0 picks a free port (printed)")
+    p.add_argument("--auth-tokens", default=None, metavar="FILE",
+                   help="JSON {token: tenant} map; when set, the HTTP "
+                        "front requires Authorization: Bearer <token> "
+                        "and derives the tenant from it (the AF_UNIX "
+                        "front stays filesystem-permission trust)")
+    p.add_argument("--cache-dir", default=None, metavar="DIR",
+                   help="persist compiled executables via JAX's on-disk "
+                        "compilation cache: a restarted daemon re-serves "
+                        "its working set with zero fresh compiles")
+    p.add_argument("--max-pending", type=int, default=None,
+                   help="backpressure high-water mark on accepted-but-"
+                        "undispatched requests; beyond it submissions "
+                        "are rejected (HTTP 429 / socket 'rejected') "
+                        "with a deferral-derived retry-after. Default: "
+                        "unbounded")
+    p.add_argument("--request-timeout", type=float, default=None,
+                   metavar="SECONDS",
+                   help="per-request result deadline from intake; on "
+                        "expiry the daemon delivers a typed timeout "
+                        "error (and emits a request_timeout warning) "
+                        "instead of leaving the client to a silent "
+                        "queue timeout. Default: wait forever")
+    p.add_argument("--tenant-quota", type=int, default=None,
+                   help="hard cap on one tenant's slots per packed "
+                        "dispatch window (weighted-fair packing already "
+                        "round-robins tenants; the quota is the "
+                        "absolute bound, closing windows short when "
+                        "only over-quota traffic remains)")
+    p.add_argument("--no-fair", action="store_true",
+                   help="disable weighted-fair packing: windows fill "
+                        "FIFO by arrival, letting one chatty tenant "
+                        "monopolize dispatches (the pre-PR-13 behavior)")
     ns = p.parse_args(argv)
     budget = resolve_serve_budget(ns.budget)
     max_cohort = resolve_serve_max_cohort(
@@ -672,21 +1114,50 @@ def main(argv=None) -> int:
             dispatch_workers=ns.dispatch_workers,
             pad_cohorts=not ns.no_pad,
             eta_surface=eta_surface,
+            max_pending=ns.max_pending,
+            request_timeout_s=ns.request_timeout,
+            fair=not ns.no_fair,
+            tenant_quota=ns.tenant_quota,
+            cache_dir=ns.cache_dir,
         )
         srv.start()
         front = SocketFront(srv, ns.socket)
+        http_front = None
+        if ns.http:
+            import json as json_lib
+
+            from erasurehead_tpu.serve.http_front import (
+                HttpFront,
+                parse_hostport,
+            )
+
+            tokens = None
+            if ns.auth_tokens:
+                with open(ns.auth_tokens) as f:
+                    tokens = json_lib.load(f)
+            host, port = parse_hostport(ns.http)
+            http_front = HttpFront(srv, host=host, port=port, tokens=tokens)
         budget_str = f"{budget} bytes" if budget is not None else "unbounded"
         print(
             f"serve: listening on {ns.socket} (budget {budget_str}, "
             f"max cohort {max_cohort}, window {ns.window_ms:g} ms)",
             flush=True,
         )
+        if http_front is not None:
+            print(
+                f"serve: http front on {http_front.host}:"
+                f"{http_front.port} "
+                f"(auth {'on' if ns.auth_tokens else 'off'})",
+                flush=True,
+            )
         try:
             while True:
                 time.sleep(0.5)
         except KeyboardInterrupt:
             print("serve: draining and shutting down", flush=True)
         finally:
+            if http_front is not None:
+                http_front.close()
             front.close()
             srv.stop()
     return 0
@@ -836,7 +1307,20 @@ class SocketFront:
                                 config=cfg,
                                 target_loss=msg.get("target_loss"),
                                 data_seed=int(msg.get("data_seed", 0)),
+                                priority=int(msg.get("priority", 0)),
+                                retry=int(msg.get("retry", 0)),
                             )
+                        except ServeOverloadedError as e:
+                            # backpressure, not failure: the client's
+                            # capped-exponential backoff honors the quote
+                            send(
+                                {
+                                    "type": "rejected",
+                                    "retry_after_s": e.retry_after_s,
+                                    "message": str(e),
+                                }
+                            )
+                            continue
                         except Exception as e:  # noqa: BLE001 — per-line
                             send(
                                 {

@@ -1,0 +1,139 @@
+"""Intake write-ahead log: no accepted request is ever lost to a crash.
+
+The per-tenant journals (train/journal.py) persist *finished* rows; this
+WAL persists *acceptances*. Every config-resolvable request the daemon
+admits is appended — as a ``request`` event record carrying the full wire
+payload (serve/queue.config_payload) plus its idempotency digest — BEFORE
+any dispatch work happens, through the same O_APPEND single-write
+EventLogger the journals use (one ``write(2)`` per line, so a kill can
+tear at most the final line).
+
+On restart, :meth:`IntakeWAL.replay` hands the daemon back its working
+set: every WAL record, deduped by digest (last acceptance wins). The
+server resubmits each one through its normal intake path — records whose
+rows already landed in the tenant's journal rehydrate bitwise with no
+dispatch; the rest re-dispatch, warm against the on-disk compilation
+cache (train/cache.enable_persistent_compilation_cache). The ``restart``
+event records the split.
+
+Requests carrying an in-process dataset OBJECT are not WAL'd (a live
+array isn't serializable as an acceptance, and its submitter died with
+the process anyway); the network fronts are always config-resolvable, so
+everything that arrived over a socket is covered.
+
+The WAL is append-only and never compacted in-place: replay cost is one
+JSON parse per acceptance since the journal directory was created, and
+rotating the directory rotates the WAL with the journals it indexes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+from erasurehead_tpu.obs import events as events_lib
+
+#: WAL file name inside the serve journal directory
+WAL_NAME = "intake_wal.jsonl"
+
+
+class IntakeWAL:
+    """Append-only acceptance log over ``<journal_dir>/intake_wal.jsonl``.
+
+    Thread-safe: intake runs on the serve loop but resubmission helpers
+    may append from client threads. The writer opens lazily in append
+    mode so constructing the WAL never clobbers a crashed daemon's
+    records."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self.path = os.path.join(directory, WAL_NAME)
+        self._logger: Optional[events_lib.EventLogger] = None
+        self._lock = threading.Lock()
+        self._seen: set[str] = set()
+        if os.path.exists(self.path):
+            for rec in self._read():
+                self._seen.add(rec["digest"])
+
+    def _read(self) -> list[dict]:
+        records: list[dict] = []
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn final line from a kill mid-write
+                if (
+                    isinstance(rec, dict)
+                    and rec.get("type") == "request"
+                    and isinstance(rec.get("digest"), str)
+                    and isinstance(rec.get("config"), dict)
+                ):
+                    records.append(rec)
+        return records
+
+    def __len__(self) -> int:
+        return len(self._seen)
+
+    def seen(self, digest: str) -> bool:
+        return digest in self._seen
+
+    def append(
+        self,
+        *,
+        tenant: str,
+        request_id: str,
+        label: str,
+        digest: str,
+        config_payload: dict,
+        data_seed: int = 0,
+        target_loss: Optional[float] = None,
+        priority: int = 0,
+    ) -> bool:
+        """Record one acceptance; returns False (and writes nothing) when
+        the digest is already WAL'd — the resubmission coalesces onto the
+        in-flight original, and one acceptance record is enough to
+        rehydrate both."""
+        with self._lock:
+            if digest in self._seen:
+                return False
+            if self._logger is None:
+                self._logger = events_lib.EventLogger(self.path, mode="a")
+            self._logger.emit(
+                "request",
+                tenant=tenant,
+                request_id=request_id,
+                label=label,
+                digest=digest,
+                config=config_payload,
+                data_seed=int(data_seed),
+                target_loss=target_loss,
+                priority=int(priority),
+            )
+            self._seen.add(digest)
+        return True
+
+    def replay(self) -> list[dict]:
+        """The deduped working set: one record per digest, last
+        acceptance wins, in first-acceptance order."""
+        if not os.path.exists(self.path):
+            return []
+        by_digest: dict[str, dict] = {}
+        order: list[str] = []
+        for rec in self._read():
+            d = rec["digest"]
+            if d not in by_digest:
+                order.append(d)
+            by_digest[d] = rec
+        return [by_digest[d] for d in order]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._logger is not None:
+                self._logger.close()
+                self._logger = None

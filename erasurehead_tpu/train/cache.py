@@ -152,6 +152,63 @@ def drop_data_cache() -> int:
 
 
 # ---------------------------------------------------------------------------
+# persistent (on-disk) compilation cache: warm restarts for long-lived
+# daemons. The in-process executable cache above dies with the process;
+# routing XLA compiles through JAX's on-disk compilation cache makes the
+# restarted process's "misses" disk hits — the serve daemon re-serves its
+# working set with zero fresh backend compiles (the restart-under-load
+# contract in tests/test_serve.py and the serve_load bench extra). The
+# disk key is JAX's hash of the lowered computation + compile options +
+# backend, a superset of our lowering signature, so a disk hit is exactly
+# as bitwise-safe as an in-process hit.
+
+
+def enable_persistent_compilation_cache(directory: str) -> str:
+    """Route this process's XLA compiles through JAX's on-disk
+    compilation cache at ``directory`` (created if absent). Thresholds
+    are zeroed so even sub-second CPU test compiles persist — a daemon's
+    working set is warm because it was WRITTEN, not because it was slow.
+    Returns the directory."""
+    import jax
+
+    os.makedirs(directory, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", directory)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # the cache module latches its config at the process's FIRST compile
+    # (jax 0.4.x: _cache_initialized); a daemon enabling the dir after
+    # any compile has happened must reset it or every write is silently
+    # skipped. Public alias first, private fallback, best-effort.
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc,
+        )
+
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — older/newer layouts
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # noqa: BLE001
+            pass
+    _METRICS.counter("sweep_cache.persistent_enabled").inc()
+    return directory
+
+
+def persistent_cache_entries(directory: str) -> int:
+    """How many compiled executables the on-disk cache holds (its
+    ``*-cache`` payload files; ``*-atime`` bookkeeping files don't
+    count). The restart-under-load tests pin this delta to ZERO across a
+    warm restart — the re-served working set added no fresh compiles."""
+    if not os.path.isdir(directory):
+        return 0
+    return sum(
+        1 for name in os.listdir(directory) if name.endswith("-cache")
+    )
+
+
+# ---------------------------------------------------------------------------
 # key builders
 
 

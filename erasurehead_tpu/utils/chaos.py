@@ -16,13 +16,17 @@ list of independently armed faults; each spec is
 
   - ``mode``   — ``kill`` (the process dies via ``os._exit`` with
                  :data:`KILL_EXIT`, simulating a preemption: no cleanup, no
-                 atexit, nothing flushed beyond what already hit disk) or
+                 atexit, nothing flushed beyond what already hit disk),
                  ``raise`` (a :class:`ChaosInjection` whose message carries
                  an XLA-style status marker, default ``RESOURCE_EXHAUSTED``,
                  so the cohort-degradation guard exercises its real
-                 classification path). For the MEMBERSHIP sites below the
-                 mode field is a WORKER ID instead (an integer — the fault
-                 is a membership change, not a process fault).
+                 classification path), or ``stall`` (the invocation sleeps
+                 the number of SECONDS carried in the message field,
+                 default 30 — a hung dispatch, distinguishable from a dead
+                 one, which is what request timeouts exist for). For the
+                 MEMBERSHIP sites below the mode field is a WORKER ID
+                 instead (an integer — the fault is a membership change,
+                 not a process fault).
   - ``site``   — which instrumented hook arms: ``trajectory`` (after a
                  sweep trajectory's summary row is finalized/journaled —
                  experiments.compare), ``cohort`` (at the head of a
@@ -71,6 +75,14 @@ KILL_EXIT = 43
 SITES = (
     "trajectory", "cohort", "checkpoint", "adapt", "elastic",
     "worker_death", "worker_revive",
+    # serve-daemon failure domains (erasurehead_tpu/serve/server.py):
+    # "serve_intake" fires after a request's intake-WAL append (a kill
+    # there proves the WAL preserved the acceptance), "serve_dispatch"
+    # at the head of a packed cohort dispatch (accepted + WAL'd, row not
+    # yet journaled — the warm-restart working set), "serve_reply" after
+    # the row is journaled but before the reply is delivered (the client
+    # must be able to re-fetch by resubmitting)
+    "serve_intake", "serve_dispatch", "serve_reply",
 )
 
 #: sites whose fault is a MEMBERSHIP change (a worker dying or offering
@@ -127,8 +139,22 @@ def parse_spec(spec: str) -> ChaosSpec:
                 f"{CHAOS_ENV}={spec!r}: worker id must be >= 0"
             )
         mode = "member"
-    elif mode not in ("kill", "raise"):
-        raise ValueError(f"{CHAOS_ENV}={spec!r}: mode must be kill|raise")
+    elif mode not in ("kill", "raise", "stall"):
+        raise ValueError(
+            f"{CHAOS_ENV}={spec!r}: mode must be kill|raise|stall"
+        )
+    if mode == "stall":
+        # the message field carries the stall duration in seconds
+        if len(parts) <= 3:
+            message = "30"
+        try:
+            if float(message) < 0:
+                raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"{CHAOS_ENV}={spec!r}: stall takes a non-negative "
+                f"seconds value in the message field, got {message!r}"
+            ) from None
     sticky = count.endswith("+")
     try:
         n = int(count[:-1] if sticky else count)
@@ -193,6 +219,11 @@ def maybe_fire(site: str) -> None:
             # preemption semantics: no cleanup, no atexit — only what
             # already reached disk (the journal flushes per line) survives
             os._exit(KILL_EXIT)
+        if spec.mode == "stall":
+            import time
+
+            time.sleep(float(spec.message))
+            continue
         raise ChaosInjection(
             f"{spec.message}: chaos injection at site {site!r} "
             f"(invocation {n}, spec {spec.mode}:{spec.site}:"

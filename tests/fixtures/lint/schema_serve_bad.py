@@ -1,0 +1,14 @@
+"""event-schema violations against the PR-13 serve records: a ``reject``
+emit missing its reason, a ``stream`` emit missing its lifecycle event,
+and a logger-object ``restart`` emit missing the rehydrated count — the
+contracts the network fronts' backpressure/streaming/warm-restart
+telemetry (serve/server.py, serve/http_front.py, serve/wal.py) must
+satisfy."""
+
+from erasurehead_tpu.obs import events as events_lib
+
+
+def emit_serve(logger):
+    events_lib.emit("reject", tenant="a")  # missing reason
+    events_lib.emit("stream", tenant="a")  # missing event
+    logger.emit("restart", wal_records=3, resubmitted=2)  # no rehydrated

@@ -109,6 +109,19 @@ def test_schema_membership_fixture():
     assert len(findings) == 2
 
 
+def test_schema_serve_fixture():
+    """The PR-13 serve records (reject/stream/restart) are lint-enforced
+    like every other type: emits missing required fields are findings —
+    a drifted backpressure or warm-restart emit fails `erasurehead-tpu
+    lint`, not the first overloaded daemon in production."""
+    findings = _unsup(_lint(_fx("schema_serve_bad.py")), "event-schema")
+    msgs = "\n".join(f.message for f in findings)
+    assert "reason" in msgs
+    assert "event" in msgs
+    assert "rehydrated" in msgs  # the logger-object emit is checked too
+    assert len(findings) == 3
+
+
 def test_schema_whatif_fixture():
     """The what-if engine's `whatif` record (ISSUE 12) is lint-enforced
     like every other type: emits missing spec_hash/kind are findings,

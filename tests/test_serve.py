@@ -63,6 +63,33 @@ def fresh_state():
     cache.clear()
 
 
+@pytest.fixture
+def restore_jax_compile_cache():
+    """The persistent compilation cache is process-global jax config;
+    tests that enable it (SweepServer cache_dir=...) must point it back
+    off so later tests don't write into a deleted tmp dir."""
+    import jax
+
+    prev = {
+        name: getattr(jax.config, name)
+        for name in (
+            "jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes",
+        )
+    }
+    yield
+    for name, value in prev.items():
+        jax.config.update(name, value)
+    # the cache module latches its config; drop the latched handle so
+    # later compiles re-read the restored (off) config
+    from jax.experimental.compilation_cache import (
+        compilation_cache as cc,
+    )
+
+    cc.reset_cache()
+
+
 def _cfg(**kw):
     base = dict(
         scheme="naive", n_workers=W, n_stragglers=1, rounds=R,
@@ -153,6 +180,23 @@ class TestAdmission:
         assert ctl.try_admit(cohort, "d2")
         ctl.release("d2")
         assert ctl.in_flight_bytes == 0
+
+    def test_pressure_snapshot(self, gmm):
+        cohort = packer_lib.plan_packs([_req(gmm)])[0]
+        est = admission_lib.estimate_cohort_bytes(cohort)
+        ctl = admission_lib.AdmissionController(budget_bytes=est)
+        assert ctl.pressure() == {
+            "budget_bytes": est, "in_flight_bytes": 0,
+            "in_flight_dispatches": 0, "deferred_total": 0,
+        }
+        assert ctl.try_admit(cohort, "d1")
+        assert not ctl.try_admit(cohort, "d2")  # defers
+        p = ctl.pressure()
+        assert p["in_flight_bytes"] == est
+        assert p["in_flight_dispatches"] == 1
+        assert p["deferred_total"] == 1
+        ctl.release("d1")
+        assert ctl.pressure()["in_flight_bytes"] == 0
 
     def test_impossible_alone_admits_instead_of_deadlocking(self, gmm):
         cohort = packer_lib.plan_packs([_req(gmm)])[0]
@@ -675,6 +719,527 @@ def test_report_renders_per_tenant_serve_section(gmm, tmp_path, capsys):
     out = capsys.readouterr().out
     assert "serve (multi-tenant cohort packing)" in out
     assert "alice" in out and "bob" in out
-    # bob's diverged row is counted in his tenant line
+    # bob's diverged row is counted in his tenant line (columns: tenant
+    # requests rows diverged errors rejects retried)
     bob_line = [l for l in out.splitlines() if l.strip().startswith("bob")]
-    assert bob_line and bob_line[0].split()[-2] == "1"
+    assert bob_line and bob_line[0].split()[3] == "1"
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair packing (PR 13): round-robin windows, quotas, priorities
+
+
+class TestFairPacking:
+    def _flood(self, gmm):
+        """The starvation pattern: tenant a's 6-deep backlog arrives
+        before b's and c's 2 each."""
+        return (
+            [_req(gmm, tenant="a", label=f"a{k}", seed=k)
+             for k in range(6)]
+            + [_req(gmm, tenant="b", label=f"b{k}", seed=10 + k)
+               for k in range(2)]
+            + [_req(gmm, tenant="c", label=f"c{k}", seed=20 + k)
+               for k in range(2)]
+        )
+
+    def test_round_robin_interleaves_tenants(self, gmm):
+        """FIFO would give the flooder the first 6 of 8 window slots;
+        fair windows alternate tenants, so b and c ride the FIRST
+        dispatch instead of queueing behind a's backlog."""
+        packs = packer_lib.plan_packs(self._flood(gmm), max_cohort=4)
+        labels = [[r.label for r in p.requests] for p in packs]
+        assert labels[0] == ["a0", "b0", "c0", "a1"]
+        assert labels[1] == ["a2", "b1", "c1", "a3"]
+        assert labels[2] == ["a4", "a5"]
+
+    def test_fifo_mode_preserves_arrival_order(self, gmm):
+        packs = packer_lib.plan_packs(
+            self._flood(gmm), max_cohort=4, fair=False
+        )
+        labels = [[r.label for r in p.requests] for p in packs]
+        assert labels[0] == ["a0", "a1", "a2", "a3"]  # the starvation
+
+    def test_tenant_quota_is_a_hard_cap(self, gmm):
+        """quota=1: once every backlogged tenant holds its one slot the
+        window closes SHORT; the lone tenant's overflow waits for later
+        windows instead of monopolizing this one."""
+        packs = packer_lib.plan_packs(
+            self._flood(gmm), max_cohort=4, tenant_quota=1
+        )
+        labels = [[r.label for r in p.requests] for p in packs]
+        assert labels[0] == ["a0", "b0", "c0"]
+        assert labels[1] == ["a1", "b1", "c1"]
+        assert labels[2:] == [["a2"], ["a3"], ["a4"], ["a5"]]
+        with pytest.raises(ValueError, match="tenant_quota"):
+            packer_lib.plan_packs(self._flood(gmm), tenant_quota=0)
+
+    def test_priority_orders_within_tenant_only(self, gmm):
+        """Priority is intra-tenant: a's P5 request jumps a's own queue
+        but cannot displace b's share of the window."""
+        reqs = [
+            _req(gmm, tenant="a", label="a0", seed=0),
+            _req(gmm, tenant="a", label="a_hot", seed=1),
+            _req(gmm, tenant="a", label="a2", seed=2),
+            _req(gmm, tenant="b", label="b0", seed=3),
+        ]
+        reqs[1].priority = 5
+        packs = packer_lib.plan_packs(reqs, max_cohort=2)
+        labels = [[r.label for r in p.requests] for p in packs]
+        assert labels[0] == ["a_hot", "b0"]
+        assert labels[1] == ["a0", "a2"]  # FIFO within the P0 class
+
+    def test_lone_tenant_fills_whole_windows(self, gmm):
+        """Fairness costs nothing under no contention: one tenant's
+        requests chunk exactly as FIFO did."""
+        reqs = [_req(gmm, label=f"r{k}", seed=k) for k in range(5)]
+        fair = packer_lib.plan_packs(reqs, max_cohort=2)
+        fifo = packer_lib.plan_packs(reqs, max_cohort=2, fair=False)
+        assert [[r.label for r in p.requests] for p in fair] == (
+            [[r.label for r in p.requests] for p in fifo]
+        )
+
+
+# ---------------------------------------------------------------------------
+# backpressure: high-water mark, reject events, retry-after, client backoff
+
+
+class TestBackpressure:
+    def test_max_pending_rejects_with_retry_after(self, gmm, tmp_path,
+                                                  monkeypatch):
+        """Past the high-water mark submit() raises ServeOverloadedError
+        carrying a positive retry-after, a `reject` event lands, and the
+        queue drains back below the mark afterwards."""
+        real_dispatch = experiments._dispatch_cohort
+        release = threading.Event()
+
+        def gated(labels, configs, dataset, arrivals):
+            release.wait(timeout=30)
+            return real_dispatch(labels, configs, dataset, arrivals)
+
+        monkeypatch.setattr(experiments, "_dispatch_cohort", gated)
+        path = str(tmp_path / "reject.jsonl")
+        with events_lib.capture(path):
+            with serve_server.serving(
+                window_s=0.01, max_pending=1, max_cohort=2
+            ) as srv:
+                h1 = srv.submit(
+                    tenant="a", label="one", config=_cfg(), dataset=gmm
+                )
+                # h1 sits in intake/pending (dispatch gated); the mark
+                # is 1, so the next submit must bounce
+                deadline = time.monotonic() + 5
+                rejected = None
+                while time.monotonic() < deadline:
+                    try:
+                        srv.submit(
+                            tenant="b", label="two",
+                            config=_cfg(seed=1), dataset=gmm,
+                        )
+                        time.sleep(0.01)  # h1 already dispatched; retry
+                    except serve_queue.ServeOverloadedError as e:
+                        rejected = e
+                        break
+                assert rejected is not None, "high-water mark never hit"
+                assert rejected.retry_after_s > 0
+                release.set()
+                assert h1.result(timeout=120).status == "ok"
+        recs = [json.loads(l) for l in open(path) if l.strip()]
+        rejects = [r for r in recs if r["type"] == "reject"]
+        assert rejects and rejects[0]["tenant"] == "b"
+        assert rejects[0]["reason"] == "overloaded"
+        assert rejects[0]["retry_after_s"] > 0
+        assert events_lib.validate_file(path) == []
+
+    def test_socket_client_retries_on_rejected(self, gmm, tmp_path,
+                                               monkeypatch):
+        """A 'rejected' reply with max_retries>0 is retried on the
+        capped-exponential schedule until accepted — the submission
+        ultimately lands exactly once (no accepted-then-lost, no dup)."""
+        real_dispatch = experiments._dispatch_cohort
+
+        def slow(labels, configs, dataset, arrivals):
+            time.sleep(0.3)
+            return real_dispatch(labels, configs, dataset, arrivals)
+
+        monkeypatch.setattr(experiments, "_dispatch_cohort", slow)
+        sock = str(tmp_path / "eh.sock")
+        payload = {
+            "scheme": "naive", "n_workers": W, "n_stragglers": 1,
+            "rounds": R, "n_rows": N_ROWS, "n_cols": N_COLS,
+            "lr_schedule": 0.5, "add_delay": True,
+            "compute_mode": "deduped",
+        }
+        with serve_server.serving(
+            window_s=0.01, max_pending=1, max_cohort=1
+        ) as srv:
+            front = serve_server.SocketFront(srv, sock)
+            try:
+                client = ServeClient(sock)
+                rids = []
+                for k in range(3):
+                    rids.append(client.submit(
+                        "t", f"r{k}", {**payload, "seed": k},
+                        max_retries=20,
+                    ))
+                assert client.rejected_total > 0, (
+                    "the mark never rejected — the test lost its teeth"
+                )
+                assert client.retried_total == client.rejected_total
+                got = {client.result(timeout=120)["request_id"]
+                       for _ in range(3)}
+                assert got == set(rids)  # each exactly once
+                client.close()
+            finally:
+                front.close()
+
+    def test_backoff_schedule_is_deterministic(self):
+        from erasurehead_tpu.serve.client import backoff_s
+
+        # the daemon's quote wins when longer; the exponential floor
+        # wins when the quote is stale-low; the cap bounds the tail
+        assert backoff_s(0, 5.0) == 5.0
+        assert backoff_s(0, None) == pytest.approx(0.1)
+        assert backoff_s(3, 0.2) == pytest.approx(0.8)
+        assert backoff_s(30, 0.0) == 10.0
+        assert [backoff_s(a, 0.0) for a in range(4)] == [
+            pytest.approx(x) for x in (0.1, 0.2, 0.4, 0.8)
+        ]
+
+    def test_retry_after_scales_with_queue_depth(self, gmm):
+        srv = serve_server.SweepServer(max_cohort=4)
+        srv._dispatch_ewma_s = 2.0
+        assert srv.retry_after_s() == pytest.approx(2.0)
+        with srv._state_lock:
+            srv._queued = 12  # 4 windows ahead (ceil(13/4))
+        assert srv.retry_after_s() == pytest.approx(8.0)
+        srv._dispatch_ewma_s = 100.0
+        assert srv.retry_after_s() == 60.0  # clamped
+
+
+# ---------------------------------------------------------------------------
+# request timeouts: a stalled dispatch becomes a TYPED error, never a
+# silent queue.Empty (the serve/server.py:151 satellite)
+
+
+class TestRequestTimeout:
+    def test_chaos_stalled_dispatch_times_out_typed(self, gmm, tmp_path,
+                                                    monkeypatch):
+        from erasurehead_tpu.utils import chaos
+
+        monkeypatch.setenv(chaos.CHAOS_ENV, "stall:serve_dispatch:1:3")
+        chaos.reset()
+        path = str(tmp_path / "timeout.jsonl")
+        with events_lib.capture(path):
+            with serve_server.serving(
+                window_s=0.01, request_timeout_s=0.4
+            ) as srv:
+                h = srv.submit(
+                    tenant="t", label="stalled", config=_cfg(),
+                    dataset=gmm,
+                )
+                res = h.result(timeout=30)
+        assert res.status == "error"
+        assert "RequestTimeout" in res.error
+        assert "0.4" in res.error  # names the knob's value
+        recs = [json.loads(l) for l in open(path) if l.strip()]
+        warn = [r for r in recs if r["type"] == "warning"
+                and r.get("kind") == "request_timeout"]
+        assert warn and "stalled" in warn[0]["message"]
+        assert events_lib.validate_file(path) == []
+
+    def test_late_dispatch_loses_the_deliver_once_race(self, gmm,
+                                                       monkeypatch):
+        """The dispatch that eventually lands after a timeout must not
+        deliver a second result; its row still journals."""
+        real_dispatch = experiments._dispatch_cohort
+
+        def slow(labels, configs, dataset, arrivals):
+            time.sleep(0.8)
+            return real_dispatch(labels, configs, dataset, arrivals)
+
+        monkeypatch.setattr(experiments, "_dispatch_cohort", slow)
+        r0 = _counter("serve.results")
+        with serve_server.serving(
+            window_s=0.01, request_timeout_s=0.2
+        ) as srv:
+            h = srv.submit(
+                tenant="t", label="late", config=_cfg(), dataset=gmm
+            )
+            res = h.result(timeout=30)
+            assert res.status == "error"
+        # exactly ONE result counted for the request despite the late
+        # dispatch landing during drain
+        assert _counter("serve.results") == r0 + 1
+
+    def test_validates_knob(self):
+        with pytest.raises(ValueError, match="request_timeout_s"):
+            serve_server.SweepServer(request_timeout_s=0.0)
+        with pytest.raises(ValueError, match="max_pending"):
+            serve_server.SweepServer(max_pending=0)
+
+
+# ---------------------------------------------------------------------------
+# typed daemon-death errors (ServeUnavailableError satellite)
+
+
+class TestServeUnavailable:
+    def test_connect_refused_is_typed(self, tmp_path):
+        from erasurehead_tpu.serve.client import ServeUnavailableError
+
+        missing = str(tmp_path / "nope.sock")
+        with pytest.raises(ServeUnavailableError, match="nope.sock"):
+            ServeClient(missing)
+
+    def test_daemon_death_translates_queue_empty(self, gmm, tmp_path):
+        """A client waiting on result() when the daemon dies gets the
+        typed error naming the socket path and last event seen — never a
+        raw queue.Empty."""
+        from erasurehead_tpu.serve.client import ServeUnavailableError
+
+        sock = str(tmp_path / "eh.sock")
+        srv = serve_server.SweepServer(window_s=0.05).start()
+        front = serve_server.SocketFront(srv, sock)
+        client = ServeClient(sock)
+        rid = client.submit(
+            "t", "ok",
+            {"scheme": "naive", "n_workers": W, "n_stragglers": 1,
+             "rounds": R, "n_rows": N_ROWS, "n_cols": N_COLS,
+             "lr_schedule": 0.5, "add_delay": True,
+             "compute_mode": "deduped"},
+        )
+        res = client.result(timeout=120)
+        assert res["request_id"] == rid
+        front.close()  # the daemon goes away mid-session
+        srv.stop()
+        with pytest.raises(ServeUnavailableError) as ei:
+            client.result(timeout=30)
+        assert sock in str(ei.value)
+        assert ei.value.last_event == "result"  # names what it last saw
+        with pytest.raises(ServeUnavailableError):
+            client.submit("t", "again", {"scheme": "naive",
+                                         "n_workers": W, "rounds": R})
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+# intake WAL + warm restart (the crash-safety tentpole)
+
+
+class TestIntakeWAL:
+    def test_append_dedupes_by_digest(self, tmp_path):
+        from erasurehead_tpu.serve import wal as wal_lib
+
+        w = wal_lib.IntakeWAL(str(tmp_path))
+        rec = dict(
+            tenant="t", request_id="t-req-1", label="l", digest="d1",
+            config_payload={"scheme": "naive"},
+        )
+        assert w.append(**rec)
+        assert not w.append(**{**rec, "request_id": "t-req-2"})
+        assert w.seen("d1") and not w.seen("d2")
+        assert len(w.replay()) == 1
+        w.close()
+        # a fresh WAL over the same file rereads the digests
+        w2 = wal_lib.IntakeWAL(str(tmp_path))
+        assert w2.seen("d1") and len(w2) == 1
+        w2.close()
+
+    def test_torn_final_line_tolerated(self, tmp_path):
+        from erasurehead_tpu.serve import wal as wal_lib
+
+        w = wal_lib.IntakeWAL(str(tmp_path))
+        w.append(tenant="t", request_id="r1", label="l", digest="d1",
+                 config_payload={"scheme": "naive"})
+        w.close()
+        with open(w.path, "a") as f:
+            f.write('{"type": "request", "digest": "d2", "conf')  # torn
+        w2 = wal_lib.IntakeWAL(str(tmp_path))
+        assert len(w2.replay()) == 1  # the whole line survives, torn dies
+        w2.close()
+
+    def test_config_payload_round_trip(self):
+        cfg = _cfg(scheme="approx", num_collect=3, seed=7)
+        payload = serve_queue.config_payload(cfg)
+        assert payload is not None
+        rebuilt = serve_queue.config_from_payload(payload)
+        assert events_lib.config_hash(rebuilt) == (
+            events_lib.config_hash(cfg)
+        )
+        # unserveable fields make the config non-WAL-replayable: None
+        bad = _cfg(is_real_data=True, input_dir="/x", dataset="covtype")
+        assert serve_queue.config_payload(bad) is None
+
+    def test_digest_coalesces_inflight_resubmission(self, gmm, tmp_path,
+                                                    monkeypatch):
+        """An idempotent resubmission of an in-flight request rides the
+        original dispatch (one dispatch, two replies) instead of
+        double-dispatching."""
+        real_dispatch = experiments._dispatch_cohort
+
+        def slow(labels, configs, dataset, arrivals):
+            time.sleep(0.5)
+            return real_dispatch(labels, configs, dataset, arrivals)
+
+        monkeypatch.setattr(experiments, "_dispatch_cohort", slow)
+        d0 = _counter("serve.dispatches")
+        c0 = _counter("serve.coalesced")
+        cfg = _cfg()
+        with serve_server.serving(
+            window_s=0.01, journal_dir=str(tmp_path / "j")
+        ) as srv:
+            h1 = srv.submit(tenant="t", label="same", config=cfg)
+            time.sleep(0.2)  # h1 in flight
+            h2 = srv.submit(tenant="t", label="same", config=cfg)
+            r1 = h1.result(timeout=120)
+            r2 = h2.result(timeout=120)
+        assert r1.status == r2.status == "ok"
+        assert r2.resumed  # the follower's reply is marked resumed
+        assert _counter("serve.dispatches") == d0 + 1
+        assert _counter("serve.coalesced") == c0 + 1
+        assert json.dumps(r1.row, sort_keys=True) == json.dumps(
+            r2.row, sort_keys=True
+        )
+
+
+class TestWarmRestart:
+    def test_restart_rehydrates_bitwise_with_zero_recompiles(
+        self, tmp_path, monkeypatch, restore_jax_compile_cache
+    ):
+        """The tier-1 restart-under-load pin (in-process; the REAL
+        process-kill variant is `make serve-chaos-smoke` / the slow
+        test below): warm one signature, fail a dispatch mid-flight via
+        chaos, 'restart' on the same journal+cache dirs with the
+        in-process caches cleared, and assert (a) the WAL replays the
+        working set, (b) every resubmission rehydrates bitwise, (c) the
+        on-disk compilation cache gains ZERO entries."""
+        from erasurehead_tpu.train.cache import persistent_cache_entries
+        from erasurehead_tpu.utils import chaos
+
+        jdir = str(tmp_path / "journal")
+        cdir = str(tmp_path / "xla")
+        cfgs = {f"r{k}": _cfg(seed=k) for k in range(3)}
+
+        # leg 1: warm r0's signature, then chaos-fail r1/r2's dispatch
+        # (accepted + WAL'd, no rows journaled — the working set)
+        with serve_server.serving(
+            window_s=0.05, journal_dir=jdir, cache_dir=cdir
+        ) as srv:
+            first = srv.submit(
+                tenant="t", label="r0", config=cfgs["r0"]
+            ).result(timeout=120)
+            assert first.status == "ok"
+            monkeypatch.setenv(
+                chaos.CHAOS_ENV, "raise:serve_dispatch:1+"
+            )
+            chaos.reset()
+            hs = [
+                srv.submit(tenant="t", label=l, config=cfgs[l])
+                for l in ("r1", "r2")
+            ]
+            for h in hs:
+                assert h.result(timeout=120).status == "error"
+        monkeypatch.delenv(chaos.CHAOS_ENV)
+        chaos.reset()
+        entries_before = persistent_cache_entries(cdir)
+        assert entries_before > 0  # the warm leg hit the disk cache
+
+        # leg 2: cold-process proxy — in-process exec/data caches gone,
+        # only the disk survives (what a real restart sees)
+        cache.clear()
+        path = str(tmp_path / "restart.jsonl")
+        with events_lib.capture(path):
+            with serve_server.serving(
+                window_s=0.05, journal_dir=jdir, cache_dir=cdir
+            ) as srv:
+                rows = {
+                    l: srv.submit(
+                        tenant="t", label=l, config=cfgs[l]
+                    ).result(timeout=120)
+                    for l in ("r0", "r1", "r2")
+                }
+        assert all(r.status == "ok" for r in rows.values())
+        assert all(r.resumed for r in rows.values()), (
+            "resubmission must rehydrate (journal or coalesced replay), "
+            "never recompute"
+        )
+        assert rows["r0"].row == first.row  # bitwise, incl. loss arrays
+        assert persistent_cache_entries(cdir) == entries_before, (
+            "warm restart recompiled a warm signature"
+        )
+        recs = [json.loads(l) for l in open(path) if l.strip()]
+        restart = [r for r in recs if r["type"] == "restart"]
+        assert restart and restart[0]["wal_records"] == 3
+        assert restart[0]["rehydrated"] >= 1  # r0 straight from journal
+        assert restart[0]["resubmitted"] == 2  # r1/r2 re-dispatched
+        assert events_lib.validate_file(path) == []
+
+    @pytest.mark.slow
+    def test_restart_under_load_with_real_kills(self):
+        """The full subprocess cycle (`make serve-chaos-smoke`): daemon
+        DIES via os._exit mid-dispatch, restarts, WAL replays, rows
+        rehydrate bitwise vs an uninterrupted baseline, zero new
+        on-disk compile-cache entries. Slow-marked: three jax boots."""
+        import subprocess
+        import sys as sys_mod
+
+        root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        p = subprocess.run(
+            [sys_mod.executable,
+             os.path.join(root, "tools", "serve_chaos_smoke.py")],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=900,
+        )
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert '"status": "PASS"' in p.stdout
+
+
+# ---------------------------------------------------------------------------
+# new serve event kinds: validator coverage
+
+
+class TestNewServeEventSchema:
+    def _validate(self, recs):
+        lines = [
+            json.dumps({"seq": i, "t": 0.0, **r})
+            for i, r in enumerate(recs)
+        ]
+        return events_lib.validate_lines(lines)
+
+    def test_valid_reject_stream_restart(self):
+        assert self._validate([
+            {"type": "reject", "tenant": "a", "reason": "overloaded",
+             "retry_after_s": 1.5},
+            {"type": "reject", "tenant": "unknown",
+             "reason": "unauthorized"},
+            {"type": "stream", "tenant": "a", "event": "open"},
+            {"type": "stream", "tenant": "a", "event": "overflow",
+             "dropped": 7},
+            {"type": "stream", "tenant": "a", "event": "close",
+             "dropped": 7},
+            {"type": "restart", "wal_records": 3, "resubmitted": 2,
+             "rehydrated": 1},
+        ]) == []
+
+    def test_invalid_records_named(self):
+        errors = self._validate([
+            {"type": "reject", "tenant": "", "reason": "overloaded"},
+            {"type": "reject", "tenant": "a", "reason": "bored"},
+            {"type": "reject", "tenant": "a", "reason": "overloaded",
+             "retry_after_s": -1},
+            {"type": "stream", "tenant": "a", "event": "explode"},
+            {"type": "stream", "tenant": "a", "event": "overflow",
+             "dropped": -2},
+            {"type": "restart", "wal_records": -1, "resubmitted": 0,
+             "rehydrated": 0},
+            {"type": "restart", "wal_records": 1, "resubmitted": 0},
+        ])
+        joined = "\n".join(errors)
+        assert "reject tenant" in joined
+        assert "reject reason" in joined
+        assert "retry_after_s" in joined
+        assert "stream event" in joined
+        assert "stream dropped" in joined
+        assert "restart wal_records" in joined
+        assert "missing required ['rehydrated']" in joined

@@ -1,0 +1,295 @@
+"""HTTP/1.1 JSONL front + client: the serve daemon on the network.
+
+The network-robustness contracts (PR 13):
+  - per-tenant bearer auth: the token NAMES the tenant — a body tenant
+    cannot impersonate, a bad token is a 401 (+ a reject event), and
+    with auth off the trusted-localhost body tenant is used verbatim;
+  - backpressure is a first-class reply: past the high-water mark the
+    front answers 429 with a Retry-After header plus the exact
+    ``retry_after_s``, and the client's deterministic capped-exponential
+    backoff lands the request on a later attempt — accepted exactly
+    once, never lost, never duplicated;
+  - result streaming is chunked JSONL as journal rows land, with a
+    BOUNDED per-connection outbox: a slow reader sheds rows
+    (drop-and-journal + an in-stream overflow marker + a ``stream``
+    event) instead of backing pressure into the dispatch pool.
+"""
+
+import http.client
+import json
+import queue as queue_lib
+import threading
+import time
+
+import pytest
+
+from erasurehead_tpu.obs import events as events_lib
+from erasurehead_tpu.serve import server as serve_server
+from erasurehead_tpu.serve.client import (
+    HttpServeClient,
+    ServeRejectedError,
+    ServeUnavailableError,
+)
+from erasurehead_tpu.serve.http_front import (
+    HttpFront,
+    StreamHub,
+    parse_hostport,
+)
+from erasurehead_tpu.serve.queue import ServeResult
+from erasurehead_tpu.train import cache, experiments
+
+W, R = 4, 2
+CFG = {
+    "scheme": "naive", "n_workers": W, "n_stragglers": 1, "rounds": R,
+    "n_rows": 64, "n_cols": 8, "lr_schedule": 0.5, "add_delay": True,
+    "compute_mode": "deduped",
+}
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    cache.clear()
+    yield
+    cache.clear()
+
+
+def _get(host, port, path, token=None):
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    headers = {}
+    if token is not None:
+        headers["Authorization"] = f"Bearer {token}"
+    conn.request("GET", path, headers=headers)
+    resp = conn.getresponse()
+    body = json.loads(resp.read() or b"{}")
+    conn.close()
+    return resp, body
+
+
+def _post(host, port, path, payload, token=None):
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    headers = {"Content-Type": "application/json"}
+    if token is not None:
+        headers["Authorization"] = f"Bearer {token}"
+    conn.request("POST", path, body=json.dumps(payload), headers=headers)
+    resp = conn.getresponse()
+    body = json.loads(resp.read() or b"{}")
+    header_retry = resp.getheader("Retry-After")
+    conn.close()
+    return resp, body, header_retry
+
+
+class TestHttpFront:
+    def test_auth_token_names_the_tenant(self, tmp_path):
+        """A valid token submits AS ITS tenant (the body's tenant field
+        cannot impersonate); a bad/missing token is 401 + reject event;
+        the stream delivers the row to the token's tenant."""
+        path = str(tmp_path / "ev.jsonl")
+        with events_lib.capture(path):
+            with serve_server.serving(window_s=0.05) as srv:
+                front = HttpFront(srv, tokens={"tok-a": "alice"})
+                try:
+                    client = HttpServeClient(
+                        front.host, front.port, "alice", token="tok-a"
+                    )
+                    rid = client.submit("mine", CFG)
+                    res = client.result(timeout=180)
+                    assert res["request_id"] == rid
+                    assert res["tenant"] == "alice"
+                    assert res["status"] == "ok"
+                    # body tenant is ignored under auth: still alice's
+                    resp, body, _ = _post(
+                        front.host, front.port, "/v1/submit",
+                        {"tenant": "mallory", "label": "steal",
+                         "config": CFG},
+                        token="tok-a",
+                    )
+                    assert resp.status == 202
+                    res2 = client.result(timeout=180)
+                    assert res2["tenant"] == "alice"
+                    # bad token: 401, WWW-Authenticate, reject event
+                    resp, body, _ = _post(
+                        front.host, front.port, "/v1/submit",
+                        {"label": "x", "config": CFG}, token="nope",
+                    )
+                    assert resp.status == 401
+                    resp, body = _get(
+                        front.host, front.port, "/v1/stream"
+                    )
+                    assert resp.status == 401
+                    client.close()
+                finally:
+                    front.close()
+        recs = [json.loads(l) for l in open(path) if l.strip()]
+        rejects = [r for r in recs if r["type"] == "reject"]
+        assert rejects and all(
+            r["reason"] == "unauthorized" for r in rejects
+        )
+        streams = [r for r in recs if r["type"] == "stream"]
+        assert {s["event"] for s in streams} >= {"open", "close"}
+        assert events_lib.validate_file(path) == []
+
+    def test_healthz_and_routes(self):
+        with serve_server.serving(window_s=0.05) as srv:
+            front = HttpFront(srv)
+            try:
+                resp, body = _get(front.host, front.port, "/healthz")
+                assert resp.status == 200 and body["status"] == "ok"
+                assert body["queued"] == 0 and body["in_flight"] == 0
+                assert body["admission"]["in_flight_bytes"] == 0
+                assert body["admission"]["deferred_total"] == 0
+                resp, body = _get(front.host, front.port, "/nope")
+                assert resp.status == 404
+                resp, body, _ = _post(
+                    front.host, front.port, "/v1/submit",
+                    {"tenant": "t", "label": "bad",
+                     "config": {"warp_drive": 9}},
+                )
+                assert resp.status == 400
+                assert "unserveable" in body["message"]
+                # stream without auth wants an explicit tenant
+                resp, body = _get(front.host, front.port, "/v1/stream")
+                assert resp.status == 400
+            finally:
+                front.close()
+
+    def test_429_retry_after_then_client_backoff_lands(self, monkeypatch):
+        """Past the high-water mark: 429 with a Retry-After header >= 1
+        and the exact quote in the body; an HttpServeClient with retries
+        enabled lands the same request on a later attempt — exactly one
+        result, no duplicates."""
+        real_dispatch = experiments._dispatch_cohort
+        release = threading.Event()
+
+        def gated(labels, configs, dataset, arrivals):
+            release.wait(timeout=60)
+            return real_dispatch(labels, configs, dataset, arrivals)
+
+        monkeypatch.setattr(experiments, "_dispatch_cohort", gated)
+        with serve_server.serving(
+            window_s=0.01, max_pending=1
+        ) as srv:
+            front = HttpFront(srv)
+            try:
+                client = HttpServeClient(
+                    front.host, front.port, "t"
+                )
+                rid1 = client.submit("first", CFG)
+                # the daemon holds one outstanding request; the next
+                # submit must bounce with the retry-after contract
+                resp, body, header_retry = _post(
+                    front.host, front.port, "/v1/submit",
+                    {"tenant": "t", "label": "second",
+                     "config": {**CFG, "seed": 1}},
+                )
+                assert resp.status == 429
+                assert body["type"] == "rejected"
+                assert body["retry_after_s"] > 0
+                assert int(header_retry) >= 1
+                with pytest.raises(ServeRejectedError):
+                    client.submit("second", {**CFG, "seed": 1})
+
+                # with retries armed, release capacity mid-backoff: the
+                # client's schedule lands the request
+                def free():
+                    time.sleep(0.3)
+                    release.set()
+
+                threading.Thread(target=free, daemon=True).start()
+                rid2 = client.submit(
+                    "second", {**CFG, "seed": 1}, max_retries=20,
+                    backoff_base=0.05, backoff_cap=0.5,
+                )
+                assert client.rejected_total >= 2
+                got = {client.result(timeout=180)["request_id"]
+                       for _ in range(2)}
+                assert got == {rid1, rid2}
+                client.close()
+            finally:
+                front.close()
+
+    def test_dead_front_raises_typed_unavailable(self):
+        with serve_server.serving(window_s=0.05) as srv:
+            front = HttpFront(srv)
+            host, port = front.host, front.port
+            client = HttpServeClient(host, port, "t")
+            front.close()
+        with pytest.raises(ServeUnavailableError, match=f"{port}"):
+            client.submit("x", CFG)
+        with pytest.raises(ServeUnavailableError):
+            client.result(timeout=10)
+        client.close()
+
+    def test_parse_hostport(self):
+        assert parse_hostport("0.0.0.0:8080") == ("0.0.0.0", 8080)
+        assert parse_hostport("8080") == ("127.0.0.1", 8080)
+        assert parse_hostport(":0") == ("127.0.0.1", 0)
+        with pytest.raises(ValueError, match="HOST:PORT"):
+            parse_hostport("nope:port")
+
+
+class TestStreamHub:
+    def _result(self, k: int) -> ServeResult:
+        return ServeResult(
+            request_id=f"t-req-{k}", tenant="t", label=f"r{k}",
+            status="ok", row={"k": k},
+        )
+
+    def test_bounded_outbox_sheds_and_journals(self, tmp_path):
+        """A slow reader's outbox fills; further rows are SHED (counted,
+        one `stream` overflow event per burst) — publish never blocks.
+        Other tenants' subscriptions are untouched."""
+        path = str(tmp_path / "ev.jsonl")
+        hub = StreamHub(outbox_limit=2)
+        with events_lib.capture(path):
+            sid, sub = hub.subscribe("t")
+            _, other = hub.subscribe("other")
+            for k in range(5):
+                hub.publish(self._result(k))
+            assert sub.q.qsize() == 2
+            assert sub.dropped == 3 and sub.total_dropped == 3
+            assert other.q.qsize() == 0  # tenant-scoped fan-out
+            hub.unsubscribe(sid)
+        recs = [json.loads(l) for l in open(path) if l.strip()]
+        overflows = [r for r in recs if r["type"] == "stream"
+                     and r["event"] == "overflow"]
+        assert len(overflows) == 1  # one event per burst, not per row
+        closes = [r for r in recs if r["type"] == "stream"
+                  and r["event"] == "close"]
+        assert closes and closes[0]["dropped"] == 3
+        assert events_lib.validate_file(path) == []
+
+    def test_publish_never_blocks(self):
+        hub = StreamHub(outbox_limit=1)
+        hub.subscribe("t")
+        t0 = time.monotonic()
+        for k in range(1000):
+            hub.publish(self._result(k))
+        assert time.monotonic() - t0 < 1.0  # shed, not blocked
+
+    def test_overflow_marker_after_drain(self):
+        """The in-stream overflow marker rides AFTER the queued rows
+        drain, telling the reader exactly where the gap is (the shed
+        rows are journaled — re-fetch by resubmitting)."""
+        hub = StreamHub(outbox_limit=1)
+        _, sub = hub.subscribe("t")
+        hub.publish(self._result(0))
+        hub.publish(self._result(1))  # shed
+        assert sub.q.get_nowait()["label"] == "r0"
+        with sub.lock:
+            dropped, sub.dropped = sub.dropped, 0
+        assert dropped == 1
+        with pytest.raises(queue_lib.Empty):
+            sub.q.get_nowait()
+
+
+class TestLoadgenUnits:
+    def test_percentile(self):
+        from erasurehead_tpu.serve.loadgen import percentile
+
+        assert percentile([], 50) is None
+        assert percentile([3.0], 99) == 3.0
+        xs = [float(x) for x in range(1, 101)]
+        assert percentile(xs, 50) == 51.0  # nearest rank on 100 items
+        assert percentile(xs, 99) == 99.0
+        assert percentile(xs, 0) == 1.0
+        assert percentile(xs, 100) == 100.0

@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Restart-under-load smoke with REAL process kills (`make serve-chaos-smoke`).
+
+The crash-safety contract end-to-end, with the daemon as an actual
+subprocess dying via ``os._exit`` (chaos ``kill:serve_dispatch:2`` — no
+cleanup, no atexit, only what hit disk survives):
+
+  leg 1  daemon (chaos-armed) serves one request to completion — the
+         signature is WARM: executable journaled to JAX's on-disk
+         compilation cache, row in the tenant journal, acceptance in the
+         intake WAL. Two more same-signature requests arrive; their
+         dispatch is invocation #2 of the serve_dispatch site -> the
+         daemon DIES mid-dispatch (exit 43). The client's next result()
+         raises the typed ServeUnavailableError, never a bare
+         queue.Empty.
+  leg 2  a fresh daemon starts on the same directories: the WAL replays
+         (restart event: 3 records -> 1 rehydrated + 2 re-dispatched),
+         the re-dispatch compiles against the on-disk cache, and the
+         client resubmits all three requests -> every reply rehydrates
+         (resumed=true) with rows BITWISE equal to leg 3's, and the
+         compilation cache gained ZERO entries (0 recompiles of warm
+         signatures).
+  leg 3  an uninterrupted daemon in fresh directories serves the same
+         three requests — the baseline the resubmitted rows must match
+         byte-for-byte (science columns; volatile wall-clock keys
+         excluded, train/journal.science_row).
+
+Exit 0 = PASS (summary JSON on stdout); 1 = failure.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU relay
+
+CFG = {
+    "scheme": "naive", "n_workers": 4, "n_stragglers": 1, "rounds": 2,
+    "n_rows": 64, "n_cols": 8, "lr_schedule": 0.5, "add_delay": True,
+    "compute_mode": "deduped",
+}
+KILL_EXIT = 43  # utils/chaos.KILL_EXIT
+
+
+def launch(sock, journal, cache, events, log, chaos=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("ERASUREHEAD_CHAOS", None)
+    if chaos:
+        env["ERASUREHEAD_CHAOS"] = chaos
+    cmd = [
+        sys.executable, "-m", "erasurehead_tpu.cli", "serve",
+        "--socket", sock, "--journal-dir", journal,
+        "--cache-dir", cache, "--events", events, "--window-ms", "50",
+    ]
+    out = open(log, "w")
+    return subprocess.Popen(
+        cmd, env=env, cwd=ROOT, stdout=out, stderr=subprocess.STDOUT
+    )
+
+
+def wait_socket(path, proc, timeout=300):
+    """Wait until the daemon actually ACCEPTS on ``path`` — a killed
+    daemon leaves a stale socket file behind, so existence alone lies."""
+    import socket as socket_lib
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            probe = socket_lib.socket(
+                socket_lib.AF_UNIX, socket_lib.SOCK_STREAM
+            )
+            try:
+                probe.connect(path)
+                return
+            except OSError:
+                pass
+            finally:
+                probe.close()
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"daemon exited {proc.returncode} before listening"
+            )
+        time.sleep(0.2)
+    raise RuntimeError(f"daemon never bound {path}")
+
+
+def science(row):
+    from erasurehead_tpu.train import journal as journal_lib
+
+    return json.dumps(journal_lib.science_row(row), sort_keys=True)
+
+
+def serve_three(sock, expect_resumed):
+    """Submit the three requests and collect rows by label."""
+    from erasurehead_tpu.serve.client import ServeClient
+
+    c = ServeClient(sock)
+    for label, seed in (("warm", 0), ("b", 1), ("c", 2)):
+        c.submit("t", label, {**CFG, "seed": seed})
+    rows = {}
+    for _ in range(3):
+        res = c.result(timeout=300)
+        assert res["status"] == "ok", res
+        if expect_resumed:
+            assert res["resumed"], f"{res['label']} was not rehydrated"
+        rows[res["label"]] = science(res["row"])
+    c.close()
+    return rows
+
+
+def main() -> int:
+    from erasurehead_tpu.obs import events as events_lib
+    from erasurehead_tpu.serve.client import (
+        ServeClient,
+        ServeUnavailableError,
+    )
+    from erasurehead_tpu.train.cache import persistent_cache_entries
+
+    base = tempfile.mkdtemp(prefix="eh-serve-chaos-")
+    sock = os.path.join(base, "eh.sock")
+    journal, cache = os.path.join(base, "journal"), os.path.join(base, "xla")
+    ev1, ev2 = os.path.join(base, "ev1.jsonl"), os.path.join(base, "ev2.jsonl")
+
+    # ---- leg 1: warm one signature, then die mid-dispatch --------------
+    p1 = launch(sock, journal, cache, ev1, os.path.join(base, "d1.log"),
+                chaos="kill:serve_dispatch:2")
+    wait_socket(sock, p1)
+    c = ServeClient(sock)
+    c.submit("t", "warm", {**CFG, "seed": 0})
+    res = c.result(timeout=300)
+    assert res["status"] == "ok" and not res["resumed"], res
+    # two more acceptances; their dispatch is serve_dispatch #2 -> kill
+    c.submit("t", "b", {**CFG, "seed": 1})
+    c.submit("t", "c", {**CFG, "seed": 2})
+    rc = p1.wait(timeout=300)
+    assert rc == KILL_EXIT, f"daemon exit {rc}, wanted chaos kill {KILL_EXIT}"
+    try:
+        c.result(timeout=30)
+        raise AssertionError("dead daemon produced a result")
+    except ServeUnavailableError as e:
+        assert sock in str(e), e
+    c.close()
+    entries_before = persistent_cache_entries(cache)
+    assert entries_before > 0, "warm leg wrote no on-disk cache entries"
+
+    # ---- leg 2: restart on the same dirs, resubmit all -----------------
+    if os.path.exists(sock):
+        os.unlink(sock)  # the kill left a stale socket file behind
+    p2 = launch(sock, journal, cache, ev2, os.path.join(base, "d2.log"))
+    wait_socket(sock, p2)
+    rows_restarted = serve_three(sock, expect_resumed=True)
+    p2.terminate()
+    p2.wait(timeout=60)
+    entries_after = persistent_cache_entries(cache)
+    new_compiles = entries_after - entries_before
+    assert new_compiles == 0, (
+        f"warm restart recompiled: {new_compiles} new cache entries"
+    )
+    restart_recs = [
+        json.loads(line)
+        for line in open(ev2)
+        if line.strip() and json.loads(line).get("type") == "restart"
+    ]
+    assert restart_recs, "no restart event in the restarted daemon's log"
+    assert restart_recs[0]["wal_records"] == 3, restart_recs
+    assert restart_recs[0]["rehydrated"] >= 1, restart_recs
+    assert events_lib.validate_file(ev2) == [], (
+        events_lib.validate_file(ev2)
+    )
+
+    # ---- leg 3: uninterrupted baseline in fresh dirs -------------------
+    base3 = tempfile.mkdtemp(prefix="eh-serve-chaos-base-")
+    sock3 = os.path.join(base3, "eh.sock")
+    p3 = launch(
+        sock3, os.path.join(base3, "journal"), os.path.join(base3, "xla"),
+        os.path.join(base3, "ev.jsonl"), os.path.join(base3, "d.log"),
+    )
+    wait_socket(sock3, p3)
+    rows_baseline = serve_three(sock3, expect_resumed=False)
+    p3.terminate()
+    p3.wait(timeout=60)
+
+    assert rows_restarted == rows_baseline, (
+        "rehydrated rows differ from the uninterrupted baseline"
+    )
+    print(json.dumps({
+        "status": "PASS",
+        "wal_records": restart_recs[0]["wal_records"],
+        "rehydrated": restart_recs[0]["rehydrated"],
+        "resubmitted": restart_recs[0]["resubmitted"],
+        "new_compile_cache_entries": new_compiles,
+        "rows_bitwise_identical": True,
+    }, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"FAIL: {e}", file=sys.stderr)
+        sys.exit(1)

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Serve load/robustness smoke on CPU (`make serve-load-smoke`).
+
+A small-scale in-process run of the serve_load harness
+(erasurehead_tpu/serve/loadgen.py) over the HTTP front, asserting the
+PR's robustness bars end-to-end:
+
+  - closed-loop fleet: every accepted request produces exactly one row
+    (zero accepted-then-lost, zero duplicates), requests pack
+    (dispatches < requests);
+  - backpressure at ~2x capacity (max_pending far below the offered
+    burst): 429s flow, every job still lands via the clients'
+    deterministic capped-exponential retry-after schedule, still zero
+    lost / zero duplicates;
+  - fairness: with one flooding tenant, every victim tenant's goodput
+    stays >= 0.5x its solo baseline (weighted-fair packing; FIFO would
+    starve them behind the flood);
+  - warm restart: bounce the daemon with in-process caches cleared —
+    every resubmission rehydrates bitwise, the on-disk compilation
+    cache gains ZERO entries;
+  - the daemon's event log (request/pack/reject/stream/restart records)
+    passes the schema validator, and `erasurehead-tpu report` renders
+    the per-tenant reject/retry columns.
+
+Exit 0 = all assertions hold; 1 = failure (printed).
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU relay
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from erasurehead_tpu.obs import events as events_lib
+    from erasurehead_tpu.obs import report as report_lib
+    from erasurehead_tpu.obs.metrics import REGISTRY
+    from erasurehead_tpu.serve import loadgen
+    from erasurehead_tpu.serve import server as serve_server
+    from erasurehead_tpu.serve.http_front import HttpFront
+
+    base = tempfile.mkdtemp(prefix="eh-serve-load-smoke-")
+    journal_dir = os.path.join(base, "journal")
+    cache_dir = os.path.join(base, "xla")
+    events_path = os.path.join(base, "events.jsonl")
+    common = dict(
+        scheme="naive", n_workers=4, n_stragglers=1, rounds=2,
+        n_rows=64, n_cols=8, lr_schedule=0.5, add_delay=True,
+        compute_mode="deduped",
+    )
+
+    def jobs_for(tenant, n, seed0=0):
+        return [
+            (f"{tenant}-r{k}", {**common, "seed": seed0 + k})
+            for k in range(n)
+        ]
+
+    def make_front(**server_kw):
+        kw = dict(
+            window_s=0.05, journal_dir=journal_dir, cache_dir=cache_dir,
+            max_cohort=8,
+        )
+        kw.update(server_kw)
+        srv = serve_server.SweepServer(**kw).start()
+        front = HttpFront(srv)
+
+        def close():
+            front.close()
+            srv.stop()
+
+        return srv, front, front.host, front.port, close
+
+    with events_lib.capture(events_path):
+        # ---- closed-loop fleet + packing -------------------------------
+        d0 = REGISTRY.counter("serve.dispatches").value
+        _s, _f, host, port, close = make_front()
+        try:
+            fleet = loadgen.run_fleet(
+                host, port,
+                {f"t{k}": jobs_for(f"t{k}", 4, seed0=100 * k)
+                 for k in range(3)},
+                concurrency=4,
+            )
+        finally:
+            close()
+        dispatches = REGISTRY.counter("serve.dispatches").value - d0
+        assert fleet["lost"] == 0, fleet
+        assert fleet["duplicates"] == 0, fleet
+        rows = sum(led["rows"] for led in fleet["tenants"].values())
+        assert rows == 12, fleet
+        assert dispatches < 12, f"no packing: {dispatches} dispatches"
+        print(f"[serve-load-smoke] closed loop: 12 rows in {dispatches} "
+              f"dispatches, p99 ttfr {fleet['latency_p99_s']}s")
+
+        # ---- backpressure at ~2x capacity ------------------------------
+        _s, _f, host, port, close = make_front(max_pending=4)
+        try:
+            pressured = loadgen.run_fleet(
+                host, port,
+                {f"b{k}": jobs_for(f"b{k}", 4, seed0=1000 + 100 * k)
+                 for k in range(4)},
+                concurrency=4,
+                max_retries=12,
+            )
+        finally:
+            close()
+        assert pressured["rejected_429s"] > 0, (
+            "high-water mark never rejected under 2x load"
+        )
+        assert pressured["lost"] == 0, pressured
+        assert pressured["duplicates"] == 0, pressured
+        for led in pressured["tenants"].values():
+            assert led["rows"] == led["jobs"] - led["rejected_final"], led
+            assert led["rejected_final"] == 0, (
+                f"retry schedule exhausted: {led}"
+            )
+        print(f"[serve-load-smoke] backpressure: "
+              f"{pressured['rejected_429s']} 429s, "
+              f"{pressured['retries']} retries, 0 lost, 0 dups")
+
+        # ---- fairness under one flooding tenant ------------------------
+        # journal OFF for these phases: rehydration of the solo phase's
+        # rows would fake the contended goodput — this measures pure
+        # scheduling (all signatures already warm from the phases above)
+        import functools
+
+        fair = loadgen.fairness_run(
+            functools.partial(make_front, journal_dir=None),
+            victim_jobs={
+                f"v{k}": jobs_for(f"v{k}", 3, seed0=5000 + 100 * k)
+                for k in range(2)
+            },
+            flood_jobs=jobs_for("flood", 24, seed0=9000),
+            flood_concurrency=24,
+        )
+        assert fair["min_goodput_ratio"] is not None, fair
+        assert fair["min_goodput_ratio"] >= 0.5, (
+            f"fairness bar missed: min goodput ratio "
+            f"{fair['min_goodput_ratio']} < 0.5 ({fair['goodput_ratio']})"
+        )
+        print(f"[serve-load-smoke] fairness: victim goodput ratios "
+              f"{fair['goodput_ratio']} (bar 0.5)")
+
+        # ---- warm restart ----------------------------------------------
+        # fresh seeds: the first pass must genuinely dispatch (and write
+        # the on-disk cache) so the bounce proves rehydration, not reuse
+        restart = loadgen.restart_run(
+            make_front,
+            {f"r{k}": jobs_for(f"r{k}", 4, seed0=7000 + 100 * k)
+             for k in range(2)},
+            cache_dir=cache_dir,
+        )
+        assert restart["bitwise_mismatches"] == 0, restart
+        assert restart["resumed"] == restart["rows_resubmitted"], restart
+        assert restart["new_compile_cache_entries"] == 0, restart
+        print(f"[serve-load-smoke] restart: "
+              f"{restart['rows_resubmitted']} rows rehydrated bitwise, "
+              f"0 new compile-cache entries, "
+              f"{restart['restart_wall_s']}s wall")
+
+    # ---- event log + report ------------------------------------------
+    errors = events_lib.validate_file(events_path)
+    assert errors == [], errors[:5]
+    assert report_lib.main([events_path, "--validate"]) == 0
+    print("[serve-load-smoke] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"FAIL: {e}", file=sys.stderr)
+        sys.exit(1)
