@@ -44,7 +44,7 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 	test lint tier1 bench sweep rehearse watch compare real_data dryrun \
 	telemetry-smoke sweep-batch-smoke chaos-smoke roofline-smoke \
 	serve-smoke serve-load-smoke serve-chaos-smoke adapt-smoke \
-	deep-smoke elastic-smoke whatif-smoke clean
+	deep-smoke elastic-smoke whatif-smoke outofcore-smoke clean
 
 naive:            ## uncoded wait-for-all baseline (src/naive.py)
 	$(RUN) --scheme naive
@@ -132,6 +132,9 @@ serve-load-smoke: ## CPU HTTP-front load harness: closed-loop fleet, 2x-capacity
 
 serve-chaos-smoke: ## CPU restart-under-load with REAL kills: daemon dies mid-dispatch (chaos serve_dispatch), restarts, WAL replays, rows rehydrate bitwise, 0 recompiles of warm signatures (tools/serve_chaos_smoke.py)
 	JAX_PLATFORMS=cpu $(PY) tools/serve_chaos_smoke.py
+
+outofcore-smoke:  ## CPU shard-store->streamed sweep->kill mid-prefetch->resume: journal rehydrates completed rows bitwise (tools/outofcore_smoke.py)
+	JAX_PLATFORMS=cpu $(PY) tools/outofcore_smoke.py
 
 adapt-smoke:      ## CPU regime-shift drive of the adaptive controller: policy switches, adapt events validate, decisions replay bitwise (tools/adapt_smoke.py)
 	JAX_PLATFORMS=cpu $(PY) tools/adapt_smoke.py

@@ -249,6 +249,22 @@ def _flags_parser() -> argparse.ArgumentParser:
                         "fidelity cost is measured per scheme: bench.py "
                         "fidelity extra, decode-error columns); auto "
                         "follows --dtype")
+    p.add_argument("--stack-residency", default="resident",
+                   choices=["resident", "streamed", "auto"],
+                   help="where the partition stack LIVES: 'streamed' "
+                        "keeps it in an on-disk shard store (data/"
+                        "store.py) and materializes only a window of "
+                        "partitions per scan chunk, double-buffered by a "
+                        "host prefetcher — data larger than HBM trains "
+                        "on a fixed byte budget (ERASUREHEAD_STREAM_"
+                        "WINDOW); a window covering the whole stack is "
+                        "bitwise-identical to resident. 'auto' streams "
+                        "exactly when the budget env is set")
+    p.add_argument("--stream-window", type=int, default=None,
+                   help="streamed residency: partitions per window "
+                        "(default: sized so TWO windows fit the "
+                        "ERASUREHEAD_STREAM_WINDOW byte budget; rounded "
+                        "down to a divisor of the partition count)")
     p.add_argument("--donate", default="auto", choices=["auto", "on", "off"],
                    help="buffer donation for the training scan's carry "
                         "(params + optimizer state) and per-round weight "
@@ -417,6 +433,8 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         stack_mode=ns.stack_mode,
         ring_pipeline=ns.ring_pipeline,
         stack_dtype=ns.stack_dtype,
+        stack_residency=ns.stack_residency,
+        stream_window=ns.stream_window,
         donate=ns.donate,
         use_pallas=ns.use_pallas,
         dtype=ns.dtype,

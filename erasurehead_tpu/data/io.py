@@ -33,16 +33,24 @@ def save_dense_text(path: str, m: np.ndarray, fmt: str = "%.18g") -> None:
     np.savetxt(path, np.atleast_2d(m), fmt=fmt)
 
 
-def load_dense_text(path: str) -> np.ndarray:
+def load_dense_text(path: str, mmap: bool = True) -> np.ndarray:
     """Dense text matrix with a .npy cache sidecar.
 
     Cold loads go through the native from_chars parser (data/native,
     measured ~7x np.loadtxt on the 54000x100 reference shape) when the
     toolchain is available, np.loadtxt otherwise; both produce identical
-    arrays (pinned in test_native)."""
+    arrays (pinned in test_native).
+
+    Warm loads memory-map the .npy cache read-only (``mmap=True``, the
+    default) instead of materializing the full array eagerly: partitions
+    a run never touches never leave the page cache, which is what lets
+    the out-of-core path open a reference layout without paying its full
+    host footprint. Values are bitwise-identical either way (np.load
+    semantics; pinned in tests) — pass ``mmap=False`` for a private
+    writable copy."""
     cache = path + ".npy"
     if os.path.exists(cache) and os.path.getmtime(cache) >= os.path.getmtime(path):
-        return np.load(cache)
+        return np.load(cache, mmap_mode="r" if mmap else None)
     from erasurehead_tpu.data import native
 
     m = native.load_dense_text_native(path)

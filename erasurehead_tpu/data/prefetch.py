@@ -1,0 +1,195 @@
+"""Host→device prefetch pipeline for streamed partition stacks.
+
+The streamed trainer (train/trainer.py, ``stack_residency="streamed"``)
+consumes the shard store (data/store.py) one partition window per scan
+chunk. Left naive, every chunk boundary would serialize
+disk→host→device→compute; this module applies the same prologue/epilogue
+pipelining discipline as parallel/step's ring fill, on the host→device
+axis: while chunk ``i`` computes on device, a staging thread reads window
+``i+1`` from the shard mmaps into a bounded ring of reusable host
+buffers and ``jax.device_put``s it behind the running computation —
+dispatch is async, so the transfer overlaps the chunk that is already
+executing. ``get(i)`` then hands the trainer device-resident arrays,
+blocking only for whatever transfer time compute failed to hide (the
+blocked seconds are the pipeline's measured overhead; ``stats()`` turns
+them into the prefetch-overlap efficiency the bench extra reports).
+
+The ring is bounded (``depth`` windows, default 2 = classic double
+buffering), so host memory holds at most ``depth`` windows regardless of
+dataset size — the whole point of streaming. A host buffer is reused
+only after its device transfer commits (``block_until_ready`` on the
+staged leaves), never while a copy may still be reading it.
+
+Every staged window fires the ``prefetch`` chaos site
+(utils/chaos.maybe_fire — ``ERASUREHEAD_CHAOS=kill:prefetch:N`` is a
+mid-epoch preemption for the kill→resume harness, tools/
+outofcore_smoke.py) and emits a typed ``prefetch`` event into the
+current capture (obs/events.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from erasurehead_tpu.obs import events as events_lib
+from erasurehead_tpu.utils import chaos as chaos_lib
+
+#: ring depth: the window being consumed + the window in flight. Deeper
+#: rings only help when read time varies a lot between windows; they cost
+#: host RAM proportionally.
+DEFAULT_DEPTH = 2
+
+
+class Prefetcher:
+    """Bounded staging pipeline over a schedule of partition windows.
+
+    ``windows`` is the exact consume-order sequence of ``(lo, hi)``
+    partition ranges the trainer will request — one entry per scan chunk,
+    repeats allowed (epochs revisit windows). ``put`` maps the host
+    arrays of one window to device arrays (the trainer passes its
+    sharded ``device_put``); it runs on the staging thread, which is the
+    overlap. ``get(i)`` must be called for ``i = 0, 1, ...`` in order.
+
+    Errors on the staging thread (a torn store, a chaos ``raise``)
+    surface at the next ``get`` call — never silently, never deadlocked
+    (the ring slot the failed stage held is released with the error).
+    """
+
+    def __init__(
+        self,
+        store,
+        windows: Sequence[tuple],
+        put: Callable,
+        *,
+        depth: int = DEFAULT_DEPTH,
+        run_id: Optional[str] = None,
+    ):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.store = store
+        self.windows = [(int(lo), int(hi)) for lo, hi in windows]
+        self._put = put
+        self.run_id = run_id
+        self._ready: queue.Queue = queue.Queue(maxsize=depth)
+        # depth reusable host-buffer sets; slot i % depth backs window i,
+        # safe because the staging thread blocks the transfer to
+        # completion before moving on and the ready queue holds at most
+        # depth windows
+        self._bufs = [dict() for _ in range(depth)]
+        self._next_get = 0
+        self._blocked_s = 0.0
+        self._blocked_after_first_s = 0.0
+        self._fetch_s = 0.0
+        self._fetch_after_first_s = 0.0
+        self._bytes = 0
+        self._staged = 0
+        self._thread = threading.Thread(
+            target=self._run, name="eh-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    # -- staging thread ---------------------------------------------------
+
+    def _run(self) -> None:
+        for i, (lo, hi) in enumerate(self.windows):
+            try:
+                chaos_lib.maybe_fire("prefetch")
+                t0 = time.perf_counter()
+                X, y = self.store.read_window(
+                    lo, hi, out=self._bufs[i % len(self._bufs)]
+                )
+                dev = self._put(X, y)
+                # commit the transfer before the slot can be reused (and
+                # so fetch_s measures disk + PCIe, not dispatch)
+                jax.block_until_ready(dev)
+                dt = time.perf_counter() - t0
+                n_bytes = sum(
+                    np.asarray(leaf).nbytes
+                    for leaf in jax.tree.leaves((X, y))
+                )
+            except BaseException as e:  # noqa: BLE001 — repaired at get()
+                self._ready.put((i, None, e))
+                return
+            self._fetch_s += dt
+            if i:
+                self._fetch_after_first_s += dt
+            self._bytes += n_bytes
+            self._staged += 1
+            if self.run_id is not None:
+                events_lib.emit(
+                    "prefetch",
+                    run_id=self.run_id,
+                    window=i,
+                    bytes=n_bytes,
+                    partitions=[lo, hi],
+                    fetch_s=round(dt, 6),
+                )
+            self._ready.put((i, dev, None))
+
+    # -- consumer side ----------------------------------------------------
+
+    def get(self, i: int):
+        """Device arrays for window ``i`` (strictly in-order). Blocks
+        until staged; the wait is recorded as unhidden transfer time."""
+        if i != self._next_get:
+            raise ValueError(
+                f"prefetch windows are consumed in order; expected "
+                f"{self._next_get}, got {i}"
+            )
+        t0 = time.perf_counter()
+        idx, dev, err = self._ready.get()
+        waited = time.perf_counter() - t0
+        self._blocked_s += waited
+        if i:
+            self._blocked_after_first_s += waited
+        if err is not None:
+            raise err
+        assert idx == i, f"prefetch ring out of order: {idx} != {i}"
+        self._next_get += 1
+        return dev
+
+    def stats(self) -> dict:
+        """Pipeline telemetry for cache_info / the bench extra.
+
+        ``overlap_efficiency`` is the fraction of steady-state transfer
+        time hidden behind compute: 1 - blocked/fetch over every window
+        AFTER the first (the prologue window has nothing to hide
+        behind). 1.0 when a single window made the question moot."""
+        fetch = self._fetch_after_first_s
+        blocked = self._blocked_after_first_s
+        eff = 1.0 if fetch <= 0 else max(0.0, 1.0 - blocked / fetch)
+        return {
+            "windows": self._staged,
+            "bytes": int(self._bytes),
+            "fetch_s": round(self._fetch_s, 6),
+            "blocked_s": round(self._blocked_s, 6),
+            "overlap_efficiency": round(eff, 4),
+        }
+
+    def close(self) -> None:
+        """Drain and join the staging thread (idempotent)."""
+        t = self._thread
+        if t is None:
+            return
+        self._thread = None
+        while True:
+            try:
+                self._ready.get_nowait()
+            except queue.Empty:
+                if not t.is_alive():
+                    break
+                time.sleep(0.005)
+        t.join(timeout=10.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

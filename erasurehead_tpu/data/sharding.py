@@ -230,7 +230,14 @@ def estimate_worker_stack_bytes(dataset: Dataset, layout: CodingLayout, dtype) -
         per_row = nnz_per_row * (np.dtype(np.int32).itemsize + dtype.itemsize)
     else:
         per_row = X.shape[1] * dtype.itemsize
-    return int(layout.n_workers * layout.n_slots * rows * per_row)
+    est = int(layout.n_workers * layout.n_slots * rows * per_row)
+    if dtype == np.int8:
+        # a quantized stack is payload PLUS one f32 scale row per slot
+        # block (QuantizedStack.scale, [W, S, F] after the worker gather)
+        # — counting payload alone undercharges every int8 admission and
+        # auto-gate decision by W*S*F*4 bytes
+        est += layout.n_workers * layout.n_slots * X.shape[1] * 4
+    return est
 
 
 def resolve_ring_stack(
@@ -408,7 +415,22 @@ def shard_run_data(
                 "use stack_dtype float32/bfloat16 (or auto) with sparse "
                 "features"
             )
-        Xp_h = QuantizedStack.quantize(Xp_h)
+        # an int8 shard store (data/store.py) quantized at write time;
+        # reuse its (q, scale) tables verbatim — requantizing the
+        # dequantized row-major view would NOT be bitwise-stable
+        pre = getattr(dataset, "_store_prequantized", None)
+        if pre is not None:
+            if pre.q.shape[:1] != (layout.n_partitions,):
+                raise ValueError(
+                    f"shard store holds {pre.q.shape[0]} partitions; this "
+                    f"layout needs {layout.n_partitions} — rewrite the "
+                    f"store with the run's partition count"
+                )
+            Xp_h = QuantizedStack(
+                np.asarray(pre.q), np.asarray(pre.scale)
+            )
+        else:
+            Xp_h = QuantizedStack.quantize(Xp_h)
 
     def _cast(leaf):
         import jax.numpy as jnp

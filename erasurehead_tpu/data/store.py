@@ -1,0 +1,353 @@
+"""On-disk shard store: the out-of-core home of the partition stack
+(``stack_residency="streamed"``, utils/config.RunConfig).
+
+The reference sharded by writing one file per partition to NFS and having
+every MPI rank load its assignment eagerly at startup
+(src/approximate_coding.py:39-69) — disk was already the partition store,
+but residency was all-or-nothing. Here the store keeps that layout
+(partition-major ``.npy`` shards, each holding a contiguous group of
+partitions) and makes residency a *window*: the streamed trainer maps the
+shards read-only (``np.load(..., mmap_mode="r")``) and materializes only
+the partition window the next scan chunk needs, which data/prefetch.py
+double-buffers behind the current chunk's compute.
+
+Two store dtypes:
+
+- ``float32`` — shards hold the source rows verbatim. A full-window read
+  reassembles the training split bitwise, so :meth:`ShardStore.dataset`
+  can hand the ordinary resident pipeline an identical dataset (the
+  single-window fast path — streamed runs that fit stay bitwise equal to
+  resident ones across every scheme/transport/stack_dtype).
+- ``int8`` — partitions are quantized AT WRITE TIME through the same
+  :class:`~erasurehead_tpu.ops.features.QuantizedStack` quantizer the
+  resident ``stack_dtype="int8"`` path uses, so disk and PCIe bytes both
+  shrink ~4x. Quantization is partition-local (per-partition scale
+  tables), so the stored ``(q, scale)`` pair is identical to what a
+  resident run would compute from the same source rows — streamed int8
+  runs reuse the tables verbatim (requantizing a dequantized stack is NOT
+  bitwise-stable; reuse is) and stay bitwise-comparable to resident int8.
+
+Identity: the store carries the SOURCE dataset's sweep-journal content
+digest in its metadata, and :meth:`ShardStore.dataset` brands rehydrated
+datasets with it plus a stable ``("shard-store", digest, ...)`` cache
+token — so the device-data cache (train/cache.dataset_token) and the
+sweep journal (train/journal.dataset_digest) key streamed runs exactly as
+they key resident ones, and a kill→resume cycle rehydrates completed rows
+from the journal without touching the shards.
+
+Writes emit ``io`` events (kind="store_write"), reads emit
+``io``/"shard_read" — the byte-accounting stream behind the report's
+prefetch section (obs/report.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from erasurehead_tpu.data.synthetic import Dataset
+from erasurehead_tpu.obs import events as events_lib
+from erasurehead_tpu.ops.features import QuantizedStack
+
+#: store layout version (refuse forward-incompatible directories loudly)
+STORE_VERSION = 1
+
+#: metadata file inside a store directory
+META_NAME = "store_meta.json"
+
+#: default shard payload target: groups of partitions are sized so one
+#: shard file is ~this many bytes (one shard = one mmap + one sequential
+#: read per visiting window edge; too small multiplies file handles, too
+#: large defeats windowed reads on small stores)
+SHARD_TARGET_BYTES = 64 << 20
+
+#: store dtypes (the ON-DISK representation; the run's ``stack_dtype``
+#: still governs the device representation — an f32 store feeds any of
+#: them, an int8 store requires ``stack_dtype="int8"``)
+STORE_DTYPES = ("float32", "int8")
+
+
+def _emit_io(kind: str, n_bytes: int, **extra) -> None:
+    events_lib.emit("io", kind=kind, bytes=int(n_bytes), **extra)
+
+
+def partitions_per_shard(
+    rows: int, n_features: int, itemsize: int, n_partitions: int
+) -> int:
+    """Partitions grouped into one shard file (~SHARD_TARGET_BYTES)."""
+    per_part = max(1, rows * n_features * itemsize)
+    return int(min(n_partitions, max(1, SHARD_TARGET_BYTES // per_part)))
+
+
+def write_store(
+    dataset: Dataset,
+    directory: str,
+    n_partitions: int,
+    *,
+    stack_dtype: str = "float32",
+    group: Optional[int] = None,
+) -> "ShardStore":
+    """Shard ``dataset``'s training split into ``directory``.
+
+    Rows follow the trainer's partition convention (sharding.
+    partition_stack): rows_per_partition = n_samples // P, trailing
+    remainder dropped. Dense features only — the sparse stacks stream
+    through their own representations and are refused here, loudly.
+    ``stack_dtype="int8"`` quantizes each partition at write time (see
+    module docstring). The eval split rides along uncompressed (it is
+    read once, host-side).
+    """
+    if stack_dtype not in STORE_DTYPES:
+        raise ValueError(
+            f"store stack_dtype must be one of {STORE_DTYPES}, "
+            f"got {stack_dtype!r}"
+        )
+    X = dataset.X_train
+    if not isinstance(X, np.ndarray):
+        raise ValueError(
+            "shard store holds dense stacks only; this dataset's "
+            f"features are {type(X).__name__} — stream sparse data "
+            "through its CSR artifacts (data/io.py) instead"
+        )
+    n = dataset.n_samples
+    rows = n // n_partitions
+    if rows == 0:
+        raise ValueError(
+            f"{n} samples cannot fill {n_partitions} partitions"
+        )
+    # digest the SOURCE dataset before any truncation/quantization: the
+    # store inherits the identity the sweep journal would have computed
+    # (deferred import: train/journal imports obs; data must stay leaf)
+    from erasurehead_tpu.train import journal as journal_lib
+
+    digest = journal_lib.dataset_digest(dataset)
+    F = int(X.shape[1])
+    Xp = np.ascontiguousarray(
+        X[: rows * n_partitions].reshape(n_partitions, rows, F)
+    )
+    yp = np.ascontiguousarray(
+        np.asarray(dataset.y_train)[: rows * n_partitions].reshape(
+            n_partitions, rows
+        )
+    )
+    G = int(group) if group else partitions_per_shard(
+        rows, F, Xp.dtype.itemsize, n_partitions
+    )
+    if G < 1:
+        raise ValueError(f"shard group must be >= 1, got {G}")
+    os.makedirs(directory, exist_ok=True)
+    quantized = stack_dtype == "int8"
+    shard_parts = []
+    total = 0
+    for i, lo in enumerate(range(0, n_partitions, G)):
+        hi = min(lo + G, n_partitions)
+        block = Xp[lo:hi]
+        if quantized:
+            qs = QuantizedStack.quantize(block)
+            np.save(os.path.join(directory, f"shard_{i:05d}.npy"), qs.q)
+            np.save(os.path.join(directory, f"scale_{i:05d}.npy"), qs.scale)
+            total += qs.q.nbytes + qs.scale.nbytes
+        else:
+            np.save(os.path.join(directory, f"shard_{i:05d}.npy"), block)
+            total += block.nbytes
+        np.save(os.path.join(directory, f"labels_{i:05d}.npy"), yp[lo:hi])
+        total += yp[lo:hi].nbytes
+        shard_parts.append(hi - lo)
+    np.save(
+        os.path.join(directory, "X_test.npy"), np.asarray(dataset.X_test)
+    )
+    np.save(
+        os.path.join(directory, "y_test.npy"), np.asarray(dataset.y_test)
+    )
+    meta = {
+        "version": STORE_VERSION,
+        "name": dataset.name,
+        "n_partitions": int(n_partitions),
+        "rows_per_partition": int(rows),
+        "n_features": F,
+        "source_dtype": str(Xp.dtype),
+        "label_dtype": str(yp.dtype),
+        "stack_dtype": stack_dtype,
+        "shard_parts": shard_parts,
+        "digest": digest,
+    }
+    with open(os.path.join(directory, META_NAME), "w") as f:
+        json.dump(meta, f, indent=1, sort_keys=True)
+    _emit_io("store_write", total, path=directory, shards=len(shard_parts))
+    return ShardStore(directory)
+
+
+class ShardStore:
+    """Read side of a shard-store directory: memory-mapped partition
+    shards plus the metadata that makes streamed runs keyable.
+
+    Shards open lazily with ``np.load(..., mmap_mode="r")`` — opening a
+    store touches only the metadata, and a window read pages in only the
+    rows it copies out. All reads assemble fresh (or caller-provided)
+    host arrays: the mmaps never leak into device_put (a page-faulting
+    transfer would serialize the prefetch pipeline behind disk).
+    """
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        path = os.path.join(directory, META_NAME)
+        with open(path) as f:
+            meta = json.load(f)
+        if meta.get("version") != STORE_VERSION:
+            raise ValueError(
+                f"{path}: store version {meta.get('version')!r} != "
+                f"{STORE_VERSION} (rewrite the store with this build's "
+                f"data/prepare.py)"
+            )
+        self.meta = meta
+        self.n_partitions: int = int(meta["n_partitions"])
+        self.rows_per_partition: int = int(meta["rows_per_partition"])
+        self.n_features: int = int(meta["n_features"])
+        self.stack_dtype: str = meta["stack_dtype"]
+        self.quantized: bool = self.stack_dtype == "int8"
+        self.digest: str = meta["digest"]
+        #: first partition of each shard (shard s covers
+        #: [starts[s], starts[s+1]))
+        self._starts = np.concatenate(
+            [[0], np.cumsum(meta["shard_parts"])]
+        ).astype(np.int64)
+        self._mmaps: dict = {}
+
+    @property
+    def cache_token(self) -> tuple:
+        """Stable device-data-cache brand (train/cache.dataset_token):
+        content-addressed, so two opens of one store — or a killed and a
+        resumed process — key the same cached stacks."""
+        return ("shard-store", self.digest, self.stack_dtype)
+
+    def partition_bytes(self) -> int:
+        """Host/PCIe bytes one partition's window slice costs (payload +
+        labels + the int8 scale row — the unit serve admission and the
+        auto-window resolver charge in)."""
+        rows, F = self.rows_per_partition, self.n_features
+        label = np.dtype(self.meta["label_dtype"]).itemsize
+        if self.quantized:
+            return rows * F + F * 4 + rows * label
+        src = np.dtype(self.meta["source_dtype"]).itemsize
+        return rows * F * src + rows * label
+
+    def _mmap(self, prefix: str, shard: int):
+        key = (prefix, shard)
+        arr = self._mmaps.get(key)
+        if arr is None:
+            arr = np.load(
+                os.path.join(self.directory, f"{prefix}_{shard:05d}.npy"),
+                mmap_mode="r",
+            )
+            self._mmaps[key] = arr
+        return arr
+
+    def read_window(self, lo: int, hi: int, out: Optional[dict] = None):
+        """Materialize partitions [lo, hi) as host arrays.
+
+        Returns ``(X, y)`` with ``X`` a ``[hi-lo, rows, F]`` ndarray
+        (f32 store) or :class:`QuantizedStack` (int8 store) and ``y``
+        ``[hi-lo, rows]``. ``out`` — a dict of preallocated buffers under
+        keys ``"X"``/``"y"``(/``"scale"``) — is filled in place when
+        shapes match (the prefetch ring's reuse path). Emits one ``io``
+        shard_read record for the bytes copied."""
+        if not 0 <= lo < hi <= self.n_partitions:
+            raise ValueError(
+                f"window [{lo}, {hi}) outside "
+                f"[0, {self.n_partitions}) partitions"
+            )
+        w = hi - lo
+        rows, F = self.rows_per_partition, self.n_features
+        out = out if out is not None else {}
+
+        def buf(key, shape, dtype):
+            b = out.get(key)
+            if b is None or b.shape != shape or b.dtype != np.dtype(dtype):
+                b = np.empty(shape, dtype)
+                out[key] = b
+            return b
+
+        X = buf(
+            "X", (w, rows, F),
+            np.int8 if self.quantized else self.meta["source_dtype"],
+        )
+        y = buf("y", (w, rows), self.meta["label_dtype"])
+        scale = (
+            buf("scale", (w, F), np.float32) if self.quantized else None
+        )
+        p = lo
+        while p < hi:
+            s = int(np.searchsorted(self._starts, p, side="right")) - 1
+            blk_lo, blk_hi = int(self._starts[s]), int(self._starts[s + 1])
+            a, b = p - blk_lo, min(hi, blk_hi) - blk_lo
+            dst = slice(p - lo, p - lo + (b - a))
+            X[dst] = self._mmap("shard", s)[a:b]
+            y[dst] = self._mmap("labels", s)[a:b]
+            if scale is not None:
+                scale[dst] = self._mmap("scale", s)[a:b]
+            p += b - a
+        n_bytes = X.nbytes + y.nbytes + (
+            scale.nbytes if scale is not None else 0
+        )
+        _emit_io("shard_read", n_bytes, partitions=[int(lo), int(hi)])
+        if self.quantized:
+            return QuantizedStack(X, scale), y
+        return X, y
+
+    def eval_split(self):
+        """The uncompressed eval split (read eagerly; it is small and
+        host-side)."""
+        X_test = np.load(os.path.join(self.directory, "X_test.npy"))
+        y_test = np.load(os.path.join(self.directory, "y_test.npy"))
+        return X_test, y_test
+
+    def dataset(self) -> Dataset:
+        """Rehydrate a resident-equivalent Dataset (the single-window
+        fast path: a streamed run whose window covers every partition is
+        the resident run, so the trainer swaps this in and takes the
+        ordinary pipeline — bitwise-identically for an f32 store).
+
+        An int8 store dequantizes for the row-major view but ALSO brands
+        the object with the stored stack (``_store_prequantized``) so
+        sharding.shard_run_data reuses the write-time ``(q, scale)``
+        tables instead of requantizing the reconstruction (which would
+        not be bitwise-stable). Branded with the source digest and a
+        content-addressed cache token, so journal and device-data-cache
+        keys match runs over the original dataset."""
+        P, rows = self.n_partitions, self.rows_per_partition
+        X, y = self.read_window(0, P)
+        pre = None
+        if self.quantized:
+            pre = X
+            X = np.asarray(pre.dequantize())
+        X_test, y_test = self.eval_split()
+        ds = Dataset(
+            X_train=np.ascontiguousarray(X.reshape(P * rows, -1)),
+            y_train=np.ascontiguousarray(y.reshape(P * rows)),
+            X_test=X_test,
+            y_test=y_test,
+            name=self.meta.get("name", "shard-store"),
+        )
+        ds._sweep_journal_digest = self.digest
+        ds._sweep_cache_token = self.cache_token
+        ds._shard_store = self
+        if pre is not None:
+            ds._store_prequantized = pre
+        return ds
+
+    def close(self) -> None:
+        self._mmaps.clear()
+
+
+def open_store(directory: str) -> ShardStore:
+    """Open an existing store directory (loud when absent)."""
+    if not os.path.exists(os.path.join(directory, META_NAME)):
+        raise FileNotFoundError(
+            f"{directory!r} is not a shard store (no {META_NAME}; write "
+            f"one with `python -m erasurehead_tpu.data.prepare ... "
+            f"--store DIR`)"
+        )
+    return ShardStore(directory)

@@ -133,6 +133,17 @@ SCHEMA: dict[str, tuple] = {
     # surface artifact is attributable to its event stream and a
     # rehydrated run is distinguishable from a simulated one.
     "whatif": ("spec_hash", "kind"),
+    # one per staged partition window of a streamed run
+    # (data/prefetch.Prefetcher): which window index moved how many
+    # host→device bytes; the optional ``fetch_s`` / ``partitions``
+    # fields carry the stage's disk+PCIe seconds and its [lo, hi)
+    # partition range — the per-window record behind the report's
+    # prefetch section and the bench extra's overlap-efficiency figure
+    "prefetch": ("run_id", "window", "bytes"),
+    # one per shard-store disk transaction (data/store.py): "kind" says
+    # which (:data:`IO_KINDS` — a window read off the mmapped shards, or
+    # a store write by data/prepare.py) and ``bytes`` how much moved
+    "io": ("kind", "bytes"),
 }
 
 #: adapt decision reasons (adapt/controller.AdaptiveController.choose)
@@ -156,6 +167,10 @@ REJECT_REASONS = ("overloaded", "unauthorized")
 #: feasibility filter, "point" = one reduced surface row, "surface" =
 #: artifact saved, "rehydrate" = identical spec served from its artifact
 WHATIF_KINDS = ("grid", "point", "surface", "rehydrate")
+
+#: shard-store io transaction kinds (data/store.py): a windowed read off
+#: the mmapped shards, or a store write (data/prepare.py ``--store``)
+IO_KINDS = ("shard_read", "store_write")
 
 #: sweep_trajectory completion statuses (train/journal.py); "diverged"
 #: rows are quarantined, not retried — divergence is deterministic under
@@ -460,7 +475,11 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
     worker ids; ``whatif`` records carry a non-empty ``spec_hash`` and a
     known ``kind`` (:data:`WHATIF_KINDS`), point records a non-empty
     label and a bool feasibility verdict, grid records non-negative point
-    counts; every ``run_start`` has a matching later ``run_end``."""
+    counts; ``prefetch`` records carry a non-negative window index and
+    byte count (plus, when present, non-negative ``fetch_s`` seconds);
+    ``io`` records carry a known kind (:data:`IO_KINDS`) and a
+    non-negative byte count; every ``run_start`` has a matching later
+    ``run_end``."""
     errors: list[str] = []
     # seq checking is MULTI-STREAM: a file may interleave several
     # append-mode loggers (concurrent journal writers, the serve daemon
@@ -748,6 +767,35 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
                             f"line {i}: whatif grid {field} must be a "
                             f"non-negative int, got {v!r}"
                         )
+        if rtype == "prefetch":
+            for field in ("window", "bytes"):
+                v = rec.get(field)
+                if not isinstance(v, int) or v < 0:
+                    errors.append(
+                        f"line {i}: prefetch {field} must be a "
+                        f"non-negative int, got {v!r}"
+                    )
+            fs = rec.get("fetch_s")
+            if fs is not None and (
+                not isinstance(fs, (int, float)) or fs < 0
+            ):
+                errors.append(
+                    f"line {i}: prefetch fetch_s must be a non-negative "
+                    f"number, got {fs!r}"
+                )
+        if rtype == "io":
+            kind = rec.get("kind")
+            if kind not in IO_KINDS:
+                errors.append(
+                    f"line {i}: io kind must be one of {IO_KINDS}, "
+                    f"got {kind!r}"
+                )
+            v = rec.get("bytes")
+            if not isinstance(v, int) or v < 0:
+                errors.append(
+                    f"line {i}: io bytes must be a non-negative int, "
+                    f"got {v!r}"
+                )
         if rtype == "run_start":
             started.add(rec.get("run_id"))
         if rtype == "run_end":

@@ -18,8 +18,9 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
     Returns one dict per run: {"run_id", "start": run_start|None,
     "end": run_end|None, "compiles": [...], "uploads": [...],
     "rounds": [...], "decode": [...], "cohort": cohort|None,
-    "warnings": [...]}. A trailing run_id=None entry carries stray
-    warnings, any ``sweep_trajectory`` journal records (a sweep
+    "warnings": [...], "prefetch": [...]}. A trailing run_id=None entry
+    carries stray warnings, shard-store ``io`` records (out-of-core
+    byte accounting), any ``sweep_trajectory`` journal records (a sweep
     journal is an events.jsonl like any other — `report` renders its
     rows, diverged ones flagged), and the serve daemon's
     request/pack/admit/evict stream (rendered as the per-tenant serving
@@ -32,6 +33,7 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
     trajectories: list = []
     adapt: list = []
     membership: list = []
+    io: list = []
     serve: dict = {
         "requests": [], "packs": [], "admits": [], "evicts": [],
         "rejects": [], "streams": [], "restarts": [],
@@ -42,7 +44,7 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
             runs[rid] = {
                 "run_id": rid, "start": None, "end": None, "compiles": [],
                 "uploads": [], "rounds": [], "decode": [], "cohort": None,
-                "warnings": [],
+                "warnings": [], "prefetch": [],
             }
             order.append(rid)
         return runs[rid]
@@ -95,15 +97,19 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
                     serve["streams"].append(rec)
                 elif rtype == "restart":
                     serve["restarts"].append(rec)
+                elif rtype == "prefetch":
+                    run(rid)["prefetch"].append(rec)
+                elif rtype == "io":
+                    io.append(rec)
     out = [runs[rid] for rid in order]
     if (
-        warnings or trajectories or adapt or membership
+        warnings or trajectories or adapt or membership or io
         or any(serve.values())
     ):
         out.append({
             "run_id": None, "warnings": warnings,
             "trajectories": trajectories, "serve": serve,
-            "adapt": adapt, "membership": membership,
+            "adapt": adapt, "membership": membership, "io": io,
         })
     return out
 
@@ -185,6 +191,37 @@ def _membership_section(stray: list) -> list[str]:
             f"sim={_fmt(r.get('sim_time'), '.3f'):>8s} "
             f"decode_err={_fmt(r.get('decode_error_mean'), '.6f')}"
             + (f" arm={arm}" if arm else "")
+        )
+    return lines
+
+
+def _prefetch_section(groups: list, stray: list) -> list[str]:
+    """The out-of-core streaming section: per streamed run, how many
+    partition windows moved how many host→device bytes and how much of
+    the transfer time compute hid; plus the shard-store disk totals —
+    from the ``prefetch`` (per-run) and ``io`` (stray) records."""
+    streamed = [g for g in groups if g.get("prefetch")]
+    io = [r for g in stray for r in g.get("io", [])]
+    if not streamed and not io:
+        return []
+    lines = ["\nout-of-core streaming (shard store + prefetch):"]
+    for g in streamed:
+        pf = g["prefetch"]
+        total = sum(p.get("bytes", 0) for p in pf)
+        fetch = sum(p.get("fetch_s") or 0.0 for p in pf)
+        lines.append(
+            f"  {str(g['run_id'])[:16]:16s} {len(pf)} window(s), "
+            f"{total / (1 << 20):.1f} MiB staged, "
+            f"fetch {fetch:.3f}s"
+        )
+    reads = [r for r in io if r.get("kind") == "shard_read"]
+    writes = [r for r in io if r.get("kind") == "store_write"]
+    if reads or writes:
+        rb = sum(r.get("bytes", 0) for r in reads)
+        wb = sum(r.get("bytes", 0) for r in writes)
+        lines.append(
+            f"  shard io: {len(reads)} read(s) {rb / (1 << 20):.1f} MiB, "
+            f"{len(writes)} write(s) {wb / (1 << 20):.1f} MiB"
         )
     return lines
 
@@ -365,6 +402,7 @@ def render(paths: Sequence[str]) -> str:
                 f"{c.get('n_trajectories', len(seeds))} trajectories in "
                 f"{disp} dispatch(es) [{c.get('lowering', '?')}]"
             )
+    lines.extend(_prefetch_section(groups, stray))
     lines.extend(_serve_section(stray))
     lines.extend(_adapt_section(stray))
     lines.extend(_membership_section(stray))

@@ -60,7 +60,16 @@ def estimate_cohort_bytes(cohort, width: Optional[int] = None) -> int:
     stack (the pack key guarantees the cohort shares it) + width-scaled
     per-round weight tables + per-trajectory slack. ``width`` overrides
     the trajectory count (the server's fixed-width padded dispatch really
-    allocates ``max_cohort`` table columns)."""
+    allocates ``max_cohort`` table columns).
+
+    ``stack_residency="streamed"`` payloads are charged their resident
+    WINDOW, not the whole stack: trainer.estimate_stack_bytes resolves the
+    stream window (explicit ``stream_window`` or the host's
+    ERASUREHEAD_STREAM_WINDOW budget) and bounds the stack term at two
+    windows (compute + prefetch double buffer). Streamed requests never
+    pack with resident ones — residency rides the static signature, so
+    the pack key separates them by construction (tests/test_outofcore.py
+    pins the negative)."""
     first = cohort.requests[0]
     cfg = first.config
     stack = trainer.estimate_stack_bytes(cfg, first.dataset)

@@ -192,6 +192,10 @@ CONFIG_PAYLOAD_FIELDS = frozenset(
         # deliberately absent like input_dir: arrival_trace points the
         # daemon at a host path — a remote client must not
         "scan_unroll", "sparse_format", "fields_scatter", "fields_margin",
+        # out-of-core streaming: residency + window COUNT are plain wire
+        # values (admission charges streamed payloads by the window); the
+        # shard-store PATH stays host-side, derived from the dataset
+        "stack_residency", "stream_window",
     }
 )
 

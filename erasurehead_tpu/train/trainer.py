@@ -621,6 +621,91 @@ class TrainResult:
     run_id: Optional[str] = None
 
 
+def _resolve_residency(cfg: RunConfig) -> str:
+    """RESOLVED stack residency (cfg.stack_residency; "auto" streams
+    exactly when the host declares a device byte budget via
+    ERASUREHEAD_STREAM_WINDOW — a budget is the only signal that the
+    resident stack might not fit, and without one streaming would only
+    add staging latency)."""
+    if cfg.stack_residency != "auto":
+        return cfg.stack_residency
+    from erasurehead_tpu.utils.config import resolve_stream_budget
+
+    return "streamed" if resolve_stream_budget() is not None else "resident"
+
+
+def _ensure_store(cfg: RunConfig, dataset: Dataset):
+    """The shard store behind a streamed run: reuse the store the dataset
+    was rehydrated from (store.dataset() brands ``_shard_store``), else
+    spill the in-memory dataset into a temp-dir store once and brand it so
+    every later run of the same sweep shares the one spill. A pre-existing
+    store must match the run's partition count — the partition grouping is
+    baked into the shard files at write time."""
+    from erasurehead_tpu.data import store as store_lib
+
+    layout = build_layout(cfg)
+    store = getattr(dataset, "_shard_store", None)
+    if store is not None:
+        if store.n_partitions != layout.n_partitions:
+            raise ValueError(
+                f"shard store at {store.directory!r} holds "
+                f"{store.n_partitions} partitions; this run's layout needs "
+                f"{layout.n_partitions} — rewrite the store "
+                f"(data/prepare.py --store) with the run's partition count"
+            )
+        if store.quantized and cfg.resolve_stack_dtype() != "int8":
+            raise ValueError(
+                f"shard store at {store.directory!r} is quantized (int8); "
+                f"this run resolves stack_dtype="
+                f"{cfg.resolve_stack_dtype()!r} — training on the "
+                "dequantized reconstruction would silently lose precision; "
+                "use stack_dtype='int8' or rewrite the store as float32"
+            )
+        return store
+    import tempfile
+
+    store = store_lib.write_store(
+        dataset,
+        tempfile.mkdtemp(prefix="eh-shard-store-"),
+        layout.n_partitions,
+        stack_dtype=(
+            "int8" if cfg.resolve_stack_dtype() == "int8" else "float32"
+        ),
+    )
+    dataset._shard_store = store
+    return store
+
+
+def _resolve_stream_window(
+    cfg: RunConfig, n_partitions: int, partition_bytes: int
+) -> int:
+    """Partitions per streamed window.
+
+    An explicit ``cfg.stream_window`` wins; else the host byte budget
+    (ERASUREHEAD_STREAM_WINDOW) divided by TWO windows' worth of bytes —
+    the double buffer keeps the current window AND the prefetched next one
+    resident. No knob and no budget → one full-stack window. Sub-full
+    windows round DOWN to a divisor of P so every window has the same
+    shape: one compiled executable serves every chunk, and any worker
+    mesh that divides the window divides all of them."""
+    P = int(n_partitions)
+    if cfg.stream_window is not None:
+        w = int(cfg.stream_window)
+    else:
+        from erasurehead_tpu.utils.config import resolve_stream_budget
+
+        budget = resolve_stream_budget()
+        if budget is None:
+            return P
+        w = int(budget // max(1, 2 * int(partition_bytes)))
+    if w >= P:
+        return P
+    w = max(1, w)
+    while P % w:
+        w -= 1
+    return w
+
+
 @_with_run_sparse_lanes
 def train(
     cfg: RunConfig,
@@ -663,6 +748,29 @@ def train(
         raise ValueError(
             f"checkpoint_every must be >= 1, got {checkpoint_every}"
         )
+    # ---- stack residency (out-of-core streaming; data/store.py) -----------
+    # resolved before any device setup. Streamed runs live out of a shard
+    # store; when the resolved window covers every partition the store's
+    # rehydrated view rides the UNCHANGED resident pipeline below (bitwise-
+    # identical by construction — the parity tests/test_outofcore.py pins),
+    # otherwise the windowed block trainer streams partition windows under
+    # the byte budget with a double-buffered prefetcher.
+    residency = _resolve_residency(cfg)
+    if residency == "streamed":
+        store = _ensure_store(cfg, dataset)
+        stream_window = _resolve_stream_window(
+            cfg, store.n_partitions, store.partition_bytes()
+        )
+        if stream_window < store.n_partitions:
+            return _train_streamed(
+                cfg, dataset, store, stream_window,
+                mesh=mesh, arrivals=arrivals, schedule=schedule,
+                checkpoint_dir=checkpoint_dir, resume=resume,
+                measure=measure, initial_state=initial_state,
+                initial_round=initial_round,
+            )
+        if getattr(dataset, "_sweep_cache_token", None) != store.cache_token:
+            dataset = store.dataset()
     from erasurehead_tpu.train import cache as cache_lib
 
     stats_before = cache_lib.stats().snapshot()
@@ -1060,6 +1168,365 @@ def train(
             "donation": donate,
             "stack_bytes": cache_lib.device_nbytes(data),
             "memory_analysis": mem_info,
+            # RESOLVED stack residency: "streamed" here means the run's
+            # window covered the whole stack (the single-window fast path
+            # — same resident pipeline, fed from the shard store)
+            "residency": residency,
+        },
+    )
+
+
+def _train_streamed(
+    cfg: RunConfig,
+    dataset: Dataset,
+    store,
+    window: int,
+    mesh=None,
+    arrivals: Optional[np.ndarray] = None,
+    schedule: Optional[collect.CollectionSchedule] = None,
+    checkpoint_dir: Optional[str] = None,
+    resume: bool = False,
+    measure: bool = True,
+    initial_state: Optional[Any] = None,
+    initial_round: int = 0,
+) -> TrainResult:
+    """Windowed streamed trainer: the partition stack never fully resides
+    on device. ``window`` partitions (a divisor of P, from
+    _resolve_stream_window) are materialized per scan chunk while
+    data/prefetch.py stages the NEXT window's shard read + host→device
+    transfer behind the current chunk's compute — at most two windows of
+    device bytes are ever pinned.
+
+    Semantics: BLOCK training, not a bitwise replay of the resident run —
+    each round's gradient reads ONE partition window (n_train is the
+    window's row count), and rounds cycle through the windows in fixed
+    order. Deterministic run-to-run for a given (config, store), which is
+    what lets the sweep journal rehydrate killed runs. The deduped scan
+    path only: faithful/ring stacks gather across the WHOLE partition
+    axis and the fused/flat/blockwise lowerings have no windowed body, so
+    those knobs are refused loudly rather than silently resident.
+
+    Reference mapping: the closest the reference could come was every MPI
+    rank eagerly loading its whole NFS assignment at startup
+    (src/approximate_coding.py:39-69) — data larger than cluster memory
+    simply could not run. Here the store IS the NFS share and residency
+    is a sliding window over it.
+    """
+    if cfg.compute_mode == ComputeMode.FAITHFUL:
+        raise ValueError(
+            "streamed windows support compute_mode='deduped' only: the "
+            "faithful worker-major stack gathers across the whole "
+            "partition axis; raise ERASUREHEAD_STREAM_WINDOW / "
+            "stream_window or run deduped"
+        )
+    if cfg.use_pallas == "on" or cfg.flat_grad == "on" \
+            or cfg.layer_coding == "on":
+        raise ValueError(
+            "streamed windows use the plain deduped scan body; "
+            "use_pallas/flat_grad/layer_coding cannot be forced 'on' "
+            "with a sub-full stream window"
+        )
+    if checkpoint_dir or resume or initial_state is not None \
+            or initial_round:
+        raise ValueError(
+            "checkpoint/resume/mid-schedule restart are not supported on "
+            "the windowed streamed path (kill→resume recovery is the "
+            "sweep journal's trajectory rehydration; see "
+            "tools/outofcore_smoke.py)"
+        )
+    if _model_axis_request(cfg) is not None:
+        raise ValueError(
+            "streamed windows have no model-parallel (2-D mesh) body; "
+            "run those configs resident"
+        )
+    from erasurehead_tpu.data.prefetch import Prefetcher
+    from erasurehead_tpu.obs import decode as obs_decode
+    from erasurehead_tpu.obs import detect as obs_detect
+    from erasurehead_tpu.obs import events as obs_events
+    from erasurehead_tpu.ops.features import QuantizedStack
+    from erasurehead_tpu.parallel import mesh as mesh_lib
+    from erasurehead_tpu.train import cache as cache_lib
+    from erasurehead_tpu.utils.tracing import annotate
+
+    stats_before = cache_lib.stats().snapshot()
+    layout = build_layout(cfg)
+    model = build_model(cfg)
+    P, rows = store.n_partitions, store.rows_per_partition
+    n_windows = P // window  # window divides P (resolver contract)
+    if mesh is None:
+        mesh = _auto_mesh(window)
+    mesh_lib.check_divisible(window, mesh, "stream_window")
+    if hasattr(model, "for_mesh"):
+        model = model.for_mesh(mesh)
+    stack_dtype = cfg.resolve_stack_dtype()
+    if store.quantized and stack_dtype != "int8":
+        raise ValueError(
+            f"int8 shard store requires stack_dtype='int8' (resolved "
+            f"{stack_dtype!r}): re-uploading a dequantized window would "
+            "silently train on reconstructed values"
+        )
+    cast_dtype = jnp.dtype(
+        cfg.dtype if stack_dtype == "int8" else stack_dtype
+    )
+
+    # ---- control plane: identical to the resident trainer -----------------
+    if arrivals is None:
+        arrivals = default_arrivals(cfg)
+    if schedule is None:
+        schedule = collect.build_schedule(
+            cfg.scheme, arrivals, layout, num_collect=cfg.num_collect,
+            deadline=cfg.deadline, decode=cfg.decode,
+        )
+    decode_err = obs_decode.decode_error_series(
+        layout, schedule.message_weights
+    )
+    run_id = obs_events.new_run_id() if obs_events.current() else None
+    lr = cfg.resolve_lr_schedule()
+    alpha = cfg.effective_alpha
+    n_train = window * rows  # the block each round's gradient averages
+    dtype = jnp.float32
+    slot_w = np.asarray(
+        step_lib.expand_slot_weights(
+            schedule.message_weights,
+            layout.coeffs,
+            np.asarray(layout.slot_is_coded),
+        )
+    )
+    pw = np.asarray(layout.fold_slot_weights(slot_w))  # [R, P]
+    grad_fn = step_lib.make_deduped_grad_fn(model, mesh)
+    update_fn = optimizer.make_update_fn(cfg.update_rule)
+    state0 = optimizer.init_state(
+        _init_params_f32(cfg, model, store.n_features), cfg.update_rule
+    )
+    state0 = jax.tree.map(
+        lambda l: put_global(np_global(l), replicated(mesh)), state0
+    )
+
+    # round chunks: each chunk consumes ONE window; chunk i's window index
+    # cycles i mod n_windows, so every window is visited once rounds cover
+    # n_windows chunks (fewer rounds visit a deterministic prefix)
+    L = max(1, cfg.rounds // n_windows)
+    bounds = list(range(0, cfg.rounds, L)) + [cfg.rounds]
+    chunks = [
+        (lo, hi) for lo, hi in zip(bounds[:-1], bounds[1:]) if hi > lo
+    ]
+    win_of = [i % n_windows for i in range(len(chunks))]
+    windows = [(k * window, (k + 1) * window) for k in win_of]
+
+    sharding = mesh_lib.worker_sharding(mesh)
+    quantize = stack_dtype == "int8"
+
+    def _cast(arr, to):
+        arr = np.asarray(arr)
+        return arr.astype(to) if np.issubdtype(
+            arr.dtype, np.floating
+        ) else arr
+
+    def put(Xh, yh):
+        # runs on the prefetch staging thread: pure host->device transfer
+        # (plus the f32-store int8 quantization, which is partition-local
+        # and therefore identical to what the resident path computes)
+        if quantize:
+            qs = (
+                Xh if isinstance(Xh, QuantizedStack)
+                else QuantizedStack.quantize(np.asarray(Xh))
+            )
+            Xd = QuantizedStack(
+                put_global(np.asarray(qs.q), sharding),
+                put_global(np.asarray(qs.scale), sharding),
+            )
+        else:
+            Xd = put_global(_cast(Xh, cast_dtype), sharding)
+        return Xd, put_global(_cast(yh, cast_dtype), sharding)
+
+    lr_np = np.asarray(lr)
+    iters_np = np.arange(cfg.rounds)
+
+    def body(Xa, ya, state, xs):
+        eta, w_t, i = xs
+        with annotate("eh_scan/coded_step"):
+            g = grad_fn(state.params, Xa, ya, w_t)
+        with annotate("eh_scan/update"):
+            new_state = update_fn(state, g, eta, alpha, n_train, i)
+        return new_state, new_state.params
+
+    def _run(state, Xa, ya, lr_c, w_c, it_c):
+        return jax.lax.scan(
+            partial(body, Xa, ya), state, (lr_c, w_c, it_c),
+            unroll=cfg.scan_unroll,
+        )
+
+    donate = _resolve_donate(cfg)
+    run = jax.jit(_run, donate_argnums=(0, 4) if donate else ())
+
+    def slices(lo, hi, k):
+        plo = k * window
+        return (
+            jnp.asarray(lr_np[lo:hi], dtype),
+            jnp.asarray(pw[lo:hi, plo:plo + window], dtype),
+            jnp.asarray(iters_np[lo:hi], dtype),
+        )
+
+    platform = jax.devices()[0].platform
+    exec_hits = exec_misses = 0
+    compile_seconds = 0.0
+    pieces = []
+    wall = 0.0
+    state = state0
+    mem_info = None
+    pf = Prefetcher(store, windows, put, run_id=run_id)
+    try:
+        # the first window synchronously: its device arrays type the
+        # lowering (and the prefetcher is already staging window 1)
+        X0, y0 = pf.get(0)
+        window_nbytes = cache_lib.device_nbytes((X0, y0))
+        if run_id is not None:
+            _emit_run_start(
+                run_id, cfg,
+                _RunSetup(
+                    layout=layout, model=model, mesh=mesh, data=(X0, y0),
+                    state0=state0, update_fn=update_fn, lr=lr,
+                    alpha=alpha, n_train=n_train, stack_dtype=stack_dtype,
+                ),
+                platform, step_lib.lowering_signature(cfg, model, X0),
+                faithful=False,
+            )
+        sig_fields = _exec_signature_fields(
+            "scan-streamed", platform, cfg, model, X0, y0, False, None,
+            (window,), mesh, state0, alpha, n_train, donation=donate,
+        )
+        exec_sig = tuple(sig_fields.values())
+        compiled = {}
+        for idx, (lo, hi) in enumerate(chunks):
+            n = hi - lo
+            if n in compiled:
+                continue
+
+            def _compile(lo=lo, hi=hi, k=win_of[idx]):
+                t0 = time.perf_counter()
+                with _quiet_donation_warnings():
+                    ex = run.lower(
+                        state0, X0, y0, *slices(lo, hi, k)
+                    ).compile()
+                if measure:
+                    lr_c, w_c, it_c = slices(lo, hi, k)
+                    st = _donate_copy(state0) if donate else state0
+                    _hard_sync(ex(st, X0, y0, lr_c, w_c, it_c)[0])
+                return ex, time.perf_counter() - t0
+
+            t_cmp = time.perf_counter()
+            compiled[n], hit = cache_lib.get_or_compile(
+                exec_sig + (n,), _compile
+            )
+            cmp_secs = time.perf_counter() - t_cmp
+            compile_seconds += cmp_secs
+            if hit:
+                exec_hits += 1
+            else:
+                exec_misses += 1
+                obs_detect.observe_and_warn(
+                    {**sig_fields, "chunk_rounds": n}, run_id
+                )
+            if run_id is not None:
+                obs_events.emit(
+                    "compile",
+                    run_id=run_id,
+                    seconds=round(cmp_secs, 4),
+                    cache_hit=hit,
+                    chunk_rounds=n,
+                    memory_analysis=_memory_analysis(compiled[n]),
+                )
+
+        for i, (lo, hi) in enumerate(chunks):
+            # the timed region INCLUDES the staging wait: any stall the
+            # prefetch failed to hide is streaming overhead and must show
+            # up in wall_time/steps_per_sec (BASELINE.md races depend on
+            # this honesty)
+            t0 = time.perf_counter()
+            Xd, yd = (X0, y0) if i == 0 else pf.get(i)
+            state, hist = compiled[hi - lo](
+                state, Xd, yd, *slices(lo, hi, win_of[i])
+            )
+            _hard_sync(state)
+            wall += time.perf_counter() - t0
+            pieces.append(hist)
+        mem_info = _memory_analysis(next(iter(compiled.values())))
+    finally:
+        pf.close()
+    pf_stats = pf.stats()
+    final_state = state
+    history = (
+        pieces[0]
+        if len(pieces) == 1
+        else jax.tree.map(lambda *xs: jnp.concatenate(xs), *pieces)
+    )
+    stats_after = cache_lib.stats().snapshot()
+    steps_per_sec = cfg.rounds / wall if wall > 0 else 0.0
+    if run_id is not None:
+        obs_events.emit_round_chunks(
+            run_id,
+            start_round=0,
+            timeset=schedule.sim_time,
+            worker_times=schedule.worker_times,
+            decode_error=decode_err,
+            update_norm=_history_update_norms(history),
+        )
+        obs_events.emit(
+            "run_end",
+            run_id=run_id,
+            wall_time_s=round(wall, 6),
+            steps_per_sec=round(steps_per_sec, 4),
+            sim_total_time_s=float(schedule.sim_time.sum()),
+            exec_hits=exec_hits,
+            exec_misses=exec_misses,
+            data_cache_hit=False,
+            compile_seconds=round(compile_seconds, 4),
+            stack_bytes=window_nbytes,
+            arrival=obs_events.arrival_summary(schedule.worker_times),
+            **obs_decode.summarize(decode_err),
+        )
+    return TrainResult(
+        params_history=history,
+        final_params=final_state.params,
+        timeset=schedule.sim_time,
+        worker_times=schedule.worker_times,
+        collected=schedule.collected,
+        sim_total_time=float(schedule.sim_time.sum()),
+        wall_time=wall,
+        steps_per_sec=steps_per_sec,
+        n_train=n_train,
+        start_round=0,
+        config=cfg,
+        layout=layout,
+        final_state=final_state,
+        decode_error=decode_err,
+        run_id=run_id,
+        cache_info={
+            "enabled": cache_lib.enabled(),
+            # the device-data cache is bypassed: windows are transient by
+            # design (caching them would defeat the residency bound)
+            "data_hit": False,
+            "exec_hits": exec_hits,
+            "exec_misses": exec_misses,
+            "compile_seconds_saved": round(
+                stats_after["compile_seconds_saved"]
+                - stats_before["compile_seconds_saved"],
+                4,
+            ),
+            "bytes_reused": stats_after["bytes_reused"]
+            - stats_before["bytes_reused"],
+            "stack_mode": "deduped",
+            "stack_dtype": stack_dtype,
+            "ring_pipeline": None,
+            "donation": donate,
+            # device bytes of ONE staged window — the residency unit; the
+            # double buffer pins at most two of these
+            "stack_bytes": window_nbytes,
+            "memory_analysis": mem_info,
+            "residency": "streamed",
+            "stream_window": window,
+            "n_windows": n_windows,
+            "prefetch": pf_stats,
         },
     )
 
@@ -1069,6 +1536,11 @@ def cohort_eligible(cfg: RunConfig) -> bool:
     The cohort engine batches the scan trainer only: measured-arrival mode
     dispatches per worker, and the forced pallas kernel has no batched
     body (it is a correctness/reference path, not a performance option).
+    Streamed-residency runs are excluded too: the cohort engine's whole
+    premise is ONE shared resident device stack, which is exactly what
+    ``stack_residency="streamed"`` exists to avoid — they dispatch as
+    per-run train() (and never pack with resident runs; serve admission
+    charges them by the window, not the stack).
     The scheme's registry descriptor can also opt out
     (``cohort_batchable=False``) — what the sweep planner
     (experiments.plan_cohorts) and the serve packer (serve/packer.py)
@@ -1078,6 +1550,7 @@ def cohort_eligible(cfg: RunConfig) -> bool:
     return (
         cfg.arrival_mode == "simulated"
         and cfg.use_pallas != "on"
+        and _resolve_residency(cfg) == "resident"
         and schemes.get(cfg.scheme).cohort_batchable
     )
 
@@ -1094,8 +1567,13 @@ def estimate_stack_bytes(cfg: RunConfig, dataset: Dataset) -> int:
     worker-major stack. ``stack_mode="auto"`` is charged at the
     MATERIALIZED estimate (the auto gate needs the mesh to resolve;
     admission is a bound, so over-charging the undecided case is the safe
-    direction). int8 stacks add their per-block f32 scale tables. An
-    estimate, not an accounting — refined per signature by the compiled
+    direction). int8 scale tables are counted inside
+    estimate_worker_stack_bytes (data/sharding.py) — the per-block unit
+    already carries them. Streamed-residency runs on the partition-major
+    path are charged their resident WINDOW — at most two stream windows
+    (compute + prefetch double buffer), never the whole stack; that drop
+    is the admission-side point of out-of-core streaming. An estimate,
+    not an accounting — refined per signature by the compiled
     ``memory_analysis`` once a dispatch has run (serve/admission.py).
     """
     layout = build_layout(cfg)
@@ -1115,13 +1593,22 @@ def estimate_stack_bytes(cfg: RunConfig, dataset: Dataset) -> int:
         cfg.compute_mode != ComputeMode.FAITHFUL or cfg.stack_mode == "ring"
     )
     if partition_major:
-        est = per_block * layout.n_partitions
         blocks = layout.n_partitions
+        if _resolve_residency(cfg) == "streamed":
+            # window resolution without a store: mirror ShardStore.
+            # partition_bytes() from the dataset's own shapes (host/PCIe
+            # bytes per partition — payload + labels + int8 scale row)
+            F = int(dataset.X_train.shape[1])
+            rows = dataset.n_samples // max(1, blocks)
+            part_bytes = rows * F * np.dtype(est_dtype).itemsize
+            part_bytes += rows * np.asarray(dataset.y_train).dtype.itemsize
+            if dtype_name == "int8":
+                part_bytes += F * 4
+            w = _resolve_stream_window(cfg, blocks, part_bytes)
+            blocks = min(blocks, 2 * w)
+        est = per_block * blocks
     else:
         est = worker_stack_est
-        blocks = layout.n_workers * layout.n_slots
-    if dtype_name == "int8":
-        est += blocks * dataset.X_train.shape[1] * 4  # f32 scale tables
     return int(est)
 
 
@@ -1213,6 +1700,12 @@ def train_cohort(
             raise ValueError(
                 "train_cohort has no batched fused-kernel dispatch; "
                 "use use_pallas='auto' or 'off'"
+            )
+        if _resolve_residency(c) != "resident":
+            raise ValueError(
+                "train_cohort shares ONE resident device stack; "
+                "stack_residency='streamed' trajectories dispatch as "
+                "per-run train() (cohort_eligible already excludes them)"
             )
     sig0 = cfg0.static_signature()
     for c in cfgs[1:]:

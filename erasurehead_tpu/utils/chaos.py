@@ -33,7 +33,9 @@ list of independently armed faults; each spec is
                  trajectory-batched cohort dispatch — trainer.train_cohort),
                  ``checkpoint`` (at the head of checkpoint.save, i.e. the
                  save never commits), ``adapt`` / ``elastic`` (the chunk
-                 boundaries of the adaptive and elastic drivers);
+                 boundaries of the adaptive and elastic drivers),
+                 ``prefetch`` (per staged partition window of a streamed
+                 run — data/prefetch.py);
   - ``count``  — fire on the Nth invocation of that site (``2``), or on the
                  Nth and every later one (``2+`` — e.g. ``raise:cohort:1+``
                  fails every cohort dispatch, forcing full degradation to
@@ -83,6 +85,11 @@ SITES = (
     # the row is journaled but before the reply is delivered (the client
     # must be able to re-fetch by resubmitting)
     "serve_intake", "serve_dispatch", "serve_reply",
+    # out-of-core streaming (data/prefetch.py): fires once per staged
+    # partition window, BEFORE the shard read — a kill there is a
+    # mid-epoch preemption of a streamed run (tools/outofcore_smoke.py
+    # proves the sweep journal rehydrates completed rows bitwise)
+    "prefetch",
 )
 
 #: sites whose fault is a MEMBERSHIP change (a worker dying or offering

@@ -235,6 +235,27 @@ class RunConfig:
     #            Dense stacks only; composes with stack_mode=ring and the
     #            cohort dispatch.
     stack_dtype: str = "auto"
+    # partition-stack RESIDENCY (train/trainer.py + data/store.py):
+    #   "resident" — the whole [P, rows, F] stack is device-resident
+    #                before round 0 (today's behavior; HBM bounds the
+    #                dataset);
+    #   "streamed" — partitions live in an on-disk shard store
+    #                (data/store.ShardStore; one is written to a temp dir
+    #                when the dataset is in-memory) and only a window of
+    #                them is device-resident at a time, double-buffered
+    #                host→device by data/prefetch.Prefetcher. A window
+    #                covering every partition takes the ordinary resident
+    #                pipeline over the store's rows (bitwise-identical
+    #                trajectories); a smaller window streams the deduped
+    #                dense path window-per-scan-chunk;
+    #   "auto"     — streamed exactly when a stream budget is armed
+    #                (ERASUREHEAD_STREAM_WINDOW), else resident.
+    stack_residency: str = "resident"
+    # partitions per streamed window (stack_residency="streamed"/auto):
+    # None resolves from the ERASUREHEAD_STREAM_WINDOW byte budget
+    # (utils/config.resolve_stream_budget; two windows in flight), else
+    # to the full partition count (the bitwise single-window path).
+    stream_window: Optional[int] = None
     # buffer donation (jax donate_argnums) for the training scan's carry
     # (params + optimizer state) and per-round weight tables: the donated
     # HBM is reused in place instead of held as a duplicate across the
@@ -489,6 +510,31 @@ class RunConfig:
                     "ring-transport body; force at most one of "
                     "stack_mode='ring' / use_pallas='on'"
                 )
+        if self.stack_residency not in ("resident", "streamed", "auto"):
+            raise ValueError(
+                f"stack_residency must be resident/streamed/auto, got "
+                f"{self.stack_residency!r}"
+            )
+        if self.stack_residency == "streamed":
+            if self.arrival_mode == "measured":
+                raise ValueError(
+                    "arrival_mode='measured' dispatches per-worker on "
+                    "resident slot stacks; the streamed window only "
+                    "exists in the simulated-arrival scan trainer — use "
+                    "stack_residency='resident' (or 'auto') with "
+                    "measured mode"
+                )
+        if self.stream_window is not None:
+            if self.stack_residency == "resident":
+                raise ValueError(
+                    "stream_window sizes the streamed partition window; "
+                    "it has no effect under stack_residency='resident' — "
+                    "drop it or set stack_residency='streamed'/'auto'"
+                )
+            if self.stream_window < 1:
+                raise ValueError(
+                    f"stream_window must be >= 1, got {self.stream_window}"
+                )
         from erasurehead_tpu.ops.features import validate_lanes
 
         self.sparse_lanes = validate_lanes(self.sparse_lanes)
@@ -650,6 +696,12 @@ class RunConfig:
             # the donation field carries the resolved aliasing)
             "ring_pipeline": self.ring_pipeline,
             "stack_dtype": self.stack_dtype,
+            # residency changes the compiled step only below a full
+            # window, but keying the raw knobs keeps streamed and
+            # resident dispatches (and their cohort signatures —
+            # serve/packer packs by this) distinct by construction
+            "stack_residency": self.stack_residency,
+            "stream_window": self.stream_window,
             "donate": self.donate,
             "update_rule": self.update_rule.value,
             "dtype": self.dtype,
@@ -894,6 +946,30 @@ def resolve_serve_max_cohort(
     if val < 1:
         raise ValueError(f"serve max-cohort must be >= 1, got {val}")
     return int(val)
+
+
+#: env var arming an out-of-core HOST→DEVICE stream budget in bytes
+#: (k/m/g/t suffixes, like the serve budget): the ceiling on device bytes
+#: the streamed partition window may occupy. stack_residency="auto"
+#: resolves to streamed exactly when this is set; the trainer sizes the
+#: window so two of them (the one computing + the one in flight,
+#: data/prefetch's double buffer) fit the budget.
+STREAM_WINDOW_ENV = "ERASUREHEAD_STREAM_WINDOW"
+
+
+def resolve_stream_budget(
+    flag: Optional[str] = None, env: Optional[str] = None
+) -> Optional[int]:
+    """The streamed-window byte budget, or None (unarmed). Precedence
+    mirrors the serve budget: explicit flag > :data:`STREAM_WINDOW_ENV`
+    env var > off. ``env`` overrides the real environment lookup
+    (tests)."""
+    val = flag
+    if val is None:
+        val = env if env is not None else os.environ.get(STREAM_WINDOW_ENV)
+    if val is None or val == "":
+        return None
+    return parse_bytes(val)
 
 
 #: env var controlling run telemetry when the CLI flag is absent

@@ -1,0 +1,13 @@
+"""event-schema violations against the out-of-core records: a
+``prefetch`` emit missing its window/bytes accounting, an ``io`` emit
+missing its byte count, and a logger-object ``io`` emit missing the
+kind — the contracts the shard store and prefetcher byte-accounting
+telemetry (data/store.py, data/prefetch.py) must satisfy."""
+
+from erasurehead_tpu.obs import events as events_lib
+
+
+def emit_outofcore(logger):
+    events_lib.emit("prefetch", run_id="r")  # missing window, bytes
+    events_lib.emit("io", kind="shard_read")  # missing bytes
+    logger.emit("io", bytes=4096)  # missing kind

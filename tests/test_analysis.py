@@ -122,6 +122,19 @@ def test_schema_serve_fixture():
     assert len(findings) == 3
 
 
+def test_schema_io_fixture():
+    """The out-of-core records (prefetch/io) are lint-enforced like
+    every other type: emits missing required fields are findings — a
+    drifted shard-read or prefetch-window byte account fails
+    `erasurehead-tpu lint`, not the first streamed run in production."""
+    findings = _unsup(_lint(_fx("schema_io_bad.py")), "event-schema")
+    msgs = "\n".join(f.message for f in findings)
+    assert "window" in msgs
+    assert "bytes" in msgs
+    assert "kind" in msgs  # the logger-object emit is checked too
+    assert len(findings) == 3
+
+
 def test_schema_whatif_fixture():
     """The what-if engine's `whatif` record (ISSUE 12) is lint-enforced
     like every other type: emits missing spec_hash/kind are findings,

@@ -1,0 +1,304 @@
+"""Out-of-core streamed partition stacks (ISSUE 15).
+
+Pins the contract the ``stack_residency`` tentpole rests on:
+
+- shard-store round trips (f32 bitwise; int8 write-time quantization
+  identical to the resident quantizer) and journal/cache key parity
+  between a store-rehydrated dataset and its in-memory source;
+- streamed single-window trajectories BITWISE identical to resident
+  across the f32/int8 x exact(repcoded)/AGC(approx) x ring on/off
+  matrix;
+- the multi-window block trainer: deterministic run-to-run, prefetch
+  telemetry present, refusals loud (faithful, checkpointing, cohorts);
+- admission estimates: streamed runs charged their double-buffered
+  window, and the int8 worker-stack estimate counts the per-partition
+  scale tables (the satellite bugfix), pinned against the REAL sharded
+  stack's device bytes and the compiled memory_analysis;
+- serve packing: residency rides the static signature / payload
+  allowlist, and streamed requests never pack into a resident cohort;
+- data/io.py mmap warm loads bitwise-identical to eager loads.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from erasurehead_tpu.data import io as data_io
+from erasurehead_tpu.data import sharding
+from erasurehead_tpu.data import store as store_lib
+from erasurehead_tpu.data.synthetic import generate_gmm
+from erasurehead_tpu.ops.features import QuantizedStack
+from erasurehead_tpu.train import cache as cache_lib
+from erasurehead_tpu.train import journal as journal_lib
+from erasurehead_tpu.train import trainer
+from erasurehead_tpu.utils.config import RunConfig
+
+W = 4
+P = 4  # every scheme below lays out 4 partitions at W=4
+ROWS = P * 32
+COLS = 8
+
+
+def _cfg(**kw):
+    base = dict(
+        scheme="repcoded", n_workers=W, n_stragglers=1,
+        partitions_per_worker=2, rounds=2, n_rows=ROWS, n_cols=COLS,
+        lr_schedule=0.5, update_rule="GD", add_delay=True, seed=0,
+    )
+    base.update(kw)
+    # kw=None drops the key back to the RunConfig default
+    return RunConfig(**{k: v for k, v in base.items() if v is not None})
+
+
+def _gmm():
+    return generate_gmm(ROWS, COLS, n_partitions=P, seed=0)
+
+
+@pytest.fixture()
+def gmm():
+    return _gmm()
+
+
+def _bitwise(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb)
+    )
+
+
+# ---------------------------------------------------------------------------
+# shard store round trips
+
+
+def test_store_roundtrip_f32(gmm, tmp_path):
+    st = store_lib.write_store(gmm, str(tmp_path / "s"), P)
+    rows = ROWS // P
+    assert st.n_partitions == P and st.rows_per_partition == rows
+    X, y = st.read_window(0, P)
+    assert np.array_equal(X.reshape(ROWS, -1), gmm.X_train)
+    assert np.array_equal(y.reshape(ROWS), np.asarray(gmm.y_train))
+    # a sub-window straddling shard boundaries reads the same rows
+    Xw, yw = st.read_window(1, 3)
+    assert np.array_equal(Xw, X[1:3]) and np.array_equal(yw, y[1:3])
+    # identity: reopening keys exactly like the in-memory source
+    st2 = store_lib.open_store(str(tmp_path / "s"))
+    assert st2.digest == st.digest == journal_lib.dataset_digest(gmm)
+    assert st2.cache_token == ("shard-store", st.digest, "float32")
+    ds = st2.dataset()
+    assert np.array_equal(ds.X_train, gmm.X_train)
+    assert journal_lib.dataset_digest(ds) == journal_lib.dataset_digest(gmm)
+    assert cache_lib.dataset_token(ds) == st2.cache_token
+    lab = np.dtype(st.meta["label_dtype"]).itemsize
+    src = np.dtype(st.meta["source_dtype"]).itemsize
+    assert st.partition_bytes() == rows * COLS * src + rows * lab
+
+
+def test_store_roundtrip_int8(gmm, tmp_path):
+    st = store_lib.write_store(gmm, str(tmp_path / "q"), P,
+                               stack_dtype="int8")
+    rows = ROWS // P
+    qs, y = st.read_window(0, P)
+    assert isinstance(qs, QuantizedStack)
+    # write-time quantization IS the resident quantizer, partition-local
+    ref = QuantizedStack.quantize(
+        np.ascontiguousarray(
+            np.asarray(gmm.X_train).reshape(P, rows, COLS)
+        )
+    )
+    assert np.array_equal(np.asarray(qs.q), np.asarray(ref.q))
+    assert np.array_equal(np.asarray(qs.scale), np.asarray(ref.scale))
+    ds = st.dataset()
+    pre = getattr(ds, "_store_prequantized", None)
+    assert pre is not None  # shard_run_data reuses the stored tables
+    assert np.array_equal(np.asarray(pre.q), np.asarray(ref.q))
+    lab = np.dtype(st.meta["label_dtype"]).itemsize
+    assert st.partition_bytes() == rows * COLS + COLS * 4 + rows * lab
+
+
+def test_store_refusals(gmm, tmp_path):
+    with pytest.raises(ValueError, match="stack_dtype"):
+        store_lib.write_store(gmm, str(tmp_path / "x"), P,
+                              stack_dtype="int4")
+    with pytest.raises(ValueError, match="cannot fill"):
+        store_lib.write_store(gmm, str(tmp_path / "x"), ROWS + 1)
+    with pytest.raises(FileNotFoundError, match="shard store"):
+        store_lib.open_store(str(tmp_path / "nope"))
+    # a quantized store refuses to feed a run that would silently train
+    # on the lossy dequantized reconstruction
+    st = store_lib.write_store(gmm, str(tmp_path / "q"), P,
+                               stack_dtype="int8")
+    ds = st.dataset()
+    with pytest.raises(ValueError, match="quantized"):
+        trainer.train(_cfg(stack_residency="streamed"), ds)
+
+
+# ---------------------------------------------------------------------------
+# streamed single-window == resident, bitwise
+
+
+@pytest.mark.parametrize("ring", [False, True], ids=["noring", "ring"])
+@pytest.mark.parametrize("scheme,extra", [
+    ("repcoded", {}),
+    ("approx", {"num_collect": 2}),
+], ids=["exact", "agc"])
+@pytest.mark.parametrize("stack_dtype", ["float32", "int8"])
+def test_streamed_single_window_bitwise(stack_dtype, scheme, extra, ring):
+    cfg = _cfg(scheme=scheme, stack_dtype=stack_dtype, **extra)
+    if ring:
+        cfg = dataclasses.replace(cfg, stack_mode="ring")
+    r = trainer.train(cfg, _gmm())
+    s = trainer.train(
+        dataclasses.replace(cfg, stack_residency="streamed"), _gmm()
+    )
+    assert r.cache_info["residency"] == "resident"
+    assert s.cache_info["residency"] == "streamed"
+    assert _bitwise(r.params_history, s.params_history)
+    assert _bitwise(r.final_params, s.final_params)
+
+
+# ---------------------------------------------------------------------------
+# the multi-window block trainer
+
+
+def test_streamed_multi_window_deterministic(gmm):
+    cfg = _cfg(compute_mode="deduped", rounds=4,
+               stack_residency="streamed", stream_window=1)
+    a = trainer.train(cfg, gmm)
+    ci = a.cache_info
+    assert ci["residency"] == "streamed"
+    assert ci["stream_window"] == 1 and ci["n_windows"] == P
+    pf = ci["prefetch"]
+    assert pf["windows"] >= P and pf["bytes"] > 0
+    assert 0.0 <= pf["overlap_efficiency"] <= 1.0
+    b = trainer.train(cfg, _gmm())
+    assert _bitwise(a.params_history, b.params_history)
+    assert _bitwise(a.final_params, b.final_params)
+
+
+def test_streamed_multi_window_refusals(gmm, tmp_path):
+    multi = _cfg(compute_mode="deduped", stack_residency="streamed",
+                 stream_window=1)
+    # faithful mode needs the whole worker stack resident
+    with pytest.raises(ValueError, match="faithful"):
+        trainer.train(_cfg(stack_residency="streamed", stream_window=1),
+                      gmm)
+    # checkpointing composes with resident scan chunks only
+    with pytest.raises(ValueError, match="checkpoint"):
+        trainer.train(multi, gmm, checkpoint_dir=str(tmp_path / "ck"),
+                      checkpoint_every=1)
+    # cohorts share ONE resident stack
+    assert not trainer.cohort_eligible(multi)
+    assert trainer.cohort_signature(multi) is None
+    with pytest.raises(ValueError, match="resident"):
+        trainer.train_cohort([multi], gmm)
+
+
+# ---------------------------------------------------------------------------
+# admission estimates (incl. the satellite-6 int8 scale-table fix)
+
+
+def test_estimate_charges_streamed_window(gmm):
+    ded = _cfg(compute_mode="deduped")
+    res = trainer.estimate_stack_bytes(ded, gmm)
+    win = trainer.estimate_stack_bytes(
+        dataclasses.replace(ded, stack_residency="streamed",
+                            stream_window=1), gmm
+    )
+    # charged two windows (compute + prefetch double buffer) of four
+    assert win == res // 2
+    # a window covering the whole stack charges exactly the resident run
+    full = trainer.estimate_stack_bytes(
+        dataclasses.replace(ded, stack_residency="streamed",
+                            stream_window=P), gmm
+    )
+    assert full == res
+
+
+def test_worker_stack_estimate_counts_int8_scales(gmm):
+    cfg = _cfg(scheme="cyccoded", partitions_per_worker=None,
+               compute_mode="faithful", stack_dtype="int8")
+    layout = trainer.build_layout(cfg)
+    est = sharding.estimate_worker_stack_bytes(gmm, layout, np.int8)
+    rows = gmm.n_samples // layout.n_partitions
+    Wl, S = layout.n_workers, layout.n_slots
+    # payload + one f32 scale row per slot block — the satellite bugfix
+    assert est == Wl * S * rows * COLS + Wl * S * COLS * 4
+    # pinned against the REAL sharded stack's device bytes — estimate
+    # and accounting agree exactly, so an admission decision made from
+    # the host-side arithmetic matches what the dispatch will pin
+    mesh = trainer._auto_mesh(layout.n_workers)
+    sd = sharding.shard_run_data(gmm, layout, mesh, faithful=True,
+                                 quantize=True)
+    assert est == cache_lib.device_nbytes(sd.Xw)
+    # the run's stack telemetry (stack + labels) can only be larger
+    r = trainer.train(cfg, gmm)
+    assert int(r.cache_info["stack_bytes"]) >= est
+
+
+# ---------------------------------------------------------------------------
+# serve: residency in the payload allowlist, never packed across
+
+
+def test_streamed_never_packs_with_resident(gmm):
+    from erasurehead_tpu.serve import packer as packer_lib
+    from erasurehead_tpu.serve import queue as serve_queue
+
+    assert "stack_residency" in serve_queue.CONFIG_PAYLOAD_FIELDS
+    assert "stream_window" in serve_queue.CONFIG_PAYLOAD_FIELDS
+    ded = _cfg(compute_mode="deduped")
+    streamed = dataclasses.replace(
+        ded, stack_residency="streamed", stream_window=1
+    )
+    # residency rides the static signature...
+    assert ded.static_signature() != streamed.static_signature()
+    # ...and a multi-window streamed request is a sequential singleton
+    reqs = [
+        serve_queue.RunRequest(tenant="a", label="r", config=ded,
+                               dataset=gmm),
+        serve_queue.RunRequest(tenant="b", label="s", config=streamed,
+                               dataset=gmm),
+        serve_queue.RunRequest(tenant="c", label="r2", config=ded,
+                               dataset=gmm),
+    ]
+    assert packer_lib.pack_key(reqs[1]) is None
+    cohorts = packer_lib.plan_packs(reqs)
+    by_label = {
+        tuple(sorted(r.label for r in c.requests)) for c in cohorts
+    }
+    assert ("r", "r2") in by_label and ("s",) in by_label
+
+
+def test_residency_round_trips_the_serve_payload(gmm):
+    from erasurehead_tpu.serve import queue as serve_queue
+
+    streamed = _cfg(compute_mode="deduped", stack_residency="streamed",
+                    stream_window=2)
+    payload = serve_queue.config_payload(streamed)
+    assert payload["stack_residency"] == "streamed"
+    assert payload["stream_window"] == 2
+    back = serve_queue.config_from_payload(payload)
+    assert back.stack_residency == "streamed"
+    assert back.stream_window == 2
+
+
+# ---------------------------------------------------------------------------
+# data/io.py mmap warm loads
+
+
+def test_mmap_load_bitwise_identical(tmp_path):
+    rng = np.random.default_rng(0)
+    m = rng.normal(size=(16, 5))
+    path = str(tmp_path / "mat.txt")
+    data_io.save_dense_text(path, m)
+    cold = data_io.load_dense_text(path)  # builds the .npy sidecar
+    warm_mmap = data_io.load_dense_text(path, mmap=True)
+    warm_eager = data_io.load_dense_text(path, mmap=False)
+    assert isinstance(warm_mmap, np.memmap)
+    assert not isinstance(warm_eager, np.memmap)
+    assert np.array_equal(np.asarray(warm_mmap), warm_eager)
+    assert np.array_equal(np.asarray(cold), warm_eager)

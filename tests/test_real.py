@@ -1,0 +1,103 @@
+"""Real-dataset preparers, no-download paths (ISSUE 15 satellite).
+
+Pins the three preparers that work in a zero-egress sandbox: covtype
+from a raw UCI ``covtype.data`` file (the genuine 54-feature +
+Cover_Type schema, synthesized tiny here), and the sklearn-bundled
+breast_cancer / diabetes sets. Shape arithmetic (80/20 split), label
+ranges (±1 classification, O(1) regression target), joint one-hot
+encoding (train and test share a feature space), and call-to-call
+determinism — the property the sweep journal's dataset digest rests
+on."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+sklearn = pytest.importorskip("sklearn")
+pytest.importorskip("pandas")
+
+from erasurehead_tpu.data import real as real_data  # noqa: E402
+
+
+def _check_prepared(ds, n_rows: int, regression: bool = False) -> None:
+    """The invariants every _one_hot_split product satisfies."""
+    n_test = int(np.ceil(n_rows * 0.2))  # train_test_split ceils the test
+    n_train = n_rows - n_test
+    assert sps.issparse(ds.X_train) and sps.issparse(ds.X_test)
+    assert ds.X_train.shape[0] == n_train == ds.y_train.shape[0]
+    assert ds.X_test.shape[0] == n_test == ds.y_test.shape[0]
+    # joint encoder fit: train and test live in ONE feature space
+    assert ds.X_train.shape[1] == ds.X_test.shape[1]
+    # one-hot rows: every entry is 1, at most one per encoded column
+    assert np.all(ds.X_train.data == 1.0)
+    if regression:
+        y = np.concatenate([ds.y_train, ds.y_test])
+        assert np.all(np.isfinite(y))
+        assert 0.1 < np.abs(y).max() < 10.0  # O(1)-scaled target
+    else:
+        assert set(np.unique(ds.y_train)) <= {-1.0, 1.0}
+        assert set(np.unique(ds.y_test)) <= {-1.0, 1.0}
+
+
+def _bitwise_same(a, b) -> bool:
+    return (
+        (a.X_train != b.X_train).nnz == 0
+        and (a.X_test != b.X_test).nnz == 0
+        and np.array_equal(a.y_train, b.y_train)
+        and np.array_equal(a.y_test, b.y_test)
+    )
+
+
+def _write_raw_covtype(path, n_rows: int = 80) -> int:
+    """A tiny file in the genuine UCI covtype.data layout: 10
+    quantitative columns, 44 indicator columns, Cover_Type 1..7.
+    Returns how many rows survive the preparer's class filter (<=2)."""
+    rng = np.random.RandomState(0)
+    quant = rng.randint(0, 50, size=(n_rows, 10))
+    indic = rng.randint(0, 2, size=(n_rows, 44))
+    target = rng.randint(1, 8, size=(n_rows, 1))
+    table = np.hstack([quant, indic, target])
+    np.savetxt(path, table, fmt="%d", delimiter=",")
+    return int((target <= 2).sum())
+
+
+def test_prepare_covtype_raw_file(tmp_path):
+    kept = _write_raw_covtype(str(tmp_path / "covtype.data"))
+    assert kept > 10  # the synthetic file exercises the class filter
+    ds = real_data.prepare("covtype", str(tmp_path))
+    assert ds.name == "covtype"
+    _check_prepared(ds, kept)
+    # both kept classes survive the {1,2} -> {-1,+1} binarization
+    y = np.concatenate([ds.y_train, ds.y_test])
+    assert {-1.0, 1.0} == set(np.unique(y))
+    assert _bitwise_same(ds, real_data.prepare("covtype", str(tmp_path)))
+
+
+def test_prepare_covtype_rejects_wrong_schema(tmp_path):
+    np.savetxt(
+        str(tmp_path / "covtype.data"),
+        np.ones((5, 7)), fmt="%d", delimiter=",",
+    )
+    with pytest.raises(ValueError, match="55 columns"):
+        real_data.prepare("covtype", str(tmp_path))
+
+
+def test_prepare_breast_cancer():
+    ds = real_data.prepare("breast_cancer", None)
+    assert ds.name == "breast_cancer"
+    _check_prepared(ds, 569)  # the bundled set's fixed row count
+    assert _bitwise_same(ds, real_data.prepare("breast_cancer", None))
+
+
+def test_prepare_diabetes():
+    ds = real_data.prepare("diabetes", None)
+    assert ds.name == "diabetes"
+    _check_prepared(ds, 442, regression=True)
+    assert _bitwise_same(ds, real_data.prepare("diabetes", None))
+
+
+def test_prepare_unknown_dataset_is_loud():
+    with pytest.raises(ValueError, match="unknown dataset"):
+        real_data.prepare("nope", ".")

@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Out-of-core harness: shard-store -> streamed sweep -> kill -> resume
+(``make outofcore-smoke``).
+
+Proves the streamed-residency resilience contract end-to-end with REAL
+process deaths, which the in-process tests cannot do:
+
+  1. **baseline** — the child writes a shard store (data/store.py), opens
+     it, and drives a journaled straggler sweep whose every trajectory
+     runs ``stack_residency="streamed"`` with a multi-partition-window
+     prefetch pipeline (stream_window=1 < P, so data/prefetch.py is on
+     the hot path); the sweep runs to completion;
+  2. **kill** — the same sweep with ``ERASUREHEAD_CHAOS=kill:prefetch:N``
+     armed: the process dies (os._exit, preemption semantics) while the
+     prefetcher stages a mid-run partition window — a kill mid-epoch of
+     a streamed trajectory. N is sized so exactly one trajectory's row
+     reached the journal first;
+  3. **resume** — the same command with ``--resume`` reopens the SAME
+     store directory (content digest -> identical journal keys), skips
+     the journaled row, trains the rest, and must produce summary rows
+     BITWISE identical to the baseline.
+
+The journal is schema-checked with the same validator as every other
+event log. Exit 0 = all invariants held.
+
+Usage: python tools/outofcore_smoke.py [--rounds 8] [--workers 4]
+       (the --child form is the harness's internal sweep runner)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+KILL_EXIT = 43  # erasurehead_tpu.utils.chaos.KILL_EXIT (no jax import here)
+
+
+def child(ns) -> int:
+    """One journaled STREAMED sweep run: the unit the orchestrator
+    kills/resumes. The first child invocation writes the shard store;
+    later ones (the resume leg) reopen it from disk, so the rehydration
+    path crosses a real process boundary."""
+    from erasurehead_tpu.data import store as store_lib
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.train import experiments
+    from erasurehead_tpu.train import journal as journal_lib
+    from erasurehead_tpu.utils.config import RunConfig
+
+    W = ns.workers
+    rows = W * 16
+    if os.path.exists(os.path.join(ns.store, store_lib.META_NAME)):
+        store = store_lib.open_store(ns.store)
+    else:
+        src = generate_gmm(rows, 8, n_partitions=W, seed=0)
+        store = store_lib.write_store(src, ns.store, W)
+    data = store.dataset()
+    base = RunConfig(
+        scheme="naive", n_workers=W, n_stragglers=0, num_collect=W // 2,
+        rounds=ns.rounds, n_rows=rows, n_cols=8, lr_schedule=1.0,
+        update_rule="GD", add_delay=True, seed=0, compute_mode="deduped",
+        stack_residency="streamed", stream_window=1,
+    )
+    sweep = {
+        "naive": [0],
+        "cyccoded": [1],
+        "avoidstragg": [1],
+    }
+    journal = journal_lib.SweepJournal(ns.journal, resume=ns.resume)
+    try:
+        summaries = experiments.straggler_sweep(
+            base, data, sweep, journal=journal
+        )
+    finally:
+        journal.close()
+    with open(ns.out, "w") as f:
+        json.dump(
+            [journal_lib.science_row(s.row()) for s in summaries],
+            f, indent=1,
+        )
+    return 0
+
+
+def _fires_per_trajectory(ns) -> int:
+    """Prefetch windows one streamed trajectory stages: the trainer's
+    chunking arithmetic (trainer._train_streamed) with stream_window=1,
+    so n_windows = P = workers and chunk length L = rounds // n_windows."""
+    n_windows = ns.workers
+    L = max(1, ns.rounds // n_windows)
+    return len(range(0, ns.rounds, L))
+
+
+def _run_child(workdir, ns, leg, journal_dir, out, store, resume=False,
+               chaos=None) -> subprocess.CompletedProcess:
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--child",
+        "--journal", journal_dir, "--out", out, "--store", store,
+        "--rounds", str(ns.rounds), "--workers", str(ns.workers),
+    ]
+    if resume:
+        cmd.append("--resume")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("ERASUREHEAD_CHAOS", None)
+    if chaos:
+        env["ERASUREHEAD_CHAOS"] = chaos
+    print(f"[outofcore-smoke] {leg}: {' '.join(cmd[2:])}"
+          + (f"  ERASUREHEAD_CHAOS={chaos}" if chaos else ""),
+          file=sys.stderr)
+    return subprocess.run(cmd, env=env, cwd=workdir)
+
+
+def _load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _assert_rows_equal(a, b, leg: str) -> None:
+    if a == b:
+        return
+    for ra, rb in zip(a, b):
+        if ra != rb:
+            diff = {
+                k: (ra.get(k), rb.get(k))
+                for k in set(ra) | set(rb)
+                if ra.get(k) != rb.get(k)
+            }
+            raise SystemExit(
+                f"[outofcore-smoke] FAIL ({leg}): row {ra.get('label')!r} "
+                f"differs from baseline: {diff}"
+            )
+    raise SystemExit(f"[outofcore-smoke] FAIL ({leg}): row sets differ")
+
+
+def orchestrate(ns) -> int:
+    import tempfile
+
+    from erasurehead_tpu.obs import events as events_lib
+
+    work = tempfile.mkdtemp(prefix="eh-outofcore-")
+    store = os.path.join(work, "store")
+    base_out = os.path.join(work, "rows_base.json")
+    res_out = os.path.join(work, "rows_resumed.json")
+    jdir_base = os.path.join(work, "journal_base")
+    jdir_kill = os.path.join(work, "journal_kill")
+
+    # 1. baseline: write the store, stream every trajectory, journaled
+    p = _run_child(work, ns, "baseline", jdir_base, base_out, store)
+    if p.returncode != 0:
+        raise SystemExit(
+            f"[outofcore-smoke] FAIL: baseline rc={p.returncode}"
+        )
+    rows_base = _load(base_out)
+    if len(rows_base) != 3:
+        raise SystemExit(
+            f"[outofcore-smoke] FAIL: baseline wrote {len(rows_base)} "
+            f"rows, expected 3"
+        )
+
+    # 2. kill while the SECOND trajectory's prefetcher stages a window
+    #    (one full trajectory journaled, the next one mid-epoch)
+    fires = _fires_per_trajectory(ns)
+    p = _run_child(
+        work, ns, "kill", jdir_kill, os.path.join(work, "unused.json"),
+        store, chaos=f"kill:prefetch:{fires + 2}",
+    )
+    if p.returncode != KILL_EXIT:
+        raise SystemExit(
+            f"[outofcore-smoke] FAIL: kill leg rc={p.returncode}, "
+            f"expected {KILL_EXIT}"
+        )
+    jpath = os.path.join(jdir_kill, "sweep_journal.jsonl")
+    n_recs = sum(
+        1 for line in open(jpath)
+        if line.strip() and json.loads(line)["type"] == "sweep_trajectory"
+    )
+    if n_recs != 1:
+        raise SystemExit(
+            f"[outofcore-smoke] FAIL: journal has {n_recs} rows after "
+            f"kill:prefetch:{fires + 2}, expected 1"
+        )
+    errors = events_lib.validate_file(jpath)
+    if errors:
+        raise SystemExit(
+            f"[outofcore-smoke] FAIL: journal invalid: {errors}"
+        )
+
+    # 3. resume: reopen the store from disk, skip the journaled row,
+    #    finish, match the baseline bitwise
+    p = _run_child(
+        work, ns, "resume", jdir_kill, res_out, store, resume=True
+    )
+    if p.returncode != 0:
+        raise SystemExit(f"[outofcore-smoke] FAIL: resume rc={p.returncode}")
+    _assert_rows_equal(rows_base, _load(res_out), "kill->resume")
+    print("[outofcore-smoke] streamed kill->resume invariance: OK",
+          file=sys.stderr)
+
+    print(json.dumps({
+        "status": "PASS",
+        "rows": len(rows_base),
+        "journaled_before_kill": n_recs,
+        "workdir": work,
+    }))
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=8)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--journal", default=None)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--store", default=None)
+    ns = ap.parse_args()
+    if ns.child:
+        if not ns.journal or not ns.out or not ns.store:
+            ap.error("--child needs --journal, --out and --store")
+        return child(ns)
+    return orchestrate(ns)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
