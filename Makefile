@@ -44,7 +44,8 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 	test lint tier1 bench sweep rehearse watch compare real_data dryrun \
 	telemetry-smoke sweep-batch-smoke chaos-smoke roofline-smoke \
 	serve-smoke serve-load-smoke serve-chaos-smoke adapt-smoke \
-	deep-smoke elastic-smoke whatif-smoke outofcore-smoke clean
+	deep-smoke elastic-smoke whatif-smoke outofcore-smoke \
+	pipeline-smoke clean
 
 naive:            ## uncoded wait-for-all baseline (src/naive.py)
 	$(RUN) --scheme naive
@@ -147,6 +148,9 @@ elastic-smoke:    ## CPU chaos-driven die-then-rejoin + kill->resume row rehydra
 
 whatif-smoke:     ## CPU what-if cycle: tiny grid -> surface artifact -> adapt priors + serve ETA round-trips, events validate, identical-spec rerun bitwise (tools/whatif_smoke.py)
 	JAX_PLATFORMS=cpu $(PY) tools/whatif_smoke.py
+
+pipeline-smoke:   ## CPU sync vs tau=1 pipelined race at exp(2.0): pipelined time-to-target <= sync, bitwise replay, tau=0 collapse, typed events validate (tools/pipeline_smoke.py)
+	JAX_PLATFORMS=cpu $(PY) tools/pipeline_smoke.py
 
 sweep:            ## the full on-TPU measurement program (resumable, tagged)
 	bash tools/tpu_measurements.sh

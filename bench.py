@@ -1474,6 +1474,133 @@ def _whatif_extra() -> dict:
     }
 
 
+#: pipeline extra scenario (ISSUE 16): sync vs pipelined (tau=1)
+#: time-to-target under exp(2.0) straggling, W=8 s=1 avoidstragg on the
+#: 256x16 GMM. Avoidstragg is where the overlap win is big: the
+#: synchronous round pays the (W-s)th order statistic of exp(2.0) every
+#: round, while the pipelined round overlaps round t+1's dispatch with
+#: round t's drain. lr_schedule is EXPLICIT: the default schedule sits at
+#: GD's stability edge and tau=1 staleness shrinks the stable region.
+PIPELINE_WORKERS = 8
+PIPELINE_STRAGGLERS = 1
+PIPELINE_ROUNDS = 80
+PIPELINE_ROWS = 256
+PIPELINE_COLS = 16
+PIPELINE_DELAY_MEAN = 2.0
+PIPELINE_TARGET_LOSS = 0.15
+PIPELINE_SEEDS = (3, 4, 5)
+PIPELINE_SPEEDUP_BAR = 1.5
+
+
+def _pipeline_extra() -> dict:
+    """Pipelined-training extra: sync vs tau=1 pipelined time-to-target
+    (simulated seconds, identical arrival draws) under exp(2.0)
+    straggling, over PIPELINE_SEEDS straggler worlds (bar: min speedup >=
+    PIPELINE_SPEEDUP_BAR x). The extra params slot the pipelined carry
+    threads is recorded from cache_info (pipeline_params_slot_bytes — the
+    +1 slot serve admission charges), and the staleness-vs-coding error
+    decomposition (obs/decode.emit_staleness_split) rides along for the
+    last seed."""
+    import numpy as _np
+
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.obs import decode as decode_lib
+    from erasurehead_tpu.train import evaluate, experiments, trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    ds = generate_gmm(
+        PIPELINE_ROWS, PIPELINE_COLS,
+        n_partitions=PIPELINE_WORKERS, seed=0,
+    )
+    common = dict(
+        scheme="avoidstragg",
+        n_workers=PIPELINE_WORKERS,
+        n_stragglers=PIPELINE_STRAGGLERS,
+        rounds=PIPELINE_ROUNDS,
+        n_rows=PIPELINE_ROWS,
+        n_cols=PIPELINE_COLS,
+        update_rule="GD",
+        compute_mode="deduped",
+        add_delay=True,
+        delay_mean=PIPELINE_DELAY_MEAN,
+        lr_schedule=1.0,
+    )
+
+    def t2t(result):
+        model = trainer.build_model(result.config)
+        n = result.n_train
+        ev = evaluate.replay(
+            model, result.config.model, result.params_history,
+            ds.X_train[:n], ds.y_train[:n], ds.X_test, ds.y_test,
+        )
+        loss = _np.asarray(ev.training_loss, dtype=_np.float64)
+        return experiments.time_to_target_loss(
+            loss, result.timeset, PIPELINE_TARGET_LOSS
+        ), float(loss[-1])
+
+    races, speedups = [], []
+    slot_bytes = None
+    split = None
+    for sd in PIPELINE_SEEDS:
+        sync = trainer.train(
+            RunConfig(**common, seed=sd), ds, measure=False
+        )
+        pipe = trainer.train(
+            RunConfig(**common, seed=sd, pipeline_depth=1),
+            ds, measure=False,
+        )
+        t_sync, loss_sync = t2t(sync)
+        t_pipe, loss_pipe = t2t(pipe)
+        speedup = (
+            round(t_sync / t_pipe, 3) if t_sync and t_pipe else None
+        )
+        if speedup is not None:
+            speedups.append(speedup)
+        slot_bytes = (pipe.cache_info or {}).get(
+            "pipeline_params_slot_bytes"
+        )
+        split = decode_lib.emit_staleness_split("bench-pipeline", pipe, ds)
+        races.append({
+            "seed": sd,
+            "sync_time_to_target_s": (
+                round(t_sync, 3) if t_sync is not None else None
+            ),
+            "pipelined_time_to_target_s": (
+                round(t_pipe, 3) if t_pipe is not None else None
+            ),
+            "sync_final_loss": round(loss_sync, 6),
+            "pipelined_final_loss": round(loss_pipe, 6),
+            "speedup": speedup,
+        })
+    min_speedup = min(speedups) if speedups else None
+    return {
+        "pipeline": {
+            "scheme": common["scheme"],
+            "workers": PIPELINE_WORKERS,
+            "stragglers": PIPELINE_STRAGGLERS,
+            "rounds": PIPELINE_ROUNDS,
+            "delay": f"exp({PIPELINE_DELAY_MEAN})",
+            "target_loss": PIPELINE_TARGET_LOSS,
+            "races": races,
+            "min_speedup": min_speedup,
+            "speedup_bar": PIPELINE_SPEEDUP_BAR,
+            "speedup_bar_met": bool(
+                min_speedup is not None
+                and min_speedup >= PIPELINE_SPEEDUP_BAR
+            ),
+            # memory honesty (BASELINE.md): the pipelined carry's extra
+            # params slot, as charged to serve admission
+            "pipeline_params_slot_bytes": slot_bytes,
+            # staleness-vs-coding error decomposition of the last race's
+            # pipelined run (obs/decode.py)
+            "staleness_split": {
+                k: v for k, v in (split or {}).items()
+                if k.endswith("_mean") or k == "staleness_share"
+            },
+        },
+    }
+
+
 def _fidelity_extra(cfg, data, result) -> dict:
     """Fidelity evidence for a lossy/compressed stack: final train/test
     loss of this run vs an f32-stack reference run of the IDENTICAL
@@ -1832,6 +1959,15 @@ def child() -> None:
     except Exception as e:  # noqa: BLE001 — extras must never kill bench
         print(f"bench: whatif extra failed: {e}", file=sys.stderr)
 
+    # ---- pipeline extra: sync vs tau=1 pipelined time-to-target under
+    # exp(2.0) straggling (bar >= 1.5x), with the extra params-slot bytes
+    # and the staleness-vs-coding error split riding along
+    pipeline_extra = {}
+    try:
+        pipeline_extra = _pipeline_extra()
+    except Exception as e:  # noqa: BLE001 — extras must never kill bench
+        print(f"bench: pipeline extra failed: {e}", file=sys.stderr)
+
     # ---- lint extra: the AST invariant analyzer rides the tier-1 loop -----
     # (erasurehead_tpu/analysis/), so its wall time is a budgeted quantity:
     # the full-tree run must stay under 5 s on CPU (lint_budget_ok)
@@ -1965,6 +2101,7 @@ def child() -> None:
                 **adapt_extra,
                 **elastic_extra,
                 **whatif_extra,
+                **pipeline_extra,
                 **fidelity_extra,
                 **outofcore_extra,
                 **lint_extra,

@@ -313,6 +313,16 @@ def _flags_parser() -> argparse.ArgumentParser:
                    help="lax.scan unroll factor for the training scan: "
                         ">1 lets XLA fuse/overlap consecutive rounds "
                         "(identical math; a lowering knob)")
+    p.add_argument("--pipeline-depth", type=int, default=0,
+                   choices=[0, 1],
+                   help="pipelined training with bounded staleness tau "
+                        "(parallel/pipeline.py): 1 dispatches round t+1's "
+                        "worker compute against round t-1's params while "
+                        "round t's arrivals drain. Deterministic and "
+                        "journal-replayable; refuses (typed "
+                        "PipelineRefusal) exact-decode schemes, non-GD "
+                        "rules and measured arrivals. 0 = synchronous "
+                        "(bitwise today's trainer)")
     p.add_argument("--flat-grad", default="auto",
                    choices=["auto", "on", "off"],
                    help="flat-stack closed-form GLM gradient lowering "
@@ -446,6 +456,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         deep_layers=ns.deep_layers,
         arrival_trace=ns.arrival_trace,
         scan_unroll=ns.scan_unroll,
+        pipeline_depth=ns.pipeline_depth,
         sparse_format=ns.sparse_format,
         fields_scatter=ns.fields_scatter,
         fields_margin=ns.fields_margin,

@@ -24,6 +24,15 @@ residuals below :data:`EXACT_TOL` snap to exactly 0.0 — the test-pinned
 "exact schemes read 0" contract. Approximate schemes (AGC group erasures,
 avoidstragg/deadline rescales, randreg's lstsq-optimal combination over an
 insufficient arrival set) are genuinely > 0 under nonzero straggling.
+
+Pipelined runs (cfg.pipeline_depth; parallel/pipeline.py) add a SECOND
+error source the weight-space norm cannot see: the gradient was taken at
+a tau-stale iterate. :func:`staleness_error_series` measures that half
+directly in gradient space (a post-run replay — it costs a compile, which
+train()'s zero-compile telemetry pin forbids inline), and
+:func:`emit_staleness_split` packages both halves as the "stale_decode"
+typed event — the record that says whether staleness noise or
+erasure-coding noise dominates a regime.
 """
 
 from __future__ import annotations
@@ -108,6 +117,115 @@ def block_decode_error(
         "cumulative": cumulative,
         "exact_block_norms": exact_norm,
     }
+
+
+def staleness_error_series(
+    model, params_history, staleness, X, y, initial_params
+) -> np.ndarray:
+    """[R] per-round gradient-space STALENESS error of a pipelined run:
+
+        s[r] = || g(p_stale[r]) - g(p_fresh[r]) || / max(||g(p_fresh[r])||, eps)
+
+    where ``p_fresh[r]`` is the iterate ENTERING round r (``history[r-1]``,
+    or ``initial_params`` for round 0), ``p_stale[r]`` is the iterate the
+    pipelined scan actually differentiated at (the one entering round
+    ``r - staleness[r]``), and g is the model's full-batch gradient. Zero
+    exactly where ``staleness[r] == 0`` (the warm-up rounds and every
+    round of a tau=0 run) — staleness error is DEFINED as the gradient
+    displacement the stale slot introduced, nothing else.
+
+    This is the half of the pipelined error decomposition the weight-space
+    coding error (:func:`decode_error_series`) cannot see, and it needs a
+    gradient replay — one vmapped full-batch grad over the entering
+    iterates, a real device compile. Train() must stay zero-extra-compile
+    (the telemetry pin), so this runs POST-run, from tools (the
+    "stale_decode" event via :func:`emit_staleness_split`, the bench
+    pipeline extra, obs report assembly) — never inside the trainer.
+
+    ``X``/``y`` are the full training arrays (dense or TPU-native; scipy
+    sparse callers convert first, as evaluate.replay does);
+    ``staleness`` is the [R] tau schedule
+    (parallel.pipeline.staleness_schedule or PipelinedSchedule.staleness).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    tau = np.asarray(staleness, dtype=np.int64)
+    R = int(tau.shape[0])
+    # entering[r] = iterate entering round r: [p0, h[0], ..., h[R-2]]
+    entering = jax.tree.map(
+        lambda p0, h: jnp.concatenate(
+            [jnp.asarray(p0, h.dtype)[None], h[: R - 1]]
+        ),
+        initial_params,
+        params_history,
+    )
+    grads = jax.jit(
+        jax.vmap(model.grad_sum, in_axes=(0, None, None))
+    )(entering, X, y)
+    g = np.stack(
+        [
+            np.asarray(l, dtype=np.float64).reshape(R, -1)
+            for l in jax.tree.leaves(grads)
+        ],
+        axis=-1,
+    ).reshape(R, -1)  # [R, n_params]
+    idx = np.arange(R)
+    diff = g[idx - tau] - g[idx]
+    fresh_norm = np.linalg.norm(g, axis=-1)
+    err = np.linalg.norm(diff, axis=-1) / np.maximum(fresh_norm, 1e-30)
+    err[tau == 0] = 0.0
+    err[err < EXACT_TOL] = 0.0
+    return err
+
+
+def emit_staleness_split(run_id, result, dataset) -> dict:
+    """Compute a finished pipelined run's staleness-vs-coding error
+    decomposition and emit it as ONE "stale_decode" event (obs/events.py
+    schema): mean gradient-space staleness error, mean coding error (the
+    run's weight-space decode-error series — the quantity the papers
+    bound), and staleness's share of their sum. Returns the payload dict
+    (also the bench extra's record) whether or not an event capture is
+    active.
+
+    Tool-side by design: costs one vmapped gradient replay compile, which
+    train() is forbidden (zero-compile telemetry pin) — see
+    :func:`staleness_error_series`.
+    """
+    from erasurehead_tpu.obs import events as events_lib
+    from erasurehead_tpu.parallel.pipeline import staleness_schedule
+    from erasurehead_tpu.train import trainer as trainer_lib
+
+    cfg = result.config
+    model = trainer_lib.build_model(cfg)
+    p0 = trainer_lib._init_params_f32(cfg, model, dataset.n_features)
+    n = result.n_train
+    tau = staleness_schedule(cfg.rounds, cfg.pipeline_depth)[
+        result.start_round:
+    ]
+    s_err = staleness_error_series(
+        model, result.params_history, tau,
+        dataset.X_train[:n], dataset.y_train[:n], p0,
+    )
+    c_err = np.asarray(result.decode_error, dtype=np.float64)[
+        result.start_round:
+    ]
+    s_mean = float(s_err.mean()) if s_err.size else 0.0
+    c_mean = float(c_err.mean()) if c_err.size else 0.0
+    total = s_mean + c_mean
+    payload = {
+        "run_id": run_id,
+        "first_round": int(result.start_round),
+        "n_rounds": int(s_err.shape[0]),
+        "staleness_error_mean": round(s_mean, 10),
+        "coding_error_mean": round(c_mean, 10),
+        # which noise source dominates the regime: 0 = pure coding error
+        # (tau=0 runs land here exactly), 1 = pure staleness
+        "staleness_share": round(s_mean / total, 10) if total > 0 else 0.0,
+    }
+    if events_lib.current():
+        events_lib.emit("stale_decode", **payload)
+    return payload
 
 
 def summarize(decode_error) -> dict:

@@ -144,6 +144,23 @@ SCHEMA: dict[str, tuple] = {
     # which (:data:`IO_KINDS` — a window read off the mmapped shards, or
     # a store write by data/prepare.py) and ``bytes`` how much moved
     "io": ("kind", "bytes"),
+    # one per pipelined run (cfg.pipeline_depth > 0; parallel/pipeline.py):
+    # how far ahead of the synchronous round barrier the dispatches ran —
+    # mean/max per-round dispatch-ahead seconds and the total overlap the
+    # pipeline bought (the simulated-clock win's direct record, emitted
+    # host-side from the precomputed schedule: zero compiles)
+    "dispatch_ahead": ("run_id", "first_round", "n_rounds",
+                      "pipeline_depth", "ahead_mean_s", "ahead_max_s",
+                      "overlap_total_s"),
+    # one per pipelined run's post-hoc error decomposition (obs/decode.
+    # emit_staleness_split, invoked by tools — needs an eval replay, so
+    # never emitted from inside train()): mean gradient-space staleness
+    # error ||g_stale - g_fresh|| vs coding error ||g_hat - g_full||, and
+    # staleness's share of the combined error — the record that says
+    # whether tau=1 noise or erasure-coding noise dominates the regime
+    "stale_decode": ("run_id", "first_round", "n_rounds",
+                     "staleness_error_mean", "coding_error_mean",
+                     "staleness_share"),
 }
 
 #: adapt decision reasons (adapt/controller.AdaptiveController.choose)
@@ -478,8 +495,10 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
     counts; ``prefetch`` records carry a non-negative window index and
     byte count (plus, when present, non-negative ``fetch_s`` seconds);
     ``io`` records carry a known kind (:data:`IO_KINDS`) and a
-    non-negative byte count; every ``run_start`` has a matching later
-    ``run_end``."""
+    non-negative byte count; ``dispatch_ahead`` records carry a positive
+    pipeline depth and non-negative overlap seconds; ``stale_decode``
+    records carry non-negative error norms and a staleness share in
+    [0, 1]; every ``run_start`` has a matching later ``run_end``."""
     errors: list[str] = []
     # seq checking is MULTI-STREAM: a file may interleave several
     # append-mode loggers (concurrent journal writers, the serve daemon
@@ -782,6 +801,35 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
                 errors.append(
                     f"line {i}: prefetch fetch_s must be a non-negative "
                     f"number, got {fs!r}"
+                )
+        if rtype == "dispatch_ahead":
+            pd = rec.get("pipeline_depth")
+            if not isinstance(pd, int) or pd < 1:
+                errors.append(
+                    f"line {i}: dispatch_ahead pipeline_depth must be a "
+                    f"positive int (the event only exists for pipelined "
+                    f"runs), got {pd!r}"
+                )
+            for field in ("ahead_mean_s", "ahead_max_s", "overlap_total_s"):
+                v = rec.get(field)
+                if not isinstance(v, (int, float)) or v < 0:
+                    errors.append(
+                        f"line {i}: dispatch_ahead {field} must be a "
+                        f"non-negative number, got {v!r}"
+                    )
+        if rtype == "stale_decode":
+            for field in ("staleness_error_mean", "coding_error_mean"):
+                v = rec.get(field)
+                if not isinstance(v, (int, float)) or v < 0:
+                    errors.append(
+                        f"line {i}: stale_decode {field} must be a "
+                        f"non-negative number, got {v!r}"
+                    )
+            share = rec.get("staleness_share")
+            if not isinstance(share, (int, float)) or not 0 <= share <= 1:
+                errors.append(
+                    f"line {i}: stale_decode staleness_share must be a "
+                    f"number in [0, 1], got {share!r}"
                 )
         if rtype == "io":
             kind = rec.get("kind")

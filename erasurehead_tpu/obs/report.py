@@ -18,7 +18,9 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
     Returns one dict per run: {"run_id", "start": run_start|None,
     "end": run_end|None, "compiles": [...], "uploads": [...],
     "rounds": [...], "decode": [...], "cohort": cohort|None,
-    "warnings": [...], "prefetch": [...]}. A trailing run_id=None entry
+    "warnings": [...], "prefetch": [...],
+    "dispatch_ahead": dispatch_ahead|None,
+    "stale_decode": stale_decode|None}. A trailing run_id=None entry
     carries stray warnings, shard-store ``io`` records (out-of-core
     byte accounting), any ``sweep_trajectory`` journal records (a sweep
     journal is an events.jsonl like any other — `report` renders its
@@ -45,6 +47,7 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
                 "run_id": rid, "start": None, "end": None, "compiles": [],
                 "uploads": [], "rounds": [], "decode": [], "cohort": None,
                 "warnings": [], "prefetch": [],
+                "dispatch_ahead": None, "stale_decode": None,
             }
             order.append(rid)
         return runs[rid]
@@ -99,6 +102,10 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
                     serve["restarts"].append(rec)
                 elif rtype == "prefetch":
                     run(rid)["prefetch"].append(rec)
+                elif rtype == "dispatch_ahead":
+                    run(rid)["dispatch_ahead"] = rec
+                elif rtype == "stale_decode":
+                    run(rid)["stale_decode"] = rec
                 elif rtype == "io":
                     io.append(rec)
     out = [runs[rid] for rid in order]
@@ -192,6 +199,41 @@ def _membership_section(stray: list) -> list[str]:
             f"decode_err={_fmt(r.get('decode_error_mean'), '.6f')}"
             + (f" arm={arm}" if arm else "")
         )
+    return lines
+
+
+def _pipeline_section(groups: list) -> list[str]:
+    """The pipelined-training section: per pipelined run, how far ahead of
+    the synchronous round barrier its dispatches ran (the overlap the
+    pipeline bought on the simulated clock) and — when a tool emitted the
+    post-run decomposition — whether staleness noise or erasure-coding
+    noise dominated its decode error. From the ``dispatch_ahead`` and
+    ``stale_decode`` records (parallel/pipeline.py, obs/decode.py)."""
+    pipelined = [
+        g for g in groups if g.get("dispatch_ahead") or g.get("stale_decode")
+    ]
+    if not pipelined:
+        return []
+    lines = ["\npipelined training (bounded staleness):"]
+    for g in pipelined:
+        da = g.get("dispatch_ahead") or {}
+        sd = g.get("stale_decode") or {}
+        line = f"  {str(g['run_id'])[:16]:16s}"
+        if da:
+            line += (
+                f" depth={da.get('pipeline_depth', '?')}"
+                f" ahead mean/max "
+                f"{_fmt(da.get('ahead_mean_s'), '.4f')}/"
+                f"{_fmt(da.get('ahead_max_s'), '.4f')}s"
+                f" overlap {_fmt(da.get('overlap_total_s'), '.3f')}s"
+            )
+        if sd:
+            line += (
+                f" | staleness err {_fmt(sd.get('staleness_error_mean'), '.6f')}"
+                f" vs coding err {_fmt(sd.get('coding_error_mean'), '.6f')}"
+                f" (staleness share {_fmt(sd.get('staleness_share'), '.3f')})"
+            )
+        lines.append(line)
     return lines
 
 
@@ -402,6 +444,7 @@ def render(paths: Sequence[str]) -> str:
                 f"{c.get('n_trajectories', len(seeds))} trajectories in "
                 f"{disp} dispatch(es) [{c.get('lowering', '?')}]"
             )
+    lines.extend(_pipeline_section(groups))
     lines.extend(_prefetch_section(groups, stray))
     lines.extend(_serve_section(stray))
     lines.extend(_adapt_section(stray))

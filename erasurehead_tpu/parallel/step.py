@@ -876,6 +876,19 @@ def lowering_signature(cfg, model, X) -> tuple:
     )
 
 
+def staleness_slot_params(params, stale_params, pipeline_depth: int):
+    """The params slot the weighted-sum/refit decode contracts against.
+
+    Synchronous runs (``pipeline_depth=0``) read the scan carry's live
+    params; pipelined runs (tau=1) read the SECOND carry slot — the params
+    round r's workers were actually dispatched with (round r-1's entering
+    iterate, train/trainer.py's restructured carry). A static Python
+    branch, resolved at trace time: the tau=0 program is byte-identical to
+    the pre-pipeline lowering (the carry never grows a slot), which is
+    what keeps ``pipeline_depth=0`` bitwise today's trainer."""
+    return stale_params if pipeline_depth else params
+
+
 def expand_slot_weights(
     message_weights: jnp.ndarray,
     coeffs: jnp.ndarray,

@@ -93,6 +93,15 @@ class SchemeDescriptor:
     #: may ride a trajectory-batched cohort dispatch (the sweep planner's
     #: plan_cohorts and the serve packer both derive eligibility from this)
     cohort_batchable: bool = True
+    #: sound under bounded-staleness pipelined training (cfg.pipeline_depth
+    #: = 1, parallel/pipeline.py): True only where the scheme's decode is
+    #: already approximate — ErasureHead's decay-rate analysis tolerates a
+    #: noisy gradient, and a tau=1-stale one is just another noise source.
+    #: Exact-decode schemes keep False: their contract is "the decoded
+    #: gradient IS the full gradient at the current iterate", which
+    #: staleness breaks by construction. Third-party schemes default to
+    #: False (refuse until proven).
+    staleness_tolerant: bool = False
 
     # ---- config / CLI surface -------------------------------------------
     #: scheme-specific RunConfig knobs (beyond COMMON_CONFIG_FIELDS)
@@ -143,6 +152,7 @@ class SchemeDescriptor:
             "supports_measured": self.supports_measured,
             "supports_dynamic": self.supports_dynamic,
             "cohort_batchable": self.cohort_batchable,
+            "staleness_tolerant": self.staleness_tolerant,
             "supports_optimal_decode": self.optimal_decode is not None,
             "needs_num_collect": self.needs_num_collect,
             "needs_deadline": self.needs_deadline,
@@ -151,6 +161,17 @@ class SchemeDescriptor:
     def validate(self, cfg) -> None:
         """Scheme-specific config validation (utils.config delegates here
         from RunConfig.__post_init__)."""
+        if getattr(cfg, "pipeline_depth", 0) and not self.staleness_tolerant:
+            from erasurehead_tpu.utils.config import PipelineRefusal
+
+            kind = "exact-decode" if self.exact else "not staleness-tolerant"
+            raise PipelineRefusal(
+                "exact_decode" if self.exact else "untested_scheme",
+                f"pipeline_depth=1 refuses scheme={self.name!r} ({kind}): "
+                "a tau=1-stale gradient breaks the exactness contract, and "
+                "only schemes whose descriptor declares staleness_tolerant "
+                "(the approximate first-k/deadline families) run pipelined",
+            )
         if self.needs_deadline and (cfg.deadline is None or cfg.deadline <= 0):
             raise ValueError(
                 f"scheme={self.name!r} needs a positive deadline "

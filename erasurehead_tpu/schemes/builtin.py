@@ -360,6 +360,10 @@ APPROX = register(SchemeDescriptor(
     ),
     optimal_decode=lstsq_optimal_decode,
     needs_num_collect=True,
+    # AGC's decode is already approximate (group erasures) — ErasureHead's
+    # decay-rate analysis absorbs a tau=1-stale gradient the same way it
+    # absorbs the erasure noise, so pipelined dispatch is sound here
+    staleness_tolerant=True,
     config_fields=("num_collect",),
     validate_config=_validate_frc,  # AGC shares FRC's grouped layout
     # the straggler sweep's "interesting regime collects fewer than all"
@@ -390,6 +394,7 @@ AVOID_STRAGGLERS = register(SchemeDescriptor(
         f"needs first {layout.n_workers - layout.n_stragglers} arrivals",
     ),
     optimal_decode=lstsq_optimal_decode,
+    staleness_tolerant=True,  # rescaled-subset gradient: already approximate
     builtin=True,
 ))
 
@@ -410,6 +415,7 @@ RANDOM_REGULAR = register(SchemeDescriptor(
     ),
     optimal_decode=lstsq_optimal_decode,
     needs_num_collect=True,
+    staleness_tolerant=True,  # lstsq decode over a partial set: approximate
     config_fields=("num_collect",),
     seed_dependent_layout=True,
     builtin=True,
@@ -465,6 +471,9 @@ def _first_k_optimal_family(
         ),
         optimal_decode=lstsq_optimal_decode,
         needs_num_collect=True,
+        # first-k + lstsq over whatever arrived: approximate by design,
+        # so the family tolerates the tau=1 staleness noise source too
+        staleness_tolerant=True,
         config_fields=("num_collect",),
         seed_dependent_layout=seed_dependent,
         # the same "interesting regime collects fewer than all" default
@@ -514,6 +523,7 @@ DEADLINE = register(SchemeDescriptor(
     ),
     optimal_decode=lstsq_optimal_decode,
     needs_deadline=True,
+    staleness_tolerant=True,  # deadline-subset rescale: already approximate
     config_fields=("deadline",),
     validate_config=_validate_deadline,
     builtin=True,

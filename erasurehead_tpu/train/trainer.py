@@ -54,6 +54,7 @@ from erasurehead_tpu.train import optimizer
 from erasurehead_tpu.utils.config import (
     ComputeMode,
     ModelKind,
+    PipelineRefusal,
     RunConfig,
     Scheme,
 )
@@ -748,6 +749,37 @@ def train(
         raise ValueError(
             f"checkpoint_every must be >= 1, got {checkpoint_every}"
         )
+    if cfg.pipeline_depth:
+        # the pipelined scan carries a tau=1-stale params slot that is NOT
+        # part of the checkpoint / donor-state contract: any mid-run
+        # restore would re-enter the scan with a fabricated stale slot and
+        # silently fork the trajectory. Refuse (typed) rather than restore
+        # wrong; journaled sweeps (train/journal.py) stay the supported
+        # kill->resume path — they re-run whole trajectories bitwise,
+        # which the deterministic pipelined schedule preserves.
+        if checkpoint_dir is not None or resume:
+            raise PipelineRefusal(
+                "checkpoint_restart",
+                "pipeline_depth=1 refuses checkpoint_dir/resume: the "
+                "stale params slot is not in the checkpoint contract, so "
+                "a mid-run restore cannot reproduce the pipelined "
+                "trajectory (use journaled sweep resume instead)",
+            )
+        if initial_state is not None:
+            raise PipelineRefusal(
+                "elastic_restart",
+                "pipeline_depth=1 refuses initial_state/initial_round: an "
+                "elastic mid-schedule restart carries no stale params "
+                "slot, so the resumed pipelined trajectory would fork",
+            )
+        if schedule is not None:
+            raise PipelineRefusal(
+                "custom_schedule",
+                "pipeline_depth=1 refuses a caller-provided schedule: the "
+                "pipelined timing recurrence and the stale-gradient carry "
+                "must agree, so the schedule is derived from the arrivals "
+                "here (parallel/pipeline.pipelined_schedule), not passed in",
+            )
     # ---- stack residency (out-of-core streaming; data/store.py) -----------
     # resolved before any device setup. Streamed runs live out of a shard
     # store; when the resolved window covers every partition the store's
@@ -762,6 +794,16 @@ def train(
             cfg, store.n_partitions, store.partition_bytes()
         )
         if stream_window < store.n_partitions:
+            if cfg.pipeline_depth:
+                raise PipelineRefusal(
+                    "streamed_window",
+                    "pipeline_depth=1 refuses windowed streamed residency: "
+                    "the block trainer re-enters the scan per window, and "
+                    "threading the stale params slot across windows is "
+                    "untested (a single-window streamed run — window "
+                    "covering every partition — rides the resident "
+                    "pipeline and composes)",
+                )
             return _train_streamed(
                 cfg, dataset, store, stream_window,
                 mesh=mesh, arrivals=arrivals, schedule=schedule,
@@ -782,12 +824,22 @@ def train(
     if arrivals is None:
         arrivals = default_arrivals(cfg)
     if schedule is None:
-        # a custom schedule (e.g. parallel/failures.plan_run's failover
-        # rewrite) overrides the scheme's plain collection rule
-        schedule = collect.build_schedule(
-            cfg.scheme, arrivals, layout, num_collect=cfg.num_collect,
-            deadline=cfg.deadline, decode=cfg.decode,
-        )
+        if cfg.pipeline_depth:
+            # pipelined control plane: same drawn arrivals, the bounded-
+            # staleness dispatch recurrence on top (parallel/pipeline.py).
+            # Duck-types CollectionSchedule, so everything downstream —
+            # slot-weight expansion, decode-error series, telemetry —
+            # reads it unchanged.
+            from erasurehead_tpu.parallel import pipeline as pipeline_lib
+
+            schedule = pipeline_lib.pipelined_schedule(cfg, arrivals, layout)
+        else:
+            # a custom schedule (e.g. parallel/failures.plan_run's failover
+            # rewrite) overrides the scheme's plain collection rule
+            schedule = collect.build_schedule(
+                cfg.scheme, arrivals, layout, num_collect=cfg.num_collect,
+                deadline=cfg.deadline, decode=cfg.decode,
+            )
     # per-round decode-error norm (obs/decode.py): host float64 from the
     # weights the run decodes with — computed unconditionally (cheap, and
     # TrainResult.decode_error feeds bench/experiment rows even without an
@@ -925,6 +977,45 @@ def train(
             unroll=cfg.scan_unroll,
         )
 
+    if cfg.pipeline_depth:
+        # pipelined carry: (live state, stale params slot). Round r's
+        # gradient is taken at the params that ENTERED round r-1 (tau=1);
+        # the update itself stays at the live iterate, so the trajectory
+        # is SGD with a one-round-stale gradient — exactly the bounded-
+        # staleness regime the timing model in parallel/pipeline.py
+        # overlaps. Init is (state0, state0.params): rounds 0 and 1 both
+        # compute at p0 (the fresh warm-up; there is no older iterate),
+        # matching staleness_schedule's tau = min(r, depth).
+        def body_pipe(Xa, ya, carry, xs):
+            state, stale = carry
+            eta, w_t, i = xs
+            with annotate("eh_scan/coded_step"):
+                g = grad_fn(
+                    step_lib.staleness_slot_params(
+                        state.params, stale, cfg.pipeline_depth
+                    ),
+                    Xa, ya, w_t,
+                )
+            with annotate("eh_scan/update"):
+                new_state = update_fn(state, g, eta, alpha, n_train, i)
+            return (new_state, state.params), new_state.params
+
+        def _run(carry, Xa, ya, lr_c, w_c, it_c):
+            return jax.lax.scan(
+                partial(body_pipe, Xa, ya), carry, (lr_c, w_c, it_c),
+                unroll=cfg.scan_unroll,
+            )
+
+    def as_carry(state):
+        # the jitted scan's carry argument; pipelined runs thread the
+        # extra stale params slot (one params-sized buffer — the +1 slot
+        # estimate_stack_bytes charges serve admission for). The slot is
+        # COPIED: under donation the carry is donated whole, and a slot
+        # aliasing state.params would donate the same buffer twice
+        if not cfg.pipeline_depth:
+            return state
+        return state, jax.tree.map(lambda l: l.copy(), state.params)
+
     # buffer donation (cfg.donate): the scan carry (params + optimizer
     # state, argnum 0) aliases straight into the final-state output, and
     # the per-round weight table (argnum 4) becomes reusable scratch —
@@ -1024,7 +1115,7 @@ def train(
                     t0 = time.perf_counter()
                     with _quiet_donation_warnings():
                         ex = run.lower(
-                            state0, X, y, *slices(lo, hi)
+                            as_carry(state0), X, y, *slices(lo, hi)
                         ).compile()
                     if measure:
                         lr_c, w_c, it_c = slices(lo, hi)
@@ -1033,9 +1124,9 @@ def train(
                             # real run still needs state0 (and a full-
                             # range weight slice aliases weights_seq)
                             lr_c2, w_c2 = lr_c, _donate_copy(w_c)
-                            st = _donate_copy(state0)
+                            st = _donate_copy(as_carry(state0))
                         else:
-                            lr_c2, w_c2, st = lr_c, w_c, state0
+                            lr_c2, w_c2, st = lr_c, w_c, as_carry(state0)
                         _hard_sync(ex(st, X, y, lr_c2, w_c2, it_c)[0])
                     return ex, time.perf_counter() - t0
 
@@ -1065,24 +1156,26 @@ def train(
                         memory_analysis=_memory_analysis(compiled[n]),
                     )
 
-        state = state0
+        carry = as_carry(state0)
         pieces = []
         wall = 0.0  # accumulates compute only; checkpoint I/O excluded
         for lo, hi in zip(bounds[:-1], bounds[1:]):
             if hi == lo:
                 continue
             t0 = time.perf_counter()
-            state, hist = compiled[hi - lo](state, X, y, *slices(lo, hi))
-            _hard_sync(state)  # small final carry, not the full history
+            carry, hist = compiled[hi - lo](carry, X, y, *slices(lo, hi))
+            _hard_sync(carry)  # small final carry, not the full history
             wall += time.perf_counter() - t0
             pieces.append(hist)
             if checkpoint_dir and checkpoint_every and hi < cfg.rounds:
+                # never pipelined here: checkpointing is config-refused
+                # above, so the carry IS the bare optimizer state
                 from erasurehead_tpu.train import checkpoint as ckpt_lib
 
                 ckpt_lib.save(
-                    os.path.join(checkpoint_dir, f"round_{hi}"), state, hi
+                    os.path.join(checkpoint_dir, f"round_{hi}"), carry, hi
                 )
-        final_state = state
+        final_state = carry[0] if cfg.pipeline_depth else carry
         history = (
             pieces[0]
             if len(pieces) == 1
@@ -1119,6 +1212,22 @@ def train(
             ),
             **obs_decode.summarize(decode_err),
         )
+        if cfg.pipeline_depth:
+            # pipeline overlap telemetry: pure numpy off the precomputed
+            # schedule (zero compiles — the telemetry pin stands). The
+            # gradient-space staleness split ("stale_decode") needs a
+            # replay compile, so it is a post-run tool concern
+            # (obs/decode.emit_staleness_split), never train()'s.
+            from erasurehead_tpu.parallel import pipeline as pipeline_lib
+
+            obs_events.emit(
+                "dispatch_ahead",
+                run_id=run_id,
+                first_round=start_round,
+                n_rounds=int(cfg.rounds - start_round),
+                pipeline_depth=int(cfg.pipeline_depth),
+                **pipeline_lib.overlap_summary(schedule),
+            )
     return TrainResult(
         params_history=history,
         final_params=final_state.params,
@@ -1168,6 +1277,15 @@ def train(
             "donation": donate,
             "stack_bytes": cache_lib.device_nbytes(data),
             "memory_analysis": mem_info,
+            # pipelined runs carry one extra params-sized buffer in the
+            # scan carry (the stale slot); surfaced so bench's memory
+            # honesty rows and serve admission can account for it
+            "pipeline_depth": cfg.pipeline_depth,
+            "pipeline_params_slot_bytes": (
+                cache_lib.device_nbytes(final_state.params)
+                if cfg.pipeline_depth
+                else 0
+            ),
             # RESOLVED stack residency: "streamed" here means the run's
             # window covered the whole stack (the single-window fast path
             # — same resident pipeline, fed from the shard store)
@@ -1544,12 +1662,16 @@ def cohort_eligible(cfg: RunConfig) -> bool:
     The scheme's registry descriptor can also opt out
     (``cohort_batchable=False``) — what the sweep planner
     (experiments.plan_cohorts) and the serve packer (serve/packer.py)
-    both key third-party compatibility on."""
+    both key third-party compatibility on.
+    Pipelined runs (pipeline_depth > 0) are excluded: the cohort scan has
+    no batched stale-carry slot, so they dispatch as per-run train() —
+    the routing train_cohort's "cohort_batch" refusal relies on."""
     from erasurehead_tpu import schemes
 
     return (
         cfg.arrival_mode == "simulated"
         and cfg.use_pallas != "on"
+        and cfg.pipeline_depth == 0
         and _resolve_residency(cfg) == "resident"
         and schemes.get(cfg.scheme).cohort_batchable
     )
@@ -1609,6 +1731,15 @@ def estimate_stack_bytes(cfg: RunConfig, dataset: Dataset) -> int:
         est = per_block * blocks
     else:
         est = worker_stack_est
+    if cfg.pipeline_depth:
+        # the pipelined scan carry pins one EXTRA params-sized buffer (the
+        # tau=1-stale slot, parallel/pipeline.py) for the whole dispatch.
+        # Charged at the dense-GLM params size — features + intercept in
+        # float32 (params/optimizer state never ride the stack dtype) —
+        # per pipeline depth. Tiny next to the data stack, but admission
+        # is a bound and the slot is real residency, so it is counted.
+        F = int(dataset.X_train.shape[1])
+        est += cfg.pipeline_depth * (F + 1) * 4
     return int(est)
 
 
@@ -1700,6 +1831,13 @@ def train_cohort(
             raise ValueError(
                 "train_cohort has no batched fused-kernel dispatch; "
                 "use use_pallas='auto' or 'off'"
+            )
+        if c.pipeline_depth:
+            raise PipelineRefusal(
+                "cohort_batch",
+                "train_cohort has no batched stale-carry scan; pipelined "
+                "trajectories dispatch sequentially as per-run train() "
+                "(experiments.plan_cohorts already routes them so)",
             )
         if _resolve_residency(c) != "resident":
             raise ValueError(
@@ -2201,6 +2339,15 @@ def train_measured(
     # configured *simulated* heterogeneity contradicts measuring the real
     # thing, and the other trainer knobs below have no measured-mode
     # implementation — refuse rather than silently run something else
+    if cfg.pipeline_depth:
+        # belt-and-braces: RunConfig already refuses measured+pipelined,
+        # but train_measured is also callable with simulated-mode configs
+        raise PipelineRefusal(
+            "measured_arrivals",
+            "pipeline_depth=1 has no measured-arrival implementation: "
+            "online per-round collection cannot overlap rounds whose "
+            "arrivals it has not measured yet",
+        )
     if cfg.compute_time or cfg.worker_speed_spread:
         raise ValueError(
             "arrival_mode='measured' measures real per-worker compute; "
@@ -2814,6 +2961,13 @@ def train_dynamic(
             "control plane (a per-round float64 lstsq); train_dynamic's "
             "weights are traced values inside the scan — use "
             "trainer.train() for optimal decoding"
+        )
+    if cfg.pipeline_depth:
+        raise PipelineRefusal(
+            "dynamic_rule",
+            "pipeline_depth=1 has no on-device dynamic implementation: "
+            "the pipelined dispatch recurrence lives on the host control "
+            "plane (parallel/pipeline.py) — use trainer.train()",
         )
     setup = _setup_run(cfg, dataset, mesh, faithful=True)
     layout, model, mesh, data = setup.layout, setup.model, setup.mesh, setup.data

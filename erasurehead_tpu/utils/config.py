@@ -97,6 +97,24 @@ def as_scheme(name) -> "Scheme | ExtensionScheme":
     )
 
 
+class PipelineRefusal(ValueError):
+    """Typed refusal for scheme x mode combinations where bounded-staleness
+    pipelining (``pipeline_depth=1``) is unsound or unproven.
+
+    A ``ValueError`` subclass so every existing feasibility filter — the
+    what-if enumerator's infeasible-point recording, serve admission's
+    config rejection, the CLI's error path — classifies it exactly like any
+    other config refusal, while callers that care WHY (the refusal matrix
+    in README/MIGRATION) can catch the specific type and read ``reason``.
+    """
+
+    def __init__(self, reason: str, message: str):
+        #: machine-readable refusal tag ("exact_decode", "agd_momentum",
+        #: "measured_arrivals", ...) — stable across message rewording
+        self.reason = reason
+        super().__init__(message)
+
+
 class UpdateRule(str, enum.Enum):
     GD = "GD"
     AGD = "AGD"  # Nesterov-style accelerated GD (src/naive.py:116-122)
@@ -347,6 +365,17 @@ class RunConfig:
     # Identical math at any value (scan semantics); a lowering knob like
     # dtype/flat_grad — raced on silicon before becoming a default.
     scan_unroll: int = 1
+    # bounded-staleness pipelined training (parallel/pipeline.py): 0 keeps
+    # the strictly synchronous round barrier (bitwise today's trainer); 1
+    # dispatches round t+1's worker compute against params from round t-1
+    # while round t's arrivals drain (staleness tau=1). The trainer's scan
+    # carry grows a second params slot; the collection schedule becomes the
+    # deterministic pipelined recurrence over the SAME drawn arrival matrix
+    # (journal/replay identity is preserved — the staleness schedule is a
+    # pure function of the run signature). Refused (PipelineRefusal) on
+    # exact-decode schemes (staleness breaks the exactness contract), AGD
+    # (momentum unproven under tau=1), and measured arrivals.
+    pipeline_depth: int = 0
     # sequence-parallel shards for the attention family: >1 builds a 2-D
     # (workers, seq) mesh; each row's token axis splits over seq and
     # attention spans it (parallel/ring.py, models/attention._predict_seq)
@@ -661,6 +690,29 @@ class RunConfig:
             raise ValueError(
                 f"decode must be fixed/optimal, got {self.decode!r}"
             )
+        if self.pipeline_depth not in (0, 1):
+            raise ValueError(
+                f"pipeline_depth must be 0 (synchronous) or 1 (bounded "
+                f"staleness tau=1), got {self.pipeline_depth}"
+            )
+        if self.pipeline_depth:
+            if self.update_rule != UpdateRule.GD:
+                raise PipelineRefusal(
+                    "momentum_unproven",
+                    f"pipeline_depth=1 refuses update_rule="
+                    f"{self.update_rule.value!r}: the momentum/adaptive "
+                    "update's stability under a tau=1 stale gradient is "
+                    "unproven here — use update_rule='GD' with pipelining",
+                )
+            if self.arrival_mode == "measured":
+                raise PipelineRefusal(
+                    "measured_arrivals",
+                    "pipeline_depth=1 refuses arrival_mode='measured': the "
+                    "measured trainer times real per-worker dispatches "
+                    "round by round, and overlapping rounds would make the "
+                    "measurement racy instead of stale — use the simulated-"
+                    "arrival trainer with pipelining",
+                )
         if self.num_collect is None:
             self.num_collect = self.n_workers
         if self.dataset not in DATASET_PRESETS:
@@ -706,6 +758,10 @@ class RunConfig:
             "update_rule": self.update_rule.value,
             "dtype": self.dtype,
             "scan_unroll": self.scan_unroll,
+            # the staleness slot restructures the scan carry (two params
+            # slots), so tau=0 and tau=1 dispatches can never share an
+            # executable — and the recompile detector names the knob
+            "pipeline_depth": self.pipeline_depth,
             # features-module lowering knobs (scoped per run by
             # trainer._with_run_sparse_lanes; they retrace every jit)
             "sparse_lanes": self.sparse_lanes,

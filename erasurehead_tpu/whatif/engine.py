@@ -212,6 +212,7 @@ def run_whatif(
             "deadline": p.policy.deadline,
             "decode": spec.decode,
             "regime": p.regime.tag,
+            "pipeline_depth": p.pipeline_depth,
             "feasible": p.feasible,
             "reason": p.reason,
             "n_seeds": spec.n_seeds if p.feasible else 0,
@@ -362,6 +363,12 @@ def main(argv=None) -> int:
     p.add_argument("--target-loss", type=float, default=None,
                    help="time-to-target anchor; default 1.05x the worst "
                         "converged final loss across the grid")
+    p.add_argument("--pipeline-depths", default="0",
+                   help="comma-separated staleness axis (subset of 0,1): "
+                        "1 adds bounded-staleness pipelined points "
+                        "(tau=1, --pipeline-depth) per coordinate; "
+                        "pipelining-refused combinations surface as "
+                        "infeasible rows with the typed reason")
     p.add_argument("--out", default=None, metavar="DIR",
                    help="save surface_rows.jsonl + surface.npz (and the "
                         "events.jsonl run log) here; reruns of an "
@@ -393,6 +400,7 @@ def main(argv=None) -> int:
             lr=ns.lr,
             decode=ns.decode,
             target_loss=ns.target_loss,
+            pipeline_depths=spec_lib.parse_ints(ns.pipeline_depths),
         )
     except ValueError as e:
         p.error(str(e))

@@ -1,8 +1,8 @@
 """Grid specification for the what-if engine.
 
 A :class:`GridSpec` is the declarative question: which (scheme, W, s,
-num_collect, deadline, decode, arrival-regime) points to simulate, over
-how many Monte-Carlo seeds, at what problem shape. Enumeration
+num_collect, deadline, decode, arrival-regime, pipeline-staleness) points
+to simulate, over how many Monte-Carlo seeds, at what problem shape. Enumeration
 (:func:`enumerate_points`) builds each point's RunConfig and filters
 feasibility through the SAME validation the real entry points use — the
 registry descriptor's ``validate_config`` hook via RunConfig's own
@@ -116,6 +116,16 @@ class GridSpec:
     #: Monte-Carlo axis (only the arrival draw varies per seed)
     model_seed: int = 0
     data_seed: int = 0
+    #: staleness axis: pipeline depths to enumerate per coordinate
+    #: (cfg.pipeline_depth; parallel/pipeline.py). Default (0,) — the
+    #: synchronous grid, and the axis is then OMITTED from the payload so
+    #: every pre-existing spec hash (and its saved surface) is unchanged.
+    #: Adding 1 grows the grid with tau=1 points; pipelining-refused
+    #: combinations (exact schemes, non-GD update rules) surface as
+    #: infeasible rows with the typed reason, exactly like any other
+    #: validator refusal — how policy search locates the regime where the
+    #: staleness win is largest without tripping over unsound corners.
+    pipeline_depths: tuple = (0,)
 
     def __post_init__(self):
         object.__setattr__(self, "policies", tuple(self.policies))
@@ -126,12 +136,25 @@ class GridSpec:
             self, "n_stragglers", tuple(int(s) for s in self.n_stragglers)
         )
         object.__setattr__(self, "regimes", tuple(self.regimes))
+        object.__setattr__(
+            self,
+            "pipeline_depths",
+            tuple(int(d) for d in self.pipeline_depths),
+        )
         if not self.policies:
             raise ValueError("grid spec needs at least one policy")
         if not self.n_workers or not self.n_stragglers or not self.regimes:
             raise ValueError(
                 "grid spec needs at least one n_workers, n_stragglers and "
                 "regime value"
+            )
+        if not self.pipeline_depths or any(
+            d not in (0, 1) for d in self.pipeline_depths
+        ):
+            raise ValueError(
+                "pipeline_depths must be a non-empty subset of {0, 1} "
+                f"(bounded staleness tau=1 is the only pipelined mode), "
+                f"got {self.pipeline_depths!r}"
             )
         if self.n_seeds < 1:
             raise ValueError(f"n_seeds must be >= 1, got {self.n_seeds}")
@@ -145,11 +168,12 @@ class GridSpec:
             * len(self.n_workers)
             * len(self.n_stragglers)
             * len(self.regimes)
+            * len(self.pipeline_depths)
         )
 
     def payload(self) -> dict:
         """Canonical JSON form (stable field order — the hash input)."""
-        return {
+        out = {
             "policies": [p.payload() for p in self.policies],
             "n_workers": list(self.n_workers),
             "n_stragglers": list(self.n_stragglers),
@@ -166,6 +190,12 @@ class GridSpec:
             "model_seed": self.model_seed,
             "data_seed": self.data_seed,
         }
+        # omitted at the default, like RegimeSpec's optional fields: every
+        # synchronous spec keeps its pre-staleness-axis hash, so saved
+        # surfaces stay rehydratable (the tau=0 no-drift contract)
+        if self.pipeline_depths != (0,):
+            out["pipeline_depths"] = list(self.pipeline_depths)
+        return out
 
     def spec_hash(self) -> str:
         blob = json.dumps(self.payload(), sort_keys=True).encode()
@@ -188,12 +218,18 @@ class GridPoint:
     config: Optional[object] = None
     feasible: bool = True
     reason: Optional[str] = None
+    #: the point's staleness coordinate (0 = synchronous)
+    pipeline_depth: int = 0
 
 
-def point_config(spec: GridSpec, policy: PolicySpec, W: int, s: int):
+def point_config(
+    spec: GridSpec, policy: PolicySpec, W: int, s: int,
+    pipeline_depth: int = 0,
+):
     """The RunConfig for one grid coordinate — raising ValueError exactly
     where any real entry point would (RunConfig.__post_init__ delegates to
-    the registry descriptor's validate hook)."""
+    the registry descriptor's validate hook, which is also where a
+    pipelined coordinate on an exact-decode scheme refuses)."""
     from erasurehead_tpu.utils.config import RunConfig
 
     num_collect = policy.resolve_num_collect(W)
@@ -219,33 +255,40 @@ def point_config(spec: GridSpec, policy: PolicySpec, W: int, s: int):
         partitions_per_worker=policy.partitions_per_worker,
         compute_mode="deduped",
         seed=spec.model_seed,
+        pipeline_depth=pipeline_depth,
     )
 
 
 def enumerate_points(spec: GridSpec) -> list:
     """Every grid coordinate in deterministic order, feasibility-filtered
     (module docstring). Infeasible points come back with the validator's
-    reason, never a config."""
+    reason, never a config — including PipelineRefusal'd staleness
+    coordinates (exact-decode schemes, non-GD update rules), which is how
+    the surface records WHERE tau=1 is unsound rather than crashing the
+    sweep."""
     points: list = []
-    for policy, W, s, regime in itertools.product(
-        spec.policies, spec.n_workers, spec.n_stragglers, spec.regimes
+    for policy, W, s, regime, depth in itertools.product(
+        spec.policies, spec.n_workers, spec.n_stragglers, spec.regimes,
+        spec.pipeline_depths,
     ):
         label = f"{policy.label}@W{W}s{s}/{regime.tag}"
+        if depth:
+            label += f"/tau{depth}"
         try:
-            cfg = point_config(spec, policy, W, s)
+            cfg = point_config(spec, policy, W, s, pipeline_depth=depth)
         except ValueError as e:
             points.append(
                 GridPoint(
                     label=label, policy=policy, n_workers=W,
                     n_stragglers=s, regime=regime, config=None,
-                    feasible=False, reason=str(e),
+                    feasible=False, reason=str(e), pipeline_depth=depth,
                 )
             )
             continue
         points.append(
             GridPoint(
                 label=label, policy=policy, n_workers=W, n_stragglers=s,
-                regime=regime, config=cfg,
+                regime=regime, config=cfg, pipeline_depth=depth,
             )
         )
     return points

@@ -36,7 +36,8 @@ import numpy as np
 #: stable row field order (the JSONL key order and the npz column set)
 ROW_FIELDS = (
     "label", "scheme", "n_workers", "n_stragglers", "num_collect",
-    "deadline", "decode", "regime", "feasible", "reason", "n_seeds",
+    "deadline", "decode", "regime", "pipeline_depth", "feasible",
+    "reason", "n_seeds",
     "n_diverged", "reach_fraction", "expected_time_to_target",
     "time_to_target_std", "sim_time_per_round", "decode_error_mean",
     "final_loss_mean",
@@ -44,7 +45,8 @@ ROW_FIELDS = (
 
 #: numeric columns mirrored into surface.npz (None -> NaN)
 _NPZ_COLUMNS = (
-    "n_workers", "n_stragglers", "num_collect", "deadline", "n_seeds",
+    "n_workers", "n_stragglers", "num_collect", "deadline",
+    "pipeline_depth", "n_seeds",
     "n_diverged", "reach_fraction", "expected_time_to_target",
     "time_to_target_std", "sim_time_per_round", "decode_error_mean",
     "final_loss_mean",

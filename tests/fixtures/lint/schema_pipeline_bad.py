@@ -1,0 +1,17 @@
+"""pipeline event-schema violations: a dispatch_ahead emit missing the
+required pipeline_depth, and a logger-object stale_decode emit missing
+the staleness_share decomposition field — the pipelined-training record
+types (ISSUE 16) are lint-enforced like every other."""
+
+from erasurehead_tpu.obs import events as events_lib
+
+
+def emit_pipeline(logger):
+    events_lib.emit(
+        "dispatch_ahead", run_id="r", first_round=0, n_rounds=8,
+        ahead_mean_s=0.1, ahead_max_s=0.5, overlap_total_s=1.0,
+    )  # missing pipeline_depth
+    logger.emit(
+        "stale_decode", run_id="r", first_round=0, n_rounds=8,
+        staleness_error_mean=0.1, coding_error_mean=0.2,
+    )  # missing staleness_share

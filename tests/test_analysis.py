@@ -146,6 +146,17 @@ def test_schema_whatif_fixture():
     assert len(findings) == 2
 
 
+def test_schema_pipeline_fixture():
+    """The pipelined-training records (ISSUE 16: dispatch_ahead /
+    stale_decode) are lint-enforced like every other type: emits missing
+    the staleness bookkeeping fields are findings."""
+    findings = _unsup(_lint(_fx("schema_pipeline_bad.py")), "event-schema")
+    msgs = "\n".join(f.message for f in findings)
+    assert "pipeline_depth" in msgs
+    assert "staleness_share" in msgs  # the logger-object emit is checked
+    assert len(findings) == 2
+
+
 def test_schema_validator_drift_fixture():
     findings = _unsup(_lint(_fx("schema_drift_bad.py")), "event-schema")
     assert len(findings) == 1

@@ -1,0 +1,304 @@
+"""Pipelined staleness-tolerant training (ISSUE 16): the bounded-
+staleness (tau=1) mode that breaks the round barrier.
+
+Pins the contracts the mode ships under:
+
+  - the tau=0 pipelined schedule is BITWISE the synchronous
+    CollectionSchedule across schemes (depth 0 is not "approximately"
+    synchronous — it is the same schedule);
+  - depth-1 schedule invariants: completion clock monotone, dispatch-
+    ahead non-negative, and exactly zero everywhere at depth 0;
+  - the refusal matrix: every unsound/untested path refuses with a
+    typed PipelineRefusal whose ``reason`` tag is stable;
+  - pipelined runs are deterministic (stale, not async-racy): reruns
+    are bitwise in params history and simulated clock, and a chaos-
+    killed journaled sweep resumes to identical rows;
+  - telemetry: dispatch_ahead rides the run, the staleness-vs-coding
+    decomposition validates, and a tau=0 run decomposes to pure coding
+    error (staleness_share exactly 0.0);
+  - serve-admission honesty: the pipelined footprint estimate charges
+    exactly one extra params slot.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from erasurehead_tpu.data.synthetic import generate_gmm
+from erasurehead_tpu.obs import decode as decode_lib
+from erasurehead_tpu.obs import events as events_lib
+from erasurehead_tpu.parallel import collect, pipeline as pipeline_lib
+from erasurehead_tpu.train import experiments, trainer
+from erasurehead_tpu.train import journal as journal_lib
+from erasurehead_tpu.utils import chaos
+from erasurehead_tpu.utils.config import PipelineRefusal, RunConfig
+
+W = 4
+R = 6
+
+
+@pytest.fixture(scope="module")
+def gmm():
+    return generate_gmm(64, 8, n_partitions=W, seed=0)
+
+
+def _cfg(**kw):
+    # avoidstragg + GD: the staleness-tolerant reference combination.
+    # lr_schedule is EXPLICIT — the default schedule sits at GD's
+    # stability edge and tau=1 shrinks the stable region
+    d = dict(
+        scheme="avoidstragg", n_workers=W, n_stragglers=1, rounds=R,
+        n_rows=64, n_cols=8, update_rule="GD", lr_schedule=1.0,
+        add_delay=True, seed=0, compute_mode="deduped",
+    )
+    d.update(kw)
+    return RunConfig(**d)
+
+
+def _bitwise(a, b):
+    import jax
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# schedule (parallel/pipeline.py)
+
+
+def test_staleness_schedule_values():
+    np.testing.assert_array_equal(
+        pipeline_lib.staleness_schedule(5, 1), [0, 1, 1, 1, 1]
+    )
+    np.testing.assert_array_equal(
+        pipeline_lib.staleness_schedule(4, 0), [0, 0, 0, 0]
+    )
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        {"scheme": "naive"},
+        {"scheme": "avoidstragg"},
+        {"scheme": "approx", "num_collect": 3},
+        {"scheme": "cyccoded"},
+        {"scheme": "deadline", "deadline": 1.5},
+    ],
+)
+def test_tau0_schedule_bitwise_synchronous(kw):
+    """depth 0 collapses exactly: same weights, clocks, arrivals and
+    collection masks as collect.build_schedule — float-associativity
+    included (relative quantities never round-trip through the absolute
+    clock)."""
+    cfg = _cfg(**kw)
+    t = trainer.default_arrivals(cfg)
+    layout = trainer.build_layout(cfg)
+    sync = collect.build_schedule(
+        cfg.scheme, t, layout, num_collect=cfg.num_collect,
+        deadline=cfg.deadline, decode=cfg.decode,
+    )
+    pipe = pipeline_lib.pipelined_schedule(cfg, t, layout)
+    np.testing.assert_array_equal(pipe.message_weights, sync.message_weights)
+    np.testing.assert_array_equal(pipe.sim_time, sync.sim_time)
+    np.testing.assert_array_equal(pipe.worker_times, sync.worker_times)
+    np.testing.assert_array_equal(pipe.collected, sync.collected)
+    assert np.all(pipe.dispatch_ahead == 0.0)
+    assert np.all(pipe.staleness == 0)
+
+
+def test_tau1_schedule_invariants():
+    cfg = _cfg(pipeline_depth=1, rounds=20)
+    t = trainer.default_arrivals(cfg)
+    layout = trainer.build_layout(cfg)
+    sched = pipeline_lib.pipelined_schedule(cfg, t, layout)
+    assert np.all(np.diff(sched.done) >= 0.0)  # completion clock monotone
+    assert np.all(sched.dispatch_ahead >= 0.0)
+    assert np.all(sched.sim_time >= 0.0)
+    np.testing.assert_array_equal(
+        sched.staleness, pipeline_lib.staleness_schedule(20, 1)
+    )
+    # dispatch-ahead engages somewhere under exp straggling, and the
+    # pipelined completion clock never trails the per-round stop sum
+    assert float(sched.dispatch_ahead.sum()) > 0.0
+    summary = pipeline_lib.overlap_summary(sched)
+    assert set(summary) == {"ahead_mean_s", "ahead_max_s", "overlap_total_s"}
+    assert summary["overlap_total_s"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# refusal matrix
+
+
+@pytest.mark.parametrize(
+    "kw,reason",
+    [
+        ({"scheme": "cyccoded"}, "exact_decode"),
+        ({"scheme": "repcoded"}, "exact_decode"),
+        ({"scheme": "naive"}, "exact_decode"),
+        ({"update_rule": "AGD"}, "momentum_unproven"),
+        ({"arrival_mode": "measured"}, "measured_arrivals"),
+    ],
+)
+def test_refusals_at_config(kw, reason):
+    with pytest.raises(PipelineRefusal) as ei:
+        _cfg(pipeline_depth=1, **kw)
+    assert ei.value.reason == reason
+
+
+def test_refusals_are_valueerrors():
+    # every feasibility filter (whatif enumerator, serve admission, CLI)
+    # classifies a refusal like any other config error
+    with pytest.raises(ValueError):
+        _cfg(pipeline_depth=1, scheme="cyccoded")
+    with pytest.raises(ValueError):
+        _cfg(pipeline_depth=2)
+
+
+def test_refusals_at_train(gmm, tmp_path):
+    cfg = _cfg(pipeline_depth=1)
+    with pytest.raises(PipelineRefusal) as ei:
+        trainer.train(cfg, gmm, checkpoint_dir=str(tmp_path / "ck"))
+    assert ei.value.reason == "checkpoint_restart"
+    with pytest.raises(PipelineRefusal) as ei:
+        trainer.train(cfg, gmm, resume=True)
+    assert ei.value.reason == "checkpoint_restart"
+    with pytest.raises(PipelineRefusal) as ei:
+        trainer.train(cfg, gmm, initial_state=object(), initial_round=2)
+    assert ei.value.reason == "elastic_restart"
+    sync_cfg = _cfg()
+    sched = collect.build_schedule(
+        sync_cfg.scheme, trainer.default_arrivals(sync_cfg),
+        trainer.build_layout(sync_cfg),
+    )
+    with pytest.raises(PipelineRefusal) as ei:
+        trainer.train(cfg, gmm, schedule=sched)
+    assert ei.value.reason == "custom_schedule"
+    with pytest.raises(PipelineRefusal) as ei:
+        trainer.train_cohort([cfg, cfg], gmm)
+    assert ei.value.reason == "cohort_batch"
+    with pytest.raises(PipelineRefusal) as ei:
+        trainer.train_dynamic(_cfg(pipeline_depth=1), gmm)
+    assert ei.value.reason == "dynamic_rule"
+
+
+def test_cohort_planner_routes_pipelined_singletons():
+    cfgs = {
+        "sync0": _cfg(seed=0),
+        "sync1": _cfg(seed=1),
+        "pipe0": _cfg(seed=0, pipeline_depth=1),
+        "pipe1": _cfg(seed=1, pipeline_depth=1),
+    }
+    plan = experiments.plan_cohorts(cfgs)
+    assert (["sync0", "sync1"], True) in plan
+    assert (["pipe0"], False) in plan
+    assert (["pipe1"], False) in plan
+    assert not trainer.cohort_eligible(cfgs["pipe0"])
+
+
+# ---------------------------------------------------------------------------
+# determinism (stale, not async-racy)
+
+
+def test_pipelined_run_deterministic(gmm):
+    a = trainer.train(_cfg(pipeline_depth=1), gmm, measure=False)
+    b = trainer.train(_cfg(pipeline_depth=1), gmm, measure=False)
+    _bitwise(a.params_history, b.params_history)
+    np.testing.assert_array_equal(a.timeset, b.timeset)
+    np.testing.assert_array_equal(a.decode_error, b.decode_error)
+
+
+def test_pipelined_trajectory_actually_stale(gmm):
+    """tau=1 changes the trajectory after warm-up (rounds 0 and 1 both
+    differentiate at p0, so histories agree through round 1 and diverge
+    after) — the staleness slot is live, not decorative."""
+    import jax
+
+    sync = trainer.train(_cfg(), gmm, measure=False)
+    pipe = trainer.train(_cfg(pipeline_depth=1), gmm, measure=False)
+    for a, b in zip(
+        jax.tree.leaves(sync.params_history),
+        jax.tree.leaves(pipe.params_history),
+    ):
+        a, b = np.asarray(a), np.asarray(b)
+        np.testing.assert_array_equal(a[0], b[0])  # both step from g(p0)
+        assert not np.array_equal(a[-1], b[-1])
+
+
+def test_pipelined_kill_resume_rows_identical(gmm, tmp_path, monkeypatch):
+    """The journal kill->resume invariance extends to pipelined runs: a
+    sweep chaos-killed after its 2nd trajectory resumes to rows bitwise-
+    identical to the uninterrupted sweep, and the journal validates."""
+    configs = {
+        "pipe_a": _cfg(pipeline_depth=1, seed=0),
+        "pipe_b": _cfg(pipeline_depth=1, seed=1),
+        "sync": _cfg(seed=0),
+    }
+    baseline = experiments.compare(dict(configs), gmm)
+
+    jdir = str(tmp_path / "journal")
+    monkeypatch.setenv(chaos.CHAOS_ENV, "raise:trajectory:2")
+    chaos.reset()
+    j = journal_lib.SweepJournal(jdir, resume=False)
+    with pytest.raises(chaos.ChaosInjection):
+        experiments.compare(dict(configs), gmm, journal=j)
+    j.close()
+    monkeypatch.delenv(chaos.CHAOS_ENV)
+    chaos.reset()
+
+    j2 = journal_lib.SweepJournal(jdir, resume=True)
+    assert len(j2) == 2
+    resumed = experiments.compare(dict(configs), gmm, journal=j2)
+    j2.close()
+
+    base_rows = [journal_lib.science_row(s.row()) for s in baseline]
+    res_rows = [journal_lib.science_row(s.row()) for s in resumed]
+    assert base_rows == res_rows
+    for a, b in zip(baseline, resumed):
+        assert np.array_equal(
+            np.asarray(a.training_loss), np.asarray(b.training_loss)
+        )
+        np.testing.assert_array_equal(a.timeset, b.timeset)
+    assert events_lib.validate_file(j2.path) == []
+
+
+# ---------------------------------------------------------------------------
+# telemetry + admission
+
+
+def test_dispatch_ahead_event_and_staleness_split(gmm, tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with events_lib.capture(path):
+        pipe = trainer.train(_cfg(pipeline_depth=1), gmm, measure=False)
+        split = decode_lib.emit_staleness_split("test-run", pipe, gmm)
+    assert events_lib.validate_file(path) == []
+    with open(path) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    ahead = [r for r in recs if r["type"] == "dispatch_ahead"]
+    assert len(ahead) == 1
+    assert ahead[0]["pipeline_depth"] == 1
+    assert ahead[0]["n_rounds"] == R
+    stale = [r for r in recs if r["type"] == "stale_decode"]
+    assert len(stale) == 1
+    assert 0.0 <= split["staleness_share"] <= 1.0
+    assert split["staleness_error_mean"] > 0.0  # tau=1 engaged
+    assert pipe.cache_info["pipeline_depth"] == 1
+    assert pipe.cache_info["pipeline_params_slot_bytes"] > 0
+
+
+def test_tau0_split_is_pure_coding_error(gmm):
+    sync = trainer.train(_cfg(), gmm, measure=False)
+    split = decode_lib.emit_staleness_split("tau0", sync, gmm)
+    assert split["staleness_error_mean"] == 0.0
+    assert split["staleness_share"] == 0.0  # pure coding error, exactly
+    assert split["coding_error_mean"] > 0.0
+    assert sync.cache_info["pipeline_params_slot_bytes"] == 0
+
+
+def test_admission_charges_one_extra_params_slot(gmm):
+    base = trainer.estimate_stack_bytes(_cfg(), gmm)
+    pipe = trainer.estimate_stack_bytes(_cfg(pipeline_depth=1), gmm)
+    F = gmm.X_train.shape[1]
+    assert pipe - base == (F + 1) * 4  # one f32 (weights, bias) slot

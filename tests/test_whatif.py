@@ -154,6 +154,47 @@ def test_spec_hash_stable_and_sensitive():
     assert a.spec_hash() != _tiny_spec(n_seeds=4).spec_hash()
 
 
+def test_pipeline_axis_tau0_no_drift():
+    """The staleness axis (ISSUE 16) defaults to (0,) and is
+    hash-invisible there: a spec written before the axis existed and an
+    explicit pipeline_depths=(0,) spec hash AND enumerate identically —
+    so every saved surface (the 336-run grid included) rehydrates
+    unchanged. tau=1 points ride the existing feasibility filter:
+    exact-decode policies surface as infeasible with the typed refusal
+    reason, never dispatched."""
+    a, b = _tiny_spec(), _tiny_spec(pipeline_depths=(0,))
+    assert a.spec_hash() == b.spec_hash()
+    assert [p.label for p in enumerate_points(a)] == [
+        p.label for p in enumerate_points(b)
+    ]
+    assert all(p.pipeline_depth == 0 for p in enumerate_points(a))
+
+    c = _tiny_spec(pipeline_depths=(0, 1))
+    assert c.spec_hash() != a.spec_hash()
+    assert c.n_points == 2 * a.n_points
+    tau1 = [p for p in enumerate_points(c) if p.pipeline_depth == 1]
+    naive1 = [p for p in tau1 if p.policy.scheme == "naive"]
+    assert naive1 and not naive1[0].feasible
+    assert "exactness contract" in naive1[0].reason
+    approx1 = [p for p in tau1 if p.policy.scheme == "approx"]
+    assert approx1 and approx1[0].feasible
+    assert approx1[0].label.endswith("/tau1")
+    with pytest.raises(ValueError, match="pipeline_depths"):
+        _tiny_spec(pipeline_depths=(2,))
+
+
+def test_pipeline_axis_tau0_surface_rows_identical(tmp_path):
+    """Simulating the SAME grid through a default spec and an explicit
+    pipeline_depths=(0,) spec produces identical surface rows — the
+    tau=0 no-drift pin at the artifact level, not just the hash."""
+    spec_a = _tiny_spec(n_seeds=2, target_loss=0.6)
+    spec_b = _tiny_spec(n_seeds=2, target_loss=0.6, pipeline_depths=(0,))
+    surf_a = run_whatif(spec_a)
+    surf_b = run_whatif(spec_b)
+    assert surf_a.rows == surf_b.rows
+    assert all(r["pipeline_depth"] == 0 for r in surf_a.rows)
+
+
 # ---------------------------------------------------------------------------
 # Monte-Carlo arrival sampling (whatif/sampler.py)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,144 @@
+"""pipeline-smoke: CPU sync vs tau=1 pipelined race under exp(2.0).
+
+`make pipeline-smoke` asserts, end to end:
+
+  1. the pipelined run's simulated time-to-target is <= the synchronous
+     run's on the identical straggler world (the overlap win the mode
+     exists for), and both reach the target;
+  2. pipelined training replays deterministically: a rerun of the same
+     config is bitwise-identical in params history AND timeset (stale,
+     not async-racy — the bounded-staleness contract);
+  3. tau=0 collapses exactly: pipeline_depth=0 is bitwise today's
+     synchronous trainer (params history, timeset, decode error);
+  4. the typed pipeline telemetry lands and validates: the run emits a
+     "dispatch_ahead" event, the post-run staleness-vs-coding split
+     emits "stale_decode", and the whole event log passes
+     obs/events.validate_lines;
+  5. the refusal matrix holds where the smoke can cheaply check it:
+     exact-decode schemes and momentum rules refuse with typed reasons.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from erasurehead_tpu.data.synthetic import generate_gmm  # noqa: E402
+from erasurehead_tpu.obs import decode as decode_lib  # noqa: E402
+from erasurehead_tpu.obs import events as obs_events  # noqa: E402
+from erasurehead_tpu.train import evaluate, experiments, trainer  # noqa: E402
+from erasurehead_tpu.utils.config import (  # noqa: E402
+    PipelineRefusal,
+    RunConfig,
+)
+
+W, S, R = 8, 1, 80
+ROWS, COLS = 256, 16
+TARGET = 0.15
+OUT = "/tmp/eh-pipeline-smoke"
+
+#: lr_schedule is EXPLICIT: the default schedule sits at GD's stability
+#: edge and tau=1 staleness shrinks the stable region
+COMMON = dict(
+    scheme="avoidstragg", n_workers=W, n_stragglers=S, rounds=R,
+    n_rows=ROWS, n_cols=COLS, update_rule="GD", compute_mode="deduped",
+    add_delay=True, delay_mean=2.0, lr_schedule=1.0, seed=3,
+)
+
+
+def _bitwise(a, b, what: str) -> None:
+    import jax
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb), f"{what}: leaf count differs"
+    for x, y in zip(la, lb):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), (
+            f"{what}: arrays differ"
+        )
+
+
+def _time_to_target(ds, result):
+    model = trainer.build_model(result.config)
+    n = result.n_train
+    ev = evaluate.replay(
+        model, result.config.model, result.params_history,
+        ds.X_train[:n], ds.y_train[:n], ds.X_test, ds.y_test,
+    )
+    loss = np.asarray(ev.training_loss, dtype=np.float64)
+    return experiments.time_to_target_loss(loss, result.timeset, TARGET)
+
+
+def main() -> int:
+    os.makedirs(OUT, exist_ok=True)
+    ds = generate_gmm(ROWS, COLS, n_partitions=W, seed=0)
+
+    # 1) the race: sync vs tau=1 pipelined, same arrival world
+    sync = trainer.train(RunConfig(**COMMON), ds, measure=False)
+    events_path = os.path.join(OUT, "events.jsonl")
+    with obs_events.capture(events_path):
+        pipe = trainer.train(
+            RunConfig(**COMMON, pipeline_depth=1), ds, measure=False
+        )
+        split = decode_lib.emit_staleness_split("pipeline-smoke", pipe, ds)
+    t_sync, t_pipe = _time_to_target(ds, sync), _time_to_target(ds, pipe)
+    assert t_sync is not None, "synchronous run never reached the target"
+    assert t_pipe is not None, "pipelined run never reached the target"
+    assert t_pipe <= t_sync, (
+        f"pipelined time-to-target {t_pipe:.3f}s worse than "
+        f"synchronous {t_sync:.3f}s"
+    )
+    print(
+        f"pipeline-smoke: time-to-target(loss<={TARGET}) sync "
+        f"{t_sync:.3f}s vs pipelined {t_pipe:.3f}s "
+        f"({t_sync / t_pipe:.2f}x), staleness_share "
+        f"{split['staleness_share']:.3f}"
+    )
+
+    # 2) deterministic replay: rerun is bitwise
+    pipe2 = trainer.train(
+        RunConfig(**COMMON, pipeline_depth=1), ds, measure=False
+    )
+    _bitwise(pipe.params_history, pipe2.params_history, "pipelined rerun")
+    assert np.array_equal(pipe.timeset, pipe2.timeset)
+    print("pipeline-smoke: pipelined replay bitwise OK")
+
+    # 3) tau=0 is bitwise the synchronous trainer
+    tau0 = trainer.train(
+        RunConfig(**COMMON, pipeline_depth=0), ds, measure=False
+    )
+    _bitwise(sync.params_history, tau0.params_history, "tau=0 collapse")
+    assert np.array_equal(sync.timeset, tau0.timeset)
+    assert np.array_equal(sync.decode_error, tau0.decode_error)
+    print("pipeline-smoke: tau=0 bitwise-synchronous OK")
+
+    # 4) typed telemetry validates
+    with open(events_path) as f:
+        lines = f.readlines()
+    errors = obs_events.validate_lines(lines)
+    assert not errors, "event log invalid:\n" + "\n".join(errors)
+    types = [json.loads(ln).get("type") for ln in lines]
+    assert "dispatch_ahead" in types, f"no dispatch_ahead event: {types}"
+    assert "stale_decode" in types, f"no stale_decode event: {types}"
+    print(f"pipeline-smoke: {len(lines)} events validate "
+          f"(dispatch_ahead + stale_decode present)")
+
+    # 5) refusal matrix spot-checks
+    for kwargs, want in (
+        ({**COMMON, "scheme": "cyccoded"}, "exact_decode"),
+        ({**COMMON, "update_rule": "AGD"}, "momentum_unproven"),
+    ):
+        try:
+            RunConfig(**kwargs, pipeline_depth=1)
+            raise AssertionError(f"{want}: config did not refuse")
+        except PipelineRefusal as e:
+            assert e.reason == want, (e.reason, want)
+    print("pipeline-smoke: refusal matrix spot-checks OK")
+    print(f"pipeline-smoke: OK (events -> {events_path})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
