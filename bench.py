@@ -225,6 +225,27 @@ if _UNROLL_ENV:
 if SCAN_UNROLL > 1:
     METRIC_SUFFIX += f"_unroll{SCAN_UNROLL}"
 
+# out-of-core residency knob (utils/config.stack_residency): "streamed"
+# runs the canonical scan over windowed partition stacks behind the
+# double-buffered prefetch pipeline (data/prefetch.py), composing with
+# BENCH_STACK=ring (assignment-aware slot-group windows staged in
+# ring-hop order). BENCH_STREAM_WINDOW picks the partitions resident at
+# once (must divide the layout's partition count and be window-uniform
+# for the scheme — the canonical approx/W=30 layout accepts 6 or 15).
+# Tagged so streamed entries never collide with the resident captures.
+RESIDENCY = os.environ.get("BENCH_RESIDENCY", "")
+if RESIDENCY == "streamed":
+    METRIC_SUFFIX += "_streamed"
+_STREAM_WINDOW_ENV = os.environ.get("BENCH_STREAM_WINDOW", "")
+STREAM_WINDOW = 0
+if _STREAM_WINDOW_ENV:
+    try:
+        STREAM_WINDOW = int(_STREAM_WINDOW_ENV)
+    except ValueError:
+        STREAM_WINDOW = -1  # flagged invalid; validated in __main__
+if STREAM_WINDOW > 0:
+    METRIC_SUFFIX += f"_w{STREAM_WINDOW}"
+
 
 def _failure_record(error: str) -> dict:
     """A valid one-line JSON payload for any can't-measure outcome — the
@@ -1770,6 +1791,129 @@ def _outofcore_extra() -> dict:
     }
 
 
+#: composed-streaming extra knobs (ISSUE 17): cohort width for the
+#: trajectory-batched windowed scan, and its throughput bar vs the
+#: sequential streamed trajectories (same bar as the sweep7 cohort)
+OUTOFCORE_COHORT_SIZE = int(os.environ.get("BENCH_OUTOFCORE_COHORT", "6"))
+OUTOFCORE_COHORT_BAR = 3.0
+
+
+def _outofcore_composed_extra() -> dict:
+    """Composed streaming extra (ISSUE 17): the window planner, ring
+    transport and cohort batching measured TOGETHER.
+
+    Three claims, measured:
+      1. streamed+ring overhead: a windowed faithful stream whose
+         slot-group windows stage their assignment halo in ring-hop
+         order stays within OUTOFCORE_OVERHEAD_BAR of the resident ring
+         run (both exec-cache warm, best of two);
+      2. window memory: the streamed run's device stack is the STAGED
+         window's fraction of the resident ring stack — bounded by two
+         staged windows (compute + prefetch double buffer);
+      3. cohort throughput: OUTOFCORE_COHORT_SIZE streamed trajectories
+         dispatched as ONE windowed cohort scan sustain >=
+         OUTOFCORE_COHORT_BAR x the sequential streamed trajectory
+         rate (the staging pipeline runs once per cohort, not once per
+         trajectory).
+    """
+    import dataclasses as _dc
+    import time as _time
+
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.utils.config import RunConfig
+
+    Wo, R = OUTOFCORE_WORKERS, OUTOFCORE_ROUNDS
+    rows = Wo * OUTOFCORE_COMP_ROWS_PW // 2
+    cols = OUTOFCORE_COMP_COLS
+    cfg = RunConfig(
+        scheme="cyccoded", n_workers=Wo, n_stragglers=2, rounds=R,
+        n_rows=rows, n_cols=cols, lr_schedule=0.5, update_rule="GD",
+        add_delay=True, seed=0, stack_mode="ring",
+    )
+    P = trainer.build_layout(cfg).n_partitions
+    window = max(1, P // 4)
+    ds = generate_gmm(rows, cols, P, seed=0)
+
+    def best_wall(c, d):
+        r1 = trainer.train(c, d)
+        r2 = trainer.train(c, d)
+        return min(r1.wall_time, r2.wall_time), r2
+
+    res_wall, r_res = best_wall(cfg, ds)
+    cfg_s = _dc.replace(
+        cfg, stack_residency="streamed", stream_window=window
+    )
+    str_wall, r_str = best_wall(cfg_s, ds)
+    ci = r_str.cache_info
+    overhead = str_wall / res_wall if res_wall > 0 else 0.0
+    eff = float(ci["prefetch"]["overlap_efficiency"])
+    staged = int(ci["stream_window"]) + int(ci["stream_halo"])
+    res_stack = int(r_res.cache_info["stack_bytes"])
+    str_stack = int(ci["stack_bytes"])
+    # stack_bytes reports one staged window's buffers; the double buffer
+    # bounds the true peak at twice that — both must stay under two
+    # staged windows' fraction of the resident ring stack
+    window_bytes_ok = str_stack * P <= 2 * staged * res_stack
+
+    # cohort: B streamed trajectories (differing seeds share the static
+    # signature and the window plan) as ONE windowed scan vs the same
+    # trajectories run sequentially, both timed exec-cache warm
+    B = OUTOFCORE_COHORT_SIZE
+    cfgs = [_dc.replace(cfg_s, seed=k) for k in range(B)]
+
+    def seq_pass():
+        t0 = _time.perf_counter()
+        for c in cfgs:
+            trainer.train(c, ds)
+        return _time.perf_counter() - t0
+
+    def cohort_pass():
+        t0 = _time.perf_counter()
+        out = trainer.train_cohort(cfgs, ds)
+        return _time.perf_counter() - t0, out
+
+    seq_pass()  # warm: compile once, prime the exec/data caches
+    seq_wall = seq_pass()
+    cohort_pass()
+    cohort_wall, cohort_res = cohort_pass()
+    speedup = seq_wall / cohort_wall if cohort_wall > 0 else 0.0
+    ci_co = cohort_res[0].cache_info
+    return {
+        "outofcore_composed": {
+            "rows": rows,
+            "cols": cols,
+            "n_partitions": P,
+            "stream_window": window,
+            "stream_halo": int(ci["stream_halo"]),
+            "staged_partitions": staged,
+            "ring_resident_wall_s": round(res_wall, 4),
+            "ring_streamed_wall_s": round(str_wall, 4),
+            "overhead_ratio": round(overhead, 4),
+            "overhead_bar": OUTOFCORE_OVERHEAD_BAR,
+            "overhead_ok": bool(overhead <= OUTOFCORE_OVERHEAD_BAR),
+            "overlap_efficiency": round(eff, 4),
+            "overlap_bar": OUTOFCORE_OVERLAP_BAR,
+            "overlap_ok": bool(eff >= OUTOFCORE_OVERLAP_BAR),
+            "resident_stack_bytes": res_stack,
+            "streamed_stack_bytes": str_stack,
+            "window_bytes_ok": bool(window_bytes_ok),
+            "cohort_size": B,
+            "cohort_dispatches": ci_co.get("cohort_dispatches"),
+            "cohort_lowering": ci_co.get("cohort_lowering"),
+            "seq_wall_s": round(seq_wall, 4),
+            "cohort_wall_s": round(cohort_wall, 4),
+            "seq_traj_per_s": round(B / seq_wall, 4) if seq_wall else 0.0,
+            "cohort_traj_per_s": round(
+                B / cohort_wall, 4
+            ) if cohort_wall else 0.0,
+            "cohort_speedup": round(speedup, 4),
+            "cohort_bar": OUTOFCORE_COHORT_BAR,
+            "cohort_ok": bool(speedup >= OUTOFCORE_COHORT_BAR),
+        }
+    }
+
+
 def child() -> None:
     import jax
 
@@ -1818,6 +1962,10 @@ def child() -> None:
         flat_grad=FLAT_GRAD or "auto",
         margin_flat=MARGIN_FLAT or "auto",
         scan_unroll=SCAN_UNROLL,
+        # BENCH_RESIDENCY=streamed + BENCH_STREAM_WINDOW: windowed
+        # out-of-core stacks on the canonical run (ISSUE 17)
+        stack_residency=RESIDENCY or "resident",
+        stream_window=STREAM_WINDOW if STREAM_WINDOW > 0 else None,
         seed=0,
     )
     print(
@@ -1946,6 +2094,19 @@ def child() -> None:
             outofcore_extra = _outofcore_extra()
         except Exception as e:  # noqa: BLE001 — extras must never kill bench
             print(f"bench: outofcore extra failed: {e}", file=sys.stderr)
+
+        # ---- composed-streaming extra: window planner x ring transport
+        # x cohort batching measured together (ISSUE 17) — streamed+ring
+        # vs resident+ring wall, staged-window device bytes, and the
+        # one-windowed-scan cohort vs sequential streamed trajectories
+        outofcore_composed_extra = {}
+        try:
+            outofcore_composed_extra = _outofcore_composed_extra()
+        except Exception as e:  # noqa: BLE001 — extras must never kill bench
+            print(
+                f"bench: outofcore composed extra failed: {e}",
+                file=sys.stderr,
+            )
 
     # ---- whatif extra: the Monte-Carlo policy-search engine — grid
     # simulated-runs/sec vs sequential single-run simulation (bar >=
@@ -2104,6 +2265,7 @@ def child() -> None:
                 **pipeline_extra,
                 **fidelity_extra,
                 **outofcore_extra,
+                **outofcore_composed_extra,
                 **lint_extra,
                 **telemetry_extra,
             }
@@ -2206,6 +2368,36 @@ if __name__ == "__main__":
             json.dumps(
                 _failure_record(
                     f"BENCH_FLAT must be on or off, got {FLAT_GRAD!r}"
+                )
+            )
+        )
+        sys.exit(0 if "--child" not in sys.argv else 1)
+    if RESIDENCY not in ("", "resident", "streamed", "auto"):
+        print(
+            json.dumps(
+                _failure_record(
+                    f"BENCH_RESIDENCY must be resident, streamed, or "
+                    f"auto, got {RESIDENCY!r}"
+                )
+            )
+        )
+        sys.exit(0 if "--child" not in sys.argv else 1)
+    if _STREAM_WINDOW_ENV and STREAM_WINDOW < 1:
+        print(
+            json.dumps(
+                _failure_record(
+                    f"BENCH_STREAM_WINDOW must be an int >= 1, "
+                    f"got {_STREAM_WINDOW_ENV!r}"
+                )
+            )
+        )
+        sys.exit(0 if "--child" not in sys.argv else 1)
+    if STREAM_WINDOW > 0 and RESIDENCY not in ("streamed", "auto"):
+        print(
+            json.dumps(
+                _failure_record(
+                    "BENCH_STREAM_WINDOW sizes the streamed window; set "
+                    "BENCH_RESIDENCY=streamed (or auto) with it"
                 )
             )
         )

@@ -46,15 +46,31 @@ from erasurehead_tpu.utils import chaos as chaos_lib
 DEFAULT_DEPTH = 2
 
 
+def _norm_window(spec) -> tuple:
+    """Normalize one consume-order entry to a tuple of (lo, hi) ranges:
+    a plain ``(lo, hi)`` pair (the PR 14 partition windows) or an
+    assignment-aware plan's range tuple (data/sharding.StreamWindowPlan.
+    ranges[k] — two ranges when the slot-group halo wraps)."""
+    spec = tuple(spec)
+    if len(spec) == 2 and not isinstance(spec[0], (tuple, list)):
+        return ((int(spec[0]), int(spec[1])),)
+    return tuple((int(lo), int(hi)) for lo, hi in spec)
+
+
 class Prefetcher:
     """Bounded staging pipeline over a schedule of partition windows.
 
-    ``windows`` is the exact consume-order sequence of ``(lo, hi)``
-    partition ranges the trainer will request — one entry per scan chunk,
-    repeats allowed (epochs revisit windows). ``put`` maps the host
-    arrays of one window to device arrays (the trainer passes its
-    sharded ``device_put``); it runs on the staging thread, which is the
-    overlap. ``get(i)`` must be called for ``i = 0, 1, ...`` in order.
+    ``windows`` is the exact consume-order sequence of windows the
+    trainer will request — one entry per scan chunk, repeats allowed
+    (epochs revisit windows). Each entry is a ``(lo, hi)`` partition
+    range or a tuple of such ranges (an assignment-aware window plan's
+    staged span, in ring-hop order — see data/sharding.
+    StreamWindowPlan). ``put`` maps the host arrays of one window to
+    device arrays (the trainer passes its sharded ``device_put``); it
+    runs on the staging thread, which is the overlap. ``get(i)`` must be
+    called for ``i = 0, 1, ...`` in order. ``plan_fields`` (a dict, e.g.
+    ``StreamWindowPlan.event_fields()``) rides every staged ``prefetch``
+    event — the window-plan contract obs/events.SCHEMA validates.
 
     Errors on the staging thread (a torn store, a chaos ``raise``)
     surface at the next ``get`` call — never silently, never deadlocked
@@ -69,13 +85,15 @@ class Prefetcher:
         *,
         depth: int = DEFAULT_DEPTH,
         run_id: Optional[str] = None,
+        plan_fields: Optional[dict] = None,
     ):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.store = store
-        self.windows = [(int(lo), int(hi)) for lo, hi in windows]
+        self.windows = [_norm_window(w) for w in windows]
         self._put = put
         self.run_id = run_id
+        self._plan_fields = dict(plan_fields or {})
         self._ready: queue.Queue = queue.Queue(maxsize=depth)
         # depth reusable host-buffer sets; slot i % depth backs window i,
         # safe because the staging thread blocks the transfer to
@@ -97,12 +115,12 @@ class Prefetcher:
     # -- staging thread ---------------------------------------------------
 
     def _run(self) -> None:
-        for i, (lo, hi) in enumerate(self.windows):
+        for i, ranges in enumerate(self.windows):
             try:
                 chaos_lib.maybe_fire("prefetch")
                 t0 = time.perf_counter()
-                X, y = self.store.read_window(
-                    lo, hi, out=self._bufs[i % len(self._bufs)]
+                X, y = self.store.read_ranges(
+                    ranges, out=self._bufs[i % len(self._bufs)]
                 )
                 dev = self._put(X, y)
                 # commit the transfer before the slot can be reused (and
@@ -127,8 +145,10 @@ class Prefetcher:
                     run_id=self.run_id,
                     window=i,
                     bytes=n_bytes,
-                    partitions=[lo, hi],
+                    partitions=[ranges[0][0], ranges[0][1]],
+                    ranges=[[lo, hi] for lo, hi in ranges],
                     fetch_s=round(dt, 6),
+                    **self._plan_fields,
                 )
             self._ready.put((i, dev, None))
 
@@ -172,20 +192,53 @@ class Prefetcher:
             "overlap_efficiency": round(eff, 4),
         }
 
-    def close(self) -> None:
-        """Drain and join the staging thread (idempotent)."""
+    def close(self, join_timeout_s: float = 10.0) -> None:
+        """Drain and join the staging thread (idempotent).
+
+        Bounded: a WEDGED stage (a hung NFS read, a device transfer that
+        never completes) used to spin the drain loop forever — and even
+        once it reached the join, a join timeout was silently swallowed,
+        leaking the daemon thread (and whatever mmap/host-buffer state it
+        pinned) with no trace. Now the whole drain+join observes one
+        ``join_timeout_s`` deadline, and a thread that outlives it is
+        reported loudly: a ``warn_once`` on stderr, a
+        ``prefetch.join_timeout`` telemetry counter, and a typed
+        ``warning`` event (kind="prefetch_join_timeout") in the current
+        capture. The thread is daemonic, so the leak never blocks process
+        exit — but it is a leak, and leaks must be visible."""
         t = self._thread
         if t is None:
             return
         self._thread = None
+        deadline = time.monotonic() + max(0.0, float(join_timeout_s))
         while True:
             try:
                 self._ready.get_nowait()
             except queue.Empty:
-                if not t.is_alive():
+                if not t.is_alive() or time.monotonic() >= deadline:
                     break
                 time.sleep(0.005)
-        t.join(timeout=10.0)
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+        if t.is_alive():
+            from erasurehead_tpu.obs.metrics import REGISTRY, warn_once
+
+            REGISTRY.counter("prefetch.join_timeout").inc()
+            msg = (
+                f"prefetch staging thread {t.name!r} did not exit within "
+                f"{float(join_timeout_s):g}s of close(); a stage is "
+                "wedged (hung shard read or device transfer) and the "
+                "daemon thread leaks until process exit"
+            )
+            warn_once("prefetch-join-timeout", msg)
+            extra = (
+                {"run_id": self.run_id} if self.run_id is not None else {}
+            )
+            events_lib.emit(
+                "warning",
+                kind="prefetch_join_timeout",
+                message=msg,
+                **extra,
+            )
 
     def __enter__(self):
         return self

@@ -216,6 +216,188 @@ def _ring_hops(layout: CodingLayout, n_devices: int) -> int:
     return int(hop.max()) + 1
 
 
+# ---------------------------------------------------------------------------
+# Assignment-aware stream windows (stack_residency="streamed" composing with
+# the faithful/ring stacks; train/trainer._train_streamed)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _WindowedLayout:
+    """Layout view over ONE staged window of a :class:`StreamWindowPlan` —
+    exactly the four attributes :func:`plan_ring_transport` reads, with the
+    assignment LOCALIZED to staged-buffer indices. Every window shares this
+    view (window-uniformity is enforced by the planner), which is what lets
+    one compiled chunk executable — and one ring hop table — serve every
+    window of the stream."""
+
+    n_workers: int
+    n_slots: int
+    n_partitions: int
+    assignment: np.ndarray  # [gw, S] indices into the staged stack
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamWindowPlan:
+    """Assignment-aware window plan for a streamed run.
+
+    PR 14's windows were windows of the PARTITION axis under the one
+    deduped body — an assignment never entered. The faithful/ring stacks
+    gather through ``CodingLayout.assignment``, so their windows must be
+    windows of the CODED ASSIGNMENT: contiguous slot-groups of
+    ``group_workers`` workers whose assigned partitions all fall inside
+    the staged span ``[k*window, k*window + window + halo) mod P``. The
+    ``halo`` is the assignment's forward reach past the window edge (for
+    the cyclic ``{w..w+s} mod P`` supports it is exactly ``s``) — those
+    partitions are the head of the NEXT window, so each scan chunk's ring
+    fill (parallel/step._ring_fill) touches only partitions resident in
+    the current + in-flight window, and at most two staged windows of
+    device bytes are ever pinned.
+
+    ``ranges[k]`` is the tuple of contiguous partition ranges the
+    Prefetcher stages for window ``k`` (two when the halo wraps the
+    partition axis), ordered so staged-buffer position ``i`` holds
+    partition ``(k*window + i) mod P`` — ring-hop order: position
+    ``i``'s block arrives at ring fill-step ``i // (staged/D)``, so the
+    buffer layout IS the hop schedule. ``local_assignment[wl, s]`` maps
+    slot-group worker ``wl``'s slot ``s`` to its staged-buffer index;
+    the planner refuses assignments that are not window-uniform (e.g.
+    random-regular scatter), because those would need a different hop
+    table — a different compiled program — per window.
+
+    ``mode="deduped"`` plans degenerate to the PR 14 partition windows
+    (halo 0, no slot-groups) so one plan type describes every streamed
+    body."""
+
+    mode: str  # "deduped" | "materialized" | "ring"
+    n_partitions: int
+    window: int  # partition-window size (divides P)
+    n_windows: int
+    halo: int  # staged partitions past the window edge (0 for deduped)
+    group_workers: int  # workers per slot-group (0 for deduped)
+    ranges: tuple  # per window k: ((lo, hi), ...) contiguous staged ranges
+    local_assignment: Optional[np.ndarray]  # [gw, S] staged-buffer indices
+
+    @property
+    def staged_partitions(self) -> int:
+        """Partitions materialized per staged window (window + halo) —
+        the residency unit admission and the bench extra charge in."""
+        return self.window + self.halo
+
+    def sub_layout(self) -> _WindowedLayout:
+        """The one-window layout view a sub-:class:`RingPlan` is built
+        over (``plan_ring_transport(plan.sub_layout(), D)``). Full-cover
+        plans localize to the identity shift, so the sub-plan's hop table
+        is byte-identical to the resident ring plan's — the bitwise
+        streamed+ring == resident+ring pin rests on this."""
+        if self.local_assignment is None:
+            raise ValueError(
+                "deduped stream windows have no slot-groups (no ring "
+                "transport to plan); sub_layout() is a faithful/ring-"
+                "mode call"
+            )
+        return _WindowedLayout(
+            n_workers=self.group_workers,
+            n_slots=int(self.local_assignment.shape[1]),
+            n_partitions=self.staged_partitions,
+            assignment=self.local_assignment,
+        )
+
+    def event_fields(self) -> dict:
+        """The window-plan fields every staged ``prefetch`` event carries
+        (obs/events.SCHEMA) — what the report and the lint contract key
+        the composed-streaming telemetry on."""
+        return {
+            "plan_mode": self.mode,
+            "halo": int(self.halo),
+            "group_workers": int(self.group_workers),
+        }
+
+
+def plan_stream_windows(
+    layout: CodingLayout, window: int, *, mode: str = "deduped"
+) -> StreamWindowPlan:
+    """Plan the staged windows a streamed run of ``layout`` consumes.
+
+    ``window`` is the partition-window size (a divisor of P, from
+    trainer._resolve_stream_window). Deduped plans are pure partition
+    windows. Faithful/ring plans split the worker axis into
+    ``P // window`` contiguous slot-groups and stage each group's full
+    assigned partition span — window plus halo — refusing loudly when
+    the worker axis does not split evenly or the assignment is not
+    window-uniform (one compiled chunk must serve every window; see
+    :class:`StreamWindowPlan`)."""
+    P = int(layout.n_partitions)
+    window = int(window)
+    if window < 1 or P % window:
+        raise ValueError(
+            f"stream window must be a divisor of n_partitions={P}, "
+            f"got {window}"
+        )
+    n_windows = P // window
+    if mode == "deduped":
+        return StreamWindowPlan(
+            mode=mode,
+            n_partitions=P,
+            window=window,
+            n_windows=n_windows,
+            halo=0,
+            group_workers=0,
+            ranges=tuple(
+                ((k * window, (k + 1) * window),) for k in range(n_windows)
+            ),
+            local_assignment=None,
+        )
+    if mode not in ("materialized", "ring"):
+        raise ValueError(
+            f"stream window mode must be 'deduped', 'materialized' or "
+            f"'ring', got {mode!r}"
+        )
+    W = int(layout.n_workers)
+    if W % n_windows:
+        raise ValueError(
+            f"{W} workers cannot split into {n_windows} equal slot-groups "
+            f"(window {window} of {P} partitions); pick a stream window "
+            f"whose count divides the worker axis"
+        )
+    gw = W // n_windows
+    assignment = np.asarray(layout.assignment)
+    local = None
+    halo = 0
+    for k in range(n_windows):
+        loc = (assignment[k * gw : (k + 1) * gw] - k * window) % P
+        halo = max(halo, int(loc.max()) + 1 - window)
+        if local is None:
+            local = loc.astype(np.int64)
+        elif not np.array_equal(local, loc):
+            raise ValueError(
+                f"assignment is not window-uniform: slot-group {k} "
+                f"touches a different local partition pattern than group "
+                "0, so no single chunk executable (or ring hop table) can "
+                "serve every window — run this scheme resident, or with "
+                "a stream window covering every partition"
+            )
+    halo = max(0, min(halo, P - window))
+    staged = window + halo
+    ranges = []
+    for k in range(n_windows):
+        lo = k * window
+        hi = lo + staged
+        ranges.append(
+            ((lo, hi),) if hi <= P else ((lo, P), (0, hi - P))
+        )
+    return StreamWindowPlan(
+        mode=mode,
+        n_partitions=P,
+        window=window,
+        n_windows=n_windows,
+        halo=halo,
+        group_workers=gw,
+        ranges=tuple(ranges),
+        local_assignment=local,
+    )
+
+
 def estimate_worker_stack_bytes(dataset: Dataset, layout: CodingLayout, dtype) -> int:
     """Host-side estimate of the MATERIALIZED faithful stack's device bytes
     (the stack_mode="auto" footprint gate). Dense: W * S * rows * F *

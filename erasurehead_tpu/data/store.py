@@ -254,12 +254,29 @@ class ShardStore:
         keys ``"X"``/``"y"``(/``"scale"``) — is filled in place when
         shapes match (the prefetch ring's reuse path). Emits one ``io``
         shard_read record for the bytes copied."""
-        if not 0 <= lo < hi <= self.n_partitions:
-            raise ValueError(
-                f"window [{lo}, {hi}) outside "
-                f"[0, {self.n_partitions}) partitions"
-            )
-        w = hi - lo
+        return self.read_ranges(((lo, hi),), out=out)
+
+    def read_ranges(self, ranges, out: Optional[dict] = None):
+        """Materialize a sequence of contiguous partition ranges as ONE
+        stacked host window.
+
+        ``ranges`` is a tuple of ``(lo, hi)`` pairs; the returned arrays
+        concatenate them in order — the assignment-aware window planner
+        (data/sharding.plan_stream_windows) uses two ranges when a
+        slot-group's halo wraps the partition axis, and the staging
+        order IS the plan's ring-hop order (buffer position i holds
+        partition ``(window_head + i) mod P``). Same buffer-reuse and
+        ``io`` accounting contract as :meth:`read_window`."""
+        ranges = [(int(lo), int(hi)) for lo, hi in ranges]
+        if not ranges:
+            raise ValueError("read_ranges needs at least one range")
+        for lo, hi in ranges:
+            if not 0 <= lo < hi <= self.n_partitions:
+                raise ValueError(
+                    f"window [{lo}, {hi}) outside "
+                    f"[0, {self.n_partitions}) partitions"
+                )
+        w = sum(hi - lo for lo, hi in ranges)
         rows, F = self.rows_per_partition, self.n_features
         out = out if out is not None else {}
 
@@ -278,21 +295,31 @@ class ShardStore:
         scale = (
             buf("scale", (w, F), np.float32) if self.quantized else None
         )
-        p = lo
-        while p < hi:
-            s = int(np.searchsorted(self._starts, p, side="right")) - 1
-            blk_lo, blk_hi = int(self._starts[s]), int(self._starts[s + 1])
-            a, b = p - blk_lo, min(hi, blk_hi) - blk_lo
-            dst = slice(p - lo, p - lo + (b - a))
-            X[dst] = self._mmap("shard", s)[a:b]
-            y[dst] = self._mmap("labels", s)[a:b]
-            if scale is not None:
-                scale[dst] = self._mmap("scale", s)[a:b]
-            p += b - a
+        off = 0
+        for lo, hi in ranges:
+            p = lo
+            while p < hi:
+                s = int(np.searchsorted(self._starts, p, side="right")) - 1
+                blk_lo = int(self._starts[s])
+                blk_hi = int(self._starts[s + 1])
+                a, b = p - blk_lo, min(hi, blk_hi) - blk_lo
+                dst = slice(off + p - lo, off + p - lo + (b - a))
+                X[dst] = self._mmap("shard", s)[a:b]
+                y[dst] = self._mmap("labels", s)[a:b]
+                if scale is not None:
+                    scale[dst] = self._mmap("scale", s)[a:b]
+                p += b - a
+            off += hi - lo
         n_bytes = X.nbytes + y.nbytes + (
             scale.nbytes if scale is not None else 0
         )
-        _emit_io("shard_read", n_bytes, partitions=[int(lo), int(hi)])
+        _emit_io(
+            "shard_read",
+            n_bytes,
+            partitions=[int(r[0]) for r in ranges[:1]]
+            + [int(ranges[0][1])],
+            ranges=[[int(lo), int(hi)] for lo, hi in ranges],
+        )
         if self.quantized:
             return QuantizedStack(X, scale), y
         return X, y

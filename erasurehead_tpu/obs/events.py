@@ -135,11 +135,17 @@ SCHEMA: dict[str, tuple] = {
     "whatif": ("spec_hash", "kind"),
     # one per staged partition window of a streamed run
     # (data/prefetch.Prefetcher): which window index moved how many
-    # host→device bytes; the optional ``fetch_s`` / ``partitions``
-    # fields carry the stage's disk+PCIe seconds and its [lo, hi)
-    # partition range — the per-window record behind the report's
-    # prefetch section and the bench extra's overlap-efficiency figure
-    "prefetch": ("run_id", "window", "bytes"),
+    # host→device bytes over which partition ranges. ``ranges`` is the
+    # staged span in consume order — a list of [lo, hi) pairs, one when
+    # the window is a plain contiguous slice, two when an
+    # assignment-aware plan's halo wraps past the partition count
+    # (data/sharding.StreamWindowPlan). The optional window-plan fields
+    # ``plan_mode`` (:data:`STREAM_PLAN_MODES`), ``halo`` and
+    # ``group_workers`` say which body the window serves; ``fetch_s`` /
+    # ``partitions`` carry the stage's disk+PCIe seconds and its first
+    # range — the per-window record behind the report's prefetch
+    # section and the bench extra's overlap-efficiency figure
+    "prefetch": ("run_id", "window", "bytes", "ranges"),
     # one per shard-store disk transaction (data/store.py): "kind" says
     # which (:data:`IO_KINDS` — a window read off the mmapped shards, or
     # a store write by data/prepare.py) and ``bytes`` how much moved
@@ -176,6 +182,11 @@ MEMBERSHIP_ACTIONS = ("death", "join", "relayout", "probe", "chunk")
 #: attached, a slow reader's bounded outbox shed journaled rows, a
 #: reader detached
 STREAM_EVENTS = ("open", "overflow", "close")
+
+#: streamed window-plan modes (data/sharding.plan_stream_windows): the
+#: body the staged window serves — partition-major deduped, worker-major
+#: materialized faithful, or the ring-transport faithful body
+STREAM_PLAN_MODES = ("deduped", "materialized", "ring")
 
 #: backpressure rejection reasons (serve/server.py + serve/http_front.py)
 REJECT_REASONS = ("overloaded", "unauthorized")
@@ -493,7 +504,10 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
     known ``kind`` (:data:`WHATIF_KINDS`), point records a non-empty
     label and a bool feasibility verdict, grid records non-negative point
     counts; ``prefetch`` records carry a non-negative window index and
-    byte count (plus, when present, non-negative ``fetch_s`` seconds);
+    byte count and a ``ranges`` list of well-formed ``[lo, hi)`` int
+    pairs (plus, when present, non-negative ``fetch_s`` seconds, a
+    known ``plan_mode`` (:data:`STREAM_PLAN_MODES`) and non-negative
+    ``halo`` / ``group_workers`` ints);
     ``io`` records carry a known kind (:data:`IO_KINDS`) and a
     non-negative byte count; ``dispatch_ahead`` records carry a positive
     pipeline depth and non-negative overlap seconds; ``stale_decode``
@@ -790,6 +804,33 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
             for field in ("window", "bytes"):
                 v = rec.get(field)
                 if not isinstance(v, int) or v < 0:
+                    errors.append(
+                        f"line {i}: prefetch {field} must be a "
+                        f"non-negative int, got {v!r}"
+                    )
+            rngs = rec.get("ranges")
+            ok_ranges = isinstance(rngs, list) and all(
+                isinstance(r, list)
+                and len(r) == 2
+                and all(isinstance(v, int) and v >= 0 for v in r)
+                and r[0] < r[1]
+                for r in rngs
+            ) and len(rngs) >= 1
+            if "ranges" in rec and not ok_ranges:
+                errors.append(
+                    f"line {i}: prefetch ranges must be a non-empty "
+                    f"list of [lo, hi) non-negative int pairs with "
+                    f"lo < hi, got {rngs!r}"
+                )
+            pm = rec.get("plan_mode")
+            if pm is not None and pm not in STREAM_PLAN_MODES:
+                errors.append(
+                    f"line {i}: prefetch plan_mode must be one of "
+                    f"{STREAM_PLAN_MODES}, got {pm!r}"
+                )
+            for field in ("halo", "group_workers"):
+                v = rec.get(field)
+                if v is not None and (not isinstance(v, int) or v < 0):
                     errors.append(
                         f"line {i}: prefetch {field} must be a "
                         f"non-negative int, got {v!r}"

@@ -63,13 +63,16 @@ def estimate_cohort_bytes(cohort, width: Optional[int] = None) -> int:
     allocates ``max_cohort`` table columns).
 
     ``stack_residency="streamed"`` payloads are charged their resident
-    WINDOW, not the whole stack: trainer.estimate_stack_bytes resolves the
-    stream window (explicit ``stream_window`` or the host's
+    WINDOWS, not the whole stack: trainer.estimate_stack_bytes resolves
+    the stream window (explicit ``stream_window`` or the host's
     ERASUREHEAD_STREAM_WINDOW budget) and bounds the stack term at two
-    windows (compute + prefetch double buffer). Streamed requests never
-    pack with resident ones — residency rides the static signature, so
-    the pack key separates them by construction (tests/test_outofcore.py
-    pins the negative)."""
+    STAGED windows (compute + prefetch double buffer; a ring-transported
+    window stages its assignment halo too, a materialized-faithful one
+    its slot-group's worker gather — data/sharding.plan_stream_windows).
+    Streamed requests pack with streamed requests (one windowed cohort
+    scan) and never with resident ones — residency rides the static
+    signature, so the pack key separates them by construction
+    (tests/test_outofcore.py pins the negative)."""
     first = cohort.requests[0]
     cfg = first.config
     stack = trainer.estimate_stack_bytes(cfg, first.dataset)

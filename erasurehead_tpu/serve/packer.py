@@ -11,9 +11,13 @@ on all of it ride ONE compiled scan.
 
 What must NOT pack, packs not: the static signature carries the
 memory-system knobs (``stack_dtype``, ``stack_mode``, ``ring_pipeline``,
-``donate``...), so e.g. an int8-stack request and an f32-stack request key
-DIFFERENT data caches and land in different cohorts (pinned in
-tests/test_cohort.py's negative-packing test). Arrival schedules are NOT
+``donate``, ``stack_residency``, ``stream_window``...), so e.g. an
+int8-stack request and an f32-stack request key DIFFERENT data caches and
+land in different cohorts (pinned in tests/test_cohort.py's
+negative-packing test). Streamed requests pack WITH streamed requests —
+same residency, same window → one windowed cohort scan
+(trainer._train_cohort_streamed) — and never with resident ones
+(tests/test_outofcore.py pins both directions). Arrival schedules are NOT
 in the key — train_cohort takes them per trajectory, so tenants keep their
 own straggler streams inside a shared dispatch.
 

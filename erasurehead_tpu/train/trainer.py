@@ -1294,6 +1294,190 @@ def train(
     )
 
 
+def _stream_remedy(cfg: RunConfig) -> str:
+    """The remedy clause of a windowed-streamed refusal, naming the knob
+    the CALLER actually used to land on this path (ISSUE 17 satellite:
+    telling a ``--stack-residency streamed`` caller to raise an env
+    budget they never set is a wrong remedy)."""
+    from erasurehead_tpu.utils.config import (
+        STREAM_WINDOW_ENV,
+        resolve_stream_budget,
+    )
+
+    if cfg.stream_window is not None:
+        return (
+            "raise stream_window (--stream-window) to cover every "
+            "partition, or run resident (stack_residency='resident')"
+        )
+    if resolve_stream_budget() is not None:
+        return (
+            f"raise the {STREAM_WINDOW_ENV} byte budget to cover every "
+            "partition, or unset it to run resident"
+        )
+    return (
+        "run this config resident (stack_residency='resident' or "
+        "'auto' without a stream budget)"
+    )
+
+
+def _check_streamed_compat(cfg: RunConfig) -> None:
+    """Refuse the knobs with genuinely NO windowed body — loudly, naming
+    the knob that landed the run on the streamed path. Everything else
+    (faithful/ring transports, int8 stacks, the flat/margin-flat dense
+    lowerings, cohort batching) now composes with windowed streaming;
+    these three cannot:
+
+    - ``use_pallas='on'``: the fused kernel is a whole-stack single-pass
+      body (auto resolves it off on streamed runs rather than refusing);
+    - ``layer_coding='on'``: the blockwise decode packs whole-model block
+      tables per slot, which has no windowed form (auto likewise
+      resolves off);
+    - a model-parallel 2-D mesh: the windowed chunk shards only the
+      worker/partition axis."""
+    if cfg.use_pallas == "on":
+        raise ValueError(
+            "use_pallas='on' forces the fused whole-stack kernel, which "
+            "has no windowed streamed body; use use_pallas='auto'/'off', "
+            f"or {_stream_remedy(cfg)}"
+        )
+    if cfg.layer_coding == "on":
+        raise ValueError(
+            "layer_coding='on' forces the blockwise decode, which has no "
+            "windowed streamed body; use layer_coding='auto'/'off', "
+            f"or {_stream_remedy(cfg)}"
+        )
+    if _model_axis_request(cfg) is not None:
+        raise ValueError(
+            "streamed windows have no model-parallel (2-D mesh) body; "
+            f"{_stream_remedy(cfg)}"
+        )
+
+
+def _resolve_stream_ring(cfg: RunConfig, layout) -> bool:
+    """Stack transport for a streamed FAITHFUL run ("ring" forces,
+    "materialized" forbids). resolve_ring_stack's auto gate sizes the
+    RESIDENT stack against RING_AUTO_MIN_BYTES; a streamed run's stack
+    never resides, so the auto rule here is redundancy itself: ring
+    whenever the assignment actually duplicates partitions
+    (storage_overhead > 1) — the staged window then carries each
+    partition once and the (s+1)x blowup exists only inside the ring
+    fill's per-hop slices, never as pinned window bytes."""
+    if cfg.stack_mode == "ring":
+        return True
+    if cfg.stack_mode != "auto":
+        return False
+    return float(layout.storage_overhead) > 1.0
+
+
+def _make_stream_put(plan, sharding, quantize: bool, cast_dtype):
+    """Host→device transfer fn for one staged stream window (runs on the
+    prefetch staging thread; shared by the per-run and cohort streamed
+    trainers). Deduped/ring windows upload the staged partition-major
+    stack as-is; materialized-faithful windows first gather the
+    slot-group's worker-major ``[gw, S, rows, F]`` view through the
+    plan's local assignment — the same gather shard_run_data performs
+    resident, restricted to one slot-group. int8 stores reuse the
+    write-time ``(q, scale)`` tables verbatim; f32 stores quantize
+    per-partition BEFORE the gather, so the tables are identical to the
+    resident path's (quantization is partition-local)."""
+    from erasurehead_tpu.ops.features import QuantizedStack
+
+    local = plan.local_assignment if plan.mode == "materialized" else None
+
+    def _cast(arr, to):
+        arr = np.asarray(arr)
+        return arr.astype(to) if np.issubdtype(
+            arr.dtype, np.floating
+        ) else arr
+
+    def put(Xh, yh):
+        if quantize:
+            qs = (
+                Xh if isinstance(Xh, QuantizedStack)
+                else QuantizedStack.quantize(np.asarray(Xh))
+            )
+            q, scale = np.asarray(qs.q), np.asarray(qs.scale)
+            if local is not None:
+                q, scale = q[local], scale[local]
+            Xd = QuantizedStack(
+                put_global(q, sharding), put_global(scale, sharding)
+            )
+        else:
+            Xh = _cast(Xh, cast_dtype)
+            if local is not None:
+                Xh = Xh[local]
+            Xd = put_global(Xh, sharding)
+        yh = _cast(yh, cast_dtype)
+        if local is not None:
+            yh = yh[local]
+        return Xd, put_global(yh, sharding)
+
+    return put
+
+
+def _stream_group_slot_weights(layout, plan, schedule) -> np.ndarray:
+    """Per-slot-group decode weights for sub-full faithful stream windows.
+
+    The resident decode's [R, W] message weights cancel ACROSS workers
+    (cyccoded's telescoping sums, the MDS solves), so slicing the
+    expanded slot weights down to one slot-group's worker rows
+    reconstructs nothing — the cancelling terms live in OTHER groups and
+    the restricted sum is an arbitrary signed mixture of staged
+    partitions. Each windowed chunk instead gets its own decode: for
+    slot-group k, solve the min-norm least squares ``u @ E_k = 1_window``
+    over the group's COLLECTED workers, where ``E_k`` is the group's
+    effective coding matrix on the staged span and the target is the
+    window's partition indicator (halo partitions decode toward 0 — they
+    are the NEXT window's block). This is optimal_decode_weights_host's
+    estimator (arXiv:2006.09638) localized to one slot-group, so
+    sub-full faithful windows are APPROXIMATE gradient coding over each
+    block even for exact schemes — the halo mixes into the group's coded
+    messages and cannot always be cancelled with gw unknowns. At full
+    cover ``n_windows == 1`` and the callers keep the resident slot
+    weights (the streamed+ring == resident+ring bitwise pin never routes
+    here).
+
+    Returns ``[R, n_windows, gw, S]`` per-slot weights; separate
+    (uncoded) slots keep their always-on coeffs and their fixed
+    contribution is folded out of the target, mirroring
+    expand_slot_weights' rule."""
+    R = schedule.collected.shape[0]
+    K, gw = plan.n_windows, plan.group_workers
+    S = int(plan.local_assignment.shape[1])
+    coeffs = np.asarray(layout.coeffs, dtype=np.float64)
+    coded = np.broadcast_to(
+        np.asarray(layout.slot_is_coded, dtype=bool),
+        (int(layout.n_workers), S),
+    )
+    la = np.asarray(plan.local_assignment)  # [gw, S] staged-buffer index
+    staged = plan.staged_partitions
+    target0 = (np.arange(staged) < plan.window).astype(np.float64)
+    out = np.zeros((R, K, gw, S))
+    for k in range(K):
+        rows = slice(k * gw, (k + 1) * gw)
+        ck = coeffs[rows]
+        ik = coded[rows]
+        E = np.zeros((gw, staged))
+        np.add.at(
+            E, (np.arange(gw)[:, None], la), np.where(ik, ck, 0.0)
+        )
+        fixed = np.zeros(staged)
+        np.add.at(fixed, la[~ik], ck[~ik])
+        target = target0 - fixed
+        masks = schedule.collected[:, rows]
+        uniq, inverse = np.unique(masks, axis=0, return_inverse=True)
+        u = np.zeros((uniq.shape[0], gw))
+        for j in range(uniq.shape[0]):
+            live = np.flatnonzero(uniq[j])
+            if live.size:
+                u[j, live] = np.linalg.lstsq(
+                    E[live].T, target, rcond=None
+                )[0]
+        mw = u[inverse.reshape(-1)]  # [R, gw]
+        out[:, k] = np.where(ik, mw[:, :, None] * ck, ck)
+    return out
+
+
 def _train_streamed(
     cfg: RunConfig,
     dataset: Dataset,
@@ -1316,13 +1500,30 @@ def _train_streamed(
     device bytes are ever pinned.
 
     Semantics: BLOCK training, not a bitwise replay of the resident run —
-    each round's gradient reads ONE partition window (n_train is the
-    window's row count), and rounds cycle through the windows in fixed
-    order. Deterministic run-to-run for a given (config, store), which is
-    what lets the sweep journal rehydrate killed runs. The deduped scan
-    path only: faithful/ring stacks gather across the WHOLE partition
-    axis and the fused/flat/blockwise lowerings have no windowed body, so
-    those knobs are refused loudly rather than silently resident.
+    each round's gradient reads ONE window (n_train is the window's row
+    count), and rounds cycle through the windows in fixed order.
+    Deterministic run-to-run for a given (config, store), which is what
+    lets the sweep journal rehydrate killed runs.
+
+    Any compatible body serves the windowed chunks (the body-factory
+    seam of ISSUE 17): the deduped scan streams pure partition windows;
+    faithful stacks stream ASSIGNMENT windows (data/sharding.
+    plan_stream_windows — contiguous slot-groups staging window + halo
+    partitions in ring-hop order), either materialized worker-major per
+    window or ring-transported (cfg.stack_mode via _resolve_stream_ring),
+    and the flat/margin-flat dense lowerings compose on top exactly as
+    they do resident. Sub-full faithful windows decode PER SLOT-GROUP
+    (_stream_group_slot_weights — the optimal per-arrival refit
+    localized to the group's collected workers), since the resident
+    decode's cross-worker cancellations do not survive restriction to
+    one group's rows; exact schemes therefore train each block in the
+    approximate-gradient-coding regime when windowed. When the window covers the stack, streamed+ring is
+    bitwise-identical to resident+ring (test-pinned). Still refused,
+    loudly: the forced pallas kernel and forced blockwise decode (no
+    windowed bodies), model-parallel 2-D meshes (_check_streamed_compat),
+    and non-window-uniform assignments (the planner's refusal — e.g.
+    random-regular scatter, where no single hop table serves every
+    window).
 
     Reference mapping: the closest the reference could come was every MPI
     rank eagerly loading its whole NFS assignment at startup
@@ -1330,20 +1531,7 @@ def _train_streamed(
     simply could not run. Here the store IS the NFS share and residency
     is a sliding window over it.
     """
-    if cfg.compute_mode == ComputeMode.FAITHFUL:
-        raise ValueError(
-            "streamed windows support compute_mode='deduped' only: the "
-            "faithful worker-major stack gathers across the whole "
-            "partition axis; raise ERASUREHEAD_STREAM_WINDOW / "
-            "stream_window or run deduped"
-        )
-    if cfg.use_pallas == "on" or cfg.flat_grad == "on" \
-            or cfg.layer_coding == "on":
-        raise ValueError(
-            "streamed windows use the plain deduped scan body; "
-            "use_pallas/flat_grad/layer_coding cannot be forced 'on' "
-            "with a sub-full stream window"
-        )
+    _check_streamed_compat(cfg)
     if checkpoint_dir or resume or initial_state is not None \
             or initial_round:
         raise ValueError(
@@ -1352,16 +1540,13 @@ def _train_streamed(
             "sweep journal's trajectory rehydration; see "
             "tools/outofcore_smoke.py)"
         )
-    if _model_axis_request(cfg) is not None:
-        raise ValueError(
-            "streamed windows have no model-parallel (2-D mesh) body; "
-            "run those configs resident"
-        )
+    from math import gcd
+
     from erasurehead_tpu.data.prefetch import Prefetcher
+    from erasurehead_tpu.data.sharding import plan_stream_windows
     from erasurehead_tpu.obs import decode as obs_decode
     from erasurehead_tpu.obs import detect as obs_detect
     from erasurehead_tpu.obs import events as obs_events
-    from erasurehead_tpu.ops.features import QuantizedStack
     from erasurehead_tpu.parallel import mesh as mesh_lib
     from erasurehead_tpu.train import cache as cache_lib
     from erasurehead_tpu.utils.tracing import annotate
@@ -1370,10 +1555,35 @@ def _train_streamed(
     layout = build_layout(cfg)
     model = build_model(cfg)
     P, rows = store.n_partitions, store.rows_per_partition
-    n_windows = P // window  # window divides P (resolver contract)
+    faithful = cfg.compute_mode == ComputeMode.FAITHFUL
+    mode = (
+        ("ring" if _resolve_stream_ring(cfg, layout) else "materialized")
+        if faithful
+        else "deduped"
+    )
+    try:
+        plan = plan_stream_windows(layout, window, mode=mode)
+    except ValueError as e:
+        raise ValueError(f"{e} — or {_stream_remedy(cfg)}") from None
+    n_windows = plan.n_windows
+    gw = plan.group_workers
     if mesh is None:
-        mesh = _auto_mesh(window)
-    mesh_lib.check_divisible(window, mesh, "stream_window")
+        if mode == "deduped":
+            mesh = _auto_mesh(window)
+        elif mode == "materialized":
+            mesh = _auto_mesh(gw)
+        else:
+            # the sub-ring plan shards BOTH the slot-group's worker axis
+            # and the staged partition span across the mesh
+            mesh = _auto_mesh(gcd(gw, plan.staged_partitions))
+    if mode == "deduped":
+        mesh_lib.check_divisible(window, mesh, "stream_window")
+    else:
+        mesh_lib.check_divisible(gw, mesh, "stream slot-group workers")
+        if mode == "ring":
+            mesh_lib.check_divisible(
+                plan.staged_partitions, mesh, "staged stream window"
+            )
     if hasattr(model, "for_mesh"):
         model = model.for_mesh(mesh)
     stack_dtype = cfg.resolve_stack_dtype()
@@ -1409,9 +1619,31 @@ def _train_streamed(
             layout.coeffs,
             np.asarray(layout.slot_is_coded),
         )
+    )  # [R, W, S]
+    pw = (
+        np.asarray(layout.fold_slot_weights(slot_w))  # [R, P]
+        if mode == "deduped"
+        else None
     )
-    pw = np.asarray(layout.fold_slot_weights(slot_w))  # [R, P]
-    grad_fn = step_lib.make_deduped_grad_fn(model, mesh)
+    # sub-full faithful windows decode per slot-group (the global slot
+    # weights only reconstruct across ALL workers); full cover keeps the
+    # resident weights — the bitwise pin path
+    gsw = (
+        _stream_group_slot_weights(layout, plan, schedule)
+        if mode != "deduped" and n_windows > 1
+        else None
+    )  # [R, K, gw, S]
+    ring_pipe = mode == "ring" and step_lib.resolve_ring_pipeline(
+        cfg.ring_pipeline
+    )
+    # the one-window ring plan every chunk reuses (window-uniformity):
+    # full-cover plans localize to the identity, so this is byte-identical
+    # to the resident plan_ring_transport(layout, D) — the bitwise pin
+    sub_ring = (
+        plan_ring_transport(plan.sub_layout(), _worker_axis_size(mesh))
+        if mode == "ring"
+        else None
+    )
     update_fn = optimizer.make_update_fn(cfg.update_rule)
     state0 = optimizer.init_state(
         _init_params_f32(cfg, model, store.n_features), cfg.update_rule
@@ -1429,33 +1661,11 @@ def _train_streamed(
         (lo, hi) for lo, hi in zip(bounds[:-1], bounds[1:]) if hi > lo
     ]
     win_of = [i % n_windows for i in range(len(chunks))]
-    windows = [(k * window, (k + 1) * window) for k in win_of]
+    windows = [plan.ranges[k] for k in win_of]
 
     sharding = mesh_lib.worker_sharding(mesh)
     quantize = stack_dtype == "int8"
-
-    def _cast(arr, to):
-        arr = np.asarray(arr)
-        return arr.astype(to) if np.issubdtype(
-            arr.dtype, np.floating
-        ) else arr
-
-    def put(Xh, yh):
-        # runs on the prefetch staging thread: pure host->device transfer
-        # (plus the f32-store int8 quantization, which is partition-local
-        # and therefore identical to what the resident path computes)
-        if quantize:
-            qs = (
-                Xh if isinstance(Xh, QuantizedStack)
-                else QuantizedStack.quantize(np.asarray(Xh))
-            )
-            Xd = QuantizedStack(
-                put_global(np.asarray(qs.q), sharding),
-                put_global(np.asarray(qs.scale), sharding),
-            )
-        else:
-            Xd = put_global(_cast(Xh, cast_dtype), sharding)
-        return Xd, put_global(_cast(yh, cast_dtype), sharding)
+    put = _make_stream_put(plan, sharding, quantize, cast_dtype)
 
     lr_np = np.asarray(lr)
     iters_np = np.arange(cfg.rounds)
@@ -1478,10 +1688,20 @@ def _train_streamed(
     run = jax.jit(_run, donate_argnums=(0, 4) if donate else ())
 
     def slices(lo, hi, k):
-        plo = k * window
+        # per-chunk decode weights: the deduped body reads window k's
+        # folded partition columns; sub-full faithful bodies read
+        # slot-group k's per-group decode (_stream_group_slot_weights) —
+        # at full cover both degenerate to the resident tables
+        if mode == "deduped":
+            plo = k * window
+            w_c = pw[lo:hi, plo:plo + window]
+        elif gsw is not None:
+            w_c = gsw[lo:hi, k]
+        else:
+            w_c = slot_w[lo:hi, k * gw:(k + 1) * gw, :]
         return (
             jnp.asarray(lr_np[lo:hi], dtype),
-            jnp.asarray(pw[lo:hi, plo:plo + window], dtype),
+            jnp.asarray(w_c, dtype),
             jnp.asarray(iters_np[lo:hi], dtype),
         )
 
@@ -1492,12 +1712,33 @@ def _train_streamed(
     wall = 0.0
     state = state0
     mem_info = None
-    pf = Prefetcher(store, windows, put, run_id=run_id)
+    pf = Prefetcher(
+        store, windows, put, run_id=run_id,
+        plan_fields=plan.event_fields(),
+    )
     try:
         # the first window synchronously: its device arrays type the
         # lowering (and the prefetcher is already staging window 1)
         X0, y0 = pf.get(0)
         window_nbytes = cache_lib.device_nbytes((X0, y0))
+        # body factory (the ISSUE 17 seam): the same transport + lowering
+        # ladder the resident trainer composes, built over the windowed
+        # stack — X0's device types resolve the dense lowerings exactly
+        # as the resident path's uploaded stacks do
+        if mode == "ring":
+            grad_fn = step_lib.make_ring_faithful_grad_fn(
+                model, mesh, sub_ring, pipeline=ring_pipe
+            )
+        elif mode == "materialized":
+            grad_fn = step_lib.make_faithful_grad_fn(model, mesh)
+        else:
+            grad_fn = step_lib.make_deduped_grad_fn(model, mesh)
+        grad_fn = _apply_margin_flat(
+            cfg, model, mesh, X0, grad_fn, sub_ring, ring_pipe
+        )
+        grad_fn = _apply_flat_grad(
+            cfg, model, mesh, X0, grad_fn, sub_ring, ring_pipe
+        )
         if run_id is not None:
             _emit_run_start(
                 run_id, cfg,
@@ -1505,13 +1746,17 @@ def _train_streamed(
                     layout=layout, model=model, mesh=mesh, data=(X0, y0),
                     state0=state0, update_fn=update_fn, lr=lr,
                     alpha=alpha, n_train=n_train, stack_dtype=stack_dtype,
+                    ring=mode == "ring",
                 ),
                 platform, step_lib.lowering_signature(cfg, model, X0),
-                faithful=False,
+                faithful=faithful,
             )
         sig_fields = _exec_signature_fields(
-            "scan-streamed", platform, cfg, model, X0, y0, False, None,
-            (window,), mesh, state0, alpha, n_train, donation=donate,
+            "scan-streamed", platform, cfg, model, X0, y0, False, sub_ring,
+            (window,) if mode == "deduped" else (gw, layout.n_slots),
+            mesh, state0, alpha, n_train, ring_pipeline=ring_pipe,
+            donation=donate,
+            stream_plan=(mode, window, plan.halo, gw),
         )
         exec_sig = tuple(sig_fields.values())
         compiled = {}
@@ -1633,17 +1878,24 @@ def _train_streamed(
             ),
             "bytes_reused": stats_after["bytes_reused"]
             - stats_before["bytes_reused"],
-            "stack_mode": "deduped",
+            "stack_mode": mode,
             "stack_dtype": stack_dtype,
-            "ring_pipeline": None,
+            "ring_pipeline": (
+                ("pipelined" if ring_pipe else "sequential")
+                if mode == "ring"
+                else None
+            ),
             "donation": donate,
-            # device bytes of ONE staged window — the residency unit; the
-            # double buffer pins at most two of these
+            # device bytes of ONE staged window (window + halo partitions
+            # for the faithful plans) — the residency unit; the double
+            # buffer pins at most two of these
             "stack_bytes": window_nbytes,
             "memory_analysis": mem_info,
             "residency": "streamed",
             "stream_window": window,
             "n_windows": n_windows,
+            "stream_halo": plan.halo,
+            "stream_group_workers": gw,
             "prefetch": pf_stats,
         },
     )
@@ -1654,11 +1906,14 @@ def cohort_eligible(cfg: RunConfig) -> bool:
     The cohort engine batches the scan trainer only: measured-arrival mode
     dispatches per worker, and the forced pallas kernel has no batched
     body (it is a correctness/reference path, not a performance option).
-    Streamed-residency runs are excluded too: the cohort engine's whole
-    premise is ONE shared resident device stack, which is exactly what
-    ``stack_residency="streamed"`` exists to avoid — they dispatch as
-    per-run train() (and never pack with resident runs; serve admission
-    charges them by the window, not the stack).
+    Streamed-residency runs batch too (ISSUE 17): trajectories sharing a
+    (store digest, window plan, cohort signature) key ride ONE windowed
+    cohort scan (_train_cohort_streamed) — static_signature carries
+    stack_residency/stream_window, so streamed cohorts never group with
+    resident ones, and serve admission still charges them by the window,
+    not the stack. Excluded on the streamed path are only the knobs with
+    no windowed body (_check_streamed_compat): the forced blockwise
+    decode and model-parallel 2-D meshes.
     The scheme's registry descriptor can also opt out
     (``cohort_batchable=False``) — what the sweep planner
     (experiments.plan_cohorts) and the serve packer (serve/packer.py)
@@ -1668,11 +1923,14 @@ def cohort_eligible(cfg: RunConfig) -> bool:
     the routing train_cohort's "cohort_batch" refusal relies on."""
     from erasurehead_tpu import schemes
 
+    if _resolve_residency(cfg) == "streamed" and (
+        cfg.layer_coding == "on" or _model_axis_request(cfg) is not None
+    ):
+        return False
     return (
         cfg.arrival_mode == "simulated"
         and cfg.use_pallas != "on"
         and cfg.pipeline_depth == 0
-        and _resolve_residency(cfg) == "resident"
         and schemes.get(cfg.scheme).cohort_batchable
     )
 
@@ -1691,11 +1949,15 @@ def estimate_stack_bytes(cfg: RunConfig, dataset: Dataset) -> int:
     admission is a bound, so over-charging the undecided case is the safe
     direction). int8 scale tables are counted inside
     estimate_worker_stack_bytes (data/sharding.py) — the per-block unit
-    already carries them. Streamed-residency runs on the partition-major
-    path are charged their resident WINDOW — at most two stream windows
-    (compute + prefetch double buffer), never the whole stack; that drop
-    is the admission-side point of out-of-core streaming. An estimate,
-    not an accounting — refined per signature by the compiled
+    already carries them. Streamed-residency runs are charged their
+    resident WINDOWS — at most two (compute + prefetch double buffer),
+    never the whole stack; that drop is the admission-side point of
+    out-of-core streaming. Partition-major windows are charged STAGED
+    (window + assignment halo, data/sharding.plan_stream_windows — the
+    ring fill transports the halo partitions, so they are real residency);
+    materialized-faithful windows are charged the slot-group's worker
+    gather, ``2/n_windows`` of the full worker stack. An estimate, not an
+    accounting — refined per signature by the compiled
     ``memory_analysis`` once a dispatch has run (serve/admission.py).
     """
     layout = build_layout(cfg)
@@ -1714,23 +1976,43 @@ def estimate_stack_bytes(cfg: RunConfig, dataset: Dataset) -> int:
     partition_major = (
         cfg.compute_mode != ComputeMode.FAITHFUL or cfg.stack_mode == "ring"
     )
+    streamed = _resolve_residency(cfg) == "streamed"
+    w = None
+    if streamed:
+        # window resolution without a store: mirror ShardStore.
+        # partition_bytes() from the dataset's own shapes (host/PCIe
+        # bytes per partition — payload + labels + int8 scale row)
+        P = layout.n_partitions
+        F = int(dataset.X_train.shape[1])
+        rows = dataset.n_samples // max(1, P)
+        part_bytes = rows * F * np.dtype(est_dtype).itemsize
+        part_bytes += rows * np.asarray(dataset.y_train).dtype.itemsize
+        if dtype_name == "int8":
+            part_bytes += F * 4
+        w = _resolve_stream_window(cfg, P, part_bytes)
     if partition_major:
         blocks = layout.n_partitions
-        if _resolve_residency(cfg) == "streamed":
-            # window resolution without a store: mirror ShardStore.
-            # partition_bytes() from the dataset's own shapes (host/PCIe
-            # bytes per partition — payload + labels + int8 scale row)
-            F = int(dataset.X_train.shape[1])
-            rows = dataset.n_samples // max(1, blocks)
-            part_bytes = rows * F * np.dtype(est_dtype).itemsize
-            part_bytes += rows * np.asarray(dataset.y_train).dtype.itemsize
-            if dtype_name == "int8":
-                part_bytes += F * 4
-            w = _resolve_stream_window(cfg, blocks, part_bytes)
-            blocks = min(blocks, 2 * w)
+        if streamed and w < blocks:
+            # a buffered window's device bytes are its STAGED span:
+            # window + halo for the ring fill (deduped plans have halo 0)
+            staged = w
+            if cfg.compute_mode == ComputeMode.FAITHFUL:
+                try:
+                    staged = sharding_lib.plan_stream_windows(
+                        layout, w, mode="ring"
+                    ).staged_partitions
+                except ValueError:
+                    pass  # the run itself will refuse; charge the window
+            blocks = min(blocks, 2 * staged)
         est = per_block * blocks
     else:
         est = worker_stack_est
+        if streamed and w < layout.n_partitions:
+            # materialized-faithful window: the slot-group gather is
+            # group_workers x n_slots blocks = 1/n_windows of the worker
+            # stack, double-buffered
+            n_windows = layout.n_partitions // w
+            est = worker_stack_est * min(1.0, 2.0 / n_windows)
     if cfg.pipeline_depth:
         # the pipelined scan carry pins one EXTRA params-sized buffer (the
         # tau=1-stale slot, parallel/pipeline.py) for the whole dispatch.
@@ -1754,7 +2036,12 @@ def cohort_signature(cfg: RunConfig) -> Optional[tuple]:
     compare() is one cohort. Faithful trajectories group by assignment
     CONTENT (materialized stacks and ring hop plans are both
     assignment-derived), so e.g. FRC and AGC share a cohort while cyclic
-    MDS gets its own."""
+    MDS gets its own. Streamed trajectories group separately from
+    resident ones without any extra key material: static_signature
+    carries ``stack_residency`` and ``stream_window``, so a streamed
+    cohort shares one WINDOW PLAN (and one windowed compiled scan,
+    _train_cohort_streamed) the same way a resident cohort shares one
+    stack."""
     if not cohort_eligible(cfg):
         return None
     from erasurehead_tpu.train import cache as cache_lib
@@ -1839,12 +2126,6 @@ def train_cohort(
                 "trajectories dispatch sequentially as per-run train() "
                 "(experiments.plan_cohorts already routes them so)",
             )
-        if _resolve_residency(c) != "resident":
-            raise ValueError(
-                "train_cohort shares ONE resident device stack; "
-                "stack_residency='streamed' trajectories dispatch as "
-                "per-run train() (cohort_eligible already excludes them)"
-            )
     sig0 = cfg0.static_signature()
     for c in cfgs[1:]:
         if (
@@ -1858,6 +2139,27 @@ def train_cohort(
                 "dtype, update_rule, ...); group mixed config sets with "
                 "experiments.plan_cohorts"
             )
+    if _resolve_residency(cfg0) == "streamed":
+        # streamed cohorts (ISSUE 17): one windowed scan serves every
+        # trajectory. static_signature carries stack_residency and
+        # stream_window, so the equality check above already guarantees
+        # the whole cohort resolves residency — and the window — the same
+        # way; the store digest rides the shared dataset (plan_cohorts
+        # groups per dataset, serve packing per dataset_token).
+        store = _ensure_store(cfg0, dataset)
+        window = _resolve_stream_window(
+            cfg0, store.n_partitions, store.partition_bytes()
+        )
+        if window < store.n_partitions:
+            return _train_cohort_streamed(
+                cfg0, dataset, store, window, cfgs, mesh, arrivals,
+                measure,
+            )
+        # full-cover window: the store's rehydrated view rides the
+        # UNCHANGED resident cohort path (bitwise-identical for f32
+        # stores; the single-window fast path train() also takes)
+        if getattr(dataset, "_sweep_cache_token", None) != store.cache_token:
+            dataset = store.dataset()
     return _train_cohort_impl(cfg0, dataset, cfgs, mesh, arrivals, measure)
 
 
@@ -2211,6 +2513,462 @@ def _train_cohort_impl(cfg, dataset, cfgs, mesh, arrivals, measure):
             data_cache_hit=setup.data_cache_hit,
             compile_seconds=round(cmp_secs, 4),
             stack_bytes=cache_lib.device_nbytes(data),
+            arrival=obs_events.arrival_summary(
+                np.stack([s.worker_times for s in schedules])
+            ),
+            **obs_decode.summarize(np.concatenate(batch_err)),
+        )
+    return results
+
+
+@_with_run_sparse_lanes
+def _train_cohort_streamed(
+    cfg, dataset, store, window, cfgs, mesh, arrivals, measure
+):
+    """Trajectory-batched WINDOWED scan over a shard store — the streamed
+    counterpart of :func:`_train_cohort_impl` (ISSUE 17 tentpole part 3).
+
+    A 7-scheme x 4-seed sweep over a disk-resident store used to
+    dispatch as 28 sequential streamed runs, each re-staging every
+    window; here the whole cohort rides ONE compiled windowed scan per
+    chunk length — one prefetch stream, one window staging per chunk,
+    B trajectories of arithmetic per staged window (the same B-fold
+    intensity lever as the resident cohort engine, PR 4). Per-trajectory
+    semantics are _train_streamed's block training exactly: same window
+    plan, same chunk/window cycle, same per-window weight slices — the
+    cohort-streamed rows == sequential-streamed rows pin
+    (tests/test_outofcore.py) rests on that mirroring.
+
+    Trainer-side mirror of _train_cohort_impl otherwise: per-trajectory
+    control planes, [B]-stacked optimizer state, vmapped update, the
+    cohort body ladder (minus the layer-block form — no windowed
+    blockwise body; _check_streamed_compat refused the forced knob), one
+    ``cohort`` event + dispatch counters, one run_end."""
+    from math import gcd
+
+    from erasurehead_tpu.data.prefetch import Prefetcher
+    from erasurehead_tpu.data.sharding import plan_stream_windows
+    from erasurehead_tpu.obs import decode as obs_decode
+    from erasurehead_tpu.obs import detect as obs_detect
+    from erasurehead_tpu.obs import events as obs_events
+    from erasurehead_tpu.obs.metrics import REGISTRY as _metrics
+    from erasurehead_tpu.parallel import mesh as mesh_lib
+    from erasurehead_tpu.train import cache as cache_lib
+    from erasurehead_tpu.utils import chaos as chaos_lib
+    from erasurehead_tpu.utils.tracing import annotate
+
+    # same chaos site as the resident cohort dispatch: a kill here is a
+    # mid-cohort preemption (journal rehydration is the recovery), a
+    # raise exercises the sweep guard's bisection/retry ladder
+    chaos_lib.maybe_fire("cohort")
+    _check_streamed_compat(cfg)
+    stats_before = cache_lib.stats().snapshot()
+    B = len(cfgs)
+    faithful = cfg.compute_mode == ComputeMode.FAITHFUL
+
+    layouts = [build_layout(c) for c in cfgs]
+    stack0 = cache_lib.layout_stack_signature(
+        layouts[0], worker_major=faithful
+    )
+    for c, lay in zip(cfgs[1:], layouts[1:]):
+        if (
+            cache_lib.layout_stack_signature(lay, worker_major=faithful)
+            != stack0
+        ):
+            raise ValueError(
+                f"trajectory {c.scheme.value!r} (seed {c.seed}) builds a "
+                "different device data stack than the cohort's first "
+                "trajectory; train_cohort shares one stack — group by "
+                "cohort_signature (experiments.plan_cohorts) or run "
+                "per-trajectory train()"
+            )
+    layout = layouts[0]
+    model = build_model(cfg)
+    Pn, rows = store.n_partitions, store.rows_per_partition
+    mode = (
+        ("ring" if _resolve_stream_ring(cfg, layout) else "materialized")
+        if faithful
+        else "deduped"
+    )
+    try:
+        plan = plan_stream_windows(layout, window, mode=mode)
+    except ValueError as e:
+        raise ValueError(f"{e} — or {_stream_remedy(cfg)}") from None
+    n_windows = plan.n_windows
+    gw = plan.group_workers
+    if mesh is None:
+        if mode == "deduped":
+            mesh = _auto_mesh(window)
+        elif mode == "materialized":
+            mesh = _auto_mesh(gw)
+        else:
+            mesh = _auto_mesh(gcd(gw, plan.staged_partitions))
+    if mode == "deduped":
+        mesh_lib.check_divisible(window, mesh, "stream_window")
+    else:
+        mesh_lib.check_divisible(gw, mesh, "stream slot-group workers")
+        if mode == "ring":
+            mesh_lib.check_divisible(
+                plan.staged_partitions, mesh, "staged stream window"
+            )
+    if hasattr(model, "for_mesh"):
+        model = model.for_mesh(mesh)
+    stack_dtype = cfg.resolve_stack_dtype()
+    if store.quantized and stack_dtype != "int8":
+        raise ValueError(
+            f"int8 shard store requires stack_dtype='int8' (resolved "
+            f"{stack_dtype!r}): re-uploading a dequantized window would "
+            "silently train on reconstructed values"
+        )
+    cast_dtype = jnp.dtype(
+        cfg.dtype if stack_dtype == "int8" else stack_dtype
+    )
+    n_train = window * rows
+    dtype = jnp.float32
+
+    # per-trajectory control plane: exactly _train_cohort_impl's
+    if arrivals is None:
+        arr_list = [default_arrivals(c) for c in cfgs]
+    elif isinstance(arrivals, (list, tuple)):
+        if len(arrivals) != B:
+            raise ValueError(
+                f"got {len(arrivals)} arrival matrices for {B} trajectories"
+            )
+        arr_list = [np.asarray(a) for a in arrivals]
+    else:
+        arr_list = [np.asarray(arrivals)] * B
+    schedules = [
+        collect.build_schedule(
+            c.scheme, a, lay, num_collect=c.num_collect,
+            deadline=c.deadline, decode=c.decode,
+        )
+        for c, a, lay in zip(cfgs, arr_list, layouts)
+    ]
+    slot_ws = [
+        np.asarray(
+            step_lib.expand_slot_weights(
+                s.message_weights, lay.coeffs, np.asarray(lay.slot_is_coded)
+            )
+        )
+        for s, lay in zip(schedules, layouts)
+    ]  # each [R, W, S]
+    if mode == "deduped":
+        pws = [
+            lay.fold_slot_weights(w) for lay, w in zip(layouts, slot_ws)
+        ]
+        weights_np = np.stack(pws, axis=1)  # [R, B, P]
+    elif n_windows > 1:
+        # sub-full faithful windows: per-slot-group decode per trajectory
+        # (_train_streamed's rule exactly — the cohort == sequential rows
+        # pin needs the same weights)
+        weights_np = np.stack(
+            [
+                _stream_group_slot_weights(lay, plan, s)
+                for s, lay in zip(schedules, layouts)
+            ],
+            axis=1,
+        )  # [R, B, K, gw, S]
+    else:
+        weights_np = np.stack(slot_ws, axis=1)  # [R, B, W, S]
+    ring_pipe = mode == "ring" and step_lib.resolve_ring_pipeline(
+        cfg.ring_pipeline
+    )
+    sub_ring = (
+        plan_ring_transport(plan.sub_layout(), _worker_axis_size(mesh))
+        if mode == "ring"
+        else None
+    )
+
+    states = [
+        optimizer.init_state(
+            _init_params_f32(c, model, store.n_features), cfg.update_rule
+        )
+        for c in cfgs
+    ]
+    state0 = jax.tree.map(lambda *ls: jnp.stack(ls), *states)
+    state0 = jax.tree.map(
+        lambda l: put_global(np_global(l), replicated(mesh)), state0
+    )
+    lr_seq_np = np.stack(
+        [c.resolve_lr_schedule() for c in cfgs], axis=1
+    )  # [R, B]
+    alpha_B = jnp.asarray([c.effective_alpha for c in cfgs], dtype)
+    iters_np = np.arange(cfg.rounds)
+    update_fn = optimizer.make_update_fn(cfg.update_rule)
+    b_update = jax.vmap(update_fn, in_axes=(0, 0, 0, 0, None, None))
+
+    # round chunks and the window cycle: byte-for-byte _train_streamed's
+    # (the cohort == sequential rows pin needs the same block schedule)
+    L = max(1, cfg.rounds // n_windows)
+    bounds = list(range(0, cfg.rounds, L)) + [cfg.rounds]
+    chunks = [
+        (lo, hi) for lo, hi in zip(bounds[:-1], bounds[1:]) if hi > lo
+    ]
+    win_of = [i % n_windows for i in range(len(chunks))]
+    windows = [plan.ranges[k] for k in win_of]
+
+    sharding = mesh_lib.worker_sharding(mesh)
+    quantize = stack_dtype == "int8"
+    put = _make_stream_put(plan, sharding, quantize, cast_dtype)
+
+    def body(Xa, ya, state, xs):
+        eta_t, w_t, i = xs
+        with annotate("eh_scan/coded_step"):
+            g = grad_fn(state.params, Xa, ya, w_t)
+        with annotate("eh_scan/update"):
+            new_state = b_update(state, g, eta_t, alpha_B, n_train, i)
+        return new_state, new_state.params
+
+    def _run(state, Xa, ya, lr_c, w_c, it_c):
+        return jax.lax.scan(
+            partial(body, Xa, ya), state, (lr_c, w_c, it_c),
+            unroll=cfg.scan_unroll,
+        )
+
+    donate = _resolve_donate(cfg)
+    run = jax.jit(_run, donate_argnums=(0, 4) if donate else ())
+
+    def slices(lo, hi, k):
+        if mode == "deduped":
+            plo = k * window
+            w_c = weights_np[lo:hi, :, plo:plo + window]
+        elif n_windows > 1:
+            w_c = weights_np[lo:hi, :, k]  # per-group decode [.., gw, S]
+        else:
+            w_c = weights_np[lo:hi, :, k * gw:(k + 1) * gw, :]
+        return (
+            jnp.asarray(lr_seq_np[lo:hi], dtype),
+            jnp.asarray(w_c, dtype),
+            jnp.asarray(iters_np[lo:hi], dtype),
+        )
+
+    platform = jax.devices()[0].platform
+    schemes_list = sorted({c.scheme.value for c in cfgs})
+    run_id = obs_events.new_run_id() if obs_events.current() else None
+    exec_hits = exec_misses = 0
+    compile_seconds = 0.0
+    pieces = []
+    wall = 0.0
+    state = state0
+    mem_info = None
+    pf = Prefetcher(
+        store, windows, put, run_id=run_id,
+        plan_fields=plan.event_fields(),
+    )
+    try:
+        X0, y0 = pf.get(0)
+        window_nbytes = cache_lib.device_nbytes((X0, y0))
+        # the cohort body ladder (minus layer-block), resolved on the
+        # staged window's device types like _train_cohort_impl resolves
+        # on the resident stack's
+        if cfg.flat_grad == "on" and not step_lib.supports_flat_grad(
+            model, X0
+        ):
+            raise ValueError(
+                "flat_grad='on' needs a closed-form GLM stack; "
+                f"got model="
+                f"{getattr(model, 'name', type(model).__name__)!r}, "
+                f"X={type(X0).__name__}"
+            )
+        if step_lib.supports_cohort_matmul(model, X0):
+            local_body = step_lib._cohort_matmul_local_body(model)
+            cohort_lowering = "cohort_matmul"
+        elif step_lib.resolve_flat_grad(cfg.flat_grad, model, X0):
+            local_body = step_lib._batched_local_body(
+                step_lib._flat_local_body(model)
+            )
+            cohort_lowering = "flat_vmap"
+        else:
+            local_body = None  # the compute mode's default body, vmapped
+            cohort_lowering = "per_slot_vmap"
+        grad_fn = step_lib.make_cohort_grad_fn(
+            model, mesh, faithful=faithful, ring_plan=sub_ring,
+            local_body=local_body, ring_pipeline=ring_pipe,
+        )
+        if run_id is not None:
+            _emit_run_start(
+                run_id, cfg,
+                _RunSetup(
+                    layout=layout, model=model, mesh=mesh, data=(X0, y0),
+                    state0=state0, update_fn=update_fn,
+                    lr=cfg.resolve_lr_schedule(), alpha=0.0,
+                    n_train=n_train, stack_dtype=stack_dtype,
+                    ring=mode == "ring",
+                ),
+                platform, step_lib.lowering_signature(cfg, model, X0),
+                faithful=faithful,
+            )
+            obs_events.emit(
+                "cohort",
+                run_id=run_id,
+                n_trajectories=B,
+                schemes=schemes_list,
+                seeds=[c.seed for c in cfgs],
+                dispatches=1,
+                lowering=cohort_lowering,
+            )
+        # one cohort dispatch per window CYCLE, not per trajectory — the
+        # amortization the smoke target asserts via these counters
+        _metrics.counter("cohort.dispatches").inc()
+        _metrics.counter("cohort.trajectories").inc(B)
+        sig_fields = _exec_signature_fields(
+            "cohort_scan_streamed", platform, cfg, model, X0, y0, False,
+            sub_ring,
+            (B, window) if mode == "deduped" else (B, gw, layout.n_slots),
+            mesh, state0, 0.0, n_train, ring_pipeline=ring_pipe,
+            donation=donate, batch_size=B,
+            cohort_lowering=cohort_lowering,
+            stream_plan=(mode, window, plan.halo, gw),
+        )
+        exec_sig = tuple(sig_fields.values())
+        compiled = {}
+        for idx, (lo, hi) in enumerate(chunks):
+            n = hi - lo
+            if n in compiled:
+                continue
+
+            def _compile(lo=lo, hi=hi, k=win_of[idx]):
+                t0 = time.perf_counter()
+                with _quiet_donation_warnings():
+                    ex = run.lower(
+                        state0, X0, y0, *slices(lo, hi, k)
+                    ).compile()
+                if measure:
+                    lr_c, w_c, it_c = slices(lo, hi, k)
+                    st = _donate_copy(state0) if donate else state0
+                    _hard_sync(ex(st, X0, y0, lr_c, w_c, it_c)[0])
+                return ex, time.perf_counter() - t0
+
+            t_cmp = time.perf_counter()
+            compiled[n], hit = cache_lib.get_or_compile(
+                exec_sig + (n,), _compile
+            )
+            cmp_secs = time.perf_counter() - t_cmp
+            compile_seconds += cmp_secs
+            if hit:
+                exec_hits += 1
+            else:
+                exec_misses += 1
+                obs_detect.observe_and_warn(
+                    {**sig_fields, "chunk_rounds": n}, run_id
+                )
+            if run_id is not None:
+                obs_events.emit(
+                    "compile",
+                    run_id=run_id,
+                    seconds=round(cmp_secs, 4),
+                    cache_hit=hit,
+                    chunk_rounds=n,
+                    memory_analysis=_memory_analysis(compiled[n]),
+                )
+
+        for i, (lo, hi) in enumerate(chunks):
+            # timed region includes the staging wait (same honesty rule
+            # as _train_streamed: unhidden transfer time is overhead)
+            t0 = time.perf_counter()
+            Xd, yd = (X0, y0) if i == 0 else pf.get(i)
+            state, hist = compiled[hi - lo](
+                state, Xd, yd, *slices(lo, hi, win_of[i])
+            )
+            _hard_sync(state)
+            wall += time.perf_counter() - t0
+            pieces.append(hist)
+        mem_info = _memory_analysis(next(iter(compiled.values())))
+    finally:
+        pf.close()
+    pf_stats = pf.stats()
+    final_state = state
+    history = (
+        pieces[0]
+        if len(pieces) == 1
+        else jax.tree.map(lambda *xs: jnp.concatenate(xs), *pieces)
+    )
+    stats_after = cache_lib.stats().snapshot()
+    agg_rate = cfg.rounds * B / wall if wall > 0 else 0.0
+    cache_info = {
+        "enabled": cache_lib.enabled(),
+        "data_hit": False,  # windows are transient by design
+        "exec_hits": exec_hits,
+        "exec_misses": exec_misses,
+        "compile_seconds_saved": round(
+            stats_after["compile_seconds_saved"]
+            - stats_before["compile_seconds_saved"],
+            4,
+        ),
+        "bytes_reused": stats_after["bytes_reused"]
+        - stats_before["bytes_reused"],
+        "batch_size": B,
+        "batch_dispatches": 1,
+        "cohort_size": B,
+        "cohort_dispatches": 1,
+        "cohort_schemes": schemes_list,
+        "cohort_lowering": cohort_lowering,
+        "stack_mode": mode,
+        "stack_dtype": stack_dtype,
+        "ring_pipeline": (
+            ("pipelined" if ring_pipe else "sequential")
+            if mode == "ring"
+            else None
+        ),
+        "donation": donate,
+        "stack_bytes": window_nbytes,
+        "memory_analysis": mem_info,
+        "residency": "streamed",
+        "stream_window": window,
+        "n_windows": n_windows,
+        "stream_halo": plan.halo,
+        "stream_group_workers": gw,
+        "prefetch": pf_stats,
+    }
+    results = []
+    batch_err = []
+    for b, (c, sched, lay) in enumerate(zip(cfgs, schedules, layouts)):
+        fs = jax.tree.map(lambda l: l[b], final_state)
+        err = obs_decode.decode_error_series(lay, sched.message_weights)
+        batch_err.append(err)
+        results.append(
+            TrainResult(
+                params_history=jax.tree.map(lambda l: l[:, b], history),
+                final_params=fs.params,
+                final_state=fs,
+                timeset=sched.sim_time,
+                worker_times=sched.worker_times,
+                collected=sched.collected,
+                sim_total_time=float(sched.sim_time.sum()),
+                wall_time=wall,
+                steps_per_sec=agg_rate,
+                n_train=n_train,
+                config=c,
+                layout=lay,
+                decode_error=err,
+                run_id=run_id,
+                cache_info=dict(cache_info),
+            )
+        )
+    if run_id is not None:
+        for b, (c, sched, err) in enumerate(
+            zip(cfgs, schedules, batch_err)
+        ):
+            obs_events.emit_round_chunks(
+                run_id,
+                start_round=0,
+                timeset=sched.sim_time,
+                worker_times=sched.worker_times,
+                decode_error=err,
+                trajectory=f"{b}:{c.scheme.value}:s{c.seed}",
+            )
+        obs_events.emit(
+            "run_end",
+            run_id=run_id,
+            wall_time_s=round(wall, 6),
+            steps_per_sec=round(agg_rate, 4),
+            batch_size=B,
+            cohort_size=B,
+            exec_hits=exec_hits,
+            exec_misses=exec_misses,
+            data_cache_hit=False,
+            compile_seconds=round(compile_seconds, 4),
+            stack_bytes=window_nbytes,
             arrival=obs_events.arrival_summary(
                 np.stack([s.worker_times for s in schedules])
             ),

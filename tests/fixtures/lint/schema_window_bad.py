@@ -1,0 +1,14 @@
+"""event-schema violations against the ISSUE-17 window-plan contract: a
+``prefetch`` emit carrying the byte account but missing the ``ranges``
+list (the staged ``[lo, hi)`` spans an assignment-aware window plan
+stages in ring-hop order — data/sharding.StreamWindowPlan), and a
+logger-object ``prefetch`` emit missing both ``ranges`` and ``bytes`` —
+the contracts the windowed prefetcher (data/prefetch.py) must satisfy."""
+
+from erasurehead_tpu.obs import events as events_lib
+
+
+def emit_window_plan(logger):
+    # missing ranges (the window-plan field)
+    events_lib.emit("prefetch", run_id="r", window=0, bytes=4096)
+    logger.emit("prefetch", run_id="r", window=1)  # missing bytes, ranges

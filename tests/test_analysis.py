@@ -135,6 +135,19 @@ def test_schema_io_fixture():
     assert len(findings) == 3
 
 
+def test_schema_window_fixture():
+    """The ISSUE-17 window-plan contract is lint-enforced: a ``prefetch``
+    emit that accounts bytes but drops the staged ``ranges`` list (the
+    assignment-aware window plan's [lo, hi) spans) is a finding — a
+    drifted windowed-prefetch emit fails `erasurehead-tpu lint`, not the
+    first composed streamed+ring run in production."""
+    findings = _unsup(_lint(_fx("schema_window_bad.py")), "event-schema")
+    msgs = "\n".join(f.message for f in findings)
+    assert "ranges" in msgs
+    assert "bytes" in msgs  # the logger-object emit is checked too
+    assert len(findings) == 2
+
+
 def test_schema_whatif_fixture():
     """The what-if engine's `whatif` record (ISSUE 12) is lint-enforced
     like every other type: emits missing spec_hash/kind are findings,

@@ -1,4 +1,5 @@
-"""Out-of-core streamed partition stacks (ISSUE 15).
+"""Out-of-core streamed partition stacks (ISSUE 15 + the ISSUE 17
+composition tentpole).
 
 Pins the contract the ``stack_residency`` tentpole rests on:
 
@@ -9,13 +10,28 @@ Pins the contract the ``stack_residency`` tentpole rests on:
   across the f32/int8 x exact(repcoded)/AGC(approx) x ring on/off
   matrix;
 - the multi-window block trainer: deterministic run-to-run, prefetch
-  telemetry present, refusals loud (faithful, checkpointing, cohorts);
+  telemetry present, refusals loud — and NARROW (ISSUE 17): only the
+  knobs with genuinely no windowed body refuse (forced pallas, forced
+  blockwise decode, model-parallel meshes, non-window-uniform
+  assignments), each naming the remedy knob the caller actually used;
+- composed streaming (ISSUE 17): full-cover streamed+ring BITWISE
+  identical to resident+ring (f32 and int8), windowed faithful/ring
+  runs carry their assignment-aware window plan (halo, slot-group,
+  ring-hop ranges) through cache_info and the typed prefetch events,
+  and a streamed COHORT's per-trajectory rows match the sequential
+  streamed runs (full-cover cohort: bitwise vs the resident cohort);
+- the wedged ``Prefetcher.close`` regression: a hung stage can no
+  longer spin the drain loop forever — close() observes its deadline
+  and reports the leaked thread (counter + typed warning event);
+- kill→resume: ``ERASUREHEAD_CHAOS=kill:prefetch:N`` mid-cohort dies
+  with KILL_EXIT and the resumed journaled sweep reproduces the
+  baseline rows;
 - admission estimates: streamed runs charged their double-buffered
-  window, and the int8 worker-stack estimate counts the per-partition
-  scale tables (the satellite bugfix), pinned against the REAL sharded
-  stack's device bytes and the compiled memory_analysis;
+  STAGED window (ring halo included), and the int8 worker-stack
+  estimate counts the per-partition scale tables, pinned against the
+  REAL sharded stack's device bytes and the compiled memory_analysis;
 - serve packing: residency rides the static signature / payload
-  allowlist, and streamed requests never pack into a resident cohort;
+  allowlist — streamed packs WITH streamed, never with resident;
 - data/io.py mmap warm loads bitwise-identical to eager loads.
 """
 
@@ -180,22 +196,400 @@ def test_streamed_multi_window_deterministic(gmm):
     assert _bitwise(a.final_params, b.final_params)
 
 
-def test_streamed_multi_window_refusals(gmm, tmp_path):
+def test_streamed_multi_window_refusals(gmm, tmp_path, monkeypatch):
     multi = _cfg(compute_mode="deduped", stack_residency="streamed",
                  stream_window=1)
-    # faithful mode needs the whole worker stack resident
-    with pytest.raises(ValueError, match="faithful"):
-        trainer.train(_cfg(stack_residency="streamed", stream_window=1),
-                      gmm)
+    # the refusal surface is NARROW (ISSUE 17): faithful windows now
+    # stream (assignment-aware plans), so only the knobs with genuinely
+    # no windowed body refuse — each naming the remedy knob the caller
+    # actually used (--stream-window here, since that is what was set)
+    with pytest.raises(ValueError, match=r"(?s)use_pallas.*stream_window"):
+        trainer.train(
+            dataclasses.replace(multi, use_pallas="on"), gmm
+        )
+    with pytest.raises(ValueError, match="layer_coding"):
+        trainer.train(
+            dataclasses.replace(multi, layer_coding="on"), gmm
+        )
+    # a caller routed here by the env byte budget is told about the
+    # BUDGET, not a --stream-window they never passed
+    monkeypatch.setenv("ERASUREHEAD_STREAM_WINDOW", "1")
+    with pytest.raises(ValueError, match="ERASUREHEAD_STREAM_WINDOW"):
+        trainer.train(
+            _cfg(compute_mode="deduped", stack_residency="streamed",
+                 use_pallas="on"), gmm
+        )
+    monkeypatch.delenv("ERASUREHEAD_STREAM_WINDOW")
+    # non-window-uniform assignments (random-regular scatter) refuse at
+    # the planner: no single hop table serves every window
+    rr = RunConfig(
+        scheme="randreg", n_workers=6, n_stragglers=2, rounds=2,
+        lr_schedule=0.5, update_rule="GD", add_delay=True, seed=0,
+        stack_residency="streamed", stream_window=3,
+    )
+    rr_data = generate_gmm(6 * 32, COLS, n_partitions=6, seed=0)
+    with pytest.raises(ValueError, match="window-uniform"):
+        trainer.train(rr, rr_data)
     # checkpointing composes with resident scan chunks only
     with pytest.raises(ValueError, match="checkpoint"):
         trainer.train(multi, gmm, checkpoint_dir=str(tmp_path / "ck"),
                       checkpoint_every=1)
-    # cohorts share ONE resident stack
-    assert not trainer.cohort_eligible(multi)
-    assert trainer.cohort_signature(multi) is None
-    with pytest.raises(ValueError, match="resident"):
-        trainer.train_cohort([multi], gmm)
+    # streamed cohorts are ELIGIBLE now (one windowed scan serves the
+    # batch); only the no-windowed-body knobs stay sequential
+    assert trainer.cohort_eligible(multi)
+    assert trainer.cohort_signature(multi) is not None
+    assert not trainer.cohort_eligible(
+        dataclasses.replace(multi, layer_coding="on")
+    )
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17: composed streaming — assignment-aware windows x ring x cohorts.
+# Geometry: W=P=6 cyclic s=2 -> stream_window=3 gives two slot-groups of 3
+# workers whose assignments reach 2 partitions past their window (halo=2,
+# staged=5, wraparound ranges on window 1).
+
+
+def _cfg6(**kw):
+    base = dict(
+        scheme="cyccoded", n_workers=6, n_stragglers=2, rounds=8,
+        lr_schedule=0.5, update_rule="GD", add_delay=True, seed=0,
+    )
+    base.update(kw)
+    return RunConfig(**{k: v for k, v in base.items() if v is not None})
+
+
+def _store6(tmp_path, **kw):
+    src = generate_gmm(6 * 32, COLS, n_partitions=6, seed=0)
+    st = store_lib.write_store(src, str(tmp_path / "s6"), 6, **kw)
+    return st, st.dataset()
+
+
+@pytest.mark.parametrize("stack_dtype", ["float32", "int8"])
+def test_streamed_ring_full_cover_bitwise(tmp_path, stack_dtype):
+    """The composition pin: a full-cover window plan localizes to the
+    identity, so the streamed+ring body is the SAME program as
+    resident+ring — bitwise, not allclose, f32 and int8 alike."""
+    st, ds = _store6(
+        tmp_path,
+        stack_dtype="int8" if stack_dtype == "int8" else "float32",
+    )
+    cfg = _cfg6(stack_mode="ring", stack_dtype=stack_dtype)
+    r = trainer.train(cfg, ds)
+    s = trainer._train_streamed(
+        dataclasses.replace(cfg, stack_residency="streamed"),
+        ds, st, window=6,
+    )
+    assert r.cache_info["stack_mode"] == "ring"
+    assert s.cache_info["stack_mode"] == "ring"
+    assert s.cache_info["stream_halo"] == 0  # full cover degenerates
+    assert _bitwise(r.params_history, s.params_history)
+    assert _bitwise(r.final_params, s.final_params)
+
+
+def test_streamed_materialized_full_cover_bitwise(tmp_path):
+    st, ds = _store6(tmp_path)
+    cfg = _cfg6()  # faithful + materialized (the defaults)
+    r = trainer.train(cfg, ds)
+    s = trainer._train_streamed(
+        dataclasses.replace(cfg, stack_residency="streamed"),
+        ds, st, window=6,
+    )
+    assert s.cache_info["stack_mode"] == "materialized"
+    assert _bitwise(r.params_history, s.params_history)
+    assert _bitwise(r.final_params, s.final_params)
+
+
+@pytest.mark.parametrize("mode", ["ring", "materialized"])
+def test_streamed_windowed_faithful_carries_plan(tmp_path, mode):
+    """Sub-full faithful windows run (the old blanket refusal is gone)
+    and carry the assignment-aware plan through cache_info; the block
+    trainer stays deterministic run-to-run."""
+    st, ds = _store6(tmp_path)
+    cfg = _cfg6(stack_mode=mode if mode == "ring" else None,
+                stack_residency="streamed", stream_window=3)
+    a = trainer.train(cfg, ds)
+    ci = a.cache_info
+    assert ci["residency"] == "streamed" and ci["stack_mode"] == mode
+    assert ci["stream_window"] == 3 and ci["n_windows"] == 2
+    assert ci["stream_halo"] == 2 and ci["stream_group_workers"] == 3
+    assert ci["prefetch"]["windows"] >= 2
+    b = trainer.train(cfg, st.dataset())
+    assert _bitwise(a.params_history, b.params_history)
+    assert _bitwise(a.final_params, b.final_params)
+
+
+def test_stream_group_decode_weights():
+    """Sub-full faithful windows decode PER SLOT-GROUP. The resident
+    decode's [R, W] weights cancel across workers (cyccoded's
+    telescoping), so slicing them to one group's rows reconstructs an
+    arbitrary signed mixture of staged partitions — the non-convergent
+    windowed runs a W=30 CLI drive caught. The per-group least-squares
+    weights (a) never reconstruct the window's partition indicator
+    worse than the sliced weights (the slice is a feasible point of the
+    group's lstsq), (b) beat them decisively somewhere, and (c) put no
+    weight on uncollected workers."""
+    from erasurehead_tpu.data.sharding import plan_stream_windows
+    from erasurehead_tpu.parallel import collect
+    from erasurehead_tpu.parallel import step as step_lib
+
+    cfg = _cfg6(stack_residency="streamed", stream_window=3)
+    lay = trainer.build_layout(cfg)
+    plan = plan_stream_windows(lay, 3, mode="ring")
+    arr = trainer.default_arrivals(cfg)
+    sched = collect.build_schedule(
+        cfg.scheme, arr, lay, num_collect=cfg.num_collect,
+        deadline=cfg.deadline, decode=cfg.decode,
+    )
+    gsw = trainer._stream_group_slot_weights(lay, plan, sched)
+    assert gsw.shape == (
+        cfg.rounds, plan.n_windows, plan.group_workers,
+        int(plan.local_assignment.shape[1]),
+    )
+    slot_w = np.asarray(
+        step_lib.expand_slot_weights(
+            sched.message_weights, lay.coeffs,
+            np.asarray(lay.slot_is_coded),
+        )
+    )
+    la = np.asarray(plan.local_assignment)
+    staged, gw = plan.staged_partitions, plan.group_workers
+    target = (np.arange(staged) < plan.window).astype(float)
+
+    def recon(w):  # [gw, S] slot weights -> decoded partition sums
+        out = np.zeros(staged)
+        np.add.at(out, la, w)
+        return out
+
+    news, olds = [], []
+    for k in range(plan.n_windows):
+        for r in range(cfg.rounds):
+            new = np.linalg.norm(recon(gsw[r, k]) - target)
+            old = np.linalg.norm(
+                recon(slot_w[r, k * gw:(k + 1) * gw]) - target
+            )
+            assert new <= old + 1e-9
+            news.append(new)
+            olds.append(old)
+        sub = sched.collected[:, k * gw:(k + 1) * gw]
+        assert not np.any(np.abs(gsw[:, k][~sub]) > 0)
+    assert max(olds) > 5 * max(news)  # the slice was garbage somewhere
+
+
+def test_streamed_window_plan_events(tmp_path):
+    """Every staged window's prefetch event carries the window-plan
+    fields (ranges in ring-hop order, plan_mode/halo/group_workers) and
+    the whole stream passes the shared validator — the same contract
+    `erasurehead-tpu lint` enforces at emit sites."""
+    import json
+
+    from erasurehead_tpu.obs import events as events_lib
+
+    st, ds = _store6(tmp_path)
+    cfg = _cfg6(stack_mode="ring", stack_residency="streamed",
+                stream_window=3)
+    path = str(tmp_path / "ev.jsonl")
+    with events_lib.capture(path):
+        trainer.train(cfg, ds)
+    raw = [ln for ln in open(path).read().splitlines() if ln.strip()]
+    assert events_lib.validate_lines(raw) == []
+    pre = [
+        r for r in map(json.loads, raw)
+        if (r.get("type") or r.get("event")) == "prefetch"
+    ]
+    assert len(pre) >= 2
+    for r in pre:
+        assert r["plan_mode"] == "ring"
+        assert r["halo"] == 2 and r["group_workers"] == 3
+        spans = [hi - lo for lo, hi in r["ranges"]]
+        assert sum(spans) == 5  # staged = window + halo
+    # window 1's halo wraps: two ranges
+    assert any(len(r["ranges"]) == 2 for r in pre)
+
+
+def test_cohort_streamed_matches_sequential(tmp_path):
+    """A streamed cohort's per-trajectory rows match the sequential
+    streamed runs to float tolerance (the batched lowering changes only
+    the reduction order), and the cohort really was ONE dispatch."""
+    from erasurehead_tpu.obs.metrics import REGISTRY
+
+    st, ds = _store6(tmp_path)
+    cfgs = [
+        _cfg6(stack_mode="ring", stack_residency="streamed",
+              stream_window=3, seed=s)
+        for s in (0, 1, 2)
+    ]
+    seq = [trainer._train_streamed(c, ds, st, window=3) for c in cfgs]
+    before = REGISTRY.snapshot().get("cohort.dispatches", 0)
+    res = trainer.train_cohort(cfgs, ds)
+    assert REGISTRY.snapshot()["cohort.dispatches"] == before + 1
+    assert len(res) == 3
+    for r_seq, r_co in zip(seq, res):
+        np.testing.assert_allclose(
+            np.asarray(r_seq.params_history),
+            np.asarray(r_co.params_history),
+            rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(r_seq.final_params),
+            np.asarray(r_co.final_params),
+            rtol=1e-5, atol=1e-5,
+        )
+    ci = res[0].cache_info
+    assert ci["cohort_size"] == 3 and ci["cohort_dispatches"] == 1
+    assert ci["residency"] == "streamed" and ci["stack_mode"] == "ring"
+    assert ci["stream_window"] == 3 and ci["stream_halo"] == 2
+
+
+def test_cohort_streamed_full_cover_bitwise(tmp_path):
+    """At full cover the windowed cohort engine IS the resident cohort
+    engine — bitwise, per trajectory."""
+    st, ds = _store6(tmp_path)
+    res_cfgs = [
+        _cfg6(stack_mode="ring", seed=s) for s in (0, 1)
+    ]
+    str_cfgs = [
+        dataclasses.replace(c, stack_residency="streamed")
+        for c in res_cfgs
+    ]
+    r_res = trainer.train_cohort(res_cfgs, ds)
+    r_str = trainer._train_cohort_streamed(
+        str_cfgs[0], ds, st, 6, str_cfgs, None, None, True
+    )
+    for a, b in zip(r_res, r_str):
+        assert _bitwise(a.params_history, b.params_history)
+        assert _bitwise(a.final_params, b.final_params)
+
+
+# ---------------------------------------------------------------------------
+# the wedged Prefetcher.close regression (ISSUE 17 satellite)
+
+
+def test_prefetcher_close_bounds_wedged_stage(tmp_path):
+    """A stage that never finishes (hung shard read) can no longer spin
+    close()'s drain loop forever: the deadline bounds drain+join and the
+    leaked daemon thread is reported — counter + typed warning event."""
+    import json
+    import threading
+    import time
+
+    from erasurehead_tpu.data.prefetch import Prefetcher
+    from erasurehead_tpu.obs import events as events_lib
+    from erasurehead_tpu.obs.metrics import REGISTRY
+
+    release = threading.Event()
+
+    class WedgedStore:
+        def read_ranges(self, ranges, out=None):
+            release.wait()  # a hung NFS read
+            return (np.zeros((1, 2, 2), np.float32),
+                    np.zeros((1, 2), np.float32))
+
+    pf = Prefetcher(
+        WedgedStore(), [(0, 1)], lambda X, y: (X, y), run_id="t"
+    )
+    before = REGISTRY.snapshot().get("prefetch.join_timeout", 0)
+    path = str(tmp_path / "ev.jsonl")
+    t0 = time.monotonic()
+    with events_lib.capture(path):
+        pf.close(join_timeout_s=0.3)
+    assert time.monotonic() - t0 < 5.0  # bounded, not forever
+    assert REGISTRY.snapshot()["prefetch.join_timeout"] == before + 1
+    recs = [
+        json.loads(ln) for ln in open(path).read().splitlines()
+        if ln.strip()
+    ]
+    warn = [
+        r for r in recs
+        if (r.get("type") or r.get("event")) == "warning"
+        and r.get("kind") == "prefetch_join_timeout"
+    ]
+    assert warn and "did not exit" in warn[0]["message"]
+    release.set()  # let the daemon thread finish before teardown
+
+
+# ---------------------------------------------------------------------------
+# kill→resume across the cohort-streamed path (ISSUE 17 satellite)
+
+
+def test_cohort_streamed_kill_resume(tmp_path):
+    """ERASUREHEAD_CHAOS=kill:prefetch:2 preempts the process while the
+    streamed COHORT dispatch stages its second window; the resumed
+    journaled sweep reproduces the uninterrupted baseline's science rows
+    exactly. Cohort batching is the default dispatch for these streamed
+    trajectories (they share residency, window, and the deduped stack),
+    so the kill lands mid-cohort — nothing journaled — and resume
+    re-trains the whole cohort."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from erasurehead_tpu.data import store as store_lib_
+    from erasurehead_tpu.train import experiments
+    from erasurehead_tpu.train import journal as journal_lib_
+    from erasurehead_tpu.utils.chaos import KILL_EXIT
+
+    store_dir = str(tmp_path / "store")
+    src = generate_gmm(ROWS, COLS, n_partitions=P, seed=0)
+    store = store_lib_.write_store(src, store_dir, P)
+    data = store.dataset()
+    base_kw = dict(
+        scheme="repcoded", n_workers=W, n_stragglers=1,
+        partitions_per_worker=2, rounds=4, n_rows=ROWS, n_cols=COLS,
+        lr_schedule=0.5, update_rule="GD", add_delay=True, seed=0,
+        compute_mode="deduped", stack_residency="streamed",
+        stream_window=1,
+    )
+    base = RunConfig(**base_kw)
+    sweep = {"naive": [0], "cyccoded": [1], "avoidstragg": [1]}
+
+    def run_sweep(journal_dir, resume):
+        journal = journal_lib_.SweepJournal(journal_dir, resume=resume)
+        try:
+            return experiments.straggler_sweep(base, data, sweep,
+                                               journal=journal)
+        finally:
+            journal.close()
+
+    rows_base = [
+        journal_lib_.science_row(s.row())
+        for s in run_sweep(str(tmp_path / "jbase"), False)
+    ]
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    child = (
+        "import sys\n"
+        f"sys.path.insert(0, {repr(repo_root)})\n"
+        "from erasurehead_tpu.data import store as store_lib\n"
+        "from erasurehead_tpu.train import experiments\n"
+        "from erasurehead_tpu.train import journal as journal_lib\n"
+        "from erasurehead_tpu.utils.config import RunConfig\n"
+        f"store = store_lib.open_store({repr(store_dir)})\n"
+        "data = store.dataset()\n"
+        f"base = RunConfig(**{repr(base_kw)})\n"
+        f"journal = journal_lib.SweepJournal({repr(str(tmp_path / 'jkill'))})\n"
+        "try:\n"
+        "    experiments.straggler_sweep(\n"
+        f"        base, data, {repr(sweep)}, journal=journal)\n"
+        "finally:\n"
+        "    journal.close()\n"
+    )
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        ERASUREHEAD_CHAOS="kill:prefetch:2",
+    )
+    p = subprocess.run([sys.executable, "-c", child], env=env,
+                       capture_output=True, text=True)
+    assert p.returncode == KILL_EXIT, (p.returncode, p.stderr[-2000:])
+
+    rows_res = [
+        journal_lib_.science_row(s.row())
+        for s in run_sweep(str(tmp_path / "jkill"), True)
+    ]
+    assert rows_res == rows_base
 
 
 # ---------------------------------------------------------------------------
@@ -256,7 +650,12 @@ def test_streamed_never_packs_with_resident(gmm):
     )
     # residency rides the static signature...
     assert ded.static_signature() != streamed.static_signature()
-    # ...and a multi-window streamed request is a sequential singleton
+    # ...so streamed packs WITH streamed (one windowed cohort scan,
+    # ISSUE 17) and never with resident
+    assert packer_lib.pack_key(
+        serve_queue.RunRequest(tenant="b", label="s", config=streamed,
+                               dataset=gmm)
+    ) is not None
     reqs = [
         serve_queue.RunRequest(tenant="a", label="r", config=ded,
                                dataset=gmm),
@@ -264,13 +663,23 @@ def test_streamed_never_packs_with_resident(gmm):
                                dataset=gmm),
         serve_queue.RunRequest(tenant="c", label="r2", config=ded,
                                dataset=gmm),
+        serve_queue.RunRequest(tenant="d", label="s2", config=streamed,
+                               dataset=gmm),
     ]
-    assert packer_lib.pack_key(reqs[1]) is None
     cohorts = packer_lib.plan_packs(reqs)
     by_label = {
         tuple(sorted(r.label for r in c.requests)) for c in cohorts
     }
-    assert ("r", "r2") in by_label and ("s",) in by_label
+    assert ("r", "r2") in by_label and ("s", "s2") in by_label
+    # differing windows key differing plans — never one scan
+    other = dataclasses.replace(streamed, stream_window=2)
+    assert (
+        packer_lib.pack_key(
+            serve_queue.RunRequest(tenant="e", label="w2", config=other,
+                                   dataset=gmm)
+        )
+        != packer_lib.pack_key(reqs[1])
+    )
 
 
 def test_residency_round_trips_the_serve_payload(gmm):

@@ -3,24 +3,37 @@
 (``make outofcore-smoke``).
 
 Proves the streamed-residency resilience contract end-to-end with REAL
-process deaths, which the in-process tests cannot do:
+process deaths, which the in-process tests cannot do. Three stories:
 
-  1. **baseline** — the child writes a shard store (data/store.py), opens
-     it, and drives a journaled straggler sweep whose every trajectory
-     runs ``stack_residency="streamed"`` with a multi-partition-window
-     prefetch pipeline (stream_window=1 < P, so data/prefetch.py is on
-     the hot path); the sweep runs to completion;
-  2. **kill** — the same sweep with ``ERASUREHEAD_CHAOS=kill:prefetch:N``
-     armed: the process dies (os._exit, preemption semantics) while the
-     prefetcher stages a mid-run partition window — a kill mid-epoch of
-     a streamed trajectory. N is sized so exactly one trajectory's row
-     reached the journal first;
-  3. **resume** — the same command with ``--resume`` reopens the SAME
-     store directory (content digest -> identical journal keys), skips
-     the journaled row, trains the rest, and must produce summary rows
-     BITWISE identical to the baseline.
+  1. **per-trajectory** (``--batch off``) — the child writes a shard
+     store (data/store.py), opens it, and drives a journaled straggler
+     sweep whose every trajectory runs ``stack_residency="streamed"``
+     with a multi-partition-window prefetch pipeline (stream_window=1 <
+     P, so data/prefetch.py is on the hot path). The kill leg arms
+     ``ERASUREHEAD_CHAOS=kill:prefetch:N`` with N sized so exactly one
+     trajectory's row reached the journal first; the resume leg reopens
+     the SAME store directory (content digest -> identical journal
+     keys), SKIPS the journaled row, trains the rest, and must produce
+     summary rows BITWISE identical to the baseline.
+  2. **cohort** (the ``--batch auto`` default) — the same three streamed
+     trajectories share a static signature (scheme is not in it; the
+     deduped partition-major stack is scheme-agnostic), so the sweep
+     dispatches them as ONE windowed cohort scan
+     (trainer._train_cohort_streamed): one dispatch stages n_windows
+     windows TOTAL, not per trajectory. ``kill:prefetch:2`` therefore
+     dies mid-cohort with NOTHING journaled, and resume re-trains the
+     whole cohort to rows bitwise identical to the cohort baseline.
+     The stats file pins the shape: cohort.dispatches == 1,
+     cohort.trajectories == 3.
+  3. **ring** (``--ring``) — a faithful streamed+ring sweep (cyccoded
+     s=1 and s=2, stream_window=2) runs the assignment-aware window
+     plan end-to-end: each trajectory's slot-group windows stage their
+     assignment halo in ring-hop order. Differing straggler budgets
+     mean differing assignments mean differing cohort signatures, so
+     these trajectories never share a compiled scan
+     (cohort.dispatches == 0) — the negative the packer contract pins.
 
-The journal is schema-checked with the same validator as every other
+Every journal is schema-checked with the same validator as every other
 event log. Exit 0 = all invariants held.
 
 Usage: python tools/outofcore_smoke.py [--rounds 8] [--workers 4]
@@ -61,21 +74,34 @@ def child(ns) -> int:
         src = generate_gmm(rows, 8, n_partitions=W, seed=0)
         store = store_lib.write_store(src, ns.store, W)
     data = store.dataset()
-    base = RunConfig(
-        scheme="naive", n_workers=W, n_stragglers=0, num_collect=W // 2,
-        rounds=ns.rounds, n_rows=rows, n_cols=8, lr_schedule=1.0,
-        update_rule="GD", add_delay=True, seed=0, compute_mode="deduped",
-        stack_residency="streamed", stream_window=1,
-    )
-    sweep = {
-        "naive": [0],
-        "cyccoded": [1],
-        "avoidstragg": [1],
-    }
+    if ns.ring:
+        # faithful streamed+ring: the assignment-aware window plan on
+        # the hot path (slot-group windows, ring-hop halo staging)
+        base = RunConfig(
+            scheme="cyccoded", n_workers=W, n_stragglers=1,
+            rounds=ns.rounds, n_rows=rows, n_cols=8, lr_schedule=1.0,
+            update_rule="GD", add_delay=True, seed=0,
+            stack_residency="streamed", stream_window=2,
+            stack_mode="ring",
+        )
+        sweep = {"cyccoded": [1, 2]}
+    else:
+        base = RunConfig(
+            scheme="naive", n_workers=W, n_stragglers=0,
+            num_collect=W // 2, rounds=ns.rounds, n_rows=rows, n_cols=8,
+            lr_schedule=1.0, update_rule="GD", add_delay=True, seed=0,
+            compute_mode="deduped", stack_residency="streamed",
+            stream_window=1,
+        )
+        sweep = {
+            "naive": [0],
+            "cyccoded": [1],
+            "avoidstragg": [1],
+        }
     journal = journal_lib.SweepJournal(ns.journal, resume=ns.resume)
     try:
         summaries = experiments.straggler_sweep(
-            base, data, sweep, journal=journal
+            base, data, sweep, journal=journal, batch=ns.batch
         )
     finally:
         journal.close()
@@ -84,27 +110,49 @@ def child(ns) -> int:
             [journal_lib.science_row(s.row()) for s in summaries],
             f, indent=1,
         )
+    if ns.stats:
+        from erasurehead_tpu.obs.metrics import REGISTRY
+
+        snap = REGISTRY.snapshot()
+        with open(ns.stats, "w") as f:
+            json.dump(
+                {
+                    "cohort.dispatches": snap.get("cohort.dispatches", 0),
+                    "cohort.trajectories": snap.get(
+                        "cohort.trajectories", 0
+                    ),
+                },
+                f,
+            )
     return 0
 
 
 def _fires_per_trajectory(ns) -> int:
-    """Prefetch windows one streamed trajectory stages: the trainer's
-    chunking arithmetic (trainer._train_streamed) with stream_window=1,
-    so n_windows = P = workers and chunk length L = rounds // n_windows."""
+    """Prefetch windows one SEQUENTIAL streamed trajectory stages: the
+    trainer's chunking arithmetic (trainer._train_streamed) with
+    stream_window=1, so n_windows = P = workers and chunk length
+    L = rounds // n_windows. Only valid for ``--batch off`` legs — a
+    cohort dispatch stages this many windows for the WHOLE cohort."""
     n_windows = ns.workers
     L = max(1, ns.rounds // n_windows)
     return len(range(0, ns.rounds, L))
 
 
 def _run_child(workdir, ns, leg, journal_dir, out, store, resume=False,
-               chaos=None) -> subprocess.CompletedProcess:
+               chaos=None, batch="off", ring=False,
+               stats=None) -> subprocess.CompletedProcess:
     cmd = [
         sys.executable, os.path.abspath(__file__), "--child",
         "--journal", journal_dir, "--out", out, "--store", store,
         "--rounds", str(ns.rounds), "--workers", str(ns.workers),
+        "--batch", batch,
     ]
     if resume:
         cmd.append("--resume")
+    if ring:
+        cmd.append("--ring")
+    if stats:
+        cmd.extend(["--stats", stats])
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("ERASUREHEAD_CHAOS", None)
     if chaos:
@@ -137,74 +185,161 @@ def _assert_rows_equal(a, b, leg: str) -> None:
     raise SystemExit(f"[outofcore-smoke] FAIL ({leg}): row sets differ")
 
 
-def orchestrate(ns) -> int:
-    import tempfile
+def _journal_rows(jdir: str) -> int:
+    jpath = os.path.join(jdir, "sweep_journal.jsonl")
+    if not os.path.exists(jpath):
+        # a kill mid-cohort can land before the journal's first write
+        return 0
+    return sum(
+        1 for line in open(jpath)
+        if line.strip() and json.loads(line)["type"] == "sweep_trajectory"
+    )
 
+
+def _validate_journal(jdir: str, leg: str) -> None:
     from erasurehead_tpu.obs import events as events_lib
 
-    work = tempfile.mkdtemp(prefix="eh-outofcore-")
-    store = os.path.join(work, "store")
-    base_out = os.path.join(work, "rows_base.json")
-    res_out = os.path.join(work, "rows_resumed.json")
-    jdir_base = os.path.join(work, "journal_base")
-    jdir_kill = os.path.join(work, "journal_kill")
+    jpath = os.path.join(jdir, "sweep_journal.jsonl")
+    if not os.path.exists(jpath):
+        return
+    errors = events_lib.validate_file(jpath)
+    if errors:
+        raise SystemExit(
+            f"[outofcore-smoke] FAIL ({leg}): journal invalid: {errors}"
+        )
 
-    # 1. baseline: write the store, stream every trajectory, journaled
-    p = _run_child(work, ns, "baseline", jdir_base, base_out, store)
+
+def _kill_resume_story(work, ns, store, tag, batch, chaos_count,
+                       expect_journaled) -> list:
+    """Baseline -> kill -> resume for one dispatch mode; returns the
+    baseline science rows after asserting the whole invariant chain."""
+    base_out = os.path.join(work, f"rows_{tag}_base.json")
+    res_out = os.path.join(work, f"rows_{tag}_resumed.json")
+    stats = os.path.join(work, f"stats_{tag}.json")
+    jdir_base = os.path.join(work, f"journal_{tag}_base")
+    jdir_kill = os.path.join(work, f"journal_{tag}_kill")
+
+    p = _run_child(work, ns, f"{tag}-baseline", jdir_base, base_out,
+                   store, batch=batch, stats=stats)
     if p.returncode != 0:
         raise SystemExit(
-            f"[outofcore-smoke] FAIL: baseline rc={p.returncode}"
+            f"[outofcore-smoke] FAIL: {tag} baseline rc={p.returncode}"
         )
     rows_base = _load(base_out)
     if len(rows_base) != 3:
         raise SystemExit(
-            f"[outofcore-smoke] FAIL: baseline wrote {len(rows_base)} "
-            f"rows, expected 3"
+            f"[outofcore-smoke] FAIL: {tag} baseline wrote "
+            f"{len(rows_base)} rows, expected 3"
+        )
+    st = _load(stats)
+    want_disp = 1 if batch == "auto" else 0
+    if st["cohort.dispatches"] != want_disp:
+        raise SystemExit(
+            f"[outofcore-smoke] FAIL: {tag} baseline "
+            f"cohort.dispatches={st['cohort.dispatches']}, "
+            f"expected {want_disp}"
+        )
+    if batch == "auto" and st["cohort.trajectories"] != 3:
+        raise SystemExit(
+            f"[outofcore-smoke] FAIL: cohort baseline batched "
+            f"{st['cohort.trajectories']} trajectories, expected 3"
         )
 
-    # 2. kill while the SECOND trajectory's prefetcher stages a window
-    #    (one full trajectory journaled, the next one mid-epoch)
-    fires = _fires_per_trajectory(ns)
     p = _run_child(
-        work, ns, "kill", jdir_kill, os.path.join(work, "unused.json"),
-        store, chaos=f"kill:prefetch:{fires + 2}",
+        work, ns, f"{tag}-kill", jdir_kill,
+        os.path.join(work, f"unused_{tag}.json"), store, batch=batch,
+        chaos=f"kill:prefetch:{chaos_count}",
     )
     if p.returncode != KILL_EXIT:
         raise SystemExit(
-            f"[outofcore-smoke] FAIL: kill leg rc={p.returncode}, "
+            f"[outofcore-smoke] FAIL: {tag} kill leg rc={p.returncode}, "
             f"expected {KILL_EXIT}"
         )
-    jpath = os.path.join(jdir_kill, "sweep_journal.jsonl")
-    n_recs = sum(
-        1 for line in open(jpath)
-        if line.strip() and json.loads(line)["type"] == "sweep_trajectory"
-    )
-    if n_recs != 1:
+    n_recs = _journal_rows(jdir_kill)
+    if n_recs != expect_journaled:
         raise SystemExit(
-            f"[outofcore-smoke] FAIL: journal has {n_recs} rows after "
-            f"kill:prefetch:{fires + 2}, expected 1"
+            f"[outofcore-smoke] FAIL: {tag} journal has {n_recs} rows "
+            f"after kill:prefetch:{chaos_count}, "
+            f"expected {expect_journaled}"
         )
-    errors = events_lib.validate_file(jpath)
-    if errors:
+    _validate_journal(jdir_kill, f"{tag}-kill")
+
+    p = _run_child(work, ns, f"{tag}-resume", jdir_kill, res_out, store,
+                   batch=batch, resume=True)
+    if p.returncode != 0:
         raise SystemExit(
-            f"[outofcore-smoke] FAIL: journal invalid: {errors}"
+            f"[outofcore-smoke] FAIL: {tag} resume rc={p.returncode}"
+        )
+    _assert_rows_equal(rows_base, _load(res_out), f"{tag} kill->resume")
+    print(f"[outofcore-smoke] {tag} kill->resume invariance: OK",
+          file=sys.stderr)
+    return rows_base
+
+
+def orchestrate(ns) -> int:
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="eh-outofcore-")
+    store = os.path.join(work, "store")
+
+    # 1. per-trajectory dispatch: kill lands while the SECOND
+    #    trajectory's prefetcher stages a window -> one full trajectory
+    #    journaled, resume skips it
+    fires = _fires_per_trajectory(ns)
+    rows_seq = _kill_resume_story(
+        work, ns, store, "seq", batch="off",
+        chaos_count=fires + 2, expect_journaled=1,
+    )
+
+    # 2. cohort dispatch (the sweep default): one windowed cohort scan
+    #    stages n_windows windows TOTAL, so the kill lands mid-cohort
+    #    and NOTHING is journaled; resume re-trains the whole cohort
+    rows_cohort = _kill_resume_story(
+        work, ns, store, "cohort", batch="auto",
+        chaos_count=2, expect_journaled=0,
+    )
+    if [r.get("label") for r in rows_cohort] != [
+        r.get("label") for r in rows_seq
+    ]:
+        raise SystemExit(
+            "[outofcore-smoke] FAIL: cohort sweep trained different "
+            "trajectories than the per-trajectory sweep"
         )
 
-    # 3. resume: reopen the store from disk, skip the journaled row,
-    #    finish, match the baseline bitwise
-    p = _run_child(
-        work, ns, "resume", jdir_kill, res_out, store, resume=True
-    )
+    # 3. ring: faithful streamed+ring windows with real assignment
+    #    halos; differing assignments never share a compiled scan
+    ring_out = os.path.join(work, "rows_ring.json")
+    ring_stats = os.path.join(work, "stats_ring.json")
+    jdir_ring = os.path.join(work, "journal_ring")
+    ring_store = os.path.join(work, "store_ring")
+    p = _run_child(work, ns, "ring", jdir_ring, ring_out, ring_store,
+                   batch="auto", ring=True, stats=ring_stats)
     if p.returncode != 0:
-        raise SystemExit(f"[outofcore-smoke] FAIL: resume rc={p.returncode}")
-    _assert_rows_equal(rows_base, _load(res_out), "kill->resume")
-    print("[outofcore-smoke] streamed kill->resume invariance: OK",
+        raise SystemExit(
+            f"[outofcore-smoke] FAIL: ring leg rc={p.returncode}"
+        )
+    rows_ring = _load(ring_out)
+    if len(rows_ring) != 2:
+        raise SystemExit(
+            f"[outofcore-smoke] FAIL: ring leg wrote {len(rows_ring)} "
+            f"rows, expected 2"
+        )
+    st = _load(ring_stats)
+    if st["cohort.dispatches"] != 0:
+        raise SystemExit(
+            f"[outofcore-smoke] FAIL: ring trajectories with differing "
+            f"assignments shared {st['cohort.dispatches']} cohort "
+            f"dispatches, expected 0"
+        )
+    _validate_journal(jdir_ring, "ring")
+    print("[outofcore-smoke] streamed+ring windowed sweep: OK",
           file=sys.stderr)
 
     print(json.dumps({
         "status": "PASS",
-        "rows": len(rows_base),
-        "journaled_before_kill": n_recs,
+        "rows_seq": len(rows_seq),
+        "rows_cohort": len(rows_cohort),
+        "rows_ring": len(rows_ring),
         "workdir": work,
     }))
     return 0
@@ -219,6 +354,9 @@ def main() -> int:
     ap.add_argument("--resume", action="store_true")
     ap.add_argument("--out", default=None)
     ap.add_argument("--store", default=None)
+    ap.add_argument("--batch", default="off", choices=["off", "auto"])
+    ap.add_argument("--ring", action="store_true")
+    ap.add_argument("--stats", default=None)
     ns = ap.parse_args()
     if ns.child:
         if not ns.journal or not ns.out or not ns.store:

@@ -87,6 +87,17 @@ run dense_int8_ring      1800 env BENCH_STACK=ring BENCH_STACK_DTYPE=int8 python
 run dense_int8_ringpipe  1800 env BENCH_STACK=ring BENCH_RING_PIPELINE=on BENCH_STACK_DTYPE=int8 python bench.py
 run dense_int8           1800 env BENCH_STACK_DTYPE=int8 python bench.py
 run dense_f32_nodonate   1800 env BENCH_DONATE=off python bench.py
+# composed out-of-core streaming (ISSUE 17): the canonical run over
+# windowed partition stacks behind the prefetch pipeline, composed with
+# ring transport (window 6 of 30 resident; the approx layout is window-
+# uniform at 6). The payload's outofcore_composed extra carries the
+# streamed-vs-resident overhead, overlap efficiency, staged-window
+# device bytes, and the windowed-cohort-vs-sequential trajectory rate
+# (cohort_stream re-captures it on the int8 stack so both claims land
+# even if one entry dies mid-window).
+run dense_f32_streamring  1800 env BENCH_STACK=ring BENCH_RESIDENCY=streamed BENCH_STREAM_WINDOW=6 python bench.py
+run dense_int8_streamring 1800 env BENCH_STACK=ring BENCH_STACK_DTYPE=int8 BENCH_RESIDENCY=streamed BENCH_STREAM_WINDOW=6 python bench.py
+run cohort_stream         1800 env BENCH_STACK=ring BENCH_STACK_DTYPE=int8 BENCH_RESIDENCY=streamed BENCH_STREAM_WINDOW=6 BENCH_OUTOFCORE_COHORT=16 python bench.py
 # deduped compute mode on the dense flagship: bit-compatible gradients at
 # 1/(s+1) the HBM traffic — the framework's structural win over the
 # faithful reference protocol, never yet TPU-measured for dense

@@ -43,6 +43,11 @@ run dense_int8_ringpipe_rep2   1800 env BENCH_STACK=ring BENCH_RING_PIPELINE=on 
 run dense_int8_rep2            1800 env BENCH_STACK_DTYPE=int8 python bench.py
 run dense_f32_nodonate_rep2    1800 env BENCH_DONATE=off python bench.py
 
+# --- composed out-of-core streaming (ISSUE 17 headliners) ----------------
+run dense_f32_streamring_rep2  1800 env BENCH_STACK=ring BENCH_RESIDENCY=streamed BENCH_STREAM_WINDOW=6 python bench.py
+run dense_int8_streamring_rep2 1800 env BENCH_STACK=ring BENCH_STACK_DTYPE=int8 BENCH_RESIDENCY=streamed BENCH_STREAM_WINDOW=6 python bench.py
+run cohort_stream_rep2         1800 env BENCH_STACK=ring BENCH_STACK_DTYPE=int8 BENCH_RESIDENCY=streamed BENCH_STREAM_WINDOW=6 BENCH_OUTOFCORE_COHORT=16 python bench.py
+
 # --- fields constellation (per-shape default gates) ----------------------
 for shape in covtype amazon; do
   run "sparse_${shape}_faithful_fields_flat_rep2" 1200 python tools/bench_sparse.py \
