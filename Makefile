@@ -45,7 +45,7 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 	telemetry-smoke sweep-batch-smoke chaos-smoke roofline-smoke \
 	serve-smoke serve-load-smoke serve-chaos-smoke adapt-smoke \
 	deep-smoke elastic-smoke whatif-smoke outofcore-smoke \
-	pipeline-smoke clean
+	pipeline-smoke obs-smoke clean
 
 naive:            ## uncoded wait-for-all baseline (src/naive.py)
 	$(RUN) --scheme naive
@@ -151,6 +151,9 @@ whatif-smoke:     ## CPU what-if cycle: tiny grid -> surface artifact -> adapt p
 
 pipeline-smoke:   ## CPU sync vs tau=1 pipelined race at exp(2.0): pipelined time-to-target <= sync, bitwise replay, tau=0 collapse, typed events validate (tools/pipeline_smoke.py)
 	JAX_PLATFORMS=cpu $(PY) tools/pipeline_smoke.py
+
+obs-smoke:        ## CPU live-telemetry drive: critical-path ledgers close, reducer tails the log, regime shift detected in budget, /metrics exposition valid, bitwise dark rerun (tools/obs_smoke.py)
+	JAX_PLATFORMS=cpu $(PY) tools/obs_smoke.py
 
 sweep:            ## the full on-TPU measurement program (resumable, tagged)
 	bash tools/tpu_measurements.sh

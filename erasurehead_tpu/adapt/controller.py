@@ -102,6 +102,13 @@ class ControllerConfig:
     #: regime shift and resets the per-arm values so the bandit
     #: re-explores instead of trusting stale pre-shift rewards
     shift_factor: float = 2.5
+    #: where the shift verdict comes from: "chunk_mean" (default — the
+    #: controller's own arrival-mean jump rule above) or "regime" — the
+    #: caller passes the live estimator's verdict into ``observe``
+    #: (obs/regime.ArrivalRegimeEstimator.poll_shift, which sees every
+    #: ROUND's arrivals instead of one mean per chunk and also carries
+    #: the tail-index change-point machinery)
+    shift_source: str = "chunk_mean"
     #: exploration seed (decision replay: same seed + same telemetry ->
     #: same decisions, bitwise)
     seed: int = 0
@@ -128,6 +135,11 @@ class ControllerConfig:
         if self.shift_factor <= 1.0:
             raise ValueError(
                 f"shift_factor must be > 1, got {self.shift_factor}"
+            )
+        if self.shift_source not in ("chunk_mean", "regime"):
+            raise ValueError(
+                f"shift_source must be chunk_mean/regime, got "
+                f"{self.shift_source!r}"
             )
         if self.prior_weight <= 0.0:
             raise ValueError(
@@ -253,12 +265,24 @@ class AdaptiveController:
             1.0 + self.cfg.error_penalty * err * err
         )
 
-    def observe(self, arm_index: int, stats: ChunkStats) -> Optional[str]:
+    def observe(
+        self,
+        arm_index: int,
+        stats: ChunkStats,
+        regime_shift: Optional[bool] = None,
+    ) -> Optional[str]:
         """Feed one chunk's telemetry back; returns "regime_shift" when
         the arrival statistics jumped past ``shift_factor`` (per-arm
         values are then reset so the next choices re-explore — the
         discounted estimates from the old regime are evidence about a
-        world that no longer exists)."""
+        world that no longer exists).
+
+        Under ``shift_source="regime"`` the jump rule is replaced by the
+        caller's verdict: ``regime_shift`` is the live estimator's
+        ``poll_shift()`` for this chunk (obs/regime.py), and a chunk
+        observed without a verdict falls back to the jump rule so a
+        driver that stopped feeding the estimator degrades to the old
+        behavior instead of going shift-blind."""
         r = self.reward(stats)
         g = self.cfg.discount
         self._weight *= g
@@ -269,19 +293,27 @@ class AdaptiveController:
         self._weight[arm_index] += 1.0
         shift = None
         mean = stats.arrival_mean
-        if mean is not None and self._last_arrival_mean is not None:
-            lo, hi = sorted(
-                (max(mean, 1e-12), max(self._last_arrival_mean, 1e-12))
-            )
-            if hi / lo >= self.cfg.shift_factor:
-                shift = "regime_shift"
-                # keep only THIS chunk's reward (it is from the new
-                # regime); every other arm restarts from scratch
-                self._value[:] = 0.0
-                self._weight[:] = 0.0
-                self._value[arm_index] = r
-                self._weight[arm_index] = 1.0
-                self._pending_shift = True
+        use_verdict = (
+            self.cfg.shift_source == "regime" and regime_shift is not None
+        )
+        if use_verdict:
+            shifted = bool(regime_shift)
+        else:
+            shifted = False
+            if mean is not None and self._last_arrival_mean is not None:
+                lo, hi = sorted(
+                    (max(mean, 1e-12), max(self._last_arrival_mean, 1e-12))
+                )
+                shifted = hi / lo >= self.cfg.shift_factor
+        if shifted:
+            shift = "regime_shift"
+            # keep only THIS chunk's reward (it is from the new
+            # regime); every other arm restarts from scratch
+            self._value[:] = 0.0
+            self._weight[:] = 0.0
+            self._value[arm_index] = r
+            self._weight[arm_index] = 1.0
+            self._pending_shift = True
         if shift is None:
             self._pending_shift = False
         self._last_arrival_mean = mean

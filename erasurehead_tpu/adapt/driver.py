@@ -167,6 +167,18 @@ def train_adaptive(
     _validate_arms(cfg, arms)
     ctl = AdaptiveController(arms, ctl_cfg, priors=priors)
 
+    # shift_source="regime": the live estimator (obs/regime.py) watches
+    # every ROUND of the raw arrival schedule and hands its change-point
+    # verdict to observe() — chunk-size-independent detection, plus the
+    # Hill tail-index machinery the chunk-mean rule lacks
+    estimator = None
+    if ctl_cfg.shift_source == "regime":
+        from erasurehead_tpu.obs import regime as regime_lib
+
+        estimator = regime_lib.ArrivalRegimeEstimator(
+            shift_factor=ctl_cfg.shift_factor
+        )
+
     # chunk-boundary loss probe (reward_mode="progress"): one-snapshot
     # eval replays on the full host training set — evaluate.replay caches
     # its jitted scan per model identity, so each probe is one tiny
@@ -252,8 +264,8 @@ def train_adaptive(
         # policy-dependent (avoidstragg never stamps the straggler it
         # skipped), and a policy-dependent detector would read every arm
         # switch as a regime change
-        raw = arrivals[lo:hi]
-        raw = raw[np.isfinite(raw)]
+        raw_rows = arrivals[lo:hi]
+        raw = raw_rows[np.isfinite(raw_rows)]
         loss_delta = None
         if loss_prev is not None:
             loss_now = _loss_of(res.final_params)
@@ -269,7 +281,14 @@ def train_adaptive(
             ),
             loss_delta=loss_delta,
         )
-        shift = ctl.observe(idx, stats)
+        verdict = None
+        if estimator is not None:
+            # same raw (policy-independent) rows the jump rule reads,
+            # but per-round — the estimator's change-point fires within
+            # its short window instead of waiting out a chunk mean
+            estimator.update_rounds(lo, raw_rows)
+            verdict = estimator.poll_shift()
+        shift = ctl.observe(idx, stats, regime_shift=verdict)
         chunk_stats.append((arm.label, stats))
         obs_events.emit(
             "adapt",

@@ -896,6 +896,15 @@ def main(argv: list[str] | None = None) -> int:
         from erasurehead_tpu.whatif import engine as whatif_lib
 
         return whatif_lib.main(argv[1:])
+    if argv and argv[0] == "top":
+        # `erasurehead-tpu top <events.jsonl|http://host:port> ...` — the
+        # live terminal telemetry renderer (obs/exporter.top_main): tails
+        # an event log (or polls a serve front's /metrics) through the
+        # streaming reducer and redraws one summary frame per interval;
+        # --slo-ttlr arms the per-tenant SLO burn-rate tracker
+        from erasurehead_tpu.obs import exporter as exporter_lib
+
+        return exporter_lib.top_main(argv[1:])
     if argv and argv[0] == "lint":
         # `erasurehead-tpu lint [--strict] [paths]` — the AST invariant
         # analyzer (erasurehead_tpu/analysis/): trace-purity,

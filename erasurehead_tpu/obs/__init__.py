@@ -19,7 +19,23 @@ afterthought:
     executable-cache miss lands on a signature family already compiled
     in-process, naming the key fields that differed;
   - :mod:`erasurehead_tpu.obs.report` — renders an events.jsonl into the
-    human summary table behind ``erasurehead-tpu report``.
+    human summary table behind ``erasurehead-tpu report``;
+  - :mod:`erasurehead_tpu.obs.timeseries` — bounded-memory streaming
+    reducer over the live event stream (in-process observer attach or
+    events.jsonl tail) producing windowed series: rounds/sec, arrival
+    quantiles, decode-error split, prefetch throughput, cache hit
+    rates, per-tenant serve goodput;
+  - :mod:`erasurehead_tpu.obs.critical_path` — per-run wall-clock
+    attribution (straggler-wait vs compute vs dispatch-gap on the
+    simulated clock; decode+update vs prefetch-stall on the host wall),
+    emitted as the typed ``critical_path`` event;
+  - :mod:`erasurehead_tpu.obs.regime` — online arrival-regime estimator
+    (rolling rate + Hill tail index + change-point detection) consumed
+    by the adaptive controller's ``shift_source="regime"`` path;
+  - :mod:`erasurehead_tpu.obs.exporter` — Prometheus text exposition of
+    the registry + reducer gauges (the serve front's ``GET /metrics``),
+    the per-tenant SLO burn-rate tracker, and the ``erasurehead-tpu
+    top`` live terminal renderer.
 """
 
 from erasurehead_tpu.obs import events, metrics  # noqa: F401

@@ -167,10 +167,43 @@ SCHEMA: dict[str, tuple] = {
     "stale_decode": ("run_id", "first_round", "n_rounds",
                      "staleness_error_mean", "coding_error_mean",
                      "staleness_share"),
+    # one per run: the wall-clock attribution ledger (obs/critical_path.py)
+    # — where the run's measured host wall and simulated master clock
+    # actually went. ``components`` attributes the HOST wall (decode+update
+    # execution vs prefetch-stall vs compile, real seconds of the timed
+    # region); ``sim_components`` attributes the SIMULATED clock
+    # (fastest-arrival compute floor vs straggler-wait vs pipelined
+    # dispatch-gap). Each ledger's values must sum to its measured total
+    # within 5% — the validator enforces the reconciliation, so a ledger
+    # that silently drops a bucket is a schema error, not a report footnote
+    "critical_path": ("run_id", "wall_s", "sim_total_s", "components",
+                      "sim_components", "fractions"),
+    # arrival-regime estimator output (obs/regime.py): the rolling
+    # exp-rate + heavy-tail classification of the masked arrival stream
+    # at round ``round``, and whether a change-point fired there.
+    # ``rate`` is 1/mean of the rolling window (arrivals/sim-second);
+    # optional ``tail_index`` carries the Hill estimate behind the kind
+    "regime": ("round", "kind", "rate", "n", "shifted"),
+    # one per SLO tracker evaluation window (obs/exporter.SloTracker):
+    # the tenant's time-to-last-row SLO, how many requests the window
+    # scored, how many breached, and the burn rate (breach fraction /
+    # error budget — > 1 means the budget is burning faster than allowed)
+    "slo": ("tenant", "slo_s", "window_requests", "breaches",
+            "burn_rate"),
 }
 
 #: adapt decision reasons (adapt/controller.AdaptiveController.choose)
 ADAPT_REASONS = ("warmup", "exploit", "explore", "regime_shift")
+
+#: arrival-regime classifications (obs/regime.ArrivalRegimeEstimator):
+#: "exp" = light (exponential-like) tail, "heavytail" = Pareto-like tail
+#: by the rolling Hill index, "unknown" = not enough masked arrivals yet
+REGIME_KINDS = ("exp", "heavytail", "unknown")
+
+#: critical-path reconciliation tolerance: each attribution ledger's
+#: component sum must land within this fraction of its measured total
+#: (the acceptance bar the validator enforces on every critical_path line)
+CRITICAL_PATH_TOL = 0.05
 
 #: membership actions (elastic/controller.py): deaths/joins are detector
 #: decisions, "relayout" commits them into a fresh W'-worker layout,
@@ -226,6 +259,20 @@ def _jsonable(v):
     return v
 
 
+def _checked_payload(type: str, fields: dict) -> dict:
+    """Validate ``fields`` against :data:`SCHEMA` and JSON-coerce them —
+    the shared gate for file emission and in-process observers."""
+    required = SCHEMA.get(type)
+    if required is None:
+        raise ValueError(
+            f"unknown event type {type!r}; known: {sorted(SCHEMA)}"
+        )
+    missing = [k for k in required if k not in fields]
+    if missing:
+        raise ValueError(f"event {type!r} missing required {missing}")
+    return {k: _jsonable(v) for k, v in fields.items()}
+
+
 class EventLogger:
     """Append-only JSONL writer with per-line flush (a crashed run keeps
     every event emitted before the crash).
@@ -260,16 +307,8 @@ class EventLogger:
         self._seq = itertools.count()
         self._lock = threading.Lock()
 
-    def emit(self, type: str, **fields) -> None:
-        required = SCHEMA.get(type)
-        if required is None:
-            raise ValueError(
-                f"unknown event type {type!r}; known: {sorted(SCHEMA)}"
-            )
-        missing = [k for k in required if k not in fields]
-        if missing:
-            raise ValueError(f"event {type!r} missing required {missing}")
-        payload = {k: _jsonable(v) for k, v in fields.items()}
+    def emit(self, type: str, **fields) -> dict:
+        payload = _checked_payload(type, fields)
         with self._lock:
             if self._f is None:
                 raise ValueError(f"event logger {self.path!r} is closed")
@@ -284,6 +323,7 @@ class EventLogger:
             else:
                 self._f.write(line)
                 self._f.flush()
+        return rec
 
     def close(self) -> None:
         with self._lock:
@@ -299,17 +339,68 @@ class EventLogger:
 _current: Optional[EventLogger] = None
 _run_counter = itertools.count(1)
 
+#: in-process event observers (obs/timeseries.py live attach): callables
+#: invoked host-side with each emitted record dict. Observers see the
+#: same typed stream a capture writes — with no capture installed they
+#: still receive records (the serve daemon's live /metrics loop), stamped
+#: with a process-local seq
+_observers: list = []
+_observer_seq = itertools.count()
+
 
 def current() -> Optional[EventLogger]:
     return _current
 
 
+def add_observer(fn) -> None:
+    """Attach an in-process event observer. ``fn(record)`` is called
+    host-side, synchronously, for every :func:`emit` — the live-telemetry
+    attachment point (obs/timeseries.TimeseriesReducer.attach). Observer
+    exceptions are swallowed with a warn_once: telemetry consumers must
+    never break the producer."""
+    _observers.append(fn)
+
+
+def remove_observer(fn) -> None:
+    """Detach a previously added observer (no-op if absent)."""
+    try:
+        _observers.remove(fn)
+    except ValueError:
+        pass
+
+
+def _notify_observers(rec: dict) -> None:
+    for fn in list(_observers):
+        try:
+            fn(rec)
+        except Exception as e:  # noqa: BLE001 — observers are passive
+            from erasurehead_tpu.obs.metrics import warn_once
+
+            warn_once(
+                f"event-observer-{type(e).__name__}",
+                f"event observer {fn!r} raised {e!r}; record dropped "
+                f"from the live stream (the event log is unaffected)",
+            )
+
+
 def emit(type: str, **fields) -> bool:
-    """Emit into the current capture; no-op (False) when none installed."""
-    if _current is None:
-        return False
-    _current.emit(type, **fields)
-    return True
+    """Emit into the current capture; no-op (False) when none installed.
+
+    In-process observers (:func:`add_observer`) always see the record,
+    capture or not — the file is the durable log, observers are the live
+    plane."""
+    if _current is not None:
+        rec = _current.emit(type, **fields)
+        _notify_observers(rec)
+        return True
+    if _observers:
+        rec = {
+            "type": type, "seq": next(_observer_seq),
+            "t": round(time.time(), 3),
+        }
+        rec.update(_checked_payload(type, fields))
+        _notify_observers(rec)
+    return False
 
 
 @contextlib.contextmanager
@@ -871,6 +962,112 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
                 errors.append(
                     f"line {i}: stale_decode staleness_share must be a "
                     f"number in [0, 1], got {share!r}"
+                )
+        if rtype == "critical_path":
+            for total_field, comp_field in (
+                ("wall_s", "components"),
+                ("sim_total_s", "sim_components"),
+            ):
+                total = rec.get(total_field)
+                comps = rec.get(comp_field)
+                if not isinstance(total, (int, float)) or total < 0:
+                    errors.append(
+                        f"line {i}: critical_path {total_field} must be a "
+                        f"non-negative number, got {total!r}"
+                    )
+                    continue
+                if not isinstance(comps, dict) or not all(
+                    isinstance(v, (int, float)) and v >= 0
+                    for v in comps.values()
+                ):
+                    errors.append(
+                        f"line {i}: critical_path {comp_field} must map "
+                        f"bucket names to non-negative seconds, got "
+                        f"{comps!r}"
+                    )
+                    continue
+                # the reconciliation contract: the ledger sums to its
+                # measured total within CRITICAL_PATH_TOL — an attribution
+                # that loses (or invents) wall-clock is a schema error
+                s = sum(comps.values())
+                if abs(s - total) > CRITICAL_PATH_TOL * total + 1e-9:
+                    errors.append(
+                        f"line {i}: critical_path {comp_field} sum "
+                        f"{s:.6f}s does not reconcile with {total_field} "
+                        f"{total:.6f}s within {CRITICAL_PATH_TOL:.0%}"
+                    )
+            fractions = rec.get("fractions")
+            if not isinstance(fractions, dict) or not all(
+                isinstance(v, (int, float)) and 0 <= v <= 1
+                for v in fractions.values()
+            ):
+                errors.append(
+                    f"line {i}: critical_path fractions must map bucket "
+                    f"names to numbers in [0, 1], got {fractions!r}"
+                )
+        if rtype == "regime":
+            kind = rec.get("kind")
+            if kind not in REGIME_KINDS:
+                errors.append(
+                    f"line {i}: regime kind must be one of "
+                    f"{REGIME_KINDS}, got {kind!r}"
+                )
+            rate = rec.get("rate")
+            if not isinstance(rate, (int, float)) or rate < 0:
+                errors.append(
+                    f"line {i}: regime rate must be a non-negative "
+                    f"number, got {rate!r}"
+                )
+            rnd = rec.get("round")
+            if not isinstance(rnd, int) or rnd < 0:
+                errors.append(
+                    f"line {i}: regime round must be a non-negative int, "
+                    f"got {rnd!r}"
+                )
+            n = rec.get("n")
+            if not isinstance(n, int) or n < 0:
+                errors.append(
+                    f"line {i}: regime n must be a non-negative int, "
+                    f"got {n!r}"
+                )
+            if not isinstance(rec.get("shifted"), bool):
+                errors.append(
+                    f"line {i}: regime shifted must be a bool, got "
+                    f"{rec.get('shifted')!r}"
+                )
+        if rtype == "slo":
+            tenant = rec.get("tenant")
+            if not isinstance(tenant, str) or not tenant:
+                errors.append(
+                    f"line {i}: slo tenant must be a non-empty string, "
+                    f"got {tenant!r}"
+                )
+            slo_s = rec.get("slo_s")
+            if not isinstance(slo_s, (int, float)) or slo_s <= 0:
+                errors.append(
+                    f"line {i}: slo slo_s must be a positive number, "
+                    f"got {slo_s!r}"
+                )
+            burn = rec.get("burn_rate")
+            if not isinstance(burn, (int, float)) or burn < 0:
+                errors.append(
+                    f"line {i}: slo burn_rate must be a non-negative "
+                    f"number, got {burn!r}"
+                )
+            reqs = rec.get("window_requests")
+            breaches = rec.get("breaches")
+            if not isinstance(reqs, int) or reqs < 0:
+                errors.append(
+                    f"line {i}: slo window_requests must be a "
+                    f"non-negative int, got {reqs!r}"
+                )
+            elif (
+                not isinstance(breaches, int)
+                or not 0 <= breaches <= reqs
+            ):
+                errors.append(
+                    f"line {i}: slo breaches must be an int in "
+                    f"[0, window_requests], got {breaches!r}"
                 )
         if rtype == "io":
             kind = rec.get("kind")

@@ -166,6 +166,18 @@ class MetricsRegistry:
             name: m.export() for name, m in sorted(self._metrics.items())
         }
 
+    def export_typed(self) -> list:
+        """``[(name, kind, exported_value)]`` sorted by name, with the
+        metric SET read in one pass under the registry lock — the scrape
+        surface (obs/exporter.py) renders from this so a concurrently
+        registering run can never hand it a half-seen dict (each value
+        read stays individually consistent via the counters' own
+        locks)."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        kinds = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
+        return [(n, kinds[type(m)], m.export()) for n, m in items]
+
     def reset(self) -> None:
         """Zero every metric (tests; the names stay registered)."""
         for m in self._metrics.values():

@@ -20,13 +20,15 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
     "rounds": [...], "decode": [...], "cohort": cohort|None,
     "warnings": [...], "prefetch": [...],
     "dispatch_ahead": dispatch_ahead|None,
-    "stale_decode": stale_decode|None}. A trailing run_id=None entry
-    carries stray warnings, shard-store ``io`` records (out-of-core
-    byte accounting), any ``sweep_trajectory`` journal records (a sweep
-    journal is an events.jsonl like any other — `report` renders its
-    rows, diverged ones flagged), and the serve daemon's
-    request/pack/admit/evict stream (rendered as the per-tenant serving
-    section).
+    "stale_decode": stale_decode|None,
+    "critical_path": critical_path|None, "regime": [...]}. A trailing
+    run_id=None entry carries stray warnings, shard-store ``io`` records
+    (out-of-core byte accounting), any ``sweep_trajectory`` journal
+    records (a sweep journal is an events.jsonl like any other —
+    `report` renders its rows, diverged ones flagged), the serve
+    daemon's request/pack/admit/evict stream (rendered as the per-tenant
+    serving section), un-run-tagged ``regime`` snapshots, and the SLO
+    tracker's ``slo`` burn-rate records.
     Unparseable lines are skipped (the validator's job is strictness;
     the report renders what it can)."""
     runs: dict = {}
@@ -36,6 +38,8 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
     adapt: list = []
     membership: list = []
     io: list = []
+    regime: list = []
+    slo: list = []
     serve: dict = {
         "requests": [], "packs": [], "admits": [], "evicts": [],
         "rejects": [], "streams": [], "restarts": [],
@@ -48,6 +52,7 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
                 "uploads": [], "rounds": [], "decode": [], "cohort": None,
                 "warnings": [], "prefetch": [],
                 "dispatch_ahead": None, "stale_decode": None,
+                "critical_path": None, "regime": [],
             }
             order.append(rid)
         return runs[rid]
@@ -106,17 +111,24 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
                     run(rid)["dispatch_ahead"] = rec
                 elif rtype == "stale_decode":
                     run(rid)["stale_decode"] = rec
+                elif rtype == "critical_path":
+                    run(rid)["critical_path"] = rec
+                elif rtype == "regime":
+                    (run(rid)["regime"] if rid else regime).append(rec)
+                elif rtype == "slo":
+                    slo.append(rec)
                 elif rtype == "io":
                     io.append(rec)
     out = [runs[rid] for rid in order]
     if (
         warnings or trajectories or adapt or membership or io
-        or any(serve.values())
+        or regime or slo or any(serve.values())
     ):
         out.append({
             "run_id": None, "warnings": warnings,
             "trajectories": trajectories, "serve": serve,
             "adapt": adapt, "membership": membership, "io": io,
+            "regime": regime, "slo": slo,
         })
     return out
 
@@ -237,6 +249,66 @@ def _pipeline_section(groups: list) -> list[str]:
     return lines
 
 
+def _critical_path_section(groups: list) -> list[str]:
+    """The wall-clock attribution section: per run carrying a
+    ``critical_path`` record, both ledgers rendered by
+    obs/critical_path.render_lines (simulated-clock straggler
+    decomposition + host-wall decode/prefetch split)."""
+    from erasurehead_tpu.obs import critical_path as cpath_lib
+
+    attributed = [g for g in groups if g.get("critical_path")]
+    if not attributed:
+        return []
+    lines = ["\ncritical path (wall-clock attribution):"]
+    for g in attributed:
+        lines.append(f"  {str(g['run_id'])[:16]}:")
+        lines.extend(
+            "  " + ln for ln in cpath_lib.render_lines(g["critical_path"])
+        )
+    return lines
+
+
+def _regime_section(groups: list, stray: list) -> list[str]:
+    """The arrival-regime section: the estimator's emitted snapshots
+    (obs/regime.py) — change-points flagged, latest rate/kind last."""
+    recs = [r for g in groups for r in g.get("regime", [])]
+    recs += [r for g in stray for r in g.get("regime", [])]
+    if not recs:
+        return []
+    lines = ["\narrival regime (online estimate):"]
+    for r in recs:
+        flag = " <- SHIFT" if r.get("shifted") else ""
+        lines.append(
+            f"  round {r.get('round', '?'):>4} kind={r.get('kind', '?'):9s}"
+            f" rate {_fmt(r.get('rate'), '.3f')}/s"
+            f" tail {_fmt(r.get('tail_index'), '.2f')}"
+            f" (n={r.get('n', 0)}){flag}"
+        )
+    return lines
+
+
+def _slo_section(stray: list) -> list[str]:
+    """The SLO burn-rate section: per-tenant time-to-last-row objective
+    windows from the tracker's ``slo`` records (obs/exporter.py)."""
+    recs = [r for g in stray for r in g.get("slo", [])]
+    if not recs:
+        return []
+    latest: dict = {}
+    for r in recs:
+        latest[r.get("tenant")] = r
+    lines = ["\nslo burn rate (time-to-last-row):"]
+    for tenant in sorted(latest):
+        r = latest[tenant]
+        burn = float(r.get("burn_rate", 0.0))
+        flag = " <- BURNING" if burn > 1.0 else ""
+        lines.append(
+            f"  {str(tenant):12s} slo {_fmt(r.get('slo_s'), '.2f')}s: "
+            f"{r.get('breaches', 0)}/{r.get('window_requests', 0)} breached,"
+            f" burn {burn:.2f}x budget{flag}"
+        )
+    return lines
+
+
 def _prefetch_section(groups: list, stray: list) -> list[str]:
     """The out-of-core streaming section: per streamed run, how many
     partition windows moved how many host→device bytes and how much of
@@ -283,6 +355,12 @@ def _serve_section(stray: list) -> list[str]:
         for k in serve:
             serve[k].extend((g.get("serve") or {}).get(k, []))
         trajectories.extend(g.get("trajectories", []))
+    # completion markers (phase="done", server._finish) pair with intake
+    # records for the live SLO/goodput plane; request totals here count
+    # each request once, at intake
+    serve["requests"] = [
+        r for r in serve["requests"] if r.get("phase") != "done"
+    ]
     if not serve["requests"] and not serve["packs"] and not (
         serve["rejects"] or serve["restarts"]
     ):
@@ -444,9 +522,12 @@ def render(paths: Sequence[str]) -> str:
                 f"{c.get('n_trajectories', len(seeds))} trajectories in "
                 f"{disp} dispatch(es) [{c.get('lowering', '?')}]"
             )
+    lines.extend(_critical_path_section(groups))
     lines.extend(_pipeline_section(groups))
     lines.extend(_prefetch_section(groups, stray))
+    lines.extend(_regime_section(groups, stray))
     lines.extend(_serve_section(stray))
+    lines.extend(_slo_section(stray))
     lines.extend(_adapt_section(stray))
     lines.extend(_membership_section(stray))
     # serve rows (tenant-tagged) render in the serving section above; the

@@ -23,6 +23,13 @@ can never accept a config the in-process surface would refuse:
     connections die at the writer, not in the kernel.
   - ``GET /healthz`` — queue depth, in-flight dispatches, uptime; the
     load generator and restart harnesses poll it for readiness.
+  - ``GET /metrics`` — Prometheus text exposition (obs/exporter.py) of
+    the process metrics registry plus the front's live timeseries
+    gauges (obs/timeseries.py, attached in-process to the event
+    stream). Unauthenticated like /healthz: it is the scrape surface.
+  - ``GET /v1/stats`` — per-tenant JSON stats (requests, completed
+    rows, rejects, SLO burn rate) for the authenticated tenant (or
+    ``?tenant=`` with auth off).
 
 Auth is per-tenant bearer tokens (a JSON ``{token: tenant}`` map): the
 token *names* the tenant, so a client can only submit into — and stream
@@ -178,12 +185,32 @@ class HttpFront:
         port: int = 0,
         tokens: Optional[dict] = None,
         outbox_limit: int = DEFAULT_OUTBOX_LIMIT,
+        slo_ttlr_s: Optional[float] = None,
+        slo_budget: float = 0.1,
     ):
+        from erasurehead_tpu.obs import exporter as exporter_lib
+        from erasurehead_tpu.obs.timeseries import TimeseriesReducer
+
         self.server = server
         #: token -> tenant; None = auth off (trusted-localhost mode)
         self.tokens = dict(tokens) if tokens else None
         self.hub = StreamHub(outbox_limit)
         server.add_result_listener(self.hub.publish)
+        # the live plane: a timeseries reducer rides the in-process event
+        # stream (request/pack/admit/reject + any training capture) so
+        # GET /metrics answers from windowed state, no file tail needed
+        self.reducer = TimeseriesReducer()
+        self._reducer_detach = self.reducer.attach()
+        self.slo = (
+            exporter_lib.SloTracker(
+                slo_ttlr_s, budget=slo_budget
+            )
+            if slo_ttlr_s
+            else None
+        )
+        if self.slo is not None:
+            events_lib.add_observer(self.slo.observe)
+        self._exporter = exporter_lib
         self._started = time.monotonic()
         front = self
 
@@ -298,7 +325,25 @@ class HttpFront:
                         },
                     )
                     return
-                if path != "/v1/stream":
+                if path == "/metrics":
+                    # the scrape surface: SLO windows are re-scored on
+                    # scrape (emitting slo events the reducer folds in),
+                    # then the registry + live gauges render as one
+                    # deterministic text exposition
+                    if front.slo is not None:
+                        front.slo.evaluate()
+                    body = front._exporter.render_prometheus(
+                        _METRICS, front.reducer.gauges()
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", front._exporter.PROM_CONTENT_TYPE
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path not in ("/v1/stream", "/v1/stats"):
                     self._reply(404, {"type": "error",
                                       "message": f"no route {path}"})
                     return
@@ -316,9 +361,13 @@ class HttpFront:
                         self._reply(
                             400,
                             {"type": "error",
-                             "message": "stream wants ?tenant= (or auth)"},
+                             "message": f"{path[4:]} wants ?tenant= "
+                                        f"(or auth)"},
                         )
                         return
+                if path == "/v1/stats":
+                    self._reply(200, front.tenant_stats(tenant))
+                    return
                 self._stream(tenant)
 
             def _chunk(self, obj: dict) -> None:
@@ -377,8 +426,50 @@ class HttpFront:
         )
         self._thread.start()
 
+    def tenant_stats(self, tenant: str) -> dict:
+        """One tenant's live stats from the windowed reducer state: the
+        ``GET /v1/stats`` body. Sums the retained windows (bounded, so
+        this is a rolling horizon, not all-time) plus the latest SLO
+        window if the tracker is armed."""
+        snap = self.reducer.snapshot()
+        totals = {"requests": 0, "rows_ok": 0, "done": 0, "rejects": 0}
+        goodput = 0.0
+        for w in snap["windows"]:
+            tv = w["tenants"].get(tenant)
+            if tv:
+                for k in totals:
+                    totals[k] += tv.get(k, 0)
+        if snap["windows"]:
+            last = snap["windows"][-1]["tenants"].get(tenant)
+            if last:
+                goodput = last["rows_ok"] / self.reducer.window_s
+        out = {
+            "tenant": tenant,
+            "window_s": self.reducer.window_s,
+            "horizon_s": self.reducer.window_s * len(snap["windows"]),
+            **totals,
+            "goodput_rows_per_sec": round(goodput, 4),
+            "queued": self.server.queued_depth(),
+        }
+        slo_rec = (snap.get("slo") or {}).get(tenant)
+        if self.slo is not None:
+            rows = [r for r in self.slo.evaluate() if r["tenant"] == tenant]
+            slo_rec = rows[0] if rows else slo_rec
+        if slo_rec is not None:
+            out["slo"] = {
+                k: slo_rec[k]
+                for k in (
+                    "slo_s", "window_requests", "breaches", "burn_rate"
+                )
+                if k in slo_rec
+            }
+        return out
+
     def close(self) -> None:
         self._closing = True
+        self._reducer_detach.detach()
+        if self.slo is not None:
+            events_lib.remove_observer(self.slo.observe)
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)

@@ -531,6 +531,20 @@ class SweepServer:
                 if self._by_digest.get(digest) is handle:
                     del self._by_digest[digest]
         _METRICS.counter("serve.results").inc()
+        # completion marker (phase="done"): the live-telemetry plane's
+        # per-request terminus — the SLO tracker's time-to-last-row and
+        # the timeseries reducer's per-tenant goodput both pair this
+        # record with the intake "request" line (report counts only
+        # intake records, so request totals stay one-per-request)
+        events_lib.emit(
+            "request",
+            tenant=result.tenant,
+            request_id=result.request_id,
+            label=result.label,
+            phase="done",
+            status=result.status,
+            resumed=result.resumed,
+        )
         for fn in self._result_listeners:
             try:
                 fn(result)
@@ -1085,6 +1099,16 @@ def main(argv=None) -> int:
                    help="disable weighted-fair packing: windows fill "
                         "FIFO by arrival, letting one chatty tenant "
                         "monopolize dispatches (the pre-PR-13 behavior)")
+    p.add_argument("--slo-ttlr", type=float, default=None, metavar="SECONDS",
+                   help="arm the per-tenant SLO tracker on the http front "
+                        "(obs/exporter.SloTracker): requests whose "
+                        "time-to-last-row exceeds this emit burn-rate "
+                        "`slo` events and surface on /metrics; needs "
+                        "--http")
+    p.add_argument("--slo-budget", type=float, default=0.1,
+                   help="error budget for --slo-ttlr: tolerated breach "
+                        "fraction per window (burn rate 1.0 = breaching "
+                        "exactly this often; default 0.1)")
     ns = p.parse_args(argv)
     budget = resolve_serve_budget(ns.budget)
     max_cohort = resolve_serve_max_cohort(
@@ -1136,7 +1160,10 @@ def main(argv=None) -> int:
                 with open(ns.auth_tokens) as f:
                     tokens = json_lib.load(f)
             host, port = parse_hostport(ns.http)
-            http_front = HttpFront(srv, host=host, port=port, tokens=tokens)
+            http_front = HttpFront(
+                srv, host=host, port=port, tokens=tokens,
+                slo_ttlr_s=ns.slo_ttlr, slo_budget=ns.slo_budget,
+            )
         budget_str = f"{budget} bytes" if budget is not None else "unbounded"
         print(
             f"serve: listening on {ns.socket} (budget {budget_str}, "

@@ -1228,6 +1228,22 @@ def train(
                 pipeline_depth=int(cfg.pipeline_depth),
                 **pipeline_lib.overlap_summary(schedule),
             )
+        from erasurehead_tpu.obs import critical_path as obs_cpath
+
+        obs_cpath.emit_event(
+            run_id,
+            obs_cpath.attribute(
+                schedule.sim_time[start_round:],
+                schedule.worker_times[start_round:],
+                schedule.collected[start_round:],
+                wall_s=wall,
+                # resume is config-refused on the pipelined path, so the
+                # absolute dispatch/done clocks always start at round 0
+                dispatch=getattr(schedule, "dispatch", None),
+                done=getattr(schedule, "done", None),
+                transport="ring" if setup.ring else "none",
+            ),
+        )
     return TrainResult(
         params_history=history,
         final_params=final_state.params,
@@ -1847,6 +1863,21 @@ def _train_streamed(
             stack_bytes=window_nbytes,
             arrival=obs_events.arrival_summary(schedule.worker_times),
             **obs_decode.summarize(decode_err),
+        )
+        from erasurehead_tpu.obs import critical_path as obs_cpath
+
+        obs_cpath.emit_event(
+            run_id,
+            obs_cpath.attribute(
+                schedule.sim_time,
+                schedule.worker_times,
+                schedule.collected,
+                wall_s=wall,
+                # the streamed timed region includes staging waits; the
+                # prefetcher's blocked_s is exactly the un-hidden part
+                prefetch_stall_s=float(pf_stats.get("blocked_s", 0.0)),
+                transport="ring" if mode == "ring" else "none",
+            ),
         )
     return TrainResult(
         params_history=history,
@@ -2518,6 +2549,21 @@ def _train_cohort_impl(cfg, dataset, cfgs, mesh, arrivals, measure):
             ),
             **obs_decode.summarize(np.concatenate(batch_err)),
         )
+        from erasurehead_tpu.obs import critical_path as obs_cpath
+
+        # one attribution for the one dispatch: the cohort's B schedules
+        # concatenate along the round axis, so the sim ledger decomposes
+        # the summed simulated clock while wall_s stays the cohort wall
+        obs_cpath.emit_event(
+            run_id,
+            obs_cpath.attribute(
+                np.concatenate([s.sim_time for s in schedules]),
+                np.concatenate([s.worker_times for s in schedules]),
+                np.concatenate([s.collected for s in schedules]),
+                wall_s=wall,
+                transport="ring" if setup.ring else "none",
+            ),
+        )
     return results
 
 
@@ -2973,6 +3019,19 @@ def _train_cohort_streamed(
                 np.stack([s.worker_times for s in schedules])
             ),
             **obs_decode.summarize(np.concatenate(batch_err)),
+        )
+        from erasurehead_tpu.obs import critical_path as obs_cpath
+
+        obs_cpath.emit_event(
+            run_id,
+            obs_cpath.attribute(
+                np.concatenate([s.sim_time for s in schedules]),
+                np.concatenate([s.worker_times for s in schedules]),
+                np.concatenate([s.collected for s in schedules]),
+                wall_s=wall,
+                prefetch_stall_s=float(pf_stats.get("blocked_s", 0.0)),
+                transport="ring" if mode == "ring" else "none",
+            ),
         )
     return results
 

@@ -1,0 +1,17 @@
+"""telemetry-plane event-schema violations: a critical_path emit missing
+the required sim_components ledger, and a logger-object regime emit
+missing the shifted change-point flag — the live-telemetry record types
+(ISSUE 18) are lint-enforced like every other."""
+
+from erasurehead_tpu.obs import events as events_lib
+
+
+def emit_obs(logger):
+    events_lib.emit(
+        "critical_path", run_id="r", wall_s=1.0, sim_total_s=2.0,
+        components={"decode_update_s": 1.0, "prefetch_stall_s": 0.0},
+        fractions={"decode_update": 1.0},
+    )  # missing sim_components
+    logger.emit(
+        "regime", round=4, kind="exp", rate=2.0, n=24,
+    )  # missing shifted

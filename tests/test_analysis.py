@@ -170,6 +170,17 @@ def test_schema_pipeline_fixture():
     assert len(findings) == 2
 
 
+def test_schema_obs_fixture():
+    """The live-telemetry records (ISSUE 18: critical_path / regime /
+    slo) are lint-enforced like every other type: emits missing the
+    attribution ledger or the change-point flag are findings."""
+    findings = _unsup(_lint(_fx("schema_obs_bad.py")), "event-schema")
+    msgs = "\n".join(f.message for f in findings)
+    assert "sim_components" in msgs
+    assert "shifted" in msgs  # the logger-object emit is checked too
+    assert len(findings) == 2
+
+
 def test_schema_validator_drift_fixture():
     findings = _unsup(_lint(_fx("schema_drift_bad.py")), "event-schema")
     assert len(findings) == 1

@@ -24,10 +24,16 @@ well-formed worker-id lists, what-if engine `whatif` records
 (erasurehead_tpu/whatif/) carry a non-empty spec_hash and a known kind
 (grid/point/surface/rehydrate — obs/events.WHATIF_KINDS) with per-kind
 field checks (point records name their grid point and feasibility
-verdict, grid records carry non-negative point counts), and every
-run_start has a matching run_end. Sweep journals and
-serve event logs are events.jsonl files too — point this tool at
-DIR/sweep_journal.jsonl or the daemon's --events log to check them.
+verdict, grid records carry non-negative point counts), telemetry-plane
+records are internally consistent (`critical_path` ledgers reconcile to
+their measured totals within obs/events.CRITICAL_PATH_TOL with
+fractions in [0, 1], `regime` snapshots carry a known kind
+(exp/heavytail/unknown — obs/events.REGIME_KINDS) and non-negative
+rate/counts, `slo` burn-rate records name their tenant with
+breaches <= window_requests), and every run_start has a matching
+run_end. Sweep journals and serve event logs are events.jsonl files
+too — point this tool at DIR/sweep_journal.jsonl or the daemon's
+--events log to check them.
 
 Usage: python tools/validate_events.py events.jsonl [more.jsonl ...]
 Exit 0 = all files valid; 1 = errors (printed, one per line).
