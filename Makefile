@@ -45,7 +45,7 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 	telemetry-smoke sweep-batch-smoke chaos-smoke roofline-smoke \
 	serve-smoke serve-load-smoke serve-chaos-smoke adapt-smoke \
 	deep-smoke elastic-smoke whatif-smoke outofcore-smoke \
-	pipeline-smoke obs-smoke clean
+	pipeline-smoke obs-smoke tune-smoke clean
 
 naive:            ## uncoded wait-for-all baseline (src/naive.py)
 	$(RUN) --scheme naive
@@ -154,6 +154,9 @@ pipeline-smoke:   ## CPU sync vs tau=1 pipelined race at exp(2.0): pipelined tim
 
 obs-smoke:        ## CPU live-telemetry drive: critical-path ledgers close, reducer tails the log, regime shift detected in budget, /metrics exposition valid, bitwise dark rerun (tools/obs_smoke.py)
 	JAX_PLATFORMS=cpu $(PY) tools/obs_smoke.py
+
+tune-smoke:       ## CPU autotuning-plane drive: cold race -> byte-identical re-race, auto resolves from cache (<1ms, bitwise vs forced), chaos kill leaves no cache (tools/tune_smoke.py)
+	JAX_PLATFORMS=cpu $(PY) tools/tune_smoke.py
 
 sweep:            ## the full on-TPU measurement program (resumable, tagged)
 	bash tools/tpu_measurements.sh

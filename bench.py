@@ -1783,6 +1783,81 @@ def _obs_extra() -> dict:
     }
 
 
+def _tune_extra() -> dict:
+    """Autotuning-plane extra (erasurehead_tpu/tune/): the cost ledger of
+    the measured-decision ladder. Races the blockwise-cohort decode pair
+    (fused per-leaf contraction vs treewise pack-then-einsum, the
+    resolve_block_decode knob) cold into a fresh decision cache, then
+    times the warm cached resolution the training path actually pays
+    (bar: < 1 ms — resolution must be free, racing is the explicit
+    one-time spend). The two candidates are bitwise-identical
+    trajectories, so the race is purely about time; the recorded CPU
+    verdict lands beside the PR 9 blockwise row in BASELINE.md."""
+    import tempfile as _tempfile
+
+    from erasurehead_tpu import tune as tune_lib
+    from erasurehead_tpu.data.synthetic import generate_gmm
+    from erasurehead_tpu.train import trainer
+    from erasurehead_tpu.tune import races as tune_races
+    from erasurehead_tpu.utils.config import RunConfig
+
+    cache_path = os.path.join(
+        _tempfile.mkdtemp(prefix="eh-bench-tune-"), "decisions.json"
+    )
+    prev = os.environ.get(tune_lib.ENV_PATH)
+    os.environ[tune_lib.ENV_PATH] = cache_path
+    tune_lib.reset()
+    tune_lib.reset_emitted()
+    try:
+        cfg = RunConfig(
+            scheme="approx", model="deepmlp", n_workers=8,
+            n_stragglers=1, num_collect=6, rounds=8, n_rows=512,
+            n_cols=64, update_rule="AGD", lr_schedule=0.5,
+            add_delay=True, seed=0, layer_coding="on",
+        )
+        ds = generate_gmm(
+            cfg.n_rows, cfg.n_cols, n_partitions=cfg.n_workers, seed=0
+        )
+        t0 = time.perf_counter()
+        res = tune_races.race_block_decode(cfg, ds, reps=3)
+        race_wall = time.perf_counter() - t0
+        # the warm path: the dict lookup every later run resolves through
+        model, X = trainer.resolved_stack(cfg, ds)
+        sig = tune_lib.run_shape_signature(model, X)
+        reps = 50
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            tune_lib.lookup("block_decode", sig)
+        warm_s = (time.perf_counter() - t0) / reps
+        return {
+            "tune": {
+                "race": res.race,
+                "shape": res.shape,
+                "device_kind": res.device_kind,
+                "choice": res.choice,
+                "decisive": res.decisive,
+                "timings_ms": {
+                    k: round(v * 1e3, 3)
+                    for k, v in sorted(res.timings.items())
+                },
+                "fused_vs_treewise": round(
+                    res.timings["treewise"] / res.timings["fused"], 3
+                ),
+                "race_wall_s": round(race_wall, 3),
+                "warm_resolve_ms": round(warm_s * 1e3, 4),
+                # bar: warm resolution costs nothing a step would notice
+                "warm_resolve_ok": warm_s < 1e-3,
+            }
+        }
+    finally:
+        if prev is None:
+            os.environ.pop(tune_lib.ENV_PATH, None)
+        else:
+            os.environ[tune_lib.ENV_PATH] = prev
+        tune_lib.reset()
+        tune_lib.reset_emitted()
+
+
 def _jax_leaves(tree):
     import jax
 
@@ -2305,6 +2380,15 @@ def child() -> None:
     except Exception as e:  # noqa: BLE001 — extras must never kill bench
         print(f"bench: obs extra failed: {e}", file=sys.stderr)
 
+    # ---- tune extra: the autotuning plane's cost ledger — cold race vs
+    # the warm cached resolution every later run pays (bar < 1 ms), plus
+    # the re-raced blockwise fused-vs-treewise verdict at bench shape
+    tune_extra = {}
+    try:
+        tune_extra = _tune_extra()
+    except Exception as e:  # noqa: BLE001 — extras must never kill bench
+        print(f"bench: tune extra failed: {e}", file=sys.stderr)
+
     # ---- lint extra: the AST invariant analyzer rides the tier-1 loop -----
     # (erasurehead_tpu/analysis/), so its wall time is a budgeted quantity:
     # the full-tree run must stay under 5 s on CPU (lint_budget_ok)
@@ -2443,6 +2527,7 @@ def child() -> None:
                 **fidelity_extra,
                 **outofcore_extra,
                 **outofcore_composed_extra,
+                **tune_extra,
                 **lint_extra,
                 **telemetry_extra,
             }

@@ -54,6 +54,11 @@ class LintContext:
     signature_keys: frozenset
     schema: dict
     strict: bool = False
+    # autotune vocab (ISSUE 19): TUNE_RACES/TUNE_SOURCES from the same
+    # schema source, for the tune-emit membership + TUNE_CHOICES drift
+    # checks (empty tuples disable them — doctored test sources)
+    tune_races: tuple = ()
+    tune_sources: tuple = ()
 
     @classmethod
     def load(
@@ -69,11 +74,14 @@ class LintContext:
             with open(os.path.join(_PKG_ROOT, "obs", "events.py")) as f:
                 schema_source = f.read()
         fields, keys = signature.parse_config_info(config_source)
+        races, sources = schema.parse_tune_vocab(schema_source)
         return cls(
             config_fields=frozenset(fields),
             signature_keys=frozenset(keys),
             schema=schema.parse_schema(schema_source),
             strict=strict,
+            tune_races=races,
+            tune_sources=sources,
         )
 
 

@@ -28,6 +28,15 @@ Rules:
     must not carry an independent record-type table (any dict literal
     with 2+ SCHEMA-type string keys) — the whole point of the shared
     validator is that the two can never drift.
+  - **tune vocabulary** (ISSUE 19): an ``emit("tune", ...)`` site whose
+    ``race``/``source`` keyword is a string constant must name a member
+    of ``obs/events.TUNE_RACES``/``TUNE_SOURCES`` — the runtime
+    validator's membership check, moved to lint time. And any module
+    declaring the decision-plane's own vocabulary (a top-level
+    ``TUNE_CHOICES`` dict, i.e. erasurehead_tpu/tune/__init__.py) must
+    keep its keys equal to ``TUNE_RACES`` — a race added to the plane
+    but not the schema (or vice versa) is drift, not a runtime surprise
+    on the first resolved knob.
 """
 
 from __future__ import annotations
@@ -72,6 +81,47 @@ def parse_schema(source: str) -> dict:
     return {}
 
 
+def parse_tune_vocab(source: str) -> tuple:
+    """(TUNE_RACES, TUNE_SOURCES) string tuples from an obs/events.py-
+    shaped module, parsed without importing; empty tuples when absent."""
+    tree = ast.parse(source)
+    vocab = {"TUNE_RACES": (), "TUNE_SOURCES": ()}
+    for node in tree.body:
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id in vocab
+        ):
+            continue
+        vocab[node.targets[0].id] = tuple(
+            e.value
+            for e in getattr(node.value, "elts", [])
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return vocab["TUNE_RACES"], vocab["TUNE_SOURCES"]
+
+
+def _parse_tune_choices_keys(mod: SourceModule):
+    """Keys of a top-level ``TUNE_CHOICES`` dict literal (the autotune
+    plane's own race vocabulary), or None when the module has none."""
+    for node in mod.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "TUNE_CHOICES"
+            and isinstance(node.value, ast.Dict)
+        ):
+            keys = tuple(
+                k.value
+                for k in node.value.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            )
+            return node, keys
+    return None
+
+
 def _module_defines_validator(mod: SourceModule) -> bool:
     return "validate_lines" in mod.module_scope.functions
 
@@ -90,7 +140,9 @@ def _emit_type(call: ast.Call):
     return None
 
 
-def _check_emit_sites(mod: SourceModule, schema: dict, findings: list):
+def _check_emit_sites(
+    mod: SourceModule, schema: dict, findings: list, tune_vocab=((), ())
+):
     for node in ast.walk(mod.tree):
         if not isinstance(node, ast.Call):
             continue
@@ -137,6 +189,61 @@ def _check_emit_sites(mod: SourceModule, schema: dict, findings: list):
                     f"{missing}; SCHEMA declares {list(schema[etype])}",
                 )
             )
+        if etype == "tune":
+            _check_tune_emit(mod, node, tune_vocab, findings)
+
+
+def _check_tune_emit(
+    mod: SourceModule, node: ast.Call, tune_vocab, findings: list
+):
+    """Constant ``race``/``source`` kwargs on a tune emit must be members
+    of TUNE_RACES/TUNE_SOURCES — the validator's membership check at
+    lint time (dynamic values stay runtime-validated)."""
+    races, sources = tune_vocab
+    for kw in node.keywords:
+        if kw.arg not in ("race", "source") or not (
+            isinstance(kw.value, ast.Constant)
+            and isinstance(kw.value.value, str)
+        ):
+            continue
+        vocab, table = (
+            (races, "TUNE_RACES") if kw.arg == "race"
+            else (sources, "TUNE_SOURCES")
+        )
+        if vocab and kw.value.value not in vocab:
+            findings.append(
+                Finding(
+                    CHECKER, mod.path, kw.value.lineno,
+                    kw.value.col_offset,
+                    f"emit('tune') {kw.arg}={kw.value.value!r} is not in "
+                    f"obs/events.{table} {list(vocab)} — extend the "
+                    "vocabulary before emitting it",
+                )
+            )
+
+
+def _check_tune_choices_drift(
+    mod: SourceModule, tune_vocab, findings: list
+):
+    """A module declaring the autotune plane's TUNE_CHOICES must keep its
+    keys equal to obs/events.TUNE_RACES — the two vocabulary surfaces
+    (decision plane and event schema) may never drift."""
+    races, _ = tune_vocab
+    if not races:
+        return
+    parsed = _parse_tune_choices_keys(mod)
+    if parsed is None:
+        return
+    node, keys = parsed
+    if set(keys) != set(races):
+        findings.append(
+            Finding(
+                CHECKER, mod.path, node.lineno, node.col_offset,
+                f"TUNE_CHOICES races {sorted(keys)} != obs/events."
+                f"TUNE_RACES {sorted(races)} — the decision plane and "
+                "the event schema declare different race vocabularies",
+            )
+        )
 
 
 def _check_validator_drift(mod: SourceModule, findings: list):
@@ -219,9 +326,15 @@ def check(mod: SourceModule, context) -> list:
     findings: list = []
     own_schema = parse_schema(mod.source)
     schema = own_schema or context.schema
+    tune_vocab = (
+        parse_tune_vocab(mod.source)
+        if own_schema
+        else (context.tune_races, context.tune_sources)
+    )
     if schema:
-        _check_emit_sites(mod, schema, findings)
+        _check_emit_sites(mod, schema, findings, tune_vocab)
     _check_validator_drift(mod, findings)
+    _check_tune_choices_drift(mod, tune_vocab, findings)
     if context.schema:
         _check_cli_wrapper(mod, context.schema, findings)
     return findings

@@ -63,6 +63,16 @@ daemon's admission-time ETA quotes::
        erasurehead-tpu whatif --policies naive,cyccoded,approx \\
            --workers 8 --stragglers 1,3 --regimes exp:0.1,exp:2.0 \\
            --seeds 16 --out surfaces/small --crossover approx,cyccoded
+
+An eighth runs the measured autotuning plane (erasurehead_tpu/tune/):
+races auto-gated lowering pairs (block_decode, layer_coding, glm_fused,
+ring_pipeline, stack_mode) at a run shape and persists the verdicts to
+the JSON decision cache every ``auto`` knob resolves through — the
+explicit moment measurement happens, so training and serving never
+re-race::
+
+       erasurehead-tpu tune --race block_decode --race glm_fused \\
+           --model deepmlp --workers 8 --rows 4096 --cols 256
 """
 
 from __future__ import annotations
@@ -273,12 +283,12 @@ def _flags_parser() -> argparse.ArgumentParser:
                         "stacks are never donated. auto = on")
     p.add_argument("--use-pallas", default="auto", choices=["auto", "on", "off"],
                    help="fused pallas gradient kernel (ops/kernels.py). "
-                        "A correctness/reference path, NOT a performance "
-                        "option: the end-to-end races measured it VPU-"
-                        "bound and XLA's own lowering won all three "
-                        "(supports_fused is pinned off everywhere; 'on' "
-                        "forces it anyway, and excludes the batched "
-                        "trajectory-cohort dispatch)")
+                        "The shipped end-to-end races measured it VPU-"
+                        "bound (XLA won all three on v5e), so auto "
+                        "declines unless a cached `erasurehead-tpu tune "
+                        "--race glm_fused` verdict at this run's shape "
+                        "says pallas wins; 'on' forces it anyway, and "
+                        "excludes the batched trajectory-cohort dispatch)")
     p.add_argument("--dtype", default="float32",
                    choices=["float32", "bfloat16"],
                    help="DATA dtype (params/updates stay float32)")
@@ -337,6 +347,18 @@ def _flags_parser() -> argparse.ArgumentParser:
                         "own small einsum (DeepMLP layers / MoE expert "
                         "shards are individual coded blocks); bitwise-"
                         "identical decode, a pure lowering knob")
+    p.add_argument("--block-decode", default="auto",
+                   choices=["auto", "fused", "treewise"],
+                   help="blockwise-decode lowering under --layer-coding: "
+                        "'treewise' packs per-layer grad tables then "
+                        "einsum-decodes; 'fused' contracts each gradient "
+                        "leaf directly against the decode weights "
+                        "(ops/kernels.fused_block_decode) with no "
+                        "materialized per-partition table. Bitwise-"
+                        "identical decode; auto resolves through the "
+                        "tune decision cache (erasurehead-tpu tune) "
+                        "then the hardcoded default. "
+                        "ERASUREHEAD_BLOCK_DECODE overrides")
     p.add_argument("--deep-layers", type=int, default=0,
                    help="hidden-layer count for model='deepmlp' (0 = the "
                         "model default); the decode-error-vs-depth sweep "
@@ -453,6 +475,7 @@ def _flags_to_config(ns: argparse.Namespace) -> RunConfig:
         dense_margin_cols=ns.dense_margin_cols,
         flat_grad=ns.flat_grad,
         layer_coding=ns.layer_coding,
+        block_decode=ns.block_decode,
         deep_layers=ns.deep_layers,
         arrival_trace=ns.arrival_trace,
         scan_unroll=ns.scan_unroll,
@@ -905,6 +928,16 @@ def main(argv: list[str] | None = None) -> int:
         from erasurehead_tpu.obs import exporter as exporter_lib
 
         return exporter_lib.top_main(argv[1:])
+    if argv and argv[0] == "tune":
+        # `erasurehead-tpu tune [--race ...] ...` — the measured
+        # autotuning plane (erasurehead_tpu/tune/): races auto-gated
+        # lowering pairs at a given run shape and persists the verdicts
+        # to the JSON decision cache every `auto` knob resolves through.
+        # Races run HERE (or in bench/smoke), never inside training
+        # steps or serve dispatches.
+        from erasurehead_tpu.tune import races as tune_races_lib
+
+        return tune_races_lib.main(argv[1:])
     if argv and argv[0] == "lint":
         # `erasurehead-tpu lint [--strict] [paths]` — the AST invariant
         # analyzer (erasurehead_tpu/analysis/): trace-purity,

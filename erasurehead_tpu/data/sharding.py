@@ -436,9 +436,14 @@ def resolve_ring_stack(
     "ring" forces (divisibility is validated by plan_ring_transport at use
     time); "materialized" keeps the reference's redundancy as real HBM;
     "auto" picks ring only when the redundant stack is actually redundant
-    (storage_overhead > 1), folds onto this mesh, and its footprint
-    estimate crosses RING_AUTO_MIN_BYTES. ``supported=False`` (a trainer
-    path with no ring body, e.g. measured mode) pins auto to materialized.
+    (storage_overhead > 1), folds onto this mesh, and — footprint verdict
+    — either a cached ``stack_mode`` tune-race decision says "ring" at
+    this pre-stack shape or, absent a measured verdict, the footprint
+    estimate crosses RING_AUTO_MIN_BYTES. The tune consult replaces ONLY
+    the threshold heuristic: the structural gates (redundancy,
+    divisibility, support) are correctness-shaped and no measurement
+    overrides them. ``supported=False`` (a trainer path with no ring
+    body, e.g. measured mode) pins auto to materialized.
     """
     if stack_mode == "ring":
         return True
@@ -449,7 +454,23 @@ def resolve_ring_stack(
     W, P, D = layout.n_workers, layout.n_partitions, int(n_devices)
     if W % D or P % D:
         return False
-    return estimate_worker_stack_bytes(dataset, layout, dtype) >= RING_AUTO_MIN_BYTES
+    from erasurehead_tpu import tune as tune_lib
+
+    rows = dataset.n_samples // layout.n_partitions
+    sig = tune_lib.stack_mode_signature(
+        layout, rows, dataset.X_train.shape[1], np.dtype(dtype).name
+    )
+    by_footprint = (
+        estimate_worker_stack_bytes(dataset, layout, dtype)
+        >= RING_AUTO_MIN_BYTES
+    )
+    choice = tune_lib.lookup(
+        "stack_mode", sig,
+        fallback="ring" if by_footprint else "materialized",
+    )
+    if choice is not None:
+        return choice == "ring"
+    return by_footprint
 
 
 def np_global(x, dtype=None):

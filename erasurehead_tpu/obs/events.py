@@ -190,6 +190,15 @@ SCHEMA: dict[str, tuple] = {
     # error budget — > 1 means the budget is burning faster than allowed)
     "slo": ("tenant", "slo_s", "window_requests", "breaches",
             "burn_rate"),
+    # one per autotune-decision resolution (erasurehead_tpu/tune/):
+    # which race's verdict resolved an auto knob, at which shape
+    # signature on which device kind, and where the choice came from
+    # ("race" = a racer run just measured it, "cache" = the persisted
+    # decision cache, "default" = no cached decision — the hardcoded
+    # fallback stood). Observation-only and process-deduped: resolution
+    # reads the cache, never the event stream, so telemetry on/off
+    # cannot change a single lowering choice
+    "tune": ("race", "device_kind", "shape", "choice", "source"),
 }
 
 #: adapt decision reasons (adapt/controller.AdaptiveController.choose)
@@ -237,6 +246,17 @@ IO_KINDS = ("shard_read", "store_write")
 #: rows are quarantined, not retried — divergence is deterministic under
 #: the journaled (config, data, arrivals) key
 TRAJECTORY_STATUSES = ("ok", "diverged")
+
+#: autotune races (erasurehead_tpu/tune/__init__.TUNE_CHOICES keys):
+#: every "tune" event's ``race`` field must name one of these knob pairs
+TUNE_RACES = (
+    "block_decode", "glm_fused", "layer_coding", "ring_pipeline",
+    "stack_mode",
+)
+
+#: where a tune decision came from: a just-run race, the persisted
+#: decision cache, or the hardcoded fallback (no cached verdict)
+TUNE_SOURCES = ("race", "cache", "default")
 
 #: rounds-style chunk size: small runs get one chunk, long runs stay O(R/100)
 ROUND_CHUNK = 100
@@ -600,7 +620,9 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
     known ``plan_mode`` (:data:`STREAM_PLAN_MODES`) and non-negative
     ``halo`` / ``group_workers`` ints);
     ``io`` records carry a known kind (:data:`IO_KINDS`) and a
-    non-negative byte count; ``dispatch_ahead`` records carry a positive
+    non-negative byte count; ``tune`` records carry a known race
+    (:data:`TUNE_RACES`), a known source (:data:`TUNE_SOURCES`) and
+    non-empty device_kind/shape/choice strings; ``dispatch_ahead`` records carry a positive
     pipeline depth and non-negative overlap seconds; ``stale_decode``
     records carry non-negative error norms and a staleness share in
     [0, 1]; every ``run_start`` has a matching later ``run_end``."""
@@ -1069,6 +1091,26 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
                     f"line {i}: slo breaches must be an int in "
                     f"[0, window_requests], got {breaches!r}"
                 )
+        if rtype == "tune":
+            race = rec.get("race")
+            if race not in TUNE_RACES:
+                errors.append(
+                    f"line {i}: tune race must be one of {TUNE_RACES}, "
+                    f"got {race!r}"
+                )
+            source = rec.get("source")
+            if source not in TUNE_SOURCES:
+                errors.append(
+                    f"line {i}: tune source must be one of "
+                    f"{TUNE_SOURCES}, got {source!r}"
+                )
+            for field in ("device_kind", "shape", "choice"):
+                v = rec.get(field)
+                if not isinstance(v, str) or not v:
+                    errors.append(
+                        f"line {i}: tune {field} must be a non-empty "
+                        f"string, got {v!r}"
+                    )
         if rtype == "io":
             kind = rec.get("kind")
             if kind not in IO_KINDS:

@@ -27,8 +27,9 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
     records (a sweep journal is an events.jsonl like any other —
     `report` renders its rows, diverged ones flagged), the serve
     daemon's request/pack/admit/evict stream (rendered as the per-tenant
-    serving section), un-run-tagged ``regime`` snapshots, and the SLO
-    tracker's ``slo`` burn-rate records.
+    serving section), un-run-tagged ``regime`` snapshots, the SLO
+    tracker's ``slo`` burn-rate records, and the autotune plane's
+    ``tune`` decision records (rendered as the tuned-defaults section).
     Unparseable lines are skipped (the validator's job is strictness;
     the report renders what it can)."""
     runs: dict = {}
@@ -40,6 +41,7 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
     io: list = []
     regime: list = []
     slo: list = []
+    tune: list = []
     serve: dict = {
         "requests": [], "packs": [], "admits": [], "evicts": [],
         "rejects": [], "streams": [], "restarts": [],
@@ -119,16 +121,18 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
                     slo.append(rec)
                 elif rtype == "io":
                     io.append(rec)
+                elif rtype == "tune":
+                    tune.append(rec)
     out = [runs[rid] for rid in order]
     if (
         warnings or trajectories or adapt or membership or io
-        or regime or slo or any(serve.values())
+        or regime or slo or tune or any(serve.values())
     ):
         out.append({
             "run_id": None, "warnings": warnings,
             "trajectories": trajectories, "serve": serve,
             "adapt": adapt, "membership": membership, "io": io,
-            "regime": regime, "slo": slo,
+            "regime": regime, "slo": slo, "tune": tune,
         })
     return out
 
@@ -305,6 +309,37 @@ def _slo_section(stray: list) -> list[str]:
             f"  {str(tenant):12s} slo {_fmt(r.get('slo_s'), '.2f')}s: "
             f"{r.get('breaches', 0)}/{r.get('window_requests', 0)} breached,"
             f" burn {burn:.2f}x budget{flag}"
+        )
+    return lines
+
+
+def _tune_section(stray: list) -> list[str]:
+    """The autotuned-defaults section: one line per distinct auto-knob
+    resolution from the ``tune`` records — which race, on which device
+    kind at which shape, what it chose and where the choice came from
+    (a just-run race, the persisted decision cache, or the hardcoded
+    fallback). The section that answers "which measured verdicts did
+    this run actually lower under?"."""
+    recs = [r for g in stray for r in g.get("tune", [])]
+    if not recs:
+        return []
+    latest: dict = {}
+    for r in recs:
+        latest[(r.get("race"), r.get("device_kind"), r.get("shape"))] = r
+    n_measured = sum(
+        1 for r in latest.values() if r.get("source") in ("race", "cache")
+    )
+    lines = [
+        f"\nautotuned defaults: {len(latest)} resolution(s), "
+        f"{n_measured} from measured verdicts"
+    ]
+    for key in sorted(latest, key=lambda k: tuple(str(x) for x in k)):
+        r = latest[key]
+        lines.append(
+            f"  {str(r.get('race', '?')):13s} -> "
+            f"{str(r.get('choice', '?')):12s} "
+            f"[{r.get('source', '?')}]  {r.get('device_kind', '?')}  "
+            f"{r.get('shape', '?')}"
         )
     return lines
 
@@ -528,6 +563,7 @@ def render(paths: Sequence[str]) -> str:
     lines.extend(_regime_section(groups, stray))
     lines.extend(_serve_section(stray))
     lines.extend(_slo_section(stray))
+    lines.extend(_tune_section(stray))
     lines.extend(_adapt_section(stray))
     lines.extend(_membership_section(stray))
     # serve rows (tenant-tagged) render in the serving section above; the

@@ -145,9 +145,31 @@ def reference_glm_grad(beta, X, y, w, kind: str = "logistic"):
     )
 
 
-def supports_fused(X, model_name: str, platform: str) -> bool:
-    """Auto-gate for the fused kernel. Returns False: XLA won the race.
+class FusedSupport:
+    """Verdict of the fused-kernel auto-gate, with the refusal reason.
 
+    Truthiness is the verdict (so ``if supports_fused(...)`` keeps
+    working); ``reason`` says why — surfaced as a one-time ``warning``
+    event by the trainer so ``use_pallas="auto"`` never declines silently.
+    """
+
+    __slots__ = ("ok", "reason")
+
+    def __init__(self, ok: bool, reason: str):
+        self.ok = ok
+        self.reason = reason
+
+    def __bool__(self) -> bool:
+        return self.ok
+
+    def __repr__(self) -> str:
+        return f"FusedSupport(ok={self.ok}, reason={self.reason!r})"
+
+
+def supports_fused(X, model_name: str, platform: str) -> "FusedSupport":
+    """Auto-gate for the fused GLM kernel.
+
+    The hardcoded fallback is *decline*: XLA won the original race.
     Measured on v5e at the bench shape ([90, 4400, 128] slot stack, timed
     inside one dispatch, tools/kernel_race.py):
       - MXU-dot variant:   2.7 ms  vs XLA 2.05 ms  (r1, slower — bf16
@@ -161,10 +183,135 @@ def supports_fused(X, model_name: str, platform: str) -> bool:
     XLA's two-pass lowering overlaps the margin and transpose matvecs well
     enough that the single-streaming-pass VPU kernel cannot beat it — the
     VPU multiply-reduce is the bottleneck, not HBM, so halving HBM bytes
-    (bf16) widens XLA's lead rather than closing it. CLOSED as a measured
-    negative result (three independent races across three shapes/dtypes):
-    the kernel stays as the pallas reference pattern and correctness
-    alternative only — use_pallas="on" is NOT a performance option. Tests
-    pin it to the XLA oracle in interpret mode.
+    (bf16) widens XLA's lead rather than closing it. Those three races are
+    a measured negative at *those* shapes; since ISSUE 19 the verdict is
+    re-raceable per shape through the tune decision cache
+    (erasurehead_tpu/tune/): a cached ``glm_fused`` win at this run's
+    shape flips the gate through data, not a code edit. Absent a cached
+    win, the hardcoded decline stands and use_pallas="on" remains the
+    correctness alternative, not a performance option. Tests pin the
+    kernel to the XLA oracle in interpret mode either way.
     """
-    return False
+    if model_name not in GLM_KINDS:
+        return FusedSupport(
+            False,
+            f"use_pallas auto declined: model {model_name!r} is not a "
+            f"dense GLM (fused kernel covers {GLM_KINDS})",
+        )
+    if not (isinstance(X, jax.Array) and X.ndim in (3, 4)):
+        return FusedSupport(
+            False,
+            "use_pallas auto declined: feature stack is not a dense "
+            "slot-major array (sparse/padded/compressed layouts have no "
+            "fused lowering)",
+        )
+    if platform != "tpu":
+        return FusedSupport(
+            False,
+            f"use_pallas auto declined: platform {platform!r} has no "
+            "Mosaic backend (interpret mode is a correctness path, not a "
+            "fast one)",
+        )
+    from erasurehead_tpu import tune as tune_lib
+
+    sig = tune_lib.glm_fused_signature(X.shape, str(X.dtype), model_name)
+    choice = tune_lib.lookup("glm_fused", sig, fallback="xla")
+    if choice == "pallas":
+        return FusedSupport(
+            True,
+            f"use_pallas auto accepted: cached glm_fused race win at "
+            f"shape {sig}",
+        )
+    return FusedSupport(
+        False,
+        "use_pallas auto declined: XLA won the glm_fused race (v5e, "
+        "three shapes — kernels.supports_fused docstring) and no cached "
+        "tune decision at this shape overrides it",
+    )
+
+
+# --------------------------------------------------------------------------
+# fused blockwise decode (ISSUE 19): the layer-coding decode contraction
+# without the materialized per-partition grad table
+
+# lane-dim column block for the decode kernel; multiples of 128 keep the
+# Mosaic tiling natural for every dtype
+_DECODE_BLOCK_COLS = 2048
+
+
+def choose_block_cols(n_slots: int, n_cols: int, lane: int = 128) -> int:
+    """Largest multiple-of-``lane`` column block within the VMEM budget."""
+    by_vmem = _X_BLOCK_BYTES // max(1, 4 * n_slots)
+    cap = min(
+        _DECODE_BLOCK_COLS, max(lane, by_vmem // lane * lane)
+    )
+    padded = -(-n_cols // lane) * lane
+    return min(cap, padded)
+
+
+def _decode_kernel(w_ref, g_ref, o_ref):
+    """One column block: o[0, :] = w[1, M] · g[M, BC].
+
+    A dot_general (not a VPU multiply-reduce) on purpose: the MXU dot at
+    precision=HIGHEST reduces in the same order as the einsum decode it
+    replaces, which is what makes the tier-1 bitwise pin possible — the
+    elementwise multiply+sum form reduces in a different order and drifts
+    in the last ulp (measured on CPU, ISSUE 19).
+    """
+    o_ref[...] = lax.dot_general(
+        w_ref[...], g_ref[...], (((1,), (0,)), ((), ())),
+        precision=lax.Precision.HIGHEST,
+        preferred_element_type=o_ref.dtype,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("use_pallas", "interpret", "block_cols")
+)
+def fused_block_decode(
+    w: jnp.ndarray,  # [M] decode weight per slot, einsum reduction order
+    g: jnp.ndarray,  # [M, D] per-slot flattened leaf gradients
+    *,
+    use_pallas: bool = False,
+    interpret: bool = False,
+    block_cols: int | None = None,
+) -> jnp.ndarray:
+    """Decoded leaf gradient: contraction of per-slot gradients over slots.
+
+    This is the per-leaf half of the blockwise decode
+    (parallel/step._layer_block_local_body) with the per-partition grad
+    *table* fused away: instead of packing every slot's gradient pytree
+    into a zero-padded [M, L, width] table and einsum-decoding it
+    treewise, each leaf's [M, D_leaf] slot view contracts directly —
+    no padding columns are ever materialized or streamed. ``w`` must
+    already be flattened in the einsum reduction order of the contract it
+    replaces (s-major for the faithful "ws" contract — see
+    parallel/step._fused_layer_block_local_body).
+
+    ``use_pallas=False`` lowers the same contraction through one XLA
+    dot_general (the fast CPU path); ``use_pallas=True`` runs the Mosaic
+    kernel (``interpret=True`` for the CPU parity pin). All three are
+    bitwise-identical at precision=HIGHEST — pinned by tier-1.
+    """
+    M, D = g.shape
+    w = w.astype(g.dtype)
+    if not use_pallas:
+        return lax.dot_general(
+            w, g, (((0,), (0,)), ((), ())),
+            precision=lax.Precision.HIGHEST,
+        )
+    BC = block_cols or choose_block_cols(M, D)
+    Dp = -(-D // BC) * BC
+    gp = jnp.pad(g, ((0, 0), (0, Dp - D))) if Dp != D else g
+    out = pl.pallas_call(
+        _decode_kernel,
+        grid=(Dp // BC,),
+        in_specs=[
+            pl.BlockSpec((1, M), lambda c: (0, 0)),  # w
+            pl.BlockSpec((M, BC), lambda c: (0, c)),  # g columns
+        ],
+        out_specs=pl.BlockSpec((1, BC), lambda c: (0, c)),
+        out_shape=jax.ShapeDtypeStruct((1, Dp), g.dtype),
+        interpret=interpret,
+    )(w.reshape(1, M), gp)
+    return out[0, :D]

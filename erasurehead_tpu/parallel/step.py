@@ -25,6 +25,7 @@ honestly reported as such (BASELINE.md).
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable
 
 import jax
@@ -370,16 +371,30 @@ def _ring_fill(plan, Xp, yp, pipeline: bool = False):
 RING_PIPELINE_DEFAULT = False
 
 
-def resolve_ring_pipeline(ring_pipeline: str) -> bool:
+def resolve_ring_pipeline(ring_pipeline: str, model=None, X=None) -> bool:
     """Should a ring-transport run use the double-buffered schedule?
-    "on"/"off" force; "auto" defers to :data:`RING_PIPELINE_DEFAULT`
-    (measurement-pinned module state, keyed into the executable cache via
-    the trainer's resolved ring signature so a default flip can never
-    serve a stale program)."""
+    "on"/"off" force; "auto" resolves cached tune decision -> hardcoded
+    fallback: a ``ring_pipeline`` race verdict in the tune decision cache
+    (erasurehead_tpu/tune/) at this run's shape wins, else
+    :data:`RING_PIPELINE_DEFAULT` (measurement-pinned module state). The
+    resolution is keyed into the executable cache via the trainer's
+    resolved ring signature so neither a default flip nor a cache update
+    can ever serve a stale program. ``model``/``X`` give the consult its
+    shape signature; without them the resolver is the bare constant (the
+    pre-tune behavior)."""
     if ring_pipeline == "on":
         return True
     if ring_pipeline == "off":
         return False
+    if model is not None and X is not None:
+        from erasurehead_tpu import tune as tune_lib
+
+        choice = tune_lib.lookup(
+            "ring_pipeline", tune_lib.run_shape_signature(model, X),
+            fallback="pipelined" if RING_PIPELINE_DEFAULT else "sequential",
+        )
+        if choice is not None:
+            return choice == "pipelined"
     return RING_PIPELINE_DEFAULT
 
 
@@ -473,16 +488,70 @@ def supports_layer_coding(model) -> bool:
     return True
 
 
-def resolve_layer_coding(layer_coding: str, model) -> bool:
+def resolve_layer_coding(layer_coding: str, model, X=None) -> bool:
     """Should this run decode per layer block? ("on" validity is the
-    caller's concern — this resolves the choice, it does not raise.)"""
+    caller's concern — this resolves the choice, it does not raise.)
+    "auto" resolves cached tune decision -> hardcoded fallback: a
+    ``layer_coding`` race verdict at this run's shape (erasurehead_tpu/
+    tune/) wins over :data:`LAYER_CODING_DEFAULT`; ``X`` gives the
+    consult its shape signature."""
     if not supports_layer_coding(model):
         return False
     if layer_coding == "on":
         return True
     if layer_coding == "off":
         return False
+    if X is not None:
+        from erasurehead_tpu import tune as tune_lib
+
+        choice = tune_lib.lookup(
+            "layer_coding", tune_lib.run_shape_signature(model, X),
+            fallback="blockwise" if LAYER_CODING_DEFAULT else "treewise",
+        )
+        if choice is not None:
+            return choice == "blockwise"
     return LAYER_CODING_DEFAULT
+
+
+# Whether the blockwise decode's "auto" lowering takes the FUSED per-leaf
+# contraction (ops/kernels.fused_block_decode — no materialized
+# [M, L, width] grad table) or the original treewise pack-then-einsum
+# body. False pending its races: the CPU verdict lands in the tune
+# decision cache via `make tune-smoke`/bench, the TPU verdict via the
+# fused_decode tags in tools/tpu_measurements*.sh — defaults flip through
+# data, not code edits (the FLAT_GRAD_DEFAULT rule).
+BLOCK_DECODE_FUSED_DEFAULT = False
+
+
+def resolve_block_decode(block_decode: str, model=None, X=None) -> bool:
+    """Should a blockwise (layer-coding) run decode through the fused
+    per-leaf kernel instead of the treewise table einsum?
+
+    Resolution order (explicit > env > measured > hardcoded):
+      1. ``block_decode`` = "fused"/"treewise" forces;
+      2. ``ERASUREHEAD_BLOCK_DECODE`` env forces (operator escape hatch);
+      3. a cached ``block_decode`` tune race verdict at this run's shape;
+      4. :data:`BLOCK_DECODE_FUSED_DEFAULT`.
+    Both paths are bitwise-identical (tests/test_deep_coding.py pins
+    them), so this is a pure lowering choice — but it IS keyed into
+    lowering_signature so executable caches fork on it."""
+    if block_decode == "fused":
+        return True
+    if block_decode == "treewise":
+        return False
+    env = os.environ.get("ERASUREHEAD_BLOCK_DECODE", "")
+    if env in ("fused", "treewise"):
+        return env == "fused"
+    if model is not None and X is not None:
+        from erasurehead_tpu import tune as tune_lib
+
+        choice = tune_lib.lookup(
+            "block_decode", tune_lib.run_shape_signature(model, X),
+            fallback="fused" if BLOCK_DECODE_FUSED_DEFAULT else "treewise",
+        )
+        if choice is not None:
+            return choice == "fused"
+    return BLOCK_DECODE_FUSED_DEFAULT
 
 
 def _layer_block_local_body(model, spec, contract: str) -> GradFn:
@@ -525,20 +594,99 @@ def _layer_block_local_body(model, spec, contract: str) -> GradFn:
     return local
 
 
+def _fused_layer_block_local_body(
+    model, spec, contract: str, *,
+    use_pallas: bool = False, interpret: bool = False,
+) -> GradFn:
+    """Fused variant of :func:`_layer_block_local_body`: the per-partition
+    grad TABLE is never materialized.
+
+    The treewise body packs every slot's gradient pytree into a
+    zero-padded ``[M, L, width]`` block table (one fp copy of the whole
+    gradient per slot, plus padding lanes) and einsum-decodes it. This
+    body contracts each leaf's ``[M, D_leaf]`` slot view directly through
+    :func:`ops.kernels.fused_block_decode` — same scalars, same reduction
+    order, zero padding bytes streamed. Bitwise-identity notes:
+
+      - the faithful "ws" contract's einsum lowers with contracting dims
+        ``(s, w)`` — the flattened slot axis is S-MAJOR. Both the weights
+        and each leaf are flattened in that order here (``ws.T``,
+        ``moveaxis(leaf, 1, 0)``); a plain w-major ravel drifts in the
+        last ulp (measured, ISSUE 19);
+      - leaves are cast to the table dtype first (``jnp.concatenate``
+        promotion in tree_to_blocks), so mixed-dtype pytrees decode in
+        the same precision either way;
+      - the per-leaf psum moves exactly the values the table psum moved,
+        minus the padding lanes.
+    ``use_pallas``/``interpret`` select the Mosaic kernel / its interpret
+    mode inside fused_block_decode; the default lowers through one XLA
+    dot_general per leaf (the fast CPU form — all three are bitwise-equal
+    at precision=HIGHEST, tests/test_deep_coding.py)."""
+    from erasurehead_tpu.ops import kernels as kernels_lib
+
+    def local(params, Xs, ys, ws):
+        per = lambda X, y: model.grad_sum(params, X, y)
+        for _ in range(len(contract)):
+            per = jax.vmap(per)
+        with annotate("eh_step/partial_grads"):
+            grads = per(Xs, ys)  # leaves [*contract axes, *leaf shape]
+        with annotate("eh_step/decode"):
+            leaves = jax.tree_util.tree_leaves(grads)
+            tdtype = jnp.result_type(*leaves)
+            if contract == "ws":
+                wf = jnp.transpose(ws).reshape(-1)
+            else:
+                wf = ws.reshape(-1)
+            M = wf.shape[0]
+
+            def decode_leaf(leaf):
+                leaf = leaf.astype(tdtype)
+                if contract == "ws":
+                    leaf = jnp.moveaxis(leaf, 1, 0)
+                out_shape = leaf.shape[len(contract):]
+                g2 = leaf.reshape(M, -1)
+                out = kernels_lib.fused_block_decode(
+                    wf, g2, use_pallas=use_pallas, interpret=interpret
+                )
+                return out.reshape(out_shape)
+
+            g = jax.tree.map(decode_leaf, grads)
+            g = lax.psum(g, WORKER_AXIS)
+        return g
+
+    return local
+
+
 def make_layer_block_grad_fn(
-    model, mesh: Mesh, spec, *, faithful: bool
+    model, mesh: Mesh, spec, *, faithful: bool,
+    fused: bool = False, use_pallas: bool = False, interpret: bool = False,
 ) -> GradFn:
     """Per-layer (blockwise) decoded gradient: drop-in for
     make_faithful_grad_fn / make_deduped_grad_fn on any model whose
     gradient is a pytree (the deep-model families). The ring transport
     composes via make_ring_faithful_grad_fn(local_body=...) exactly as
-    the flat/margin-flat lowerings do."""
+    the flat/margin-flat lowerings do. ``fused`` swaps the treewise
+    pack-then-einsum body for the fused per-leaf contraction
+    (:func:`_fused_layer_block_local_body`; resolve_block_decode owns the
+    "auto" choice); the two are bitwise-identical, so the swap is a pure
+    lowering fork — keyed into lowering_signature."""
+    contract = "ws" if faithful else "p"
+    body = (
+        _fused_layer_block_local_body(
+            model, spec, contract,
+            use_pallas=use_pallas, interpret=interpret,
+        )
+        if fused
+        else _layer_block_local_body(model, spec, contract)
+    )
     return shard_map(
-        _dq(_layer_block_local_body(model, spec, "ws" if faithful else "p")),
+        _dq(body),
         mesh=mesh,
         in_specs=(P(), P(WORKER_AXIS), P(WORKER_AXIS), P(WORKER_AXIS)),
         out_specs=P(),
-        check_vma=_vma_check(model),
+        # the pallas flavor's out_shape carries no varying-across-mesh
+        # info (same caveat as make_fused_grad_fn)
+        check_vma=False if (fused and use_pallas) else _vma_check(model),
     )
 
 
@@ -867,11 +1015,18 @@ def lowering_signature(cfg, model, X) -> tuple:
     defaults (FLAT_GRAD_DEFAULT / MARGIN_FLAT_DEFAULT) are
     measurement-pinned module state that future races may flip. Keying on
     the resolution rather than the knob strings keeps a cached executable
-    from surviving a default flip."""
+    from surviving a default flip — and, since ISSUE 19, from surviving a
+    tune decision-cache update (the resolvers consult the cache, so the
+    resolved tuple moves when a race verdict lands)."""
     return (
         bool(resolve_flat_grad(cfg.flat_grad, model, X)),
         bool(resolve_margin_flat(cfg.margin_flat, model, X)),
-        bool(resolve_layer_coding(cfg.layer_coding, model)),
+        bool(resolve_layer_coding(cfg.layer_coding, model, X)),
+        bool(
+            resolve_block_decode(
+                getattr(cfg, "block_decode", "auto"), model, X
+            )
+        ),
         type(X).__name__,
     )
 

@@ -94,6 +94,36 @@ def build_model(cfg: RunConfig):
     raise ValueError(f"unknown model {cfg.model}")
 
 
+#: reasons already surfaced as use_pallas-declined warnings (one event per
+#: distinct reason per process — the auto gate runs per train() call)
+_pallas_declined_seen: set = set()
+
+
+def _warn_pallas_declined(reason: str) -> None:
+    from erasurehead_tpu.obs import events as obs_events
+
+    if reason in _pallas_declined_seen:
+        return
+    _pallas_declined_seen.add(reason)
+    obs_events.emit("warning", kind="use_pallas_declined", message=reason)
+
+
+def resolved_stack(cfg: RunConfig, dataset: Dataset, mesh=None):
+    """(model, X) exactly as :func:`train` would resolve them — the shape
+    the tune plane races and resolves under (erasurehead_tpu/tune/races).
+
+    Mirrors train()'s stack selection: ring-transported faithful runs
+    consume the partition-major stack, materialized faithful runs the
+    worker-major stack, deduped runs the partition-major stack. The tune
+    decision cache keys on tune.run_shape_signature(model, X) of THIS
+    pair, so races and warm-run resolutions can never key apart."""
+    faithful = cfg.compute_mode == ComputeMode.FAITHFUL
+    setup = _setup_run(cfg, dataset, mesh, faithful=faithful)
+    if faithful and not setup.ring:
+        return setup.model, setup.data.Xw
+    return setup.model, setup.data.Xp
+
+
 def _auto_mesh(need: int):
     """Largest device count dividing the sharded axis length (the reference
     ran W workers on exactly W nodes; we fold logical workers onto whatever
@@ -870,7 +900,7 @@ def train(
     )  # [R, W, S]
     ring_plan = None
     ring_pipe = setup.ring and step_lib.resolve_ring_pipeline(
-        cfg.ring_pipeline
+        cfg.ring_pipeline, model, data.Xp
     )
     if faithful and setup.ring:
         ring_plan = plan_ring_transport(layout, _worker_axis_size(mesh))
@@ -900,9 +930,17 @@ def train(
     platform = jax.devices()[0].platform
     dense_glm = kind in kernels_lib.GLM_KINDS and isinstance(X, jax.Array)
     use_fused = False
+    fused_verdict = None
+    if cfg.use_pallas == "auto":
+        fused_verdict = kernels_lib.supports_fused(X, kind, platform)
+        if not fused_verdict:
+            # surfaced once per distinct reason per process: "auto
+            # silently declined" was the satellite bug — the refusal now
+            # names itself in the event log (and nowhere else: the
+            # decline is the measured default, not an error)
+            _warn_pallas_declined(fused_verdict.reason)
     if cfg.use_pallas == "on" or (
-        cfg.use_pallas == "auto"
-        and kernels_lib.supports_fused(X, kind, platform)
+        cfg.use_pallas == "auto" and fused_verdict
     ):
         if cfg.use_pallas == "on" and cfg.flat_grad == "on":
             # both knobs explicitly force a grad lowering; picking one
@@ -2265,7 +2303,7 @@ def _train_cohort_impl(cfg, dataset, cfgs, mesh, arrivals, measure):
 
     ring_plan = None
     ring_pipe = setup.ring and step_lib.resolve_ring_pipeline(
-        cfg.ring_pipeline
+        cfg.ring_pipeline, model, data.Xp
     )
     if faithful and setup.ring:
         ring_plan = plan_ring_transport(layout, _worker_axis_size(mesh))
@@ -2300,21 +2338,26 @@ def _train_cohort_impl(cfg, dataset, cfgs, mesh, arrivals, measure):
             "replicated-grad psum) — got "
             f"model={getattr(model, 'name', type(model).__name__)!r}"
         )
-    if step_lib.resolve_layer_coding(cfg.layer_coding, model):
+    if step_lib.resolve_layer_coding(cfg.layer_coding, model, X):
         # per-layer (blockwise) coded cohort: every trajectory's per-slot
         # gradient pytrees pack into the model's block table and decode
         # as one [B, P] x [P, L, width] einsum — DeepMLP layers and MoE
-        # expert shards are the coded units (ops/blocks.py)
+        # expert shards are the coded units (ops/blocks.py). The fused
+        # block_decode lowering composes through the same vmap wrapper:
+        # vmap(fused per-leaf contraction) is bitwise vmap(table einsum)
+        # (tests/test_deep_coding.py pins the cohort pair too)
         from erasurehead_tpu.ops import blocks as blocks_lib
 
         spec = blocks_lib.model_block_spec(
             model, _init_params_f32(cfg, model, dataset.n_features)
         )
-        local_body = step_lib._batched_local_body(
-            step_lib._layer_block_local_body(
-                model, spec, "ws" if faithful else "p"
-            )
+        contract = "ws" if faithful else "p"
+        body = (
+            step_lib._fused_layer_block_local_body(model, spec, contract)
+            if step_lib.resolve_block_decode(cfg.block_decode, model, X)
+            else step_lib._layer_block_local_body(model, spec, contract)
         )
+        local_body = step_lib._batched_local_body(body)
         cohort_lowering = "layer_block_vmap"
     elif step_lib.supports_cohort_matmul(model, X):
         local_body = step_lib._cohort_matmul_local_body(model)
@@ -3708,10 +3751,17 @@ def _apply_layer_coding(
     (ops/blocks.model_block_spec — DeepMLP layers / MoE expert shards are
     individual coded blocks) and decode as ONE batched einsum. "on"
     forces (raising where the model cannot take the path); "auto" defers
-    to step.resolve_layer_coding (LAYER_CODING_DEFAULT, pending its
-    race). Composes with the ring transport like the other lowering
-    swaps; bitwise-identical decode to the treewise form is test-pinned,
-    so the swap is a pure lowering choice."""
+    to step.resolve_layer_coding (cached tune decision, else
+    LAYER_CODING_DEFAULT). Composes with the ring transport like the
+    other lowering swaps; bitwise-identical decode to the treewise form
+    is test-pinned, so the swap is a pure lowering choice.
+
+    Inside the blockwise path, cfg.block_decode picks the decode
+    LOWERING (step.resolve_block_decode): treewise table einsum or the
+    fused per-leaf contraction (ops/kernels.fused_block_decode — no
+    materialized [M, L, width] grad table). Also bitwise-identical, also
+    a pure lowering fork — both choices are keyed through
+    step.lowering_signature so executables fork correctly."""
     if cfg.layer_coding == "on" and not step_lib.supports_layer_coding(model):
         raise ValueError(
             "layer_coding='on' needs a model whose per-slot gradients are "
@@ -3720,20 +3770,26 @@ def _apply_layer_coding(
             "replicated-grad psum) — got "
             f"model={getattr(model, 'name', type(model).__name__)!r}"
         )
-    if not step_lib.resolve_layer_coding(cfg.layer_coding, model):
+    if not step_lib.resolve_layer_coding(cfg.layer_coding, model, X):
         return grad_fn
     from erasurehead_tpu.ops import blocks as blocks_lib
 
+    fused = step_lib.resolve_block_decode(cfg.block_decode, model, X)
     spec = blocks_lib.model_block_spec(model, params_template)
     if ring_plan is not None:
+        local_body = (
+            step_lib._fused_layer_block_local_body(model, spec, "ws")
+            if fused
+            else step_lib._layer_block_local_body(model, spec, "ws")
+        )
         return step_lib.make_ring_faithful_grad_fn(
             model, mesh, ring_plan,
-            local_body=step_lib._layer_block_local_body(model, spec, "ws"),
+            local_body=local_body,
             pipeline=ring_pipeline,
             check_vma=step_lib._vma_check(model),
         )
     return step_lib.make_layer_block_grad_fn(
-        model, mesh, spec, faithful=faithful
+        model, mesh, spec, faithful=faithful, fused=fused
     )
 
 
@@ -3793,7 +3849,7 @@ def train_dynamic(
         deadline=cfg.deadline,
     )
     ring_pipe = setup.ring and step_lib.resolve_ring_pipeline(
-        cfg.ring_pipeline
+        cfg.ring_pipeline, model, data.Xp
     )
     if setup.ring:
         ring_plan = plan_ring_transport(layout, _worker_axis_size(mesh))

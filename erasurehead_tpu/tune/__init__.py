@@ -1,0 +1,156 @@
+"""Measured autotuning plane (ISSUE 19): race, cache, resolve.
+
+Every perf ``auto`` knob in this repo used to bottom out in a hardcoded
+constant pinned by a one-off measurement (step.RING_PIPELINE_DEFAULT,
+LAYER_CODING_DEFAULT, BLOCK_DECODE_FUSED_DEFAULT, kernels.supports_fused,
+sharding.RING_AUTO_MIN_BYTES). This package replaces the *bare constant*
+with a resolution ladder:
+
+    explicit knob > env override > cached measured decision > constant
+
+The measured decisions come from deterministic microbench races
+(tune/racer.py discipline: seeded inputs, warm-up, min-over-repeats,
+tie->fallback) run at the run's ACTUAL shape — by ``erasurehead-tpu
+tune``, the bench ``tune`` extra, or ``make tune-smoke`` — and persist in
+a JSON decision cache keyed by ``(device_kind, race, shape signature)``
+(tune/cache.py). Warm runs never re-race: resolution is one memoized
+dict lookup (<1 ms, the acceptance bar). Races NEVER run inside a
+training step, a request dispatch (serve preloads its per-daemon cache
+at startup), or a resolver — resolvers only read.
+
+Resolutions are observable as typed ``tune`` events (obs/events.py
+SCHEMA; source "race"/"cache"/"default"), deduplicated per process, and
+bitwise-invariant to telemetry on/off — emission never feeds back into
+the resolved choice.
+
+Races and their choice vocabularies (TUNE_RACES / TUNE_CHOICES):
+
+    block_decode   fused | treewise   (blockwise decode lowering)
+    layer_coding   blockwise | treewise  (per-layer coding on/off)
+    glm_fused      pallas | xla       (fused GLM kernel vs XLA two-pass)
+    ring_pipeline  pipelined | sequential (ring transport schedule)
+    stack_mode     ring | materialized   (faithful stack residency)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from erasurehead_tpu.tune.cache import (  # noqa: F401 (public API)
+    DecisionCache,
+    ENV_PATH,
+    canonical_bytes,
+    decision_key,
+    default_path,
+    get_cache,
+    reset,
+)
+
+#: every race the plane knows, with its candidate vocabulary — the
+#: events validator checks membership (obs/events.TUNE_RACES mirrors the
+#: keys; lint pins the two against drift via the schema fixture tests)
+TUNE_CHOICES = {
+    "block_decode": ("fused", "treewise"),
+    "layer_coding": ("blockwise", "treewise"),
+    "glm_fused": ("pallas", "xla"),
+    "ring_pipeline": ("pipelined", "sequential"),
+    "stack_mode": ("ring", "materialized"),
+}
+
+RACES = tuple(sorted(TUNE_CHOICES))
+
+
+def default_device_kind() -> str:
+    """The cache's device dimension: TPU generation string on silicon
+    (decisions must not leak across v5e/v6e), platform name elsewhere."""
+    try:
+        import jax
+
+        d = jax.devices()[0]
+        return str(getattr(d, "device_kind", None) or d.platform)
+    except Exception:  # noqa: BLE001 — no backend == no measured plane
+        return "unknown"
+
+
+def run_shape_signature(model, X) -> str:
+    """The shape key a run resolves (and races) under: model family +
+    depth + the materialized stack's type/shape/dtype. Must be
+    computable both at resolution time (trainer has model + stack) and
+    at race time (trainer.resolved_stack builds the same pair)."""
+    shape = tuple(int(s) for s in getattr(X, "shape", ()))
+    dtype = str(getattr(X, "dtype", "?"))
+    nl = getattr(model, "n_layers", None)
+    return (
+        f"model={type(model).__name__}"
+        f"|nl={nl}|X={type(X).__name__}{shape}|{dtype}"
+    )
+
+
+def glm_fused_signature(shape, dtype, kind: str) -> str:
+    """Shape key of the fused-GLM race (ops/kernels.supports_fused)."""
+    return f"glm={kind}|X={tuple(int(s) for s in shape)}|{dtype}"
+
+
+def stack_mode_signature(layout, rows: int, n_features: int, dtype) -> str:
+    """Shape key of the stack-residency race (data/sharding.
+    resolve_ring_stack): the pre-stack quantities the footprint gate
+    reads — no materialized array exists yet when this resolves."""
+    return (
+        f"W={layout.n_workers}|P={layout.n_partitions}"
+        f"|S={layout.n_slots}|rows={int(rows)}|F={int(n_features)}"
+        f"|{dtype}"
+    )
+
+
+# -- typed tune events, deduplicated per process ----------------------------
+
+_emitted: set = set()
+
+
+def emit_decision(
+    race: str, device_kind: str, shape: str, choice: str, source: str
+) -> None:
+    """Emit one ``tune`` event per distinct decision per process.
+
+    Observation only: emission happens after the choice is made and never
+    feeds back — telemetry on/off stays bitwise on tuned runs."""
+    key = (race, device_kind, shape, choice, source)
+    if key in _emitted:
+        return
+    _emitted.add(key)
+    from erasurehead_tpu.obs import events as events_lib
+
+    events_lib.emit(
+        "tune", race=race, device_kind=device_kind, shape=shape,
+        choice=choice, source=source,
+    )
+
+
+def reset_emitted() -> None:
+    """Tests: forget the per-process event dedup."""
+    _emitted.clear()
+
+
+def lookup(
+    race: str,
+    shape_sig: str,
+    device_kind: Optional[str] = None,
+    fallback: Optional[str] = None,
+) -> Optional[str]:
+    """Resolve one auto knob: cached decision or None (caller's constant).
+
+    The single consult point every resolver goes through
+    (step.resolve_ring_pipeline / resolve_layer_coding /
+    resolve_block_decode, kernels.supports_fused,
+    sharding.resolve_ring_stack). Warm path: one stat(2) + dict lookup.
+    Emits the decision as a ``tune`` event — ``source="cache"`` when a
+    verdict applies, ``source="default"`` (with ``fallback`` as the
+    choice, when given) when the hardcoded constant stands."""
+    dk = device_kind or default_device_kind()
+    choice = get_cache().lookup(dk, race, shape_sig)
+    if choice is not None:
+        emit_decision(race, dk, shape_sig, choice, "cache")
+        return choice
+    if fallback is not None:
+        emit_decision(race, dk, shape_sig, fallback, "default")
+    return None

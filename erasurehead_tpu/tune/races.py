@@ -1,0 +1,351 @@
+"""The concrete auto-knob races, each at a run's ACTUAL shape.
+
+Every race here is end-to-end honest: the candidates are two fully wired
+``trainer.train`` configurations (or, for ``glm_fused``, the two jitted
+gradient lowerings) differing ONLY in the knob under test, timed with the
+racer's warm-up + min-over-repeats discipline on seeded synthetic data.
+Racing whole short runs rather than isolated bodies is deliberate — this
+repo's history is littered with profile-favored lowerings that lost
+end-to-end races (FLAT_GRAD_DEFAULT, supports_fused), so the verdicts
+that flip defaults must be the end-to-end ones.
+
+Races that need hardware this host lacks (ring transport across >= 2
+devices) SKIP — they return None, record nothing, and the resolver keeps
+its hardcoded fallback. A skipped race is not a verdict.
+
+``erasurehead-tpu tune`` (cli.py) drives these from flags; ``make
+tune-smoke`` and the bench ``tune`` extra drive them in-process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from erasurehead_tpu.tune import racer as racer_lib
+
+
+def _replace(cfg, **over):
+    return dataclasses.replace(cfg, **over)
+
+
+def _dataset(cfg):
+    from erasurehead_tpu.data.synthetic import generate_gmm
+
+    return generate_gmm(
+        cfg.n_rows, cfg.n_cols, n_partitions=cfg.n_workers, seed=cfg.seed
+    )
+
+
+def _train_thunk(cfg, dataset):
+    from erasurehead_tpu.train import trainer
+
+    def thunk():
+        trainer.train(cfg, dataset)
+
+    return thunk
+
+
+def _signature(cfg, dataset) -> str:
+    from erasurehead_tpu import tune as tune_lib
+    from erasurehead_tpu.train import trainer
+
+    model, X = trainer.resolved_stack(cfg, dataset)
+    return tune_lib.run_shape_signature(model, X)
+
+
+def race_block_decode(
+    cfg, dataset=None, *, reps: int = racer_lib.DEFAULT_REPS,
+    timer=None, record: bool = True,
+) -> racer_lib.RaceResult:
+    """Treewise pack-then-einsum vs fused per-leaf decode, blockwise
+    coding forced on (the lowering pair behind resolve_block_decode).
+    Bitwise-identical trajectories — the race is purely about time."""
+    from erasurehead_tpu.parallel import step as step_lib
+
+    dataset = dataset if dataset is not None else _dataset(cfg)
+    base = _replace(cfg, layer_coding="on")
+    sig = _signature(base, dataset)
+    fallback = (
+        "fused" if step_lib.BLOCK_DECODE_FUSED_DEFAULT else "treewise"
+    )
+    return racer_lib.race(
+        "block_decode", sig,
+        {
+            "treewise": _train_thunk(
+                _replace(base, block_decode="treewise"), dataset
+            ),
+            "fused": _train_thunk(
+                _replace(base, block_decode="fused"), dataset
+            ),
+        },
+        fallback=fallback, reps=reps, timer=timer, record=record,
+    )
+
+
+def race_layer_coding(
+    cfg, dataset=None, *, reps: int = racer_lib.DEFAULT_REPS,
+    timer=None, record: bool = True,
+) -> racer_lib.RaceResult:
+    """Per-layer blockwise decode vs the treewise per-slot default (the
+    pair behind resolve_layer_coding's auto)."""
+    from erasurehead_tpu.parallel import step as step_lib
+
+    dataset = dataset if dataset is not None else _dataset(cfg)
+    sig = _signature(_replace(cfg, layer_coding="off"), dataset)
+    fallback = (
+        "blockwise" if step_lib.LAYER_CODING_DEFAULT else "treewise"
+    )
+    return racer_lib.race(
+        "layer_coding", sig,
+        {
+            "treewise": _train_thunk(
+                _replace(cfg, layer_coding="off"), dataset
+            ),
+            "blockwise": _train_thunk(
+                _replace(cfg, layer_coding="on"), dataset
+            ),
+        },
+        fallback=fallback, reps=reps, timer=timer, record=record,
+    )
+
+
+def race_glm_fused(
+    cfg, dataset=None, *, reps: int = racer_lib.DEFAULT_REPS,
+    timer=None, record: bool = True,
+) -> racer_lib.RaceResult:
+    """Fused pallas GLM kernel vs XLA's two-pass lowering, at the run's
+    slot-stack shape (the pair behind kernels.supports_fused). On
+    non-TPU hosts the kernel runs in interpret mode — it will lose, and
+    recording that loss is correct: supports_fused declines off-TPU
+    anyway, and the cache key is per device_kind."""
+    import jax
+    import jax.numpy as jnp
+
+    from erasurehead_tpu import tune as tune_lib
+    from erasurehead_tpu.ops import kernels as kernels_lib
+    from erasurehead_tpu.train import trainer
+
+    dataset = dataset if dataset is not None else _dataset(cfg)
+    model, X = trainer.resolved_stack(cfg, dataset)
+    kind = getattr(model, "name", "logistic")
+    if kind not in kernels_lib.GLM_KINDS or not isinstance(X, jax.Array):
+        raise ValueError(
+            f"glm_fused race needs a dense GLM stack; got model={kind!r}, "
+            f"X={type(X).__name__} (set --model logistic/linear)"
+        )
+    sig = tune_lib.glm_fused_signature(X.shape, str(X.dtype), kind)
+    lead = X.shape[:-2]
+    M = 1
+    for s in lead:
+        M *= int(s)
+    Xf = X.reshape((M,) + X.shape[-2:])
+    import numpy as np
+
+    rng = np.random.default_rng(cfg.seed)
+    y = jnp.asarray(
+        np.sign(rng.standard_normal(Xf.shape[:2])), Xf.dtype
+    ).astype(jnp.float32)
+    b = jnp.asarray(rng.standard_normal(Xf.shape[-1]), jnp.float32)
+    w = jnp.asarray(rng.standard_normal(M), jnp.float32)
+    interpret = jax.devices()[0].platform != "tpu"
+    pallas_fn = jax.jit(
+        lambda: kernels_lib.fused_glm_grad(
+            b, Xf, y, w, kind, interpret=interpret
+        )
+    )
+    xla_fn = jax.jit(
+        lambda: kernels_lib.reference_glm_grad(b, Xf, y, w, kind)
+    )
+    return racer_lib.race(
+        "glm_fused", sig,
+        {
+            "pallas": lambda: jax.block_until_ready(pallas_fn()),
+            "xla": lambda: jax.block_until_ready(xla_fn()),
+        },
+        fallback="xla", reps=reps, timer=timer, record=record,
+    )
+
+
+def race_ring_pipeline(
+    cfg, dataset=None, *, reps: int = racer_lib.DEFAULT_REPS,
+    timer=None, record: bool = True,
+) -> Optional[racer_lib.RaceResult]:
+    """Sequential vs double-buffered ring transport, stack_mode=ring
+    forced (the pair behind resolve_ring_pipeline). Skips (None) on a
+    single-device host: a one-hop ring times nothing real."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        return None
+    dataset = dataset if dataset is not None else _dataset(cfg)
+    base = _replace(cfg, stack_mode="ring")
+    sig = _signature(base, dataset)
+    return racer_lib.race(
+        "ring_pipeline", sig,
+        {
+            "sequential": _train_thunk(
+                _replace(base, ring_pipeline="off"), dataset
+            ),
+            "pipelined": _train_thunk(
+                _replace(base, ring_pipeline="on"), dataset
+            ),
+        },
+        fallback="sequential", reps=reps, timer=timer, record=record,
+    )
+
+
+def race_stack_mode(
+    cfg, dataset=None, *, reps: int = racer_lib.DEFAULT_REPS,
+    timer=None, record: bool = True,
+) -> Optional[racer_lib.RaceResult]:
+    """Materialized faithful stack vs ring-streamed, at the footprint
+    boundary (the pair behind resolve_ring_stack's auto threshold).
+    Skips on a single-device host for the same reason as ring_pipeline.
+    Keyed by the PRE-stack signature (tune.stack_mode_signature): the
+    resolver runs before any stack exists."""
+    import jax
+
+    from erasurehead_tpu import tune as tune_lib
+    from erasurehead_tpu.train import trainer
+
+    if len(jax.devices()) < 2:
+        return None
+    dataset = dataset if dataset is not None else _dataset(cfg)
+    layout = trainer.build_layout(cfg)
+    sig = tune_lib.stack_mode_signature(
+        layout, dataset.n_samples // layout.n_partitions,
+        cfg.n_cols, cfg.dtype,
+    )
+    return racer_lib.race(
+        "stack_mode", sig,
+        {
+            "materialized": _train_thunk(
+                _replace(cfg, stack_mode="materialized"), dataset
+            ),
+            "ring": _train_thunk(
+                _replace(cfg, stack_mode="ring"), dataset
+            ),
+        },
+        fallback="materialized", reps=reps, timer=timer, record=record,
+    )
+
+
+RACE_FNS = {
+    "block_decode": race_block_decode,
+    "layer_coding": race_layer_coding,
+    "glm_fused": race_glm_fused,
+    "ring_pipeline": race_ring_pipeline,
+    "stack_mode": race_stack_mode,
+}
+
+
+def main(argv=None) -> int:
+    """``erasurehead-tpu tune`` — race auto knobs at a given shape and
+    persist the verdicts to the decision cache.
+
+    The races run HERE, once, explicitly — never inside training steps or
+    serve dispatches. Warm runs then resolve from the cache file this
+    writes (override the location with ERASUREHEAD_TUNE_CACHE)."""
+    import argparse
+
+    from erasurehead_tpu import tune as tune_lib
+    from erasurehead_tpu.utils.config import RunConfig
+
+    p = argparse.ArgumentParser(
+        prog="erasurehead-tpu tune",
+        description=(
+            "race auto-gated lowerings at a run shape; verdicts persist "
+            "to the tune decision cache"
+        ),
+    )
+    p.add_argument(
+        "--race", action="append", choices=sorted(RACE_FNS) + ["all"],
+        default=None,
+        help="race(s) to run (repeatable; default: block_decode)",
+    )
+    p.add_argument("--scheme", default="approx")
+    p.add_argument("--model", default="deepmlp")
+    p.add_argument("--workers", type=int, default=8)
+    p.add_argument("--stragglers", type=int, default=1)
+    p.add_argument("--num-collect", type=int, default=6)
+    p.add_argument("--rounds", type=int, default=8)
+    p.add_argument("--rows", type=int, default=256)
+    p.add_argument("--cols", type=int, default=32)
+    p.add_argument("--deep-layers", type=int, default=0)
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--reps", type=int, default=racer_lib.DEFAULT_REPS)
+    p.add_argument(
+        "--json", action="store_true",
+        help="print ONE JSON result line (tools/measure_lib.sh capture "
+             "discipline: carries a 'platform' field) instead of the "
+             "human verdict lines",
+    )
+    ns = p.parse_args(argv)
+
+    names = ns.race or ["block_decode"]
+    if "all" in names:
+        names = sorted(RACE_FNS)
+    cfg = RunConfig(
+        scheme=ns.scheme, model=ns.model, n_workers=ns.workers,
+        n_stragglers=ns.stragglers, num_collect=ns.num_collect,
+        rounds=ns.rounds, n_rows=ns.rows, n_cols=ns.cols,
+        lr_schedule=0.5, update_rule="AGD", add_delay=True,
+        seed=ns.seed, deep_layers=ns.deep_layers, dtype=ns.dtype,
+    )
+    dataset = _dataset(cfg)
+    if not ns.json:
+        print(f"tune cache: {tune_lib.default_path()}")
+    results = {}
+    for name in names:
+        res = RACE_FNS[name](cfg, dataset, reps=ns.reps)
+        if res is None:
+            results[name] = None
+            if not ns.json:
+                print(f"{name}: SKIPPED (needs >= 2 devices)")
+            continue
+        results[name] = res
+        if ns.json:
+            continue
+        timings = "  ".join(
+            f"{k}={v * 1e3:.2f}ms" for k, v in sorted(res.timings.items())
+        )
+        verdict = "decisive" if res.decisive else "tie -> fallback"
+        print(
+            f"{name}: choice={res.choice} ({verdict})  [{timings}]  "
+            f"shape={res.shape}"
+        )
+    if ns.json:
+        import json
+
+        import jax
+
+        print(json.dumps({
+            "metric": "tune_races",
+            "platform": jax.devices()[0].platform,
+            "device_kind": tune_lib.default_device_kind(),
+            "cache": tune_lib.default_path(),
+            "races": {
+                name: (
+                    None if res is None else {
+                        "choice": res.choice,
+                        "fallback": res.fallback,
+                        "decisive": res.decisive,
+                        "shape": res.shape,
+                        "timings_ms": {
+                            k: round(v * 1e3, 3)
+                            for k, v in sorted(res.timings.items())
+                        },
+                    }
+                )
+                for name, res in results.items()
+            },
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

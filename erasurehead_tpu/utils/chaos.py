@@ -90,6 +90,11 @@ SITES = (
     # mid-epoch preemption of a streamed run (tools/outofcore_smoke.py
     # proves the sweep journal rehydrates completed rows bitwise)
     "prefetch",
+    # autotune races (tune/racer.py): fires at the head of a race, before
+    # any candidate is timed — a kill there proves a half-finished race
+    # leaves the decision cache untouched (atomic writes) and a cold
+    # re-run produces the byte-identical cache
+    "tune_race",
 )
 
 #: sites whose fault is a MEMBERSHIP change (a worker dying or offering

@@ -331,6 +331,17 @@ class RunConfig:
     # lowerings, model-internal mesh axes, measured mode); "auto"
     # resolves via step.LAYER_CODING_DEFAULT (off pending its race).
     layer_coding: str = "auto"
+    # blockwise-decode LOWERING inside layer coding (parallel/step.
+    # resolve_block_decode): "treewise" packs every slot's grad pytree
+    # into the padded [M, L, width] block table and einsum-decodes it;
+    # "fused" contracts each leaf's [M, D_leaf] slot view directly
+    # (ops/kernels.fused_block_decode — no materialized grad table, the
+    # PR 9 0.57x cause). Bitwise-identical outputs (tests/
+    # test_deep_coding.py) — a pure lowering knob. "auto" resolves
+    # env ERASUREHEAD_BLOCK_DECODE > cached tune decision
+    # (erasurehead_tpu/tune/) > step.BLOCK_DECODE_FUSED_DEFAULT. Inert
+    # unless the run decodes blockwise.
+    block_decode: str = "auto"
     # hidden-layer count for the deepmlp family (models/deep_mlp.py);
     # 0 = the model's default (4). The decode-error-vs-depth series
     # sweeps this knob (bench.py deep_cohort extra).
@@ -464,6 +475,11 @@ class RunConfig:
                     "step — use layer_coding='auto' or 'off' with "
                     "measured mode"
                 )
+        if self.block_decode not in ("auto", "fused", "treewise"):
+            raise ValueError(
+                f"block_decode must be auto/fused/treewise, got "
+                f"{self.block_decode!r}"
+            )
         if self.deep_layers < 0:
             raise ValueError(
                 f"deep_layers must be >= 0, got {self.deep_layers}"
@@ -772,6 +788,11 @@ class RunConfig:
             # the trainer keys the RESOLVED choice via
             # step.lowering_signature
             "layer_coding": self.layer_coding,
+            # blockwise-decode lowering fork (fused per-leaf kernel vs
+            # treewise table einsum): raw knob here, RESOLVED choice in
+            # step.lowering_signature — a tune decision-cache update
+            # moves the resolved tuple, never a stale executable
+            "block_decode": self.block_decode,
             "deep_layers": self.deep_layers,
             "sparse_format": self.sparse_format,
             "fields_scatter": self.fields_scatter,

@@ -19,6 +19,10 @@ def emit_run(run_id, fields):
         "whatif", spec_hash="abc123", kind="point",
         label="approx:c4@W8s1/exp0.5", feasible=True,
     )
+    events_lib.emit(  # tune record: known race + source, full field set
+        "tune", race="block_decode", device_kind="cpu",
+        shape="model=DeepMLPModel|nl=4", choice="fused", source="cache",
+    )
 
 
 def write_artifacts(paths):

@@ -1,0 +1,32 @@
+"""autotune-plane event-schema violations (ISSUE 19): a ``tune`` emit
+missing its resolution provenance, a logger-object tune emit missing the
+device dimension, a race name outside obs/events.TUNE_RACES, a source
+outside TUNE_SOURCES, and a TUNE_CHOICES declaration that drifts from
+the schema's race vocabulary — the decision-plane records are
+lint-enforced like every other."""
+
+from erasurehead_tpu.obs import events as events_lib
+
+# drift: declares a race the event schema does not know
+TUNE_CHOICES = {
+    "block_decode": ("fused", "treewise"),
+    "margin_lowering": ("flat", "cols"),
+}
+
+
+def emit_tune(logger):
+    events_lib.emit(
+        "tune", race="block_decode", device_kind="cpu",
+        shape="s", choice="fused",
+    )  # missing source
+    logger.emit(
+        "tune", race="glm_fused", shape="s", choice="xla", source="race",
+    )  # missing device_kind
+    events_lib.emit(
+        "tune", race="margin_lowering", device_kind="cpu", shape="s",
+        choice="flat", source="race",
+    )  # race not in TUNE_RACES
+    events_lib.emit(
+        "tune", race="stack_mode", device_kind="cpu", shape="s",
+        choice="ring", source="guess",
+    )  # source not in TUNE_SOURCES

@@ -181,6 +181,24 @@ def test_schema_obs_fixture():
     assert len(findings) == 2
 
 
+def test_schema_tune_fixture():
+    """The autotune-plane `tune` record (ISSUE 19) is lint-enforced like
+    every other type: emits missing required fields are findings, a
+    constant race/source outside TUNE_RACES/TUNE_SOURCES is a finding
+    (the runtime validator's membership check at lint time), and a
+    TUNE_CHOICES declaration that drifts from the schema's race
+    vocabulary is a finding — schema_ok.py's full-field tune emit stays
+    silent."""
+    findings = _unsup(_lint(_fx("schema_tune_bad.py")), "event-schema")
+    msgs = "\n".join(f.message for f in findings)
+    assert "source" in msgs
+    assert "device_kind" in msgs  # the logger-object emit is checked too
+    assert "margin_lowering" in msgs and "TUNE_RACES" in msgs
+    assert "guess" in msgs and "TUNE_SOURCES" in msgs
+    assert "TUNE_CHOICES" in msgs  # the vocabulary-drift check
+    assert len(findings) == 5
+
+
 def test_schema_validator_drift_fixture():
     findings = _unsup(_lint(_fx("schema_drift_bad.py")), "event-schema")
     assert len(findings) == 1

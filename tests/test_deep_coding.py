@@ -269,6 +269,79 @@ class TestLayerCodedTrajectories:
         ):
             assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
 
+    def test_fused_block_decode_bitwise_vs_treewise(self, gmm):
+        """ISSUE 19: the fused per-leaf decode (block_decode="fused",
+        ops/kernels.fused_block_decode) is a pure lowering of the treewise
+        pack-then-einsum blockwise body — trajectories must be BITWISE
+        identical, per model family and compute mode."""
+        for model_name, mode in (
+            ("deepmlp", "deduped"),
+            ("mlp", "faithful"),
+            ("moe", "deduped"),
+        ):
+            fused = trainer.train(
+                _cfg(model=model_name, compute_mode=mode,
+                     layer_coding="on", block_decode="fused"),
+                gmm,
+            )
+            tree = trainer.train(
+                _cfg(model=model_name, compute_mode=mode,
+                     layer_coding="on", block_decode="treewise"),
+                gmm,
+            )
+            for a, b in zip(
+                jax.tree.leaves(fused.params_history),
+                jax.tree.leaves(tree.params_history),
+            ):
+                assert (
+                    np.asarray(a).tobytes() == np.asarray(b).tobytes()
+                ), (model_name, mode)
+            np.testing.assert_array_equal(
+                fused.decode_error, tree.decode_error
+            )
+
+    def test_fused_block_decode_bitwise_on_ring(self, gmm):
+        """The fused decode composes with ring-streamed faithful stacks
+        without perturbing a single bit."""
+        runs = {
+            bd: trainer.train(
+                _cfg(model="mlp", scheme="repcoded",
+                     compute_mode="faithful", stack_mode="ring",
+                     layer_coding="on", block_decode=bd),
+                gmm,
+            )
+            for bd in ("fused", "treewise")
+        }
+        for a, b in zip(
+            jax.tree.leaves(runs["fused"].params_history),
+            jax.tree.leaves(runs["treewise"].params_history),
+        ):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+    def test_fused_block_decode_bitwise_in_cohort(self, gmm):
+        """A fused-decode cohort packs into the same vmapped dispatch and
+        stays bitwise against the treewise cohort, member by member."""
+        def cohort(bd):
+            cfgs = [
+                _cfg(scheme=s, seed=sd, layer_coding="on",
+                     block_decode=bd, **extra)
+                for s, extra in (
+                    ("approx", {"num_collect": 6}), ("repcoded", {}),
+                )
+                for sd in (0, 1)
+            ]
+            return trainer.train_cohort(cfgs, gmm)
+
+        fused, tree = cohort("fused"), cohort("treewise")
+        assert fused[0].cache_info["cohort_lowering"] == "layer_block_vmap"
+        for f, t in zip(fused, tree):
+            for a, b in zip(
+                jax.tree.leaves(f.params_history),
+                jax.tree.leaves(t.params_history),
+            ):
+                assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+            np.testing.assert_array_equal(f.collected, t.collected)
+
     def test_layer_on_refused_with_forced_lowerings(self):
         for kw in (
             {"flat_grad": "on"},

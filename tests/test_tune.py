@@ -1,0 +1,521 @@
+"""The measured autotuning plane (ISSUE 19): race, cache, resolve.
+
+The load-bearing invariants:
+  - the decision cache is DETERMINISTIC: it stores choices only
+    (no timings/timestamps), serializes canonically (insertion-order
+    independent, byte-identical for equal decisions), survives corrupt
+    files as "empty", and is re-read across instances via the
+    (mtime_ns, size) stamp;
+  - the racer's verdicts are exact under an injected fake clock:
+    min-over-repeats, a challenger only unseats the fallback by beating
+    it past TIE_MARGIN, ties keep the fallback (timer noise cannot flip
+    decisions);
+  - every resolver (resolve_block_decode / resolve_layer_coding /
+    resolve_ring_pipeline, supports_fused, resolve_ring_stack) walks the
+    ladder explicit > env > cached decision > hardcoded constant, and a
+    cached verdict actually flips the lowering;
+  - resolutions emit typed ``tune`` events (schema-validated, per-process
+    deduped) and emission is observation-only;
+  - the race-side shape signature equals the resolve-side signature
+    (trainer.resolved_stack agreement) — a persisted verdict is actually
+    FOUND by the run it was raced for;
+  - supports_fused declines carry a reason string, surfaced once as a
+    ``warning`` event by trainer's use_pallas="auto" gate.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from erasurehead_tpu import tune as tune_lib
+from erasurehead_tpu.obs import events as events_lib
+from erasurehead_tpu.parallel import step as step_lib
+from erasurehead_tpu.tune import cache as cache_lib
+from erasurehead_tpu.tune import racer as racer_lib
+from erasurehead_tpu.tune import races as races_lib
+from erasurehead_tpu.data.synthetic import generate_gmm
+from erasurehead_tpu.train import trainer
+from erasurehead_tpu.utils.config import RunConfig
+
+W = 8
+
+
+@pytest.fixture(autouse=True)
+def isolated_cache(tmp_path, monkeypatch):
+    """Every test gets its own decision cache file and a clean event
+    dedup set; the memoized cache map is dropped on both sides."""
+    path = str(tmp_path / "tune.json")
+    monkeypatch.setenv(cache_lib.ENV_PATH, path)
+    tune_lib.reset()
+    tune_lib.reset_emitted()
+    yield path
+    tune_lib.reset()
+    tune_lib.reset_emitted()
+
+
+@pytest.fixture(scope="module")
+def gmm():
+    return generate_gmm(256, 32, n_partitions=W, seed=0)
+
+
+def _cfg(**kw):
+    base = dict(
+        scheme="approx", model="deepmlp", n_workers=W, n_stragglers=1,
+        num_collect=6, rounds=3, n_rows=256, n_cols=32,
+        update_rule="AGD", lr_schedule=0.5, add_delay=True, seed=0,
+    )
+    base.update(kw)
+    return RunConfig(**base)
+
+
+class FakeTimer:
+    """Scripted clock: returns the next value per call."""
+
+    def __init__(self, values):
+        self._vals = iter(values)
+
+    def __call__(self):
+        return next(self._vals)
+
+
+# ---------------------------------------------------------------------------
+# decision cache
+
+
+class TestDecisionCache:
+    def test_roundtrip_and_canonical_bytes(self, isolated_cache):
+        c = tune_lib.get_cache()
+        assert c.lookup("cpu", "block_decode", "sig") is None
+        c.record("cpu", "block_decode", "sig", "fused")
+        c.record("cpu", "layer_coding", "sig", "treewise")
+        assert c.lookup("cpu", "block_decode", "sig") == "fused"
+        # canonical serialization is insertion-order independent
+        d = cache_lib.DecisionCache(isolated_cache + ".b")
+        d.record("cpu", "layer_coding", "sig", "treewise")
+        d.record("cpu", "block_decode", "sig", "fused")
+        assert c.to_bytes() == d.to_bytes()
+        doc = json.loads(c.to_bytes())
+        assert doc["version"] == cache_lib.VERSION
+        assert "choice" in doc["decisions"]["cpu|block_decode|sig"]
+        # no timings, timestamps, or hostnames anywhere in the file
+        assert set(doc["decisions"]["cpu|block_decode|sig"]) == {"choice"}
+
+    def test_fresh_instance_reads_persisted_file(self, isolated_cache):
+        tune_lib.get_cache().record("tpu v5e", "glm_fused", "s1", "pallas")
+        fresh = cache_lib.DecisionCache(isolated_cache)
+        assert fresh.lookup("tpu v5e", "glm_fused", "s1") == "pallas"
+
+    def test_corrupt_file_is_empty_cache(self, isolated_cache):
+        with open(isolated_cache, "w") as f:
+            f.write("{not json")
+        assert tune_lib.get_cache().lookup("cpu", "block_decode", "x") is None
+        # and recording over the corrupt file heals it
+        tune_lib.get_cache().record("cpu", "block_decode", "x", "treewise")
+        assert (
+            cache_lib.DecisionCache(isolated_cache).lookup(
+                "cpu", "block_decode", "x"
+            )
+            == "treewise"
+        )
+
+    def test_stamp_refresh_sees_external_writes(self, isolated_cache):
+        c = tune_lib.get_cache()
+        assert c.lookup("cpu", "block_decode", "x") is None
+        other = cache_lib.DecisionCache(isolated_cache)
+        other.record("cpu", "block_decode", "x", "fused")
+        # same path, different instance: the stamp moves, c re-reads
+        assert c.lookup("cpu", "block_decode", "x") == "fused"
+
+    def test_missing_file_and_default_path_env(self, isolated_cache):
+        assert cache_lib.default_path() == isolated_cache
+        assert tune_lib.get_cache().decisions() == {}
+
+
+# ---------------------------------------------------------------------------
+# racer
+
+
+class TestRacer:
+    def _candidates(self):
+        return {"treewise": lambda: None, "fused": lambda: None}
+
+    def test_decisive_challenger_wins(self):
+        # sorted order times "fused" first: fused dt=1, treewise dt=10
+        timer = FakeTimer([0.0, 1.0, 10.0, 20.0])
+        res = racer_lib.race(
+            "block_decode", "sig", self._candidates(),
+            fallback="treewise", reps=1, timer=timer, record=False,
+        )
+        assert res.choice == "fused" and res.decisive
+        assert res.timings == {"fused": 1.0, "treewise": 10.0}
+
+    def test_tie_keeps_fallback(self):
+        # fused dt=0.95, treewise dt=1.0: inside the 10% margin -> tie
+        timer = FakeTimer([0.0, 0.95, 0.0, 1.0])
+        res = racer_lib.race(
+            "block_decode", "sig", self._candidates(),
+            fallback="treewise", reps=1, timer=timer, record=False,
+        )
+        assert res.choice == "treewise" and not res.decisive
+
+    def test_fallback_winning_is_not_decisive(self):
+        timer = FakeTimer([0.0, 10.0, 0.0, 1.0])
+        res = racer_lib.race(
+            "block_decode", "sig", self._candidates(),
+            fallback="treewise", reps=1, timer=timer, record=False,
+        )
+        assert res.choice == "treewise" and not res.decisive
+
+    def test_min_over_reps(self):
+        # fused reps: 5.0 then 1.0 -> min 1.0; treewise reps: 10, 10
+        timer = FakeTimer([0.0, 5.0, 10.0, 11.0, 0.0, 10.0, 20.0, 30.0])
+        res = racer_lib.race(
+            "block_decode", "sig", self._candidates(),
+            fallback="treewise", reps=2, timer=timer, record=False,
+        )
+        assert res.timings["fused"] == 1.0
+        assert res.choice == "fused" and res.decisive
+
+    def test_unknown_fallback_raises(self):
+        with pytest.raises(ValueError, match="fallback"):
+            racer_lib.race(
+                "block_decode", "sig", self._candidates(),
+                fallback="nope", reps=1, record=False,
+            )
+
+    def test_race_records_choice_and_emits_event(self, isolated_cache):
+        timer = FakeTimer([0.0, 1.0, 10.0, 20.0])
+        seen = []
+        events_lib.add_observer(seen.append)
+        try:
+            racer_lib.race(
+                "block_decode", "shape-sig", self._candidates(),
+                fallback="treewise", reps=1, timer=timer,
+                device_kind="cpu",
+            )
+        finally:
+            events_lib.remove_observer(seen.append)
+        assert (
+            tune_lib.get_cache().lookup("cpu", "block_decode", "shape-sig")
+            == "fused"
+        )
+        tune = [r for r in seen if r["type"] == "tune"]
+        assert len(tune) == 1
+        assert tune[0]["choice"] == "fused"
+        assert tune[0]["source"] == "race"
+
+
+# ---------------------------------------------------------------------------
+# lookup: sources, dedup, schema
+
+
+class TestLookup:
+    def test_cache_hit_emits_cache_source(self, isolated_cache):
+        dk = tune_lib.default_device_kind()
+        tune_lib.get_cache().record(dk, "block_decode", "s", "fused")
+        seen = []
+        events_lib.add_observer(seen.append)
+        try:
+            assert tune_lib.lookup("block_decode", "s") == "fused"
+            # second resolve of the identical decision is deduped
+            assert tune_lib.lookup("block_decode", "s") == "fused"
+        finally:
+            events_lib.remove_observer(seen.append)
+        tune = [r for r in seen if r["type"] == "tune"]
+        assert len(tune) == 1 and tune[0]["source"] == "cache"
+
+    def test_miss_emits_default_with_fallback(self):
+        seen = []
+        events_lib.add_observer(seen.append)
+        try:
+            assert (
+                tune_lib.lookup("block_decode", "s", fallback="treewise")
+                is None
+            )
+        finally:
+            events_lib.remove_observer(seen.append)
+        tune = [r for r in seen if r["type"] == "tune"]
+        assert len(tune) == 1
+        assert tune[0]["source"] == "default"
+        assert tune[0]["choice"] == "treewise"
+
+    def test_tune_events_pass_validator(self, isolated_cache, tmp_path):
+        dk = tune_lib.default_device_kind()
+        tune_lib.get_cache().record(dk, "glm_fused", "shape", "pallas")
+        path = str(tmp_path / "events.jsonl")
+        with events_lib.capture(path):
+            tune_lib.lookup("glm_fused", "shape")
+            tune_lib.lookup("ring_pipeline", "shape", fallback="sequential")
+        assert events_lib.validate_lines(open(path)) == []
+        recs = [json.loads(x) for x in open(path) if x.strip()]
+        assert sum(r["type"] == "tune" for r in recs) == 2
+
+    def test_validator_rejects_unknown_race_and_source(self):
+        line = json.dumps({
+            "type": "tune", "seq": 0, "t": 0.0, "race": "bogus",
+            "device_kind": "cpu", "shape": "s", "choice": "x",
+            "source": "vibes",
+        })
+        errors = events_lib.validate_lines([line])
+        assert any("race" in e for e in errors)
+        assert any("source" in e for e in errors)
+
+    def test_races_constant_matches_events_constant(self):
+        assert tuple(sorted(tune_lib.TUNE_CHOICES)) == events_lib.TUNE_RACES
+        assert events_lib.TUNE_SOURCES == ("race", "cache", "default")
+
+
+# ---------------------------------------------------------------------------
+# resolvers walk the ladder
+
+
+class TestResolvers:
+    def _stack(self, gmm, **kw):
+        return trainer.resolved_stack(_cfg(**kw), gmm)
+
+    def test_block_decode_explicit_beats_everything(self):
+        assert step_lib.resolve_block_decode("fused") is True
+        assert step_lib.resolve_block_decode("treewise") is False
+
+    def test_block_decode_env_beats_cache(self, gmm, monkeypatch):
+        model, X = self._stack(gmm)
+        dk = tune_lib.default_device_kind()
+        sig = tune_lib.run_shape_signature(model, X)
+        tune_lib.get_cache().record(dk, "block_decode", sig, "treewise")
+        monkeypatch.setenv("ERASUREHEAD_BLOCK_DECODE", "fused")
+        assert step_lib.resolve_block_decode("auto", model, X) is True
+        monkeypatch.delenv("ERASUREHEAD_BLOCK_DECODE")
+        assert step_lib.resolve_block_decode("auto", model, X) is False
+
+    def test_block_decode_cached_decision_flips_auto(self, gmm):
+        model, X = self._stack(gmm)
+        # no cached verdict: the hardcoded constant stands
+        assert (
+            step_lib.resolve_block_decode("auto", model, X)
+            is step_lib.BLOCK_DECODE_FUSED_DEFAULT
+        )
+        tune_lib.get_cache().record(
+            tune_lib.default_device_kind(), "block_decode",
+            tune_lib.run_shape_signature(model, X), "fused",
+        )
+        assert step_lib.resolve_block_decode("auto", model, X) is True
+
+    def test_layer_coding_cached_decision_flips_auto(self, gmm):
+        model, X = self._stack(gmm)
+        assert (
+            step_lib.resolve_layer_coding("auto", model, X)
+            is step_lib.LAYER_CODING_DEFAULT
+        )
+        tune_lib.get_cache().record(
+            tune_lib.default_device_kind(), "layer_coding",
+            tune_lib.run_shape_signature(model, X), "blockwise",
+        )
+        assert step_lib.resolve_layer_coding("auto", model, X) is True
+        # explicit still forces
+        assert step_lib.resolve_layer_coding("off", model, X) is False
+
+    def test_ring_pipeline_cached_decision_flips_auto(self, gmm):
+        model, X = self._stack(gmm)
+        assert (
+            step_lib.resolve_ring_pipeline("auto", model, X)
+            is step_lib.RING_PIPELINE_DEFAULT
+        )
+        tune_lib.get_cache().record(
+            tune_lib.default_device_kind(), "ring_pipeline",
+            tune_lib.run_shape_signature(model, X), "pipelined",
+        )
+        assert step_lib.resolve_ring_pipeline("auto", model, X) is True
+        assert step_lib.resolve_ring_pipeline("off", model, X) is False
+
+    def test_ring_stack_cached_decision_overrides_footprint(self, gmm):
+        from erasurehead_tpu.data import sharding as sharding_lib
+
+        cfg = _cfg(
+            scheme="repcoded", compute_mode="faithful", model="mlp"
+        )
+        layout = trainer.build_layout(cfg)
+        assert layout.storage_overhead > 1.0
+        # small data: the footprint gate says materialized
+        assert (
+            sharding_lib.resolve_ring_stack(
+                "auto", layout, gmm, 1, np.float32
+            )
+            is False
+        )
+        rows = gmm.n_samples // layout.n_partitions
+        sig = tune_lib.stack_mode_signature(
+            layout, rows, gmm.X_train.shape[1], np.dtype(np.float32).name
+        )
+        tune_lib.get_cache().record(
+            tune_lib.default_device_kind(), "stack_mode", sig, "ring"
+        )
+        assert (
+            sharding_lib.resolve_ring_stack(
+                "auto", layout, gmm, 1, np.float32
+            )
+            is True
+        )
+        # structural gates still dominate the measured verdict
+        assert (
+            sharding_lib.resolve_ring_stack(
+                "auto", layout, gmm, 1, np.float32, supported=False
+            )
+            is False
+        )
+        # and explicit still forces
+        assert (
+            sharding_lib.resolve_ring_stack(
+                "materialized", layout, gmm, 1, np.float32
+            )
+            is False
+        )
+
+    def test_lowering_signature_forks_on_block_decode(self, gmm):
+        cfg_t = _cfg(layer_coding="on", block_decode="treewise")
+        cfg_f = _cfg(layer_coding="on", block_decode="fused")
+        model, X = self._stack(gmm, layer_coding="on")
+        assert step_lib.lowering_signature(
+            cfg_t, model, X
+        ) != step_lib.lowering_signature(cfg_f, model, X)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: race -> cache -> warm resolution, deterministic + bitwise
+
+
+class TestRaceToResolution:
+    def test_race_block_decode_cache_is_deterministic(
+        self, gmm, tmp_path, monkeypatch
+    ):
+        """Two races at the same shape with the same scripted clock
+        serialize to byte-identical cache files."""
+        cfg = _cfg(rounds=2)
+        blobs = []
+        for name in ("a", "b"):
+            path = str(tmp_path / f"cache_{name}.json")
+            monkeypatch.setenv(cache_lib.ENV_PATH, path)
+            tune_lib.reset()
+            tune_lib.reset_emitted()
+            races_lib.race_block_decode(
+                cfg, gmm, reps=1,
+                timer=FakeTimer([0.0, 1.0, 10.0, 20.0]),
+            )
+            blobs.append(open(path, "rb").read())
+        assert blobs[0] == blobs[1]
+        doc = json.loads(blobs[0])
+        assert len(doc["decisions"]) == 1
+        (key,) = doc["decisions"]
+        assert "|block_decode|" in key
+
+    def test_raced_verdict_resolves_next_auto_run(self, gmm, tmp_path):
+        """Signature agreement: the shape key the race persists is the
+        key the next training run's resolver computes."""
+        cfg = _cfg(rounds=2, layer_coding="on")
+        races_lib.race_block_decode(
+            cfg, gmm, reps=1, timer=FakeTimer([0.0, 1.0, 10.0, 20.0])
+        )
+        model, X = trainer.resolved_stack(cfg, gmm)
+        assert (
+            step_lib.resolve_block_decode("auto", model, X) is True
+        ), "raced 'fused' verdict was not found at resolve time"
+
+    def test_tuned_auto_run_is_bitwise_and_telemetry_invariant(
+        self, gmm, tmp_path
+    ):
+        """The tuned lowering is observation-only: auto (resolved fused
+        from the cache) == forced fused == forced treewise, with and
+        without an events capture."""
+        cfg = _cfg(rounds=2, layer_coding="on")
+        races_lib.race_block_decode(
+            cfg, gmm, reps=1, timer=FakeTimer([0.0, 1.0, 10.0, 20.0])
+        )
+
+        def leaves(r):
+            return [np.asarray(x) for x in jax.tree.leaves(r.final_params)]
+
+        auto_cfg = dataclasses.replace(cfg, block_decode="auto")
+        path = str(tmp_path / "events.jsonl")
+        with events_lib.capture(path):
+            r_auto = trainer.train(auto_cfg, gmm)
+        r_dark = trainer.train(auto_cfg, gmm)
+        r_fused = trainer.train(
+            dataclasses.replace(cfg, block_decode="fused"), gmm
+        )
+        r_tree = trainer.train(
+            dataclasses.replace(cfg, block_decode="treewise"), gmm
+        )
+        for other in (r_dark, r_fused, r_tree):
+            for a, b in zip(leaves(r_auto), leaves(other)):
+                assert a.tobytes() == b.tobytes()
+        assert events_lib.validate_lines(open(path)) == []
+        recs = [json.loads(x) for x in open(path) if x.strip()]
+        cached = [
+            r for r in recs
+            if r["type"] == "tune" and r["source"] == "cache"
+        ]
+        assert cached and cached[0]["choice"] == "fused"
+
+    def test_glm_fused_race_rejects_non_glm(self, gmm):
+        with pytest.raises(ValueError, match="dense GLM"):
+            races_lib.race_glm_fused(_cfg(model="deepmlp"), gmm, reps=1)
+
+    def test_ring_races_skip_on_single_device(self, gmm):
+        if len(jax.devices()) >= 2:
+            pytest.skip("multi-device host: the race would actually run")
+        assert races_lib.race_ring_pipeline(_cfg(), gmm) is None
+        assert races_lib.race_stack_mode(_cfg(), gmm) is None
+        assert tune_lib.get_cache().decisions() == {}
+
+
+# ---------------------------------------------------------------------------
+# supports_fused reasons + the trainer's one-time warning
+
+
+class TestSupportsFusedReasons:
+    def test_declines_carry_reasons(self):
+        from erasurehead_tpu.ops import kernels
+
+        X = jnp.zeros((2, 8, 128), jnp.float32)
+        for verdict, needle in (
+            (kernels.supports_fused(X, "mlp", "tpu"), "dense GLM"),
+            (kernels.supports_fused(X, "logistic", "cpu"), "Mosaic"),
+            (kernels.supports_fused(X, "logistic", "tpu"), "race"),
+        ):
+            assert not verdict
+            assert needle in verdict.reason
+
+    def test_cached_pallas_verdict_accepts(self):
+        from erasurehead_tpu.ops import kernels
+
+        X = jnp.zeros((2, 8, 128), jnp.float32)
+        tune_lib.get_cache().record(
+            tune_lib.default_device_kind(), "glm_fused",
+            tune_lib.glm_fused_signature(X.shape, str(X.dtype), "logistic"),
+            "pallas",
+        )
+        verdict = kernels.supports_fused(X, "logistic", "tpu")
+        assert verdict
+        assert "pallas" in verdict.reason
+
+    def test_trainer_emits_decline_warning_once(self, gmm, tmp_path):
+        trainer._pallas_declined_seen.clear()
+        cfg = _cfg(model="logistic", rounds=2, use_pallas="auto")
+        path = str(tmp_path / "events.jsonl")
+        with events_lib.capture(path):
+            trainer.train(cfg, gmm)
+            trainer.train(cfg, gmm)  # second run: deduped, no second event
+        recs = [json.loads(x) for x in open(path) if x.strip()]
+        declines = [
+            r for r in recs
+            if r["type"] == "warning"
+            and r.get("kind") == "use_pallas_declined"
+        ]
+        assert len(declines) == 1
+        assert declines[0]["message"]
+        assert events_lib.validate_lines(open(path)) == []

@@ -103,6 +103,17 @@ run cohort_stream         1800 env BENCH_STACK=ring BENCH_STACK_DTYPE=int8 BENCH
 # faithful reference protocol, never yet TPU-measured for dense
 run dense_f32_deduped  1800 env BENCH_MODE=deduped python bench.py
 run dense_bf16_deduped 1800 env BENCH_MODE=deduped BENCH_DTYPE=bfloat16 python bench.py
+
+# fused blockwise decode + the measured autotuning plane (ISSUE 19): race
+# the fused per-leaf decode against treewise pack-then-einsum AND the
+# pallas GLM kernel against XLA's lowering on real silicon at a deepmlp
+# blockwise shape; verdicts persist to the repo-local decision cache so a
+# later run with --block-decode auto / --use-pallas auto lowers under the
+# measured winner, not the CPU-era constant
+run fused_decode 1800 env ERASUREHEAD_TUNE_CACHE=tools/tune_decisions.json \
+    python -m erasurehead_tpu.cli tune --json \
+    --race block_decode --race glm_fused \
+    --model deepmlp --workers 8 --rows 4096 --cols 256 --rounds 8
 run kernel_race    900  python tools/kernel_race.py
 
 # lane-replicated gather benches: the [rows, nnz, L] gather temps are

@@ -78,4 +78,14 @@ done
 run sparse_covtype_faithful_rep2 1200 python tools/bench_sparse.py --shape covtype
 run sparse_amazon_faithful_rep2  1200 python tools/bench_sparse.py --shape amazon
 
+# --- autotune decision gates (ISSUE 19): the fused_decode verdicts flip
+# resolve_block_decode / supports_fused at this shape, so they need n>=2;
+# the rep2 pass re-races into a THROWAWAY cache (the decision record is
+# the measurements.jsonl line — harvest_decisions.py computes the spread;
+# only the base pass's cache feeds resolution)
+run fused_decode_rep2 1800 env ERASUREHEAD_TUNE_CACHE=/tmp/eh-tune-rep2.json \
+    python -m erasurehead_tpu.cli tune --json \
+    --race block_decode --race glm_fused \
+    --model deepmlp --workers 8 --rows 4096 --cols 256 --rounds 8
+
 echo "rep2 measurements appended to $OUT" >&2

@@ -1,0 +1,211 @@
+"""tune-smoke: CPU end-to-end drive of the measured autotuning plane.
+
+`make tune-smoke` asserts, end to end:
+
+  1. a cold `block_decode` race (fused per-leaf decode vs treewise
+     pack-then-einsum, blockwise coding on) runs to a verdict and
+     persists it to a fresh decision cache;
+  2. the cache is DETERMINISTIC: re-racing the identical shape with the
+     identical seeds into a second fresh cache produces a byte-identical
+     file (the cache stores choices only — no timings, no timestamps);
+  3. a subsequent block_decode="auto" training run resolves the knob
+     from the cache (a `tune` event with source="cache") without
+     re-racing, and warm resolution costs < 1 ms;
+  4. the resolution is observation-only: the tuned `auto` run's
+     parameter trajectory is bitwise-identical to the forced runs
+     (fused == treewise == auto — the knob is pure lowering), with
+     telemetry on or off;
+  5. a run chaos-killed at the head of the race (ERASUREHEAD_CHAOS
+     kill:tune_race:1, exit code chaos.KILL_EXIT) leaves NO cache file
+     (atomic writes — never a torn one), and the cold re-run (a fresh
+     subprocess, cold JIT caches) races to a complete canonical verdict
+     under the SAME decision key — the kill is invisible in the cache's
+     structure. (The cold process's wall-clock timings are its own, so
+     a within-tie-margin verdict may legitimately settle on the other
+     candidate; byte-identity is asserted between the two SAME-process
+     races in step 2, and exactly — with a scripted clock — in
+     tests/test_tune.py.);
+  6. every emitted `tune` event passes the events schema validator.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from erasurehead_tpu import tune as tune_lib  # noqa: E402
+from erasurehead_tpu.data.synthetic import generate_gmm  # noqa: E402
+from erasurehead_tpu.obs import events as obs_events  # noqa: E402
+from erasurehead_tpu.tune import races as tune_races  # noqa: E402
+from erasurehead_tpu.utils import chaos  # noqa: E402
+from erasurehead_tpu.utils.config import RunConfig  # noqa: E402
+
+OUT = "/tmp/eh-tune-smoke"
+
+
+def _leaves(result):
+    import jax
+
+    return [np.asarray(x) for x in jax.tree.leaves(result.final_params)]
+
+
+def _use_cache(path):
+    os.environ[tune_lib.ENV_PATH] = path
+    tune_lib.reset()
+    tune_lib.reset_emitted()
+
+
+def main() -> int:
+    from erasurehead_tpu.train import trainer
+
+    os.makedirs(OUT, exist_ok=True)
+    cfg = RunConfig(
+        scheme="approx", model="deepmlp", n_workers=8, n_stragglers=1,
+        num_collect=6, rounds=4, n_rows=256, n_cols=32,
+        update_rule="AGD", lr_schedule=0.5, add_delay=True, seed=0,
+        layer_coding="on",
+    )
+    ds = generate_gmm(cfg.n_rows, cfg.n_cols, n_partitions=cfg.n_workers, seed=0)
+
+    # 1. cold race into a fresh cache
+    cache_a = os.path.join(OUT, "cache_a.json")
+    for p in (cache_a,):
+        if os.path.exists(p):
+            os.unlink(p)
+    _use_cache(cache_a)
+    res = tune_races.race_block_decode(cfg, ds, reps=2)
+    assert os.path.exists(cache_a), "race did not persist a cache"
+    print(
+        f"tune-smoke: cold race -> choice={res.choice} "
+        f"({'decisive' if res.decisive else 'tie -> fallback'}) "
+        f"timings={ {k: round(v * 1e3, 2) for k, v in sorted(res.timings.items())} }ms"
+    )
+
+    # 2. determinism: identical re-race -> byte-identical cache file
+    cache_b = os.path.join(OUT, "cache_b.json")
+    if os.path.exists(cache_b):
+        os.unlink(cache_b)
+    _use_cache(cache_b)
+    tune_races.race_block_decode(cfg, ds, reps=2)
+    bytes_a = open(cache_a, "rb").read()
+    bytes_b = open(cache_b, "rb").read()
+    assert bytes_a == bytes_b, (
+        f"re-raced cache differs:\n{bytes_a!r}\nvs\n{bytes_b!r}"
+    )
+    print(f"tune-smoke: re-race byte-identical ({len(bytes_a)} bytes)")
+
+    # 3. warm resolution: auto resolves from the cache, < 1 ms, no re-race
+    _use_cache(cache_a)
+    auto_cfg = dataclasses.replace(cfg, block_decode="auto")
+    ev_path = os.path.join(OUT, "events.jsonl")
+    with obs_events.capture(ev_path):
+        r_auto = trainer.train(auto_cfg, ds)
+    tune_evs = [
+        json.loads(line)
+        for line in open(ev_path)
+        if line.strip() and json.loads(line).get("type") == "tune"
+    ]
+    cached = [
+        e for e in tune_evs
+        if e["race"] == "block_decode" and e["source"] == "cache"
+    ]
+    assert cached and cached[0]["choice"] == res.choice, (
+        f"auto did not resolve block_decode from the cache: {tune_evs}"
+    )
+    model, X = trainer.resolved_stack(auto_cfg, ds)
+    sig = tune_lib.run_shape_signature(model, X)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        tune_lib.lookup("block_decode", sig)
+    warm_s = (time.perf_counter() - t0) / 20
+    assert warm_s < 1e-3, f"warm resolution too slow: {warm_s * 1e3:.3f}ms"
+    print(
+        f"tune-smoke: auto resolved '{cached[0]['choice']}' from cache, "
+        f"warm lookup {warm_s * 1e6:.1f}us"
+    )
+
+    # 4. observation-only: fused == treewise == tuned auto, bitwise;
+    #    and the tuned run with telemetry off matches the captured one
+    r_fused = trainer.train(
+        dataclasses.replace(cfg, block_decode="fused"), ds
+    )
+    r_tree = trainer.train(
+        dataclasses.replace(cfg, block_decode="treewise"), ds
+    )
+    r_dark = trainer.train(auto_cfg, ds)
+    for name, other in (
+        ("fused", r_fused), ("treewise", r_tree), ("auto-dark", r_dark)
+    ):
+        assert all(
+            (a == b).all() for a, b in zip(_leaves(r_auto), _leaves(other))
+        ), f"tuned auto run != {name} run (must be bitwise)"
+    print("tune-smoke: fused == treewise == auto, telemetry on/off bitwise")
+
+    # 5. chaos kill mid-race: no cache file, cold re-run same verdict
+    cache_c = os.path.join(OUT, "cache_c.json")
+    if os.path.exists(cache_c):
+        os.unlink(cache_c)
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        tune_lib.ENV_PATH: cache_c,
+        chaos.CHAOS_ENV: "kill:tune_race:1",
+    })
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "erasurehead_tpu.cli", "tune",
+         "--race", "block_decode", "--rounds", "4", "--rows", "256",
+         "--cols", "32", "--reps", "2"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == chaos.KILL_EXIT, (
+        f"chaos kill did not fire: rc={proc.returncode}\n{proc.stderr}"
+    )
+    assert not os.path.exists(cache_c), (
+        "killed race left a cache file (writes must be atomic, and the "
+        "kill fires before any candidate is timed)"
+    )
+    env.pop(chaos.CHAOS_ENV)
+    proc = subprocess.run(
+        [sys.executable, "-m", "erasurehead_tpu.cli", "tune",
+         "--race", "block_decode", "--rounds", "4", "--rows", "256",
+         "--cols", "32", "--reps", "2"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, f"cold re-run failed:\n{proc.stderr}"
+    bytes_c = open(cache_c, "rb").read()
+    doc_c = json.loads(bytes_c)
+    decisions_c = {
+        k: v["choice"] for k, v in doc_c["decisions"].items()
+    }
+    assert bytes_c == tune_lib.canonical_bytes(decisions_c), (
+        "cold re-run cache is not canonically serialized"
+    )
+    assert set(decisions_c) == set(
+        json.loads(bytes_a)["decisions"]
+    ), "cold re-run decided under a different key than the in-process race"
+    (choice_c,) = decisions_c.values()
+    assert choice_c in tune_lib.TUNE_CHOICES["block_decode"], choice_c
+    print(
+        f"tune-smoke: chaos kill (rc={chaos.KILL_EXIT}) left no cache; "
+        f"cold re-run raced to a complete verdict ({choice_c}) under the "
+        f"same key"
+    )
+
+    # 6. the emitted tune events validate
+    errors = obs_events.validate_lines(open(ev_path))
+    assert not errors, f"event validation failed: {errors[:5]}"
+    print(f"tune-smoke: {len(tune_evs)} tune event(s) validate")
+
+    print("tune-smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
