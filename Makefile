@@ -45,7 +45,7 @@ RUN = $(PY) -m erasurehead_tpu.cli --workers $(N_WORKERS) \
 	telemetry-smoke sweep-batch-smoke chaos-smoke roofline-smoke \
 	serve-smoke serve-load-smoke serve-chaos-smoke adapt-smoke \
 	deep-smoke elastic-smoke whatif-smoke outofcore-smoke \
-	pipeline-smoke obs-smoke tune-smoke clean
+	pipeline-smoke obs-smoke tune-smoke fleet-smoke clean
 
 naive:            ## uncoded wait-for-all baseline (src/naive.py)
 	$(RUN) --scheme naive
@@ -133,6 +133,9 @@ serve-load-smoke: ## CPU HTTP-front load harness: closed-loop fleet, 2x-capacity
 
 serve-chaos-smoke: ## CPU restart-under-load with REAL kills: daemon dies mid-dispatch (chaos serve_dispatch), restarts, WAL replays, rows rehydrate bitwise, 0 recompiles of warm signatures (tools/serve_chaos_smoke.py)
 	JAX_PLATFORMS=cpu $(PY) tools/serve_chaos_smoke.py
+
+fleet-smoke:      ## CPU serve-fleet drill: 3 replicas + router, one replica REALLY killed mid-dispatch (chaos fleet_replica), K-streak death + WAL adoption replays bitwise on a peer, rolling deploy under 2x load with 0 lost/dup (tools/fleet_smoke.py)
+	JAX_PLATFORMS=cpu $(PY) tools/fleet_smoke.py
 
 outofcore-smoke:  ## CPU shard-store->streamed sweep->kill mid-prefetch->resume: journal rehydrates completed rows bitwise (tools/outofcore_smoke.py)
 	JAX_PLATFORMS=cpu $(PY) tools/outofcore_smoke.py

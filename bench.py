@@ -81,6 +81,12 @@ accepted-then-lost, zero duplicates, 429s retried to success on the
 daemon's retry-after schedule), goodput fairness under one flooding
 tenant (bar >= 0.5x solo), and a warm-restart phase pinning bitwise
 rehydration with zero new on-disk compile-cache entries.
+``fleet`` replicates the daemon (ISSUE 20): real subprocess replicas
+behind the consistent-hash router, measuring goodput scaling from one
+to two replicas on the same 4-tenant packable load and the
+rolling-deploy ledger — every replica bounced under load with zero
+accepted-then-lost rows, zero duplicates, and the under-deploy TTFR
+p99 against steady state.
 """
 
 import json
@@ -851,6 +857,116 @@ def _serve_load_extra() -> dict:
     }
     shutil.rmtree(base, ignore_errors=True)
     return {"serve_load": out}
+
+
+def _fleet_extra() -> dict:
+    """Replicated-serve extra (ISSUE 20): the fleet's two headline
+    figures, measured with replicas as REAL subprocesses behind the
+    consistent-hash router. (1) goodput scaling 1 -> 2 replicas on the
+    same 4-tenant packable load (distinct seeds per leg so every row is
+    a genuine dispatch, never a journal hit); (2) the rolling-deploy
+    ledger — every replica bounced under that load with zero
+    accepted-then-lost rows, zero duplicates, and the under-deploy TTFR
+    p99 against the steady-state p99 on the same bounced fleet."""
+    import shutil
+    import tempfile
+    import threading
+
+    from erasurehead_tpu.serve import loadgen
+    from erasurehead_tpu.serve.fleet import FleetSupervisor
+
+    common = dict(
+        scheme="naive", n_workers=4, n_stragglers=1, rounds=2,
+        n_rows=64, n_cols=8, lr_schedule=0.5, add_delay=True,
+        compute_mode="deduped",
+    )
+    base = tempfile.mkdtemp(prefix="eh-fleet-bench-")
+    cache_dir = os.path.join(base, "xla-cache")
+
+    def run_load(sup, seed_base, jobs_per_tenant=4, concurrency=2):
+        jobs = {
+            f"t{i}": [
+                (f"j{i}_{j}", {**common, "seed": seed_base + i * 64 + j})
+                for j in range(jobs_per_tenant)
+            ]
+            for i in range(4)
+        }
+        t0 = time.perf_counter()
+        led = loadgen.run_fleet(
+            sup.router.host, sup.router.port, jobs,
+            concurrency=concurrency, max_retries=12, timeout=600,
+        )
+        elapsed = time.perf_counter() - t0
+        rows = sum(t.get("rows", 0) for t in led["tenants"].values())
+        led["goodput_rows_per_s"] = (
+            round(rows / elapsed, 4) if elapsed > 0 else None
+        )
+        return led
+
+    def fleet(n, tag):
+        return FleetSupervisor(
+            n=n, base_dir=os.path.join(base, tag), k=3,
+            probe_interval_s=0.3, cache_dir=cache_dir,
+            extra_args=("--dispatch-workers", "1"),
+        )
+
+    out: dict = {}
+
+    # ---- leg 1: single-replica goodput (the scaling denominator) -------
+    sup1 = fleet(1, "one")
+    sup1.start()
+    try:
+        solo = run_load(sup1, seed_base=10)
+    finally:
+        sup1.stop()
+    goodput_1 = solo["goodput_rows_per_s"]
+    out["one_replica"] = {
+        "goodput_rows_per_s": goodput_1,
+        "lost": solo["lost"],
+        "duplicates": solo["duplicates"],
+    }
+
+    # ---- leg 2: two replicas — rolling deploy under load, then steady --
+    sup2 = fleet(2, "two")
+    sup2.start()
+    try:
+        ledger: dict = {}
+
+        def deploy():
+            time.sleep(1.5)  # let the load establish before draining
+            ledger.update(sup2.rolling_deploy())
+
+        t = threading.Thread(target=deploy)
+        t.start()
+        under_deploy = run_load(sup2, seed_base=1000, jobs_per_tenant=6)
+        t.join(timeout=300)
+        steady = run_load(sup2, seed_base=2000)
+    finally:
+        sup2.stop()
+    goodput_2 = steady["goodput_rows_per_s"]
+    deploy_p99 = under_deploy.get("latency_p99_s")
+    steady_p99 = steady.get("latency_p99_s")
+    out["rolling_deploy"] = {
+        "replicas_bounced": len(ledger),
+        "lost": under_deploy["lost"],
+        "duplicates": under_deploy["duplicates"],
+        "latency_p99_s": deploy_p99,
+        "steady_latency_p99_s": steady_p99,
+        "p99_deploy_over_steady": (
+            round(deploy_p99 / steady_p99, 3)
+            if deploy_p99 and steady_p99 else None
+        ),
+    }
+    out["two_replicas"] = {
+        "goodput_rows_per_s": goodput_2,
+        "goodput_scaling_1_to_2": (
+            round(goodput_2 / goodput_1, 3)
+            if goodput_1 and goodput_2 else None
+        ),
+    }
+
+    shutil.rmtree(base, ignore_errors=True)
+    return {"fleet": out}
 
 
 #: adapt extra scenario (ISSUE 8): W=4 non-iid (label-sorted) partitions,
@@ -2298,6 +2414,16 @@ def child() -> None:
         except Exception as e:  # noqa: BLE001 — extras must never kill bench
             print(f"bench: serve_load extra failed: {e}", file=sys.stderr)
 
+        # ---- fleet extra: replicated serve (real subprocess replicas
+        # behind the consistent-hash router) — goodput scaling 1 -> 2
+        # replicas and the rolling-deploy ledger (zero lost / zero dup,
+        # under-deploy p99 vs steady)
+        fleet_extra = {}
+        try:
+            fleet_extra = _fleet_extra()
+        except Exception as e:  # noqa: BLE001 — extras must never kill bench
+            print(f"bench: fleet extra failed: {e}", file=sys.stderr)
+
         # ---- adapt extra: the online straggler-adaptive controller under
         # a deterministic regime shift — controller overhead per chunk
         # (bar < 2% of run wall) and time-to-target vs every static arm
@@ -2519,6 +2645,7 @@ def child() -> None:
                 **deep_extra,
                 **serve_extra,
                 **serve_load_extra,
+                **fleet_extra,
                 **adapt_extra,
                 **elastic_extra,
                 **whatif_extra,

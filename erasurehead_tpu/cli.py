@@ -912,6 +912,14 @@ def main(argv: list[str] | None = None) -> int:
         from erasurehead_tpu.serve import server as serve_lib
 
         return serve_lib.main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # `erasurehead-tpu fleet ...` — N serve replicas behind a
+        # consistent-hash router (erasurehead_tpu/serve/fleet.py):
+        # evidential-streak membership over /healthz, WAL adoption when
+        # a replica is declared dead, zero-downtime rolling deploys
+        from erasurehead_tpu.serve import fleet as fleet_lib
+
+        return fleet_lib.main(argv[1:])
     if argv and argv[0] == "whatif":
         # `erasurehead-tpu whatif ...` — the Monte-Carlo policy-search
         # engine (erasurehead_tpu/whatif/): grid spec -> batched cohort

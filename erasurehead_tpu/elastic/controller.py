@@ -395,6 +395,92 @@ class MembershipController:
         return ctl
 
 
+class ProbeStreakDetector:
+    """The evidential-streak death rule, generalized to probe-based
+    membership over NAMED members (string ids, not worker columns).
+
+    This is the same discipline :class:`MembershipController` applies to
+    telemetry columns, lifted out for callers that watch liveness through
+    explicit probes — the serve fleet (serve/fleet.py) probing each
+    replica's ``/healthz``: a member is declared dead only after ``k``
+    CONSECUTIVE *evidential* misses. A probe is evidential only when it
+    was actually ATTEMPTED and ran its window out (connect refused, read
+    timeout, bad status); a probe the caller never made — the prober was
+    paused, the member was deliberately drained for a rolling deploy —
+    is not evidence, and leaves the streak unchanged (absence of
+    evidence is not evidence of life, and equally not of death). One
+    success resets the streak to zero. Never one timeout: ``k >= 2`` is
+    enforced, because a single miss declaring death is exactly the
+    reference's raw-timeout semantics this module exists to remove.
+    """
+
+    def __init__(self, members: Sequence[str] = (), k: int = 3):
+        if k < 2:
+            raise ValueError(
+                f"k must be >= 2, got {k} — a single evidential miss "
+                "declaring death is a raw timeout, not a streak rule"
+            )
+        self.k = int(k)
+        self._streaks: dict[str, int] = {str(m): 0 for m in members}
+        self._dead: set[str] = set()
+
+    @property
+    def members(self) -> tuple:
+        return tuple(sorted(self._streaks))
+
+    def add(self, member: str) -> None:
+        """(Re)admit a member with a clean slate — a joiner (or a
+        bounced replica re-entering the ring) starts at streak zero."""
+        m = str(member)
+        self._streaks[m] = 0
+        self._dead.discard(m)
+
+    def remove(self, member: str) -> None:
+        m = str(member)
+        self._streaks.pop(m, None)
+        self._dead.discard(m)
+
+    def observe(
+        self, member: str, ok: bool, evidential: bool = True
+    ) -> int:
+        """Feed one probe outcome; returns the member's updated streak.
+        ``ok`` resets the streak; a miss advances it only when the probe
+        was evidential (actually attempted to completion)."""
+        m = str(member)
+        if m not in self._streaks:
+            raise KeyError(f"unknown member {m!r}")
+        if ok:
+            self._streaks[m] = 0
+            self._dead.discard(m)
+        elif evidential:
+            self._streaks[m] += 1
+            if self._streaks[m] >= self.k:
+                self._dead.add(m)
+        return self._streaks[m]
+
+    def streak(self, member: str) -> int:
+        return self._streaks[str(member)]
+
+    def is_dead(self, member: str) -> bool:
+        return str(member) in self._dead
+
+    def snapshot(self) -> dict:
+        return {
+            "k": self.k,
+            "streaks": dict(self._streaks),
+            "dead": sorted(self._dead),
+        }
+
+    @classmethod
+    def restore(cls, snap: dict) -> "ProbeStreakDetector":
+        det = cls(k=int(snap["k"]))
+        det._streaks = {
+            str(m): int(s) for m, s in snap["streaks"].items()
+        }
+        det._dead = {str(m) for m in snap.get("dead", [])}
+        return det
+
+
 def auto_survivor_config(
     cfg, n_active: int, survivor_overrides: Optional[dict] = None,
     lr_schedule=None,

@@ -190,6 +190,18 @@ SCHEMA: dict[str, tuple] = {
     # error budget — > 1 means the budget is burning faster than allowed)
     "slo": ("tenant", "slo_s", "window_requests", "breaches",
             "burn_rate"),
+    # one per serve-fleet membership/deploy action (serve/fleet.py,
+    # serve/router.py, server.adopt_wal): "action" says what happened to
+    # "replica" (:data:`FLEET_ACTIONS`) — a completed health probe, a
+    # replica whose evidential miss streak is growing ("suspect" carries
+    # ``streak``/``k``), a death declared after K consecutive evidential
+    # misses, a peer adopting a dead replica's intake WAL ("adopt"
+    # carries ``records``), a rolling-deploy phase transition
+    # ("deploy_phase" carries ``phase``), a replica joining the ring, or
+    # a router failover redirect ("route" carries ``endpoint``). The
+    # fleet's decision journal: zero-downtime drills are attributable
+    # record by record.
+    "fleet": ("action", "replica"),
     # one per autotune-decision resolution (erasurehead_tpu/tune/):
     # which race's verdict resolved an auto knob, at which shape
     # signature on which device kind, and where the choice came from
@@ -241,6 +253,18 @@ WHATIF_KINDS = ("grid", "point", "surface", "rehydrate")
 #: shard-store io transaction kinds (data/store.py): a windowed read off
 #: the mmapped shards, or a store write (data/prepare.py ``--store``)
 IO_KINDS = ("shard_read", "store_write")
+
+#: serve-fleet actions (serve/fleet.py + serve/router.py): "probe" = a
+#: completed health probe (ok or evidential miss), "suspect" = a growing
+#: consecutive-miss streak short of K, "declare_dead" = the K-streak rule
+#: fired (never a single timeout), "adopt" = a peer adopted the dead
+#: replica's intake WAL, "deploy_phase" = a rolling-deploy transition,
+#: "join" = a replica (re)entered the ring, "route" = a router failover
+#: redirect away from an unreachable primary
+FLEET_ACTIONS = (
+    "probe", "suspect", "declare_dead", "adopt", "deploy_phase",
+    "join", "route",
+)
 
 #: sweep_trajectory completion statuses (train/journal.py); "diverged"
 #: rows are quarantined, not retried — divergence is deterministic under
@@ -611,7 +635,12 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
     non-negative WAL-replay counts); ``membership`` records carry a
     non-negative round, a known action (:data:`MEMBERSHIP_ACTIONS`), a
     positive worker count and — when present — a list of non-negative
-    worker ids; ``whatif`` records carry a non-empty ``spec_hash`` and a
+    worker ids; ``fleet`` records carry a known action
+    (:data:`FLEET_ACTIONS`), a non-empty replica name, non-negative
+    streak/k/records counts when present, and ``declare_dead`` must
+    carry ``streak >= k`` (a death declared on fewer than K consecutive
+    evidential misses is a schema error, not a policy choice);
+    ``whatif`` records carry a non-empty ``spec_hash`` and a
     known ``kind`` (:data:`WHATIF_KINDS`), point records a non-empty
     label and a bool feasibility verdict, grid records non-negative point
     counts; ``prefetch`` records carry a non-negative window index and
@@ -877,6 +906,40 @@ def validate_lines(lines: Iterable[str]) -> list[str]:
                     f"line {i}: membership workers must be a list of "
                     f"non-negative worker ids, got {workers!r}"
                 )
+        if rtype == "fleet":
+            action = rec.get("action")
+            if action not in FLEET_ACTIONS:
+                errors.append(
+                    f"line {i}: fleet action must be one of "
+                    f"{FLEET_ACTIONS}, got {action!r}"
+                )
+            replica = rec.get("replica")
+            if not isinstance(replica, str) or not replica:
+                errors.append(
+                    f"line {i}: fleet replica must be a non-empty "
+                    f"string, got {replica!r}"
+                )
+            for field in ("streak", "k", "records", "replayed"):
+                v = rec.get(field)
+                if v is not None and (
+                    not isinstance(v, int) or v < 0
+                ):
+                    errors.append(
+                        f"line {i}: fleet {field} must be a non-negative "
+                        f"int, got {v!r}"
+                    )
+            if action == "declare_dead":
+                streak, k = rec.get("streak"), rec.get("k")
+                if (
+                    isinstance(streak, int)
+                    and isinstance(k, int)
+                    and streak < k
+                ):
+                    errors.append(
+                        f"line {i}: fleet declare_dead with streak "
+                        f"{streak} < k {k} — death must follow K "
+                        "consecutive evidential misses, never fewer"
+                    )
         if rtype == "whatif":
             kind = rec.get("kind")
             if kind not in WHATIF_KINDS:

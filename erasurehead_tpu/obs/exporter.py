@@ -142,6 +142,34 @@ def render_prometheus(
     return "\n".join(lines) + "\n"
 
 
+def fleet_gauges(view: dict) -> dict:
+    """The serve fleet's gauge plane from a router membership view
+    (serve/router.FleetRouter.fleet_view): how many replicas are
+    routable vs merely known, how many proxies had to leave their
+    primary, how many dead peers' WALs were adopted, and each live
+    replica's admission pressure — series keys ready for
+    :func:`render_prometheus`."""
+    replicas = view.get("replicas") or {}
+    out = {
+        prom_key("fleet_replicas_live"): sum(
+            1 for r in replicas.values() if r.get("alive")
+        ),
+        prom_key("fleet_replicas_known"): len(replicas),
+        prom_key("fleet_router_redirects_total"): int(
+            view.get("redirects_total") or 0
+        ),
+        prom_key("fleet_adoptions_total"): int(
+            view.get("adoptions_total") or 0
+        ),
+    }
+    for name, r in sorted(replicas.items()):
+        if r.get("pressure") is not None:
+            out[prom_key("fleet_replica_pressure", replica=name)] = (
+                float(r["pressure"])
+            )
+    return out
+
+
 # ---------------------------------------------------------------------------
 # SLO tracking: per-tenant time-to-last-row burn rate
 

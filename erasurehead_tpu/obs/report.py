@@ -38,6 +38,7 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
     trajectories: list = []
     adapt: list = []
     membership: list = []
+    fleet: list = []
     io: list = []
     regime: list = []
     slo: list = []
@@ -93,6 +94,8 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
                     adapt.append(rec)
                 elif rtype == "membership":
                     membership.append(rec)
+                elif rtype == "fleet":
+                    fleet.append(rec)
                 elif rtype == "request":
                     serve["requests"].append(rec)
                 elif rtype == "pack":
@@ -125,14 +128,14 @@ def load_runs(paths: Sequence[str]) -> list[dict]:
                     tune.append(rec)
     out = [runs[rid] for rid in order]
     if (
-        warnings or trajectories or adapt or membership or io
+        warnings or trajectories or adapt or membership or fleet or io
         or regime or slo or tune or any(serve.values())
     ):
         out.append({
             "run_id": None, "warnings": warnings,
             "trajectories": trajectories, "serve": serve,
-            "adapt": adapt, "membership": membership, "io": io,
-            "regime": regime, "slo": slo, "tune": tune,
+            "adapt": adapt, "membership": membership, "fleet": fleet,
+            "io": io, "regime": regime, "slo": slo, "tune": tune,
         })
     return out
 
@@ -214,6 +217,54 @@ def _membership_section(stray: list) -> list[str]:
             f"sim={_fmt(r.get('sim_time'), '.3f'):>8s} "
             f"decode_err={_fmt(r.get('decode_error_mean'), '.6f')}"
             + (f" arm={arm}" if arm else "")
+        )
+    return lines
+
+
+def _fleet_section(stray: list) -> list[str]:
+    """The serve-fleet section: the fleet's membership and deploy
+    timeline — joins, probe-miss streaks, deaths declared (with the
+    evidential streak that earned them), WAL adoptions (and how many
+    acceptances each replayed), routing redirects, and the deploy
+    phases of each rolling bounce — from the typed `fleet` events
+    (serve/fleet.py, serve/router.py)."""
+    recs: list = []
+    for g in stray:
+        recs.extend(g.get("fleet", []))
+    if not recs:
+        return []
+    by = {a: [r for r in recs if r.get("action") == a]
+          for a in ("join", "suspect", "declare_dead", "adopt",
+                    "route", "deploy_phase")}
+    replayed = sum(int(r.get("records") or 0) for r in by["adopt"])
+    lines = [
+        f"\nserve fleet: {len(by['join'])} join(s), "
+        f"{len(by['declare_dead'])} death(s) declared, "
+        f"{len(by['adopt'])} adoption(s)"
+        + (f" ({replayed} acceptance(s) replayed)" if by["adopt"]
+           else "")
+        + (f", {len(by['route'])} redirect(s)" if by["route"] else "")
+    ]
+    for r in recs:
+        action = r.get("action", "?")
+        if action == "probe":
+            continue  # per-probe records are too chatty for the table
+        detail = ""
+        if action in ("suspect", "declare_dead"):
+            detail = f" streak={r.get('streak', '?')}/{r.get('k', '?')}"
+        elif action == "adopt":
+            detail = (
+                f" records={r.get('records', '?')}"
+                + (f" adopter={r['adopter']}" if r.get("adopter")
+                   else "")
+            )
+        elif action == "deploy_phase":
+            detail = f" phase={r.get('phase', '?')}"
+        elif action == "route":
+            detail = f" hop={r.get('hop', '?')}"
+        lines.append(
+            f"  {action:13s} {str(r.get('replica', '?'))[:16]:16s}"
+            f"{detail}"
         )
     return lines
 
@@ -566,6 +617,7 @@ def render(paths: Sequence[str]) -> str:
     lines.extend(_tune_section(stray))
     lines.extend(_adapt_section(stray))
     lines.extend(_membership_section(stray))
+    lines.extend(_fleet_section(stray))
     # serve rows (tenant-tagged) render in the serving section above; the
     # journal listing keeps the local-sweep rows
     trajectories = [

@@ -54,11 +54,32 @@ from erasurehead_tpu.serve.queue import (
     ServeResult,
     config_from_payload,
 )
+from erasurehead_tpu.serve.wal import WalAdoptionError
 
 #: default bound on one stream connection's outbox (result lines queued
 #: for a reader that hasn't drained them); beyond it rows are shed —
 #: drop-and-journal, never block the dispatch pool
 DEFAULT_OUTBOX_LIMIT = 256
+
+
+def healthz_answers(hostport: str, timeout: float = 1.0) -> bool:
+    """One /healthz probe of ``"host:port"``: True iff the daemon
+    answered 200 within ``timeout``. The adoption guard (POST /v1/adopt
+    with an ``owner``) and the fleet supervisor's membership probes both
+    ride this — a refused connection, a timeout, or a non-200 all read
+    as "did not answer", never as an exception."""
+    import http.client
+
+    host, port = parse_hostport(hostport)
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        try:
+            conn.request("GET", "/healthz")
+            return conn.getresponse().status == 200
+        finally:
+            conn.close()
+    except OSError:
+        return False
 
 
 def parse_hostport(spec: str) -> tuple[str, int]:
@@ -255,6 +276,9 @@ class HttpFront:
                 return tenant
 
             def do_POST(self):  # noqa: N802 — http.server API
+                if self.path == "/v1/adopt":
+                    self._adopt()
+                    return
                 if self.path != "/v1/submit":
                     self._reply(404, {"type": "error",
                                       "message": f"no route {self.path}"})
@@ -305,25 +329,76 @@ class HttpFront:
                      "eta_s": handle.eta_s},
                 )
 
+            def _adopt(self) -> None:
+                """``POST /v1/adopt`` — fleet seam (serve/fleet.py): the
+                supervisor asks THIS replica to adopt a declared-dead
+                peer's intake WAL. Body: ``{"path": <wal path>,
+                "replica": <dead peer's name>, "owner": <"host:port" or
+                null>}``. When ``owner`` is given, the adoption re-probes
+                the owner's /healthz first and refuses if it answers —
+                the final guard against adopting a live daemon's working
+                set. 409 on refusal (already adopted / owner alive)."""
+                if self._tenant() is None:
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    msg = json.loads(self.rfile.read(n) or b"{}")
+                    path = msg.get("path")
+                    if not isinstance(path, str) or not path:
+                        raise ValueError("adopt body wants a WAL 'path'")
+                    owner = msg.get("owner")
+                    owner_alive = (
+                        (lambda: healthz_answers(owner))
+                        if owner
+                        else None
+                    )
+                    out = front.server.adopt_wal(
+                        path,
+                        owner_alive=owner_alive,
+                        dead_replica=str(
+                            msg.get("replica") or "unknown"
+                        ),
+                    )
+                except WalAdoptionError as e:
+                    self._reply(
+                        409,
+                        {"type": "refused", "message": str(e)},
+                    )
+                    return
+                except Exception as e:  # noqa: BLE001 — per-request
+                    self._reply(
+                        400,
+                        {"type": "error",
+                         "message": f"{type(e).__name__}: {e}"},
+                    )
+                    return
+                self._reply(202, {"type": "adopted", **out})
+
             def do_GET(self):  # noqa: N802 — http.server API
                 path, _, query = self.path.partition("?")
                 if path == "/healthz":
                     with front.server._state_lock:
                         in_flight = front.server._in_flight
-                    self._reply(
-                        200,
-                        {
-                            "status": "ok",
-                            "queued": front.server.queued_depth(),
-                            "in_flight": in_flight,
-                            "admission": (
-                                front.server.admission.pressure()
-                            ),
-                            "uptime_s": round(
-                                time.monotonic() - front._started, 3
-                            ),
-                        },
-                    )
+                    body = {
+                        "status": "ok",
+                        "queued": front.server.queued_depth(),
+                        "in_flight": in_flight,
+                        "admission": (
+                            front.server.admission.pressure()
+                        ),
+                        "uptime_s": round(
+                            time.monotonic() - front._started, 3
+                        ),
+                    }
+                    # fleet gossip: who this replica is, where its WAL
+                    # lives (the path a peer adopts on death), and how
+                    # many peers' WALs it has adopted so far
+                    if front.server.replica_name is not None:
+                        body["replica"] = front.server.replica_name
+                    if front.server.wal is not None:
+                        body["wal_path"] = front.server.wal.path
+                    body["adoptions"] = front.server.adoptions_total
+                    self._reply(200, body)
                     return
                 if path == "/metrics":
                     # the scrape surface: SLO windows are re-scored on

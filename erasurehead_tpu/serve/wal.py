@@ -24,6 +24,14 @@ everything that arrived over a socket is covered.
 The WAL is append-only and never compacted in-place: replay cost is one
 JSON parse per acceptance since the journal directory was created, and
 rotating the directory rotates the WAL with the journals it indexes.
+
+Fleet adoption (:meth:`IntakeWAL.adopt`): when a serve-fleet replica is
+declared DEAD (the K-consecutive-evidential-miss rule, serve/fleet.py),
+a designated peer adopts its WAL — locking it with an O_EXCL sentinel so
+the double-adoption race has exactly one winner, refusing a WAL whose
+owner still answers /healthz, and deduplicating against the adopter's
+own acceptances by request_digest. Accepted-never-lost thereby survives
+daemon *death*, not just daemon restart.
 """
 
 from __future__ import annotations
@@ -37,6 +45,17 @@ from erasurehead_tpu.obs import events as events_lib
 
 #: WAL file name inside the serve journal directory
 WAL_NAME = "intake_wal.jsonl"
+
+#: sentinel written beside an adopted WAL (O_EXCL): exactly one peer may
+#: ever adopt a dead replica's acceptances — the loser of the race gets
+#: :class:`WalAdoptionError`, not a duplicate replay
+ADOPT_SENTINEL_SUFFIX = ".adopted"
+
+
+class WalAdoptionError(RuntimeError):
+    """Adoption refused: the WAL is already adopted (sentinel exists) or
+    its owner still answers /healthz (adopting a live daemon's WAL would
+    double-dispatch its working set)."""
 
 
 class IntakeWAL:
@@ -58,24 +77,7 @@ class IntakeWAL:
                 self._seen.add(rec["digest"])
 
     def _read(self) -> list[dict]:
-        records: list[dict] = []
-        with open(self.path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue  # torn final line from a kill mid-write
-                if (
-                    isinstance(rec, dict)
-                    and rec.get("type") == "request"
-                    and isinstance(rec.get("digest"), str)
-                    and isinstance(rec.get("config"), dict)
-                ):
-                    records.append(rec)
-        return records
+        return read_records(self.path)
 
     def __len__(self) -> int:
         return len(self._seen)
@@ -121,19 +123,107 @@ class IntakeWAL:
     def replay(self) -> list[dict]:
         """The deduped working set: one record per digest, last
         acceptance wins, in first-acceptance order."""
-        if not os.path.exists(self.path):
+        return dedup_records(read_records(self.path))
+
+    def adopt(self, path: str, *, owner_alive=None) -> list[dict]:
+        """Adopt a DEAD peer's WAL at ``path``: lock it (O_EXCL sentinel
+        beside the WAL file), read its deduped working set, and return
+        the records whose digests this WAL has not itself accepted —
+        the adopter resubmits those through its normal intake, which
+        WALs them again locally (so the acceptances now survive the
+        adopter's own death too).
+
+        ``replay()`` assumes the WAL belongs to the live process; this
+        is the explicit cross-process entry point, and it refuses two
+        ways a naive replay would double-dispatch:
+
+          - ``owner_alive`` (a callable; e.g. a /healthz probe of the
+            owner) returning True — adopting a live daemon's WAL would
+            re-dispatch its in-flight working set;
+          - a sentinel already present — exactly one peer wins the
+            adoption race; the loser raises instead of replaying the
+            same acceptances a second time.
+        """
+        src = os.path.abspath(path)
+        if src == os.path.abspath(self.path):
+            raise WalAdoptionError(
+                f"a WAL cannot adopt itself ({src}); adoption is the "
+                "cross-replica entry point — same-process restarts use "
+                "replay()"
+            )
+        if owner_alive is not None and owner_alive():
+            raise WalAdoptionError(
+                f"refusing to adopt {src}: its owner still answers "
+                "/healthz — adoption is for DEAD replicas (declared by "
+                "the K-streak rule), not slow ones"
+            )
+        sentinel = src + ADOPT_SENTINEL_SUFFIX
+        try:
+            fd = os.open(
+                sentinel, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644
+            )
+        except FileExistsError:
+            raise WalAdoptionError(
+                f"{src} is already adopted (sentinel {sentinel} "
+                "exists): exactly one peer replays a dead replica's "
+                "acceptances"
+            ) from None
+        with os.fdopen(fd, "w") as f:
+            json.dump({"adopter_wal": os.path.abspath(self.path)}, f)
+            f.write("\n")
+        if not os.path.exists(src):
             return []
-        by_digest: dict[str, dict] = {}
-        order: list[str] = []
-        for rec in self._read():
-            d = rec["digest"]
-            if d not in by_digest:
-                order.append(d)
-            by_digest[d] = rec
-        return [by_digest[d] for d in order]
+        with self._lock:
+            seen = set(self._seen)
+        return [
+            rec
+            for rec in dedup_records(read_records(src))
+            if rec["digest"] not in seen
+        ]
 
     def close(self) -> None:
         with self._lock:
             if self._logger is not None:
                 self._logger.close()
                 self._logger = None
+
+
+def read_records(path: str) -> list[dict]:
+    """Every well-formed acceptance record in a WAL file, in file order
+    (tolerating a torn final line from a kill mid-write). Module-level so
+    adoption can read a DEAD peer's WAL without constructing an
+    :class:`IntakeWAL` over its directory (which would open a writer seam
+    on a file the owner may still hold)."""
+    if not os.path.exists(path):
+        return []
+    records: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn final line from a kill mid-write
+            if (
+                isinstance(rec, dict)
+                and rec.get("type") == "request"
+                and isinstance(rec.get("digest"), str)
+                and isinstance(rec.get("config"), dict)
+            ):
+                records.append(rec)
+    return records
+
+
+def dedup_records(records: list[dict]) -> list[dict]:
+    """One record per digest, last acceptance wins, first-acceptance
+    order — the replay/adoption working-set view of a raw record list."""
+    by_digest: dict[str, dict] = {}
+    order: list[str] = []
+    for rec in records:
+        d = rec["digest"]
+        if d not in by_digest:
+            order.append(d)
+        by_digest[d] = rec
+    return [by_digest[d] for d in order]

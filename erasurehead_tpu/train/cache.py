@@ -163,6 +163,19 @@ def drop_data_cache() -> int:
 # as bitwise-safe as an in-process hit.
 
 
+#: directory of the process's persistent XLA compilation cache, once
+#: enabled (None = never enabled). Read by trainer._resolve_donate:
+#: donation must not combine with possibly-deserialized executables.
+_PERSISTENT_CACHE_DIR: str | None = None
+
+
+def persistent_compilation_cache_dir() -> str | None:
+    """The persistent compilation cache directory this process routes
+    compiles through, or None if :func:`enable_persistent_compilation_cache`
+    was never called."""
+    return _PERSISTENT_CACHE_DIR
+
+
 def enable_persistent_compilation_cache(directory: str) -> str:
     """Route this process's XLA compiles through JAX's on-disk
     compilation cache at ``directory`` (created if absent). Thresholds
@@ -171,6 +184,8 @@ def enable_persistent_compilation_cache(directory: str) -> str:
     Returns the directory."""
     import jax
 
+    global _PERSISTENT_CACHE_DIR
+    _PERSISTENT_CACHE_DIR = directory
     os.makedirs(directory, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", directory)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)

@@ -466,6 +466,21 @@ def _resolve_donate(cfg: RunConfig) -> bool:
         return True
     if cfg.donate == "off":
         return False
+    from erasurehead_tpu.train import cache as cache_lib
+
+    if cache_lib.persistent_compilation_cache_dir() is not None:
+        # A donating executable DESERIALIZED from the persistent
+        # compilation cache returns a carry whose jax-level alias points
+        # at the donated input buffer while the actual output landed
+        # elsewhere: reads see stale initial values or freed memory
+        # (observed as NaN final params with a bitwise-correct history,
+        # false-positiving the divergence quarantine in warm-cache serve
+        # replicas). "auto" therefore resolves to no-donation whenever
+        # this process routes compiles through the on-disk cache; the
+        # explicit "on" above remains forceable. Donation is in the
+        # executable signature, so cache entries stay consistent across
+        # every daemon sharing the directory.
+        return False
     return DONATE_DEFAULT
 
 

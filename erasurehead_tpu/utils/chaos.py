@@ -85,6 +85,12 @@ SITES = (
     # the row is journaled but before the reply is delivered (the client
     # must be able to re-fetch by resubmitting)
     "serve_intake", "serve_dispatch", "serve_reply",
+    # serve-fleet replica death (serve/server.py _run_cohort, fired just
+    # before serve_dispatch): a kill here takes down ONE replica of a
+    # fleet mid-dispatch — accepted + WAL'd, rows not yet journaled —
+    # and the drill (tools/fleet_smoke.py) proves a peer adopts the dead
+    # replica's intake WAL and replays its accepted rows bitwise
+    "fleet_replica",
     # out-of-core streaming (data/prefetch.py): fires once per staged
     # partition window, BEFORE the shard read — a kill there is a
     # mid-epoch preemption of a streamed run (tools/outofcore_smoke.py

@@ -1,0 +1,13 @@
+"""event-schema violations against the fleet records (serve/fleet.py,
+serve/router.py): a ``fleet`` emit missing its action, one missing the
+replica it concerns, and a logger-object emit missing both — the
+contracts the supervisor's probe/death/adoption/deploy telemetry must
+satisfy for `make fleet-smoke`'s validation leg to mean anything."""
+
+from erasurehead_tpu.obs import events as events_lib
+
+
+def emit_fleet(logger):
+    events_lib.emit("fleet", replica="r0")  # missing action
+    events_lib.emit("fleet", action="suspect")  # missing replica
+    logger.emit("fleet", streak=3)  # missing action AND replica

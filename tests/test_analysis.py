@@ -122,6 +122,19 @@ def test_schema_serve_fixture():
     assert len(findings) == 3
 
 
+def test_schema_fleet_fixture():
+    """The fleet records (probe/suspect/declare_dead/adopt/deploy_phase)
+    are lint-enforced like every other type: emits missing required
+    fields are findings — a drifted death-declaration or adoption emit
+    fails `erasurehead-tpu lint`, not the first replica kill in
+    production."""
+    findings = _unsup(_lint(_fx("schema_fleet_bad.py")), "event-schema")
+    msgs = "\n".join(f.message for f in findings)
+    assert "action" in msgs
+    assert "replica" in msgs
+    assert len(findings) == 3  # the logger-object emit is checked too
+
+
 def test_schema_io_fixture():
     """The out-of-core records (prefetch/io) are lint-enforced like
     every other type: emits missing required fields are findings — a

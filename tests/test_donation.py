@@ -108,6 +108,26 @@ def test_donation_is_bitwise_invisible(gmm):
     assert auto.cache_info["donation"] is trainer.DONATE_DEFAULT
 
 
+def test_auto_donation_off_under_persistent_compile_cache():
+    """A donating executable deserialized from the persistent compilation
+    cache returns a carry whose jax-level alias points at the donated
+    input while the real output landed elsewhere — stale or freed memory
+    (the warm-cache serve-replica divergence false-positive). "auto" must
+    resolve to no-donation whenever the process routes compiles through
+    the on-disk cache; explicit "on" stays forceable."""
+    from erasurehead_tpu.train import cache as cache_lib
+
+    prev = cache_lib._PERSISTENT_CACHE_DIR
+    cache_lib._PERSISTENT_CACHE_DIR = "/tmp/somewhere"
+    try:
+        assert trainer._resolve_donate(_cfg()) is False
+        assert trainer._resolve_donate(_cfg(donate="on")) is True
+        assert trainer._resolve_donate(_cfg(donate="off")) is False
+    finally:
+        cache_lib._PERSISTENT_CACHE_DIR = prev
+    assert trainer._resolve_donate(_cfg()) is trainer.DONATE_DEFAULT
+
+
 def test_donation_checkpoint_chunked_path(gmm, tmp_path):
     """The chunked scan (checkpoint_every) re-slices the weight table per
     chunk; with donation on, consumed chunk slices must never strand a

@@ -88,6 +88,9 @@ def restore_jax_compile_cache():
     )
 
     cc.reset_cache()
+    # also un-latch the repo-side marker, or every later test in this
+    # process resolves donate="auto" to off (trainer._resolve_donate)
+    cache._PERSISTENT_CACHE_DIR = None
 
 
 def _cfg(**kw):

@@ -1,0 +1,730 @@
+"""Serve-fleet contracts (serve/router.py, serve/fleet.py, serve/wal.py
+adoption, the clients' endpoint-list failover): the pieces `make
+fleet-smoke` drives end-to-end, pinned at unit scale —
+
+  - consistent hashing: minimal remap on membership change (every moved
+    key moves TO the new member, and only ~1/N of the space moves),
+    deterministic failover ring order;
+  - (tenant, cohort_signature) affinity: packable load never splits a
+    tenant's cohort across replicas;
+  - client failover: deterministic rotation order, per-endpoint
+    Retry-After embargo, and no duplicate submit when failing over;
+  - WAL adoption: O_EXCL sentinel race (exactly one winner), owner-alive
+    refusal, digest dedup;
+  - evidential-streak death: a replica is declared dead after K
+    consecutive evidential misses, NEVER fewer, and the fleet event
+    validator refuses a declare_dead record that claims otherwise.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from erasurehead_tpu.elastic.controller import ProbeStreakDetector
+from erasurehead_tpu.obs import events as events_lib
+from erasurehead_tpu.obs.metrics import REGISTRY as _METRICS
+from erasurehead_tpu.serve.client import (
+    HttpServeClient,
+    ServeClient,
+    ServeUnavailableError,
+    _normalize_endpoints,
+)
+from erasurehead_tpu.serve.router import (
+    VNODES,
+    FleetRouter,
+    HashRing,
+    affinity_key,
+)
+from erasurehead_tpu.serve.wal import (
+    ADOPT_SENTINEL_SUFFIX,
+    IntakeWAL,
+    WalAdoptionError,
+)
+
+CFG = {
+    "scheme": "naive", "n_workers": 4, "n_stragglers": 1, "rounds": 2,
+    "n_rows": 64, "n_cols": 8, "lr_schedule": 0.5,
+    "compute_mode": "deduped",
+}
+
+
+# ---- consistent hashing --------------------------------------------------
+
+
+def _keys(n=1000):
+    return [f"tenant{i % 7}:key{i}" for i in range(n)]
+
+
+def test_ring_minimal_remap_on_add():
+    """Adding a 4th member to a 3-member ring moves ~1/4 of the key
+    space — never the wholesale reshuffle a modulo hash would do — and
+    every key that moves, moves TO the new member (consistency: no key
+    swaps between two surviving members)."""
+    before = HashRing(["r0", "r1", "r2"])
+    after = HashRing(["r0", "r1", "r2", "r3"])
+    keys = _keys()
+    moved = [
+        k for k in keys if before.lookup(k) != after.lookup(k)
+    ]
+    frac = len(moved) / len(keys)
+    # ideal 0.25; VNODES=64 keeps the share smooth
+    assert 0.10 <= frac <= 0.40, f"remap fraction {frac}"
+    assert all(after.lookup(k) == "r3" for k in moved), (
+        "a moved key landed on a SURVIVING member — not consistent "
+        "hashing"
+    )
+
+
+def test_ring_minimal_remap_on_remove():
+    """Removing a member re-homes ONLY its keys; everyone else's
+    assignment is untouched (what makes a deploy bounce flush one
+    replica's cache, not all of them)."""
+    before = HashRing(["r0", "r1", "r2"])
+    after = HashRing(["r0", "r2"])
+    for k in _keys():
+        owner = before.lookup(k)
+        if owner != "r1":
+            assert after.lookup(k) == owner
+        else:
+            assert after.lookup(k) in ("r0", "r2")
+
+
+def test_ring_order_deterministic_failover():
+    """ring_order(key) is the failover sequence: starts at lookup(key),
+    contains every member exactly once, and is identical across
+    independently-built rings (every client/supervisor walks the SAME
+    ring)."""
+    a = HashRing(["r0", "r1", "r2"])
+    b = HashRing(["r2", "r0", "r1"])  # insertion order must not matter
+    for k in _keys(64):
+        order = a.ring_order(k)
+        assert order[0] == a.lookup(k)
+        assert sorted(order) == ["r0", "r1", "r2"]
+        assert b.ring_order(k) == order
+
+
+def test_ring_vnodes_spread():
+    """VNODES keeps member shares smooth: with 3 members no member owns
+    more than half the key space."""
+    ring = HashRing(["r0", "r1", "r2"], vnodes=VNODES)
+    keys = _keys()
+    counts = {}
+    for k in keys:
+        counts[ring.lookup(k)] = counts.get(ring.lookup(k), 0) + 1
+    assert max(counts.values()) / len(keys) < 0.5, counts
+
+
+def test_affinity_zero_cross_replica_cohort_splits():
+    """The ISSUE's packable-load pin: 4 tenants, each submitting
+    same-signature configs (seed is NOT in the cohort signature), on a
+    2-replica ring — every tenant's whole cohort routes to ONE replica.
+    A split cohort would halve packing efficiency exactly where the
+    daemon is supposed to amortize dispatches."""
+    ring = HashRing(["r0", "r1"])
+    for tenant in ("t0", "t1", "t2", "t3"):
+        owners = {
+            ring.lookup(affinity_key(tenant, {**CFG, "seed": s}))
+            for s in range(8)
+        }
+        assert len(owners) == 1, (
+            f"tenant {tenant} cohort split across {owners}"
+        )
+
+
+def test_affinity_key_falls_back_to_tenant():
+    """A payload that cannot resolve to a config still routes (by tenant
+    alone) — the router must never 500 on a routing key."""
+    good = affinity_key("alice", {**CFG, "seed": 0})
+    bad = affinity_key("alice", {"scheme": "no-such-scheme"})
+    assert json.loads(bad)[0] == "alice"
+    assert good != bad  # the signature really participates
+
+
+# ---- ServeClient endpoint-list failover ----------------------------------
+
+
+class _FakeDaemon:
+    """Minimal line-protocol daemon on a unix socket: replies 'accepted'
+    (or 'rejected' with a retry_after quote) and records every submit
+    line it saw."""
+
+    def __init__(self, path, reply="accepted", retry_after=1.5):
+        self.path = path
+        self.reply = reply
+        self.retry_after = retry_after
+        self.seen = []
+        self._conns = []
+        self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._srv.bind(path)
+        self._srv.listen(8)
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn):
+        buf = b""
+        with conn:
+            while True:
+                try:
+                    chunk = conn.recv(1 << 16)
+                except OSError:
+                    return
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    raw, buf = buf.split(b"\n", 1)
+                    if not raw.strip():
+                        continue
+                    msg = json.loads(raw)
+                    self.seen.append(msg)
+                    if self.reply == "accepted":
+                        out = {
+                            "type": "accepted",
+                            "request_id": f"rid-{len(self.seen)}",
+                        }
+                    else:
+                        out = {
+                            "type": "rejected",
+                            "retry_after_s": self.retry_after,
+                        }
+                    try:
+                        conn.sendall(
+                            (json.dumps(out) + "\n").encode()
+                        )
+                    except OSError:
+                        return
+
+    def close(self):
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for conn in self._conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def test_serve_client_single_path_back_compat(tmp_path):
+    """A plain string path keeps the historical single-endpoint
+    behavior; `.path` names it."""
+    p = str(tmp_path / "a.sock")
+    d = _FakeDaemon(p)
+    try:
+        c = ServeClient(p, timeout=5.0)
+        assert c.paths == [p] and c.path == p
+        rid = c.submit("alice", "j", CFG)
+        assert rid and len(d.seen) == 1
+        c.close()
+    finally:
+        d.close()
+
+
+def test_serve_client_rotation_order_and_no_duplicate_submit(tmp_path):
+    """Endpoint-list failover: with the first endpoint dead, the client
+    rotates to the NEXT in list order (deterministic), the submission is
+    delivered exactly once (no duplicate submit: only unacknowledged
+    sends re-send), and failovers_total counts the rotation."""
+    dead = str(tmp_path / "dead.sock")  # never bound
+    live = str(tmp_path / "live.sock")
+    d = _FakeDaemon(live)
+    try:
+        c = ServeClient([dead, live], timeout=5.0)
+        # _connect already walked past the dead endpoint
+        assert c.path == live
+        rid = c.submit("alice", "j", CFG)
+        assert rid
+        assert [m["label"] for m in d.seen] == ["j"]  # exactly once
+        c.close()
+    finally:
+        d.close()
+
+
+def test_serve_client_failover_mid_session(tmp_path):
+    """A daemon dying BETWEEN submits: the next submit fails over to the
+    peer and is delivered exactly once there."""
+    a = str(tmp_path / "a.sock")
+    b = str(tmp_path / "b.sock")
+    da, db = _FakeDaemon(a), _FakeDaemon(b)
+    try:
+        c = ServeClient([a, b], timeout=5.0)
+        assert c.submit("alice", "one", CFG)
+        da.close()
+        os.unlink(a)
+        time.sleep(0.05)
+        assert c.submit("alice", "two", CFG)
+        assert c.failovers_total >= 1
+        assert [m["label"] for m in da.seen] == ["one"]
+        assert [m["label"] for m in db.seen] == ["two"]
+        c.close()
+    finally:
+        da.close()
+        db.close()
+
+
+def test_serve_client_all_endpoints_down_raises(tmp_path):
+    with pytest.raises(ServeUnavailableError):
+        ServeClient(
+            [str(tmp_path / "x.sock"), str(tmp_path / "y.sock")],
+            timeout=1.0,
+        )
+
+
+def test_serve_client_embargo_deprioritizes_rejecting_endpoint(tmp_path):
+    """A 429 quote embargoes THAT endpoint: the failover walk tries
+    un-embargoed peers first, so one overloaded replica never stalls
+    submission to its peers."""
+    busy = str(tmp_path / "busy.sock")
+    calm = str(tmp_path / "calm.sock")
+    d_busy = _FakeDaemon(busy, reply="rejected", retry_after=60.0)
+    d_calm = _FakeDaemon(calm)
+    try:
+        c = ServeClient([busy, calm], timeout=5.0)
+        # first submit eats the 429 from `busy` and embargoes it …
+        with pytest.raises(Exception):
+            c.submit("alice", "j0", CFG, max_retries=0)
+        assert c._not_before.get(busy, 0.0) > time.monotonic()
+        # … so a reconnect walk prefers `calm` even though `busy` is
+        # earlier in list order
+        c._idx = 0
+        c._connect()
+        assert c.path == calm
+        assert c.submit("alice", "j1", CFG)
+        assert [m["label"] for m in d_calm.seen] == ["j1"]
+        c.close()
+    finally:
+        d_busy.close()
+        d_calm.close()
+
+
+# ---- HttpServeClient endpoint lists --------------------------------------
+
+
+def test_normalize_endpoints_forms():
+    assert _normalize_endpoints("h", 1, None) == [("h", 1)]
+    assert _normalize_endpoints(None, None, [("a", 1), ("b", 2)]) == [
+        ("a", 1), ("b", 2),
+    ]
+    assert _normalize_endpoints(None, None, ["a:1", "b:2"]) == [
+        ("a", 1), ("b", 2),
+    ]
+    # host-as-list is the endpoints form too
+    assert _normalize_endpoints(["a:1"], None, None) == [("a", 1)]
+    with pytest.raises(ValueError):
+        _normalize_endpoints(None, None, [])
+    with pytest.raises(ValueError):
+        _normalize_endpoints(None, None, None)
+
+
+class _FakeHttpFront:
+    """Counts /v1/submit POSTs; can answer 202 or 429+Retry-After."""
+
+    def __init__(self, status=202, retry_after=30.0):
+        import http.server
+
+        front = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n) or b"{}")
+                front.seen.append(body)
+                if front.status == 202:
+                    out = json.dumps(
+                        {"type": "accepted",
+                         "request_id": f"rid-{len(front.seen)}"}
+                    ).encode()
+                    self.send_response(202)
+                else:
+                    out = json.dumps(
+                        {"type": "rejected",
+                         "retry_after_s": front.retry_after}
+                    ).encode()
+                    self.send_response(429)
+                    self.send_header(
+                        "Retry-After", str(int(front.retry_after))
+                    )
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def do_GET(self):  # /v1/stream — hold the stream open
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.status = status
+        self.retry_after = retry_after
+        self.seen = []
+        self._srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self._srv.server_address[1]
+        threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        ).start()
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def test_http_client_failover_rotation_no_duplicate():
+    """First endpoint dead -> the submit rotates to the live peer in
+    list order and is delivered exactly once; failovers_total pins the
+    rotation count."""
+    live = _FakeHttpFront()
+    # a dead endpoint: bind-then-close leaves a refused port
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    try:
+        c = HttpServeClient(
+            tenant="alice",
+            endpoints=[("127.0.0.1", dead_port),
+                       ("127.0.0.1", live.port)],
+        )
+        rid = c.submit("j", CFG)
+        assert rid
+        assert [m["label"] for m in live.seen] == ["j"]
+        assert c.failovers_total == 1
+        c.close()
+    finally:
+        live.close()
+
+
+def test_http_client_per_endpoint_retry_after_embargo():
+    """A 429 from one endpoint embargoes only that endpoint: the same
+    pass continues to the peer, which accepts — no sleep, no global
+    stall, and the busy endpoint's quote is remembered."""
+    busy = _FakeHttpFront(status=429, retry_after=60.0)
+    calm = _FakeHttpFront()
+    try:
+        c = HttpServeClient(
+            tenant="alice",
+            endpoints=[("127.0.0.1", busy.port),
+                       ("127.0.0.1", calm.port)],
+        )
+        t0 = time.monotonic()
+        rid = c.submit("j", CFG)
+        assert rid and time.monotonic() - t0 < 5.0
+        assert len(busy.seen) == 1 and len(calm.seen) == 1
+        assert c._not_before.get(0, 0.0) > time.monotonic()
+        # the next submit skips the embargoed endpoint outright
+        assert c.submit("j2", CFG)
+        assert len(busy.seen) == 1  # never bothered again
+        assert [m["label"] for m in calm.seen] == ["j", "j2"]
+        c.close()
+    finally:
+        busy.close()
+        calm.close()
+
+
+def test_http_client_result_dedups_by_request_id():
+    """Exactly-once delivery: a row replayed by WAL adoption (same
+    request_id, different stream) is absorbed client-side."""
+    live = _FakeHttpFront()
+    try:
+        c = HttpServeClient(
+            tenant="alice", endpoints=[("127.0.0.1", live.port)]
+        )
+        for _ in range(2):  # the same result arriving twice
+            c._results.put(
+                {"type": "result", "request_id": "r1", "tenant": "alice",
+                 "label": "j", "status": "ok", "row": {}}
+            )
+        c._results.put(
+            {"type": "result", "request_id": "r2", "tenant": "alice",
+             "label": "k", "status": "ok", "row": {}}
+        )
+        got = [c.result(timeout=1.0)["request_id"] for _ in range(2)]
+        assert got == ["r1", "r2"]  # the duplicate r1 was swallowed
+        c.close()
+    finally:
+        live.close()
+
+
+# ---- WAL adoption --------------------------------------------------------
+
+
+def _seed_wal(dirpath, n=3):
+    wal = IntakeWAL(str(dirpath))
+    for i in range(n):
+        wal.append(
+            tenant="alice", request_id=f"req-{i}", label=f"j{i}",
+            digest=f"digest-{i}", config_payload={**CFG, "seed": i},
+            data_seed=0, target_loss=None, priority=0,
+        )
+    return wal
+
+
+def test_adopt_replays_dedups_and_sentinels(tmp_path):
+    dead = tmp_path / "dead"
+    wal = _seed_wal(dead)
+    # a duplicate acceptance (client retry) must collapse
+    wal.append(
+        tenant="alice", request_id="req-0b", label="j0",
+        digest="digest-0", config_payload={**CFG, "seed": 0},
+        data_seed=0, target_loss=None, priority=0,
+    )
+    adopter = IntakeWAL(str(tmp_path / "peer"))
+    records = adopter.adopt(str(dead / "intake_wal.jsonl"))
+    assert [r["digest"] for r in records] == [
+        "digest-0", "digest-1", "digest-2",
+    ]
+    assert os.path.exists(
+        str(dead / "intake_wal.jsonl") + ADOPT_SENTINEL_SUFFIX
+    )
+
+
+def test_double_adoption_race_exactly_one_winner(tmp_path):
+    """The regression the ISSUE names: two replicas declaring the same
+    peer dead concurrently — the O_EXCL sentinel guarantees exactly one
+    adopter; the loser gets WalAdoptionError, never a double replay."""
+    dead = tmp_path / "dead"
+    _seed_wal(dead)
+    path = str(dead / "intake_wal.jsonl")
+    outcomes = {}
+    barrier = threading.Barrier(2)
+
+    def race(name):
+        adopter = IntakeWAL(str(tmp_path / name))
+        barrier.wait()
+        try:
+            outcomes[name] = ("won", adopter.adopt(path))
+        except WalAdoptionError as e:
+            outcomes[name] = ("lost", str(e))
+
+    threads = [
+        threading.Thread(target=race, args=(n,)) for n in ("p1", "p2")
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    verdicts = sorted(v[0] for v in outcomes.values())
+    assert verdicts == ["lost", "won"], outcomes
+    winner = next(v for v in outcomes.values() if v[0] == "won")
+    assert len(winner[1]) == 3
+
+
+def test_adopt_refuses_when_owner_answers_healthz(tmp_path):
+    """A replica that still answers /healthz is NOT dead — adopting its
+    WAL would double-dispatch its working set."""
+    dead = tmp_path / "alive-actually"
+    _seed_wal(dead)
+    adopter = IntakeWAL(str(tmp_path / "peer"))
+    with pytest.raises(WalAdoptionError, match="healthz|alive|answers"):
+        adopter.adopt(
+            str(dead / "intake_wal.jsonl"), owner_alive=lambda: True
+        )
+    # no sentinel was dropped — a later, legitimate adoption must win
+    assert not os.path.exists(
+        str(dead / "intake_wal.jsonl") + ADOPT_SENTINEL_SUFFIX
+    )
+    assert adopter.adopt(
+        str(dead / "intake_wal.jsonl"), owner_alive=lambda: False
+    )
+
+
+def test_adopt_skips_digests_already_seen(tmp_path):
+    """Digest dedup across WALs: acceptances the adopter already owns
+    (client failover re-submitted them there) do not replay twice."""
+    dead = tmp_path / "dead"
+    _seed_wal(dead, n=3)
+    adopter = IntakeWAL(str(tmp_path / "peer"))
+    adopter.append(
+        tenant="alice", request_id="mine", label="j1",
+        digest="digest-1", config_payload={**CFG, "seed": 1},
+        data_seed=0, target_loss=None, priority=0,
+    )
+    records = adopter.adopt(str(dead / "intake_wal.jsonl"))
+    assert [r["digest"] for r in records] == ["digest-0", "digest-2"]
+
+
+# ---- evidential-streak death ---------------------------------------------
+
+
+def test_death_only_after_k_evidential_misses():
+    """The acceptance criterion pinned at the detector: k-1 misses never
+    declare death; the kth does; a success resets the streak; and
+    non-evidential misses (a deliberate deploy bounce) never count."""
+    det = ProbeStreakDetector(["r0"], k=3)
+    for _ in range(2):
+        det.observe("r0", ok=False)
+    assert not det.is_dead("r0")
+    det.observe("r0", ok=True)  # success resets
+    assert det.streak("r0") == 0
+    # a deploy bounce: misses observed while deliberately down
+    for _ in range(10):
+        det.observe("r0", ok=False, evidential=False)
+    assert not det.is_dead("r0")
+    for _ in range(3):
+        det.observe("r0", ok=False)
+    assert det.is_dead("r0")
+    assert det.streak("r0") >= 3
+
+
+def test_fleet_event_validator_rejects_premature_death(tmp_path):
+    """A declare_dead record with streak < k is exactly the bug the
+    evidential rule exists to prevent; the validator refuses it."""
+    p = tmp_path / "ev.jsonl"
+    with events_lib.capture(str(p)):
+        events_lib.emit(
+            "fleet", action="declare_dead", replica="r1", streak=2, k=3
+        )
+    errs = events_lib.validate_lines(open(p))
+    assert errs and any("never fewer" in e for e in errs)
+
+    good = tmp_path / "good.jsonl"
+    with events_lib.capture(str(good)):
+        events_lib.emit("fleet", action="probe", replica="r1", ok=True)
+        events_lib.emit(
+            "fleet", action="suspect", replica="r1", streak=1, k=3
+        )
+        events_lib.emit(
+            "fleet", action="declare_dead", replica="r1", streak=3, k=3
+        )
+        events_lib.emit(
+            "fleet", action="adopt", replica="r1", records=4,
+            adopter="r0",
+        )
+        events_lib.emit(
+            "fleet", action="deploy_phase", replica="r0", phase="drain"
+        )
+    assert events_lib.validate_lines(open(good)) == []
+
+
+def test_fleet_event_validator_rejects_unknown_action(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    with events_lib.capture(str(p)):
+        events_lib.emit("fleet", action="resurrect", replica="r1")
+    errs = events_lib.validate_lines(open(p))
+    assert errs and any("action" in e for e in errs)
+
+
+# ---- router membership + gauges ------------------------------------------
+
+
+def test_router_membership_and_fleet_gauges():
+    """set_alive toggles ring membership without forgetting the replica;
+    fleet_view/fleet_gauges expose what /metrics renders."""
+    router = FleetRouter(port=0)
+    try:
+        router.add_replica("r0", "127.0.0.1", 1111)
+        router.add_replica("r1", "127.0.0.1", 2222)
+        assert sorted(router.ring.members) == ["r0", "r1"]
+        router.set_alive("r1", False)
+        assert router.ring.members == ["r0"]
+        assert set(router.replicas) == {"r0", "r1"}
+        router.set_alive("r1", True, pressure=0.5)
+        assert sorted(router.ring.members) == ["r0", "r1"]
+
+        view = router.fleet_view()
+        assert view["replicas"]["r1"]["pressure"] == 0.5
+        gauges = router.fleet_gauges()
+        by_name = {k.split("{")[0]: v for k, v in gauges.items()}
+        live = next(
+            k for k in by_name if k.endswith("fleet_replicas_live")
+        )
+        known = next(
+            k for k in by_name if k.endswith("fleet_replicas_known")
+        )
+        assert by_name[live] == 2.0
+        assert by_name[known] == 2.0
+    finally:
+        router.close()
+
+
+def test_router_routes_by_affinity_and_fails_over():
+    """The ring decides the primary; with the primary marked dead the
+    same key resolves to the survivor (deterministic failover)."""
+    router = FleetRouter(port=0)
+    try:
+        router.add_replica("r0", "127.0.0.1", 1111)
+        router.add_replica("r1", "127.0.0.1", 2222)
+        key = affinity_key("alice", {**CFG, "seed": 0})
+        primary = router.ring.lookup(key)
+        order = router.ring.ring_order(key)
+        assert order[0] == primary and len(order) == 2
+        router.set_alive(primary, False)
+        assert router.ring.lookup(key) == order[1]
+    finally:
+        router.close()
+
+
+def test_wait_front_parses_only_this_incarnations_log(tmp_path):
+    """A bounced replica APPENDS to its log, so the first "http front
+    on" line names the dead pre-bounce port. _wait_front must parse only
+    lines written after the latest spawn (rep.log_offset) — the
+    rolling-deploy wedge regression: probing the stale port forever."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from erasurehead_tpu.serve import fleet as fleet_lib
+
+    class _Healthz(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b'{"status": "ok"}'
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # noqa: D102 — quiet test server
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Healthz)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    live_port = httpd.server_address[1]
+    try:
+        log = tmp_path / "r0.log"
+        stale = "serve: http front on 127.0.0.1:1 (auth off)\n"
+        log.write_text(stale)
+        rep = fleet_lib.Replica(
+            name="r0", journal_dir=str(tmp_path / "r0"),
+            cache_dir=str(tmp_path / "cache"), events_path=None,
+            log_path=str(log),
+        )
+        rep.log_offset = len(stale)  # what spawn() records on a bounce
+
+        class _LiveProc:
+            def poll(self):
+                return None
+
+        rep.proc = _LiveProc()
+        with open(log, "a") as f:
+            f.write(
+                f"serve: http front on 127.0.0.1:{live_port} (auth off)\n"
+            )
+        fleet_lib.FleetSupervisor._wait_front(None, rep, timeout=10)
+        assert rep.port == live_port, (
+            f"parsed stale port {rep.port} instead of {live_port}"
+        )
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
